@@ -1,0 +1,10 @@
+"""veneur_tpu — a TPU-native rebuild of the Veneur observability pipeline.
+
+A DogStatsD / SSF metrics aggregation server whose per-interval sketch math
+(t-digest histograms, HyperLogLog sets, counter/gauge reductions) runs as
+batched XLA programs over all metric series at once, with multi-chip global
+aggregation expressed as JAX collectives over a device mesh instead of the
+reference's HTTP/gRPC fan-in (waffledonkey/veneur, mounted at /root/reference).
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,3 @@
+"""Pure JAX/Pallas sketch kernels: the device-side core of the framework."""
+
+from veneur_tpu.ops import tdigest  # noqa: F401

@@ -1,0 +1,271 @@
+"""Batched merging t-digest as dense XLA tensor ops.
+
+The reference implementation (Dunning's merging t-digest,
+``/root/reference/tdigest/merging_digest.go``) maintains, per metric series, a
+sorted list of (mean, weight) centroids and merges new samples with an
+inherently sequential greedy scan (``mergeAllTemps``, ``merging_digest.go:135``)
+that walks centroids in mean order and fuses neighbours while the k-scale index
+``k(q) = C * (asin(2q-1)/pi + 1/2)`` (``merging_digest.go:254-257``) advances by
+less than one.
+
+That scan does not vectorise. This module re-derives the merge for TPU as a
+data-parallel program over *all* series at once:
+
+    1. sort         -- per-row sort of the concatenated centroid/sample list
+    2. prefix sum   -- cumulative weight gives each centroid its quantile q
+    3. k-binning    -- cluster id = floor(k(q_mid)); k-width of every cluster
+                       is <= 1, the same invariant the greedy scan enforces
+    4. segmented reduce -- per-cluster weight and weighted-mean via two more
+                       prefix sums + a row-wise binary search over the
+                       (monotone) cluster ids
+
+Everything is fixed-shape: a digest is a ``[..., K]`` pair of mean/weight
+arrays (weight==0 marks an empty slot), so the whole state for S series is a
+dense ``[S, K]`` tensor that jit/vmap/shard_map can slice across a device mesh.
+Quantile/CDF queries mirror the uniform-centroid interpolation of the
+reference (``merging_digest.go:261-327``) as gathers over cumulative weights.
+
+Accuracy contract: same k-scale, same size bound (ceil(pi*C/2) slots), so
+quantile error stays within the documented t-digest bounds used by the
+reference's tests (eps=0.02, ``tdigest/histo_test.go:11-25``).
+"""
+
+from __future__ import annotations
+
+import math
+from functools import partial
+from typing import NamedTuple, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+DEFAULT_COMPRESSION = 100.0
+
+
+def size_bound(compression: float) -> int:
+    """Max number of centroids a digest can hold (merging_digest.go:66-68),
+    rounded up to a multiple of 8 for TPU sublane alignment."""
+    raw = int(math.pi * compression / 2 + 0.5) + 1
+    return (raw + 7) // 8 * 8
+
+
+def temp_buffer_size(compression: float) -> int:
+    """Heuristic ingest-buffer size per merge pass (merging_digest.go:101-107),
+    rounded up to a multiple of 8."""
+    c = min(925.0, max(20.0, compression))
+    raw = int(7.5 + 0.37 * c - 2e-4 * c * c)
+    return (raw + 7) // 8 * 8
+
+
+class TDigest(NamedTuple):
+    """A batch of t-digests as dense arrays.
+
+    mean / weight: ``[..., K]``; slots with weight == 0 are empty and keep
+    mean == +inf so that live centroids sort to the front in ascending order.
+    min / max: ``[...]`` observed extrema (+inf/-inf when empty).
+    """
+
+    mean: jax.Array
+    weight: jax.Array
+    min: jax.Array
+    max: jax.Array
+
+    @property
+    def batch_shape(self):
+        return self.mean.shape[:-1]
+
+    @property
+    def capacity(self) -> int:
+        return self.mean.shape[-1]
+
+    def count(self) -> jax.Array:
+        return jnp.sum(self.weight, axis=-1)
+
+
+def init(batch_shape: Sequence[int] = (), compression: float = DEFAULT_COMPRESSION,
+         capacity: int | None = None, dtype=jnp.float32) -> TDigest:
+    """Create empty digests for a batch of series."""
+    k = capacity if capacity is not None else size_bound(compression)
+    shape = tuple(batch_shape)
+    return TDigest(
+        mean=jnp.full(shape + (k,), jnp.inf, dtype),
+        weight=jnp.zeros(shape + (k,), dtype),
+        min=jnp.full(shape, jnp.inf, dtype),
+        max=jnp.full(shape, -jnp.inf, dtype),
+    )
+
+
+def _rowwise_searchsorted(a: jax.Array, v: jax.Array, side: str) -> jax.Array:
+    """searchsorted along the last axis for every row of a batch.
+
+    a: [..., M] row-sorted values; v: [..., P] (or [P], broadcast) queries.
+    """
+    batch = a.shape[:-1]
+    if v.ndim == 1:
+        v = jnp.broadcast_to(v, batch + v.shape)
+    a2 = a.reshape((-1, a.shape[-1]))
+    v2 = v.reshape((-1, v.shape[-1]))
+    out = jax.vmap(partial(jnp.searchsorted, side=side))(a2, v2)
+    return out.reshape(batch + (v.shape[-1],))
+
+
+def _compress(mean: jax.Array, weight: jax.Array, compression: float,
+              out_size: int) -> tuple[jax.Array, jax.Array]:
+    """Re-cluster per-row centroid lists down to <= out_size centroids.
+
+    mean/weight: [..., M] unsorted; weight==0 slots ignored. Returns sorted,
+    front-compacted [..., out_size] arrays (empty slots mean=+inf, weight=0).
+    """
+    dtype = mean.dtype
+    live = weight > 0
+    key = jnp.where(live, mean, jnp.inf)
+    # Sort each row by mean; empties ride to the back.
+    key, w = lax.sort((key, weight), dimension=-1, num_keys=1, is_stable=True)
+    live = w > 0
+    m0 = jnp.where(live, key, 0.0)  # inf*0 would poison the weighted sums
+
+    total = jnp.sum(w, axis=-1, keepdims=True)
+    incl = jnp.cumsum(w, axis=-1)
+    safe_total = jnp.maximum(total, jnp.finfo(dtype).tiny)
+    q_mid = (incl - 0.5 * w) / safe_total
+    # k-scale (merging_digest.go:254-257); arcsin arg clipped for fp safety.
+    k = compression * (jnp.arcsin(jnp.clip(2.0 * q_mid - 1.0, -1.0, 1.0)) / jnp.pi + 0.5)
+    cluster = jnp.clip(jnp.floor(k), 0, out_size - 1).astype(jnp.int32)
+    cluster = jnp.where(live, cluster, out_size)  # park empties out of range
+
+    # Segmented sums over monotone cluster ids via prefix sums + binary search.
+    zeros = jnp.zeros(cluster.shape[:-1] + (1,), dtype)
+    cum_w = jnp.concatenate([zeros, incl], axis=-1)
+    cum_wm = jnp.concatenate([zeros, jnp.cumsum(w * m0, axis=-1)], axis=-1)
+    targets = jnp.arange(out_size, dtype=jnp.int32)
+    left = _rowwise_searchsorted(cluster, targets, "left")
+    right = _rowwise_searchsorted(cluster, targets, "right")
+    sum_w = jnp.take_along_axis(cum_w, right, axis=-1) - jnp.take_along_axis(cum_w, left, axis=-1)
+    sum_wm = jnp.take_along_axis(cum_wm, right, axis=-1) - jnp.take_along_axis(cum_wm, left, axis=-1)
+
+    new_live = sum_w > 0
+    new_mean = jnp.where(new_live, sum_wm / jnp.where(new_live, sum_w, 1.0), jnp.inf)
+    # Bins that floor(k) skipped are empty and interleave; one more sort
+    # compacts live centroids (already in ascending mean order) to the front.
+    new_mean, new_w = lax.sort((new_mean, sum_w), dimension=-1, num_keys=1, is_stable=True)
+    return new_mean, new_w
+
+
+def merge_samples(state: TDigest, values: jax.Array, weights: jax.Array,
+                  compression: float = DEFAULT_COMPRESSION) -> TDigest:
+    """Fold a padded batch of raw samples into every digest.
+
+    values/weights: [..., T]; weight==0 marks padding. The TPU analogue of
+    draining tempCentroids (merging_digest.go:111-132 + mergeAllTemps).
+    """
+    values = values.astype(state.mean.dtype)
+    weights = weights.astype(state.weight.dtype)
+    live = weights > 0
+    vmin = jnp.min(jnp.where(live, values, jnp.inf), axis=-1)
+    vmax = jnp.max(jnp.where(live, values, -jnp.inf), axis=-1)
+    mean = jnp.concatenate([state.mean, jnp.where(live, values, jnp.inf)], axis=-1)
+    weight = jnp.concatenate([state.weight, weights], axis=-1)
+    new_mean, new_weight = _compress(mean, weight, compression, state.capacity)
+    return TDigest(
+        mean=new_mean,
+        weight=new_weight,
+        min=jnp.minimum(state.min, vmin),
+        max=jnp.maximum(state.max, vmax),
+    )
+
+
+def merge(a: TDigest, b: TDigest, compression: float = DEFAULT_COMPRESSION) -> TDigest:
+    """Merge digest batches elementwise: the associative op behind the global
+    aggregation tree (samplers.Histo.Combine / Merge, samplers.go:657-691).
+
+    Deterministic (sorted merge order) unlike the reference's shuffled re-add
+    (merging_digest.go:358-370); accuracy bound is the same.
+    """
+    mean = jnp.concatenate([a.mean, b.mean], axis=-1)
+    weight = jnp.concatenate([a.weight, b.weight], axis=-1)
+    new_mean, new_weight = _compress(mean, weight, compression, a.capacity)
+    return TDigest(
+        mean=new_mean,
+        weight=new_weight,
+        min=jnp.minimum(a.min, b.min),
+        max=jnp.maximum(a.max, b.max),
+    )
+
+
+def _upper_bounds(state: TDigest) -> jax.Array:
+    """Per-centroid upper bound: midpoint to the next live centroid, or max
+    for the last live one (merging_digest.go:339-354). [..., K]."""
+    m, w = state.mean, state.weight
+    next_m = jnp.concatenate([m[..., 1:], jnp.full_like(m[..., :1], jnp.inf)], axis=-1)
+    next_live = jnp.concatenate([w[..., 1:] > 0, jnp.zeros_like(w[..., :1], bool)], axis=-1)
+    mx = state.max[..., None]
+    ub = jnp.where(next_live, 0.5 * (m + next_m), mx)
+    # Empty slots get ub == max so cumulative searches stay monotone.
+    return jnp.where(w > 0, ub, mx)
+
+
+def quantile(state: TDigest, qs: jax.Array) -> jax.Array:
+    """Batched inverse-CDF (merging_digest.go:297-327).
+
+    qs: [P] in [0, 1] (shared across the batch). Returns [..., P]; NaN for
+    empty digests.
+    """
+    qs = jnp.asarray(qs, state.mean.dtype)
+    w = state.weight
+    total = jnp.sum(w, axis=-1, keepdims=True)          # [..., 1]
+    incl = jnp.cumsum(w, axis=-1)                       # [..., K]
+    excl = incl - w
+    ub = _upper_bounds(state)
+    target = qs * total                                  # [..., P]
+    # First centroid i with incl[i] >= target  <=>  Go's q <= weightSoFar + c.W
+    idx = jnp.clip(_rowwise_searchsorted(incl, target, "left"), 0, state.capacity - 1)
+    lb0 = state.min[..., None]
+    prev_ub = jnp.take_along_axis(ub, jnp.maximum(idx - 1, 0), axis=-1)
+    lb = jnp.where(idx == 0, lb0, prev_ub)
+    ub_i = jnp.take_along_axis(ub, idx, axis=-1)
+    w_i = jnp.take_along_axis(w, idx, axis=-1)
+    excl_i = jnp.take_along_axis(excl, idx, axis=-1)
+    prop = (target - excl_i) / jnp.where(w_i > 0, w_i, 1.0)
+    out = lb + prop * (ub_i - lb)
+    return jnp.where(total > 0, out, jnp.nan)
+
+
+def cdf(state: TDigest, xs: jax.Array) -> jax.Array:
+    """Batched CDF (merging_digest.go:261-293). xs: [P] shared queries.
+    Returns [..., P]; NaN for empty digests."""
+    xs = jnp.asarray(xs, state.mean.dtype)
+    w = state.weight
+    total = jnp.sum(w, axis=-1, keepdims=True)
+    incl = jnp.cumsum(w, axis=-1)
+    excl = incl - w
+    ub = _upper_bounds(state)
+    # First centroid whose upper bound exceeds x (the one x falls inside).
+    idx = jnp.clip(_rowwise_searchsorted(ub, xs, "right"), 0, state.capacity - 1)
+    mn = state.min[..., None]
+    mx = state.max[..., None]
+    prev_ub = jnp.take_along_axis(ub, jnp.maximum(idx - 1, 0), axis=-1)
+    lb = jnp.where(idx == 0, mn, prev_ub)
+    ub_i = jnp.take_along_axis(ub, idx, axis=-1)
+    w_i = jnp.take_along_axis(w, idx, axis=-1)
+    excl_i = jnp.take_along_axis(excl, idx, axis=-1)
+    span = ub_i - lb
+    frac = jnp.where(span > 0, (xs - lb) / jnp.where(span > 0, span, 1.0), 0.0)
+    est = (excl_i + w_i * frac) / jnp.maximum(total, jnp.finfo(w.dtype).tiny)
+    est = jnp.where(xs <= mn, 0.0, est)
+    est = jnp.where(xs >= mx, 1.0, est)
+    return jnp.where(total > 0, est, jnp.nan)
+
+
+def from_centroids(mean: jax.Array, weight: jax.Array, mins: jax.Array,
+                   maxs: jax.Array, compression: float = DEFAULT_COMPRESSION,
+                   capacity: int | None = None) -> TDigest:
+    """Build digests from imported centroid arrays (the deserialization path
+    of forwarded sketch state, cf. NewMergingFromData, merging_digest.go:83-99).
+
+    mean/weight: [..., M] with weight==0 padding; M may differ from capacity.
+    """
+    k = capacity if capacity is not None else size_bound(compression)
+    new_mean, new_weight = _compress(mean, weight, compression, k)
+    return TDigest(mean=new_mean, weight=new_weight,
+                   min=jnp.asarray(mins, mean.dtype), max=jnp.asarray(maxs, mean.dtype))

@@ -1,0 +1,1 @@
+"""Sampler layer: batched device-resident metric state + scalar references."""
