@@ -1,3 +1,3 @@
 """Pure JAX/Pallas sketch kernels: the device-side core of the framework."""
 
-from veneur_tpu.ops import tdigest  # noqa: F401
+from veneur_tpu.ops import hll, tdigest  # noqa: F401
