@@ -1,12 +1,22 @@
-"""Test configuration: force an 8-device virtual CPU mesh before jax imports.
+"""Test configuration: force an 8-device virtual CPU mesh.
 
 Multi-chip hardware is not available in CI; sharding tests run over
-xla_force_host_platform_device_count=8 as recommended by the JAX docs.
+``xla_force_host_platform_device_count=8`` as recommended by the JAX docs.
+
+The environment's sitecustomize imports jax at interpreter startup (to
+register the TPU plugin), so plain ``os.environ`` edits are too late for
+``JAX_PLATFORMS`` — use jax.config.update, which works as long as no
+backend has been initialized yet.
 """
 
 import os
 
-os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,198 @@
+"""Parser table tests, modeled on the reference's parser_test.go cases."""
+
+import pytest
+
+from veneur_tpu.samplers import (
+    GLOBAL_ONLY,
+    LOCAL_ONLY,
+    MIXED_SCOPE,
+    ParseError,
+    parse_event,
+    parse_metric,
+    parse_service_check,
+    split_lines,
+)
+from veneur_tpu.samplers.parser import fnv1a_32
+from veneur_tpu.protocol import constants as dogstatsd
+
+
+def test_fnv1a_known_vector():
+    # standard FNV-1a 32-bit test vectors
+    assert fnv1a_32("") == 0x811C9DC5
+    assert fnv1a_32("a") == 0xE40C292C
+    assert fnv1a_32("foobar") == 0xBF9CF968
+
+
+class TestParseMetric:
+    def test_counter(self):
+        m = parse_metric(b"a.b.c:1|c")
+        assert m.name == "a.b.c"
+        assert m.type == "counter"
+        assert m.value == 1.0
+        assert m.sample_rate == 1.0
+        assert m.tags == []
+
+    def test_gauge_float(self):
+        m = parse_metric(b"a.b.c:1.5|g")
+        assert m.type == "gauge"
+        assert m.value == 1.5
+
+    def test_timer_ms(self):
+        m = parse_metric(b"a.b.c:1|ms")
+        assert m.type == "timer"
+
+    def test_histogram(self):
+        assert parse_metric(b"a.b.c:1|h").type == "histogram"
+
+    def test_set_string_value(self):
+        m = parse_metric(b"a.b.c:foobar|s")
+        assert m.type == "set"
+        assert m.value == "foobar"
+
+    def test_tags_sorted_and_joined(self):
+        m = parse_metric(b"a.b.c:1|c|#foo:bar,baz:qux")
+        assert m.tags == ["baz:qux", "foo:bar"]
+        assert m.joined_tags == "baz:qux,foo:bar"
+
+    def test_sample_rate(self):
+        m = parse_metric(b"a.b.c:1|c|@0.5")
+        assert m.sample_rate == pytest.approx(0.5)
+
+    def test_sample_rate_and_tags_any_order(self):
+        m1 = parse_metric(b"a.b.c:1|c|@0.5|#foo")
+        m2 = parse_metric(b"a.b.c:1|c|#foo|@0.5")
+        assert m1.sample_rate == m2.sample_rate == pytest.approx(0.5)
+        assert m1.tags == m2.tags == ["foo"]
+
+    def test_digest_deterministic_under_tag_order(self):
+        m1 = parse_metric(b"a.b.c:1|c|#a:1,b:2")
+        m2 = parse_metric(b"a.b.c:1|c|#b:2,a:1")
+        assert m1.digest == m2.digest
+        assert m1.key == m2.key
+
+    def test_digest_differs_across_types(self):
+        assert parse_metric(b"a.b.c:1|c").digest != parse_metric(b"a.b.c:1|g").digest
+
+    def test_local_only_magic_tag(self):
+        m = parse_metric(b"a.b.c:1|h|#veneurlocalonly,foo:bar")
+        assert m.scope == LOCAL_ONLY
+        assert m.tags == ["foo:bar"]
+
+    def test_global_only_magic_tag(self):
+        m = parse_metric(b"a.b.c:1|c|#veneurglobalonly")
+        assert m.scope == GLOBAL_ONLY
+        assert m.tags == []
+
+    def test_default_scope_mixed(self):
+        assert parse_metric(b"a.b.c:1|c").scope == MIXED_SCOPE
+
+    @pytest.mark.parametrize("packet", [
+        b"a.b.c",                # no colon
+        b":1|c",                 # empty name
+        b"a.b.c:1",              # no type
+        b"foo:1||",              # empty type section
+        b"a.b.c:1|x",            # unknown type
+        b"a.b.c:fail|c",         # bad number
+        b"a.b.c:nan|g",          # NaN rejected
+        b"a.b.c:inf|g",          # Inf rejected
+        b"a.b.c:1|c|@0.5|@0.2",  # duplicate rate
+        b"a.b.c:1|c|#a|#b",      # duplicate tags
+        b"a.b.c:1|c|",           # trailing empty section
+        b"a.b.c:1|c||@0.1",      # empty section between pipes
+        b"a.b.c:1|c|bad",        # unknown section
+        b"a.b.c:1|c|@1.5",       # rate out of range
+        b"a.b.c:1|c|@0",         # rate zero
+    ])
+    def test_invalid(self, packet):
+        with pytest.raises(ParseError):
+            parse_metric(packet)
+
+
+class TestParseEvent:
+    def test_basic(self):
+        e = parse_event(b"_e{5,4}:title|text", now=100)
+        assert e.name == "title"
+        assert e.message == "text"
+        assert e.timestamp == 100
+        assert dogstatsd.EVENT_IDENTIFIER_KEY in e.tags
+
+    def test_full_metadata(self):
+        e = parse_event(
+            b"_e{5,4}:title|text|d:1136239445|h:ahost|k:akey|p:low|"
+            b"s:asource|t:warning|#foo:bar,baz:qux", now=100)
+        assert e.timestamp == 1136239445
+        assert e.tags[dogstatsd.EVENT_HOSTNAME_TAG] == "ahost"
+        assert e.tags[dogstatsd.EVENT_AGGREGATION_KEY_TAG] == "akey"
+        assert e.tags[dogstatsd.EVENT_PRIORITY_TAG] == "low"
+        assert e.tags[dogstatsd.EVENT_SOURCE_TYPE_TAG] == "asource"
+        assert e.tags[dogstatsd.EVENT_ALERT_TYPE_TAG] == "warning"
+        assert e.tags["foo"] == "bar"
+        assert e.tags["baz"] == "qux"
+
+    def test_newline_unescape(self):
+        e = parse_event(b"_e{5,10}:title|text\\ntext")
+        assert e.message == "text\ntext"
+
+    @pytest.mark.parametrize("packet", [
+        b"_e{5,4}title|text",        # no colon
+        b"_x{5,4}:title|text",       # bad prefix
+        b"_e{54}:title|text",        # no comma
+        b"_e{0,4}:|text",            # zero title length
+        b"_e{5,0}:title|",           # zero text length
+        b"_e{6,4}:title|text",       # title length mismatch
+        b"_e{5,5}:title|text",       # text length mismatch
+        b"_e{5,4}:title",            # no text section
+        b"_e{5,4}:title|text|p:urgent",   # bad priority
+        b"_e{5,4}:title|text|t:bogus",    # bad alert type
+        b"_e{5,4}:title|text|d:1|d:2",    # duplicate section
+        b"_e{5,4}:title|text|z:huh",      # unknown section
+    ])
+    def test_invalid(self, packet):
+        with pytest.raises(ParseError):
+            parse_event(packet)
+
+
+class TestParseServiceCheck:
+    def test_basic(self):
+        m = parse_service_check(b"_sc|my.service|0", now=100)
+        assert m.name == "my.service"
+        assert m.type == "status"
+        assert m.value == 0
+        assert m.timestamp == 100
+
+    def test_statuses(self):
+        for b, want in ((b"0", 0), (b"1", 1), (b"2", 2), (b"3", 3)):
+            assert parse_service_check(b"_sc|x|" + b).value == want
+
+    def test_full(self):
+        m = parse_service_check(
+            b"_sc|svc|2|d:1136239445|h:ahost|#foo:bar|m:oh\\nno", now=100)
+        assert m.timestamp == 1136239445
+        assert m.hostname == "ahost"
+        assert m.tags == ["foo:bar"]
+        assert m.message == "oh\nno"
+
+    def test_scope_tag_exact_match_only(self):
+        m = parse_service_check(b"_sc|svc|0|#veneurlocalonly")
+        assert m.scope == LOCAL_ONLY
+        # the service-check path requires exact equality, not a prefix
+        m2 = parse_service_check(b"_sc|svc|0|#veneurlocalonlyX")
+        assert m2.scope == MIXED_SCOPE
+
+    @pytest.mark.parametrize("packet", [
+        b"_sx|svc|0",           # bad prefix
+        b"_sc||0",              # empty name
+        b"_sc|svc",             # no status
+        b"_sc|svc|9",           # bad status
+        b"_sc|svc|0|m:msg|h:x", # message must be last
+        b"_sc|svc|0|z:huh",     # unknown section
+    ])
+    def test_invalid(self, packet):
+        with pytest.raises(ParseError):
+            parse_service_check(packet)
+
+
+def test_split_lines():
+    assert list(split_lines(b"a:1|c\nb:2|g\n")) == [b"a:1|c", b"b:2|g"]
+    assert list(split_lines(b"a:1|c")) == [b"a:1|c"]
+    assert list(split_lines(b"\n\na:1|c\n\n")) == [b"a:1|c"]

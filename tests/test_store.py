@@ -1,0 +1,285 @@
+"""MetricStore behavior: scope routing, flush semantics, merge equivalence.
+
+Plays the role of the reference's samplers_test.go + worker_test.go: golden
+scalar samplers (ScalarTDigest / ScalarHLL) check the batched device path
+within documented error bounds.
+"""
+
+import numpy as np
+import pytest
+
+from veneur_tpu.core import MetricStore
+from veneur_tpu.samplers import (
+    Aggregate,
+    HistogramAggregates,
+    MetricType,
+    ScalarHLL,
+    ScalarTDigest,
+    parse_metric,
+)
+from veneur_tpu.samplers.parser import MetricKey
+
+ALL_AGGS = HistogramAggregates(
+    Aggregate.MIN | Aggregate.MAX | Aggregate.MEDIAN | Aggregate.AVERAGE |
+    Aggregate.COUNT | Aggregate.SUM | Aggregate.HARMONIC_MEAN)
+DEFAULT_AGGS = HistogramAggregates()
+
+
+def make_store(**kw):
+    kw.setdefault("initial_capacity", 32)
+    kw.setdefault("chunk", 128)
+    return MetricStore(**kw)
+
+
+def flush_map(metrics):
+    return {m.name: m for m in metrics}
+
+
+class TestCounters:
+    def test_accumulate(self):
+        s = make_store()
+        for _ in range(3):
+            s.process_metric(parse_metric(b"x:2|c"))
+        final, _, _ = s.flush([], DEFAULT_AGGS, is_local=True, now=1)
+        assert flush_map(final)["x"].value == 6.0
+        assert flush_map(final)["x"].type == MetricType.COUNTER
+
+    def test_sample_rate_integer_semantics(self):
+        # Go: value += int64(sample) * int64(1/rate) — 1/0.3 truncates to 3
+        s = make_store()
+        s.process_metric(parse_metric(b"x:5|c|@0.3"))
+        final, _, _ = s.flush([], DEFAULT_AGGS, is_local=True, now=1)
+        assert flush_map(final)["x"].value == 5 * 3
+
+    def test_global_counter_forwarded_not_flushed(self):
+        s = make_store()
+        s.process_metric(parse_metric(b"x:1|c|#veneurglobalonly"))
+        final, fwd, _ = s.flush([], DEFAULT_AGGS, is_local=True, now=1)
+        assert "x" not in flush_map(final)
+        assert fwd.counters == [("x", [], 1)]
+
+    def test_global_counter_flushed_on_global(self):
+        s = make_store()
+        key = MetricKey("x", "counter", "")
+        s.import_counter(key, [], 5)
+        s.import_counter(key, [], 7)
+        final, _, _ = s.flush([], DEFAULT_AGGS, is_local=False, now=1)
+        assert flush_map(final)["x"].value == 12.0
+
+    def test_reset_between_intervals(self):
+        s = make_store()
+        s.process_metric(parse_metric(b"x:1|c"))
+        s.flush([], DEFAULT_AGGS, is_local=True, now=1)
+        final, _, _ = s.flush([], DEFAULT_AGGS, is_local=True, now=2)
+        assert final == []
+
+
+class TestGauges:
+    def test_last_write_wins(self):
+        s = make_store()
+        s.process_metric(parse_metric(b"g:1|g"))
+        s.process_metric(parse_metric(b"g:9|g"))
+        final, _, _ = s.flush([], DEFAULT_AGGS, is_local=True, now=1)
+        assert flush_map(final)["g"].value == 9.0
+
+    def test_tag_separates_series(self):
+        s = make_store()
+        s.process_metric(parse_metric(b"g:1|g|#env:a"))
+        s.process_metric(parse_metric(b"g:2|g|#env:b"))
+        final, _, _ = s.flush([], DEFAULT_AGGS, is_local=True, now=1)
+        assert len(final) == 2
+
+
+class TestHistograms:
+    def test_aggregates_match_exact_values(self):
+        s = make_store()
+        vals = [1.0, 2.0, 3.0, 4.0, 5.0]
+        for v in vals:
+            s.process_metric(parse_metric(f"h:{v}|h".encode()))
+        final, _, _ = s.flush([], ALL_AGGS, is_local=True, now=1)
+        fm = flush_map(final)
+        assert fm["h.min"].value == 1.0
+        assert fm["h.max"].value == 5.0
+        assert fm["h.sum"].value == 15.0
+        assert fm["h.avg"].value == 3.0
+        assert fm["h.count"].value == 5.0
+        assert fm["h.count"].type == MetricType.COUNTER
+        hmean = 5.0 / sum(1.0 / v for v in vals)
+        assert fm["h.hmean"].value == pytest.approx(hmean, rel=1e-6)
+
+    def test_quantiles_vs_golden_model(self):
+        rng = np.random.RandomState(42)
+        vals = rng.uniform(0, 100, size=2000)
+        s = make_store(chunk=256)
+        golden = ScalarTDigest(compression=100.0)
+        for v in vals:
+            s.process_metric(parse_metric(f"h:{v:.6f}|h".encode()))
+            golden.add(float(f"{v:.6f}"))
+        final, _, _ = s.flush([0.25, 0.5, 0.9, 0.99], ALL_AGGS,
+                              is_local=False, now=1)
+        fm = flush_map(final)
+        for p, name in ((0.25, "h.25percentile"), (0.5, "h.50percentile"),
+                        (0.9, "h.90percentile"), (0.99, "h.99percentile")):
+            # eps=0.02 of the value range, the reference's own tolerance
+            # (tdigest/histo_test.go:11-25)
+            assert abs(fm[name].value - np.quantile(vals, p)) < 2.0, name
+
+    def test_local_instance_suppresses_mixed_percentiles(self):
+        s = make_store()
+        s.process_metric(parse_metric(b"h:1|h"))
+        final, _, _ = s.flush([0.5], DEFAULT_AGGS, is_local=True, now=1)
+        assert "h.50percentile" not in flush_map(final)
+
+    def test_local_only_histo_gets_percentiles_even_on_local(self):
+        s = make_store()
+        s.process_metric(parse_metric(b"h:1|h|#veneurlocalonly"))
+        final, fwd, _ = s.flush([0.5], DEFAULT_AGGS, is_local=True, now=1)
+        assert "h.50percentile" in flush_map(final)
+        assert fwd.histograms == []
+
+    def test_timer_is_histogram(self):
+        s = make_store()
+        s.process_metric(parse_metric(b"t:5|ms"))
+        final, fwd, _ = s.flush([], DEFAULT_AGGS, is_local=True, now=1)
+        assert "t.count" in flush_map(final)
+        assert len(fwd.timers) == 1
+
+    def test_forward_then_import_preserves_quantiles(self):
+        rng = np.random.RandomState(7)
+        vals = rng.normal(50, 10, size=3000)
+        # two locals each see half the samples
+        locals_ = [make_store(chunk=256), make_store(chunk=256)]
+        for i, v in enumerate(vals):
+            locals_[i % 2].process_metric(parse_metric(f"h:{v:.6f}|h".encode()))
+        g = make_store(chunk=256)
+        for loc in locals_:
+            _, fwd, _ = loc.flush([], DEFAULT_AGGS, is_local=True, now=1)
+            for (name, tags, means, weights, dmin, dmax) in fwd.histograms:
+                g.import_digest(MetricKey(name, "histogram", ",".join(tags)),
+                                tags, means, weights, dmin, dmax)
+        final, _, _ = g.flush([0.5, 0.99], ALL_AGGS, is_local=False, now=2)
+        fm = flush_map(final)
+        assert abs(fm["h.50percentile"].value - np.quantile(vals, 0.5)) < 1.0
+        assert abs(fm["h.99percentile"].value - np.quantile(vals, 0.99)) < 2.5
+        # imported digests must NOT produce local aggregates
+        assert "h.min" not in fm
+        assert "h.count" not in fm
+        # but median is emitted when selected
+        assert "h.median" in fm
+
+    def test_sample_rate_weights(self):
+        s = make_store()
+        s.process_metric(parse_metric(b"h:10|h|@0.25"))
+        final, _, _ = s.flush([], ALL_AGGS, is_local=True, now=1)
+        fm = flush_map(final)
+        assert fm["h.count"].value == 4.0
+        assert fm["h.sum"].value == 40.0
+
+
+class TestSets:
+    def test_estimate_accuracy(self):
+        s = make_store(chunk=256)
+        n = 5000
+        for i in range(n):
+            s.process_metric(parse_metric(f"u:user{i}|s".encode()))
+        final, _, _ = s.flush([], DEFAULT_AGGS, is_local=False, now=1)
+        est = flush_map(final)["u"].value
+        assert abs(est - n) / n < 0.05
+
+    def test_duplicates_not_double_counted(self):
+        s = make_store()
+        for _ in range(100):
+            s.process_metric(parse_metric(b"u:same|s"))
+        final, _, _ = s.flush([], DEFAULT_AGGS, is_local=False, now=1)
+        assert flush_map(final)["u"].value == pytest.approx(1.0, abs=0.01)
+
+    def test_mixed_set_not_flushed_on_local(self):
+        s = make_store()
+        s.process_metric(parse_metric(b"u:x|s"))
+        final, fwd, _ = s.flush([], DEFAULT_AGGS, is_local=True, now=1)
+        assert "u" not in flush_map(final)
+        assert len(fwd.sets) == 1
+
+    def test_local_set_flushed_on_local(self):
+        s = make_store()
+        s.process_metric(parse_metric(b"u:x|s|#veneurlocalonly"))
+        final, fwd, _ = s.flush([], DEFAULT_AGGS, is_local=True, now=1)
+        assert flush_map(final)["u"].value == pytest.approx(1.0, abs=0.01)
+        assert fwd.sets == []
+
+    def test_forward_merge_matches_union(self):
+        a, b = make_store(chunk=256), make_store(chunk=256)
+        for i in range(1000):
+            a.process_metric(parse_metric(f"u:x{i}|s".encode()))
+        for i in range(500, 1500):
+            b.process_metric(parse_metric(f"u:x{i}|s".encode()))
+        g = make_store()
+        for loc in (a, b):
+            _, fwd, _ = loc.flush([], DEFAULT_AGGS, is_local=True, now=1)
+            for (name, tags, regs, prec) in fwd.sets:
+                g.import_set(MetricKey(name, "set", ",".join(tags)), tags, regs)
+        final, _, _ = g.flush([], DEFAULT_AGGS, is_local=False, now=2)
+        est = flush_map(final)["u"].value
+        assert abs(est - 1500) / 1500 < 0.05
+
+
+class TestNonDefaultConfig:
+    def test_custom_compression_quantiles(self):
+        # regression: compression must reach the jitted kernels, or k-binning
+        # clips against the wrong capacity and upper quantiles collapse
+        rng = np.random.RandomState(3)
+        vals = rng.uniform(0, 100, size=2000)
+        s = make_store(chunk=256, compression=50.0)
+        for v in vals:
+            s.process_metric(parse_metric(f"h:{v:.4f}|h".encode()))
+        final, _, _ = s.flush([0.9, 0.99], ALL_AGGS, is_local=False, now=1)
+        fm = flush_map(final)
+        assert abs(fm["h.90percentile"].value - 90.0) < 4.0
+        assert abs(fm["h.99percentile"].value - 99.0) < 4.0
+
+    def test_hll_precision_mismatch_rejected(self):
+        s = make_store()
+        key = MetricKey("u", "set", "")
+        with pytest.raises(ValueError, match="precision mismatch"):
+            s.import_set(key, [], np.zeros(1 << 10, np.uint8))
+
+
+class TestStatusChecks:
+    def test_flush(self):
+        from veneur_tpu.samplers import parse_service_check
+        s = make_store()
+        s.process_metric(parse_service_check(b"_sc|svc|2|h:host1|m:bad", now=5))
+        final, _, _ = s.flush([], DEFAULT_AGGS, is_local=True, now=9)
+        m = flush_map(final)["svc"]
+        assert m.type == MetricType.STATUS
+        assert m.value == 2.0
+        assert m.message == "bad"
+        assert m.hostname == "host1"
+
+
+class TestGrowth:
+    def test_capacity_growth_preserves_data(self):
+        s = MetricStore(initial_capacity=4, chunk=16)
+        n = 40
+        for i in range(n):
+            s.process_metric(parse_metric(f"h{i}:5|h".encode()))
+            s.process_metric(parse_metric(f"c{i}:1|c".encode()))
+            s.process_metric(parse_metric(f"u{i}:m{i}|s".encode()))
+        final, fwd, ms = s.flush([], ALL_AGGS, is_local=False, now=1)
+        fm = flush_map(final)
+        assert ms.histograms == n and ms.counters == n and ms.sets == n
+        for i in range(n):
+            assert fm[f"h{i}.max"].value == 5.0
+            assert fm[f"c{i}"].value == 1.0
+            assert fm[f"u{i}"].value == pytest.approx(1.0, abs=0.01)
+
+
+class TestRouting:
+    def test_veneursinkonly_restricts_sinks(self):
+        s = make_store()
+        s.process_metric(parse_metric(b"x:1|c|#veneursinkonly:datadog"))
+        final, _, _ = s.flush([], DEFAULT_AGGS, is_local=True, now=1)
+        m = flush_map(final)["x"]
+        assert m.sinks == frozenset({"datadog"})
+        assert m.is_acceptable_to("datadog")
+        assert not m.is_acceptable_to("kafka")

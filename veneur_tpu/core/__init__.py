@@ -1,0 +1,19 @@
+"""Aggregation core: the dense TPU-resident metric store."""
+
+from .store import (
+    DigestGroup,
+    ForwardableState,
+    MetricStore,
+    MetricsSummary,
+    ScalarGroup,
+    SetGroup,
+)
+
+__all__ = [
+    "DigestGroup",
+    "ForwardableState",
+    "MetricStore",
+    "MetricsSummary",
+    "ScalarGroup",
+    "SetGroup",
+]

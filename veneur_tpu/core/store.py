@@ -1,0 +1,820 @@
+"""The dense metric store: every series is a row in device-resident tensors.
+
+This is the TPU re-expression of the reference's per-worker sampler maps
+(``/root/reference/worker.go:54-157``): where the reference keeps a
+``map[MetricKey]*sampler`` per goroutine and merges each sketch one at a time,
+here every scope-class is ONE dense group —
+
+    =====================  =============================================
+    scope-class            state
+    =====================  =============================================
+    counters               host   int64  [S]   (exact, like Go int64)
+    global_counters        host   int64  [S]
+    gauges                 host   float64[S]   (last-write-wins)
+    global_gauges          host   float64[S]
+    local_status_checks    host   float64[S] + message/hostname strings
+    histograms             device t-digest [S, K] + temp bins [S, K]
+    timers                 device t-digest [S, K] + temp bins [S, K]
+    local_histograms       device t-digest [S, K] + temp bins [S, K]
+    local_timers           device t-digest [S, K] + temp bins [S, K]
+    sets                   device HLL registers [S, 2^p] (int8)
+    local_sets             device HLL registers [S, 2^p] (int8)
+    =====================  =============================================
+
+— so the per-interval flush (the hot path, ``flusher.go:26-132``) is a handful
+of jitted XLA programs over ``[S, ...]`` tensors instead of S sequential
+sketch walks. Counters/gauges stay host-side numpy: they are exact integer /
+last-write scalars whose per-interval cost is one vectorized pass; the
+FLOP/bandwidth-heavy mergeable-sketch math (t-digest compress, HLL
+estimate) is what rides the TPU.
+
+Scope semantics (which group a sample lands in, and which groups a local vs
+global instance flushes or forwards) follow ``worker.go:96-157`` and
+``flusher.go:189-254`` exactly; see ``MetricStore.process_metric`` and
+``MetricStore.flush``.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+from dataclasses import dataclass, field
+from functools import partial
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from veneur_tpu.ops import hll as hll_ops
+from veneur_tpu.ops import tdigest as td_ops
+from veneur_tpu.samplers.intermetric import (
+    AGGREGATE_SUFFIX,
+    Aggregate,
+    HistogramAggregates,
+    InterMetric,
+    MetricType,
+    route_info,
+)
+from veneur_tpu.samplers.parser import (
+    GLOBAL_ONLY,
+    LOCAL_ONLY,
+    MetricKey,
+    UDPMetric,
+)
+
+DEFAULT_CHUNK = 1 << 14
+DEFAULT_INITIAL_CAPACITY = 1 << 10
+_GROW_FACTOR = 2
+
+
+class Interner:
+    """MetricKey -> dense row index, plus per-row name/tags for flush-time
+    InterMetric assembly. The moral equivalent of the reference's
+    map[MetricKey]*sampler keys (worker.go:54-91)."""
+
+    __slots__ = ("rows", "names", "tags")
+
+    def __init__(self):
+        self.rows: Dict[MetricKey, int] = {}
+        self.names: List[str] = []
+        self.tags: List[List[str]] = []
+
+    def __len__(self) -> int:
+        return len(self.rows)
+
+    def intern(self, key: MetricKey, tags: List[str]) -> int:
+        row = self.rows.get(key)
+        if row is None:
+            row = len(self.rows)
+            self.rows[key] = row
+            self.names.append(key.name)
+            self.tags.append(tags)
+        return row
+
+    def reset(self):
+        self.rows.clear()
+        self.names.clear()
+        self.tags.clear()
+
+
+# ---------------------------------------------------------------------------
+# Host-side scalar groups
+# ---------------------------------------------------------------------------
+
+
+class ScalarGroup:
+    """Counters / gauges / status checks: host numpy state.
+
+    kind: "counter" (int64 accumulate, samplers.go:141-143),
+    "gauge" (float64 last-write, samplers.go:225-227),
+    "status" (gauge + message/hostname, samplers.go:307-313).
+    """
+
+    def __init__(self, kind: str, capacity: int = DEFAULT_INITIAL_CAPACITY):
+        self.kind = kind
+        self.interner = Interner()
+        self.capacity = capacity
+        if kind == "counter":
+            self.values = np.zeros(capacity, np.int64)
+        else:
+            self.values = np.zeros(capacity, np.float64)
+        self.messages: Optional[List[str]] = [] if kind == "status" else None
+        self.hostnames: Optional[List[str]] = [] if kind == "status" else None
+
+    def __len__(self):
+        return len(self.interner)
+
+    def _row(self, key: MetricKey, tags: List[str]) -> int:
+        row = self.interner.intern(key, tags)
+        if row >= self.capacity:
+            self.capacity *= _GROW_FACTOR
+            self.values = np.concatenate(
+                [self.values, np.zeros(self.capacity - len(self.values),
+                                       self.values.dtype)])
+        if self.messages is not None and row >= len(self.messages):
+            self.messages.append("")
+            self.hostnames.append("")
+        return row
+
+    def sample(self, key: MetricKey, tags: List[str], value: float,
+               sample_rate: float, message: str = "", hostname: str = ""):
+        row = self._row(key, tags)
+        if self.kind == "counter":
+            # Go semantics: value += int64(sample) * int64(1/rate)
+            # (samplers.go:141-143) — both factors truncate toward zero.
+            self.values[row] += int(value) * int(1.0 / sample_rate)
+        else:
+            self.values[row] = value
+            if self.messages is not None:
+                self.messages[row] = message
+                self.hostnames[row] = hostname
+
+    def combine(self, key: MetricKey, tags: List[str], value: float):
+        """Merge imported state: counters add, gauges/status overwrite
+        (samplers.go:195-212, 276-289)."""
+        row = self._row(key, tags)
+        if self.kind == "counter":
+            self.values[row] += int(value)
+        else:
+            self.values[row] = value
+
+    def snapshot_and_reset(self):
+        n = len(self.interner)
+        interner, self.interner = self.interner, Interner()
+        values = self.values[:n].copy()
+        self.values[:] = 0
+        messages = hostnames = None
+        if self.messages is not None:
+            messages, self.messages = self.messages, []
+            hostnames, self.hostnames = self.hostnames, []
+        return interner, values, messages, hostnames
+
+
+# ---------------------------------------------------------------------------
+# Device-side digest groups (histograms and timers)
+# ---------------------------------------------------------------------------
+
+
+@partial(jax.jit, donate_argnums=(0,), static_argnums=(4,))
+def _ingest_samples(temp: td_ops.TempCentroids, rows, values, weights,
+                    compression):
+    return td_ops.ingest_chunk(temp, rows, values, weights, compression)
+
+
+@partial(jax.jit, donate_argnums=(0, 1, 2), static_argnums=(9,))
+def _ingest_centroids(temp: td_ops.TempCentroids, dmin, dmax, rows, means,
+                      weights, stat_rows, stat_mins, stat_maxs, compression):
+    """Fold imported digest centroids into the bin accumulators WITHOUT
+    touching the local scalar stats (samplers.go:473-480). Imported
+    per-digest min/max land in separate dmin/dmax arrays that only bound the
+    final digest."""
+    temp = td_ops.ingest_chunk(temp, rows, means, weights, compression,
+                               update_stats=False)
+    dmin = dmin.at[stat_rows].min(stat_mins, mode="drop")
+    dmax = dmax.at[stat_rows].max(stat_maxs, mode="drop")
+    return temp, dmin, dmax
+
+
+@partial(jax.jit, donate_argnums=(0, 1), static_argnums=(5,))
+def _flush_digests(digest: td_ops.TDigest, temp: td_ops.TempCentroids,
+                   dmin, dmax, qs, compression):
+    """The per-interval flush program: one compress + one batched quantile
+    gather for the whole group (the Histo.Flush hot loop of
+    samplers.go:511-636 over all series at once)."""
+    drained = td_ops.drain_temp(digest, temp, compression)
+    drained = drained._replace(
+        min=jnp.minimum(drained.min, dmin),
+        max=jnp.maximum(drained.max, dmax),
+    )
+    pcts = td_ops.quantile(drained, qs)
+    return (drained, pcts, temp.count, temp.vsum, temp.vmin, temp.vmax,
+            temp.recip)
+
+
+class DigestGroup:
+    """One scope-class of histograms/timers as a dense t-digest batch."""
+
+    def __init__(self, capacity: int = DEFAULT_INITIAL_CAPACITY,
+                 chunk: int = DEFAULT_CHUNK,
+                 compression: float = td_ops.DEFAULT_COMPRESSION):
+        self.interner = Interner()
+        self.capacity = capacity
+        self.chunk = chunk
+        self.compression = compression
+        self.k = td_ops.size_bound(compression)
+        self._init_device()
+        self._init_staging()
+
+    def _init_device(self):
+        self.temp = td_ops.init_temp(self.capacity, self.k, self.compression)
+        self.digest = td_ops.init((self.capacity,), self.compression, self.k)
+        self.dmin = jnp.full((self.capacity,), jnp.inf, jnp.float32)
+        self.dmax = jnp.full((self.capacity,), -jnp.inf, jnp.float32)
+
+    def _init_staging(self):
+        self._new_sample_buffers()
+        self._new_import_buffers()
+        self._imp_stat_rows: List[int] = []
+        self._imp_stat_mins: List[float] = []
+        self._imp_stat_maxs: List[float] = []
+
+    def _new_sample_buffers(self):
+        # Fresh buffers per drain: jnp.asarray zero-copies aligned numpy
+        # arrays and dispatch is async, so a buffer handed to the device
+        # must never be written again from the host.
+        self._rows = np.full(self.chunk, self.capacity, np.int32)
+        self._vals = np.zeros(self.chunk, np.float32)
+        self._wts = np.zeros(self.chunk, np.float32)
+        self._fill = 0
+
+    def _new_import_buffers(self):
+        self._imp_rows = np.full(self.chunk, self.capacity, np.int32)
+        self._imp_means = np.zeros(self.chunk, np.float32)
+        self._imp_wts = np.zeros(self.chunk, np.float32)
+        self._imp_fill = 0
+
+    def __len__(self):
+        return len(self.interner)
+
+    def _row(self, key: MetricKey, tags: List[str]) -> int:
+        row = self.interner.intern(key, tags)
+        if row >= self.capacity:
+            self._grow()
+        return row
+
+    def _grow(self):
+        self._drain_staging()
+        old = self.capacity
+        self.capacity *= _GROW_FACTOR
+        pad = self.capacity - old
+        self.temp = td_ops.TempCentroids(
+            sum_w=jnp.pad(self.temp.sum_w, ((0, pad), (0, 0))),
+            sum_wm=jnp.pad(self.temp.sum_wm, ((0, pad), (0, 0))),
+            count=jnp.pad(self.temp.count, (0, pad)),
+            vsum=jnp.pad(self.temp.vsum, (0, pad)),
+            vmin=jnp.pad(self.temp.vmin, (0, pad), constant_values=np.inf),
+            vmax=jnp.pad(self.temp.vmax, (0, pad), constant_values=-np.inf),
+            recip=jnp.pad(self.temp.recip, (0, pad)),
+        )
+        self.digest = td_ops.TDigest(
+            mean=jnp.pad(self.digest.mean, ((0, pad), (0, 0)),
+                         constant_values=np.inf),
+            weight=jnp.pad(self.digest.weight, ((0, pad), (0, 0))),
+            min=jnp.pad(self.digest.min, (0, pad), constant_values=np.inf),
+            max=jnp.pad(self.digest.max, (0, pad), constant_values=-np.inf),
+        )
+        self.dmin = jnp.pad(self.dmin, (0, pad), constant_values=np.inf)
+        self.dmax = jnp.pad(self.dmax, (0, pad), constant_values=-np.inf)
+        # re-point staging padding at the new out-of-range row id
+        self._rows[self._fill:] = self.capacity
+        self._imp_rows[self._imp_fill:] = self.capacity
+
+    def sample(self, key: MetricKey, tags: List[str], value: float,
+               sample_rate: float):
+        row = self._row(key, tags)
+        i = self._fill
+        self._rows[i] = row
+        self._vals[i] = value
+        self._wts[i] = 1.0 / sample_rate
+        self._fill = i + 1
+        if self._fill == self.chunk:
+            self._drain_samples()
+
+    def import_centroids(self, key: MetricKey, tags: List[str],
+                         means: np.ndarray, weights: np.ndarray,
+                         dmin: float, dmax: float):
+        """Merge a forwarded digest: its centroids re-enter the binning
+        pipeline as weighted samples, which is exactly the reference's
+        Merge-by-re-adding-centroids (merging_digest.go:358-370) without
+        the shuffle."""
+        row = self._row(key, tags)
+        n = len(means)
+        if n > self.chunk:  # absurd, but stay safe
+            means, weights = means[:self.chunk], weights[:self.chunk]
+            n = self.chunk
+        if self._imp_fill + n > self.chunk:
+            self._drain_imports()
+        i = self._imp_fill
+        self._imp_rows[i:i + n] = row
+        self._imp_means[i:i + n] = means
+        self._imp_wts[i:i + n] = weights
+        self._imp_fill = i + n
+        if math.isfinite(dmin):
+            self._imp_stat_rows.append(row)
+            self._imp_stat_mins.append(dmin)
+            self._imp_stat_maxs.append(dmax)
+
+    def _drain_samples(self):
+        if self._fill == 0:
+            return
+        rows, vals, wts = self._rows, self._vals, self._wts
+        self._new_sample_buffers()
+        self.temp = _ingest_samples(self.temp, jnp.asarray(rows),
+                                    jnp.asarray(vals), jnp.asarray(wts),
+                                    self.compression)
+
+    def _drain_imports(self):
+        if self._imp_fill == 0 and not self._imp_stat_rows:
+            return
+        ns = len(self._imp_stat_rows)
+        stat_rows = np.full(max(ns, 1), self.capacity, np.int32)
+        stat_mins = np.full(max(ns, 1), np.inf, np.float32)
+        stat_maxs = np.full(max(ns, 1), -np.inf, np.float32)
+        if ns:
+            stat_rows[:ns] = self._imp_stat_rows
+            stat_mins[:ns] = self._imp_stat_mins
+            stat_maxs[:ns] = self._imp_stat_maxs
+        imp_rows, imp_means, imp_wts = (self._imp_rows, self._imp_means,
+                                        self._imp_wts)
+        self._new_import_buffers()
+        self._imp_stat_rows = []
+        self._imp_stat_mins = []
+        self._imp_stat_maxs = []
+        self.temp, self.dmin, self.dmax = _ingest_centroids(
+            self.temp, self.dmin, self.dmax,
+            jnp.asarray(imp_rows), jnp.asarray(imp_means),
+            jnp.asarray(imp_wts), jnp.asarray(stat_rows),
+            jnp.asarray(stat_mins), jnp.asarray(stat_maxs),
+            self.compression)
+
+    def _drain_staging(self):
+        self._drain_samples()
+        self._drain_imports()
+
+    def flush(self, percentiles: List[float]):
+        """Run the flush program; returns (interner, host result dict) and
+        resets the group."""
+        self._drain_staging()
+        n = len(self.interner)
+        interner, self.interner = self.interner, Interner()
+        qs = jnp.asarray(list(percentiles) + [0.5], jnp.float32)
+        digest, pcts, count, vsum, vmin, vmax, recip = _flush_digests(
+            self.digest, self.temp, self.dmin, self.dmax, qs, self.compression)
+        out = {
+            "digest_mean": np.asarray(digest.mean[:n]),
+            "digest_weight": np.asarray(digest.weight[:n]),
+            "digest_min": np.asarray(digest.min[:n]),
+            "digest_max": np.asarray(digest.max[:n]),
+            "percentiles": np.asarray(pcts[:n, :-1]),
+            "median": np.asarray(pcts[:n, -1]),
+            "count": np.asarray(count[:n]),
+            "sum": np.asarray(vsum[:n]),
+            "min": np.asarray(vmin[:n]),
+            "max": np.asarray(vmax[:n]),
+            "recip": np.asarray(recip[:n]),
+        }
+        self._init_device()
+        self._init_staging()
+        return interner, out
+
+
+# ---------------------------------------------------------------------------
+# Device-side set groups (HyperLogLog)
+# ---------------------------------------------------------------------------
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def _ingest_hashes(registers, rows, hi, lo):
+    idx, rho = hll_ops.idx_rho(hi, lo, _precision_of(registers))
+    return registers.at[rows, idx].max(rho.astype(registers.dtype),
+                                       mode="drop")
+
+
+def _precision_of(registers) -> int:
+    return int(math.log2(registers.shape[-1]))
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def _merge_registers(registers, rows, updates):
+    return registers.at[rows].max(updates.astype(registers.dtype),
+                                  mode="drop")
+
+
+@jax.jit
+def _estimate_all(registers):
+    return hll_ops.estimate(registers.astype(jnp.int32),
+                            _precision_of(registers))
+
+
+class SetGroup:
+    """One scope-class of Set metrics as a dense [S, 2^p] register tensor.
+
+    Registers are int8 (max value 64-p+1 = 51): at the reference's precision
+    14 a series costs 16 KiB of HBM, which is what bounds single-chip set
+    cardinality — shard the series axis across a mesh to scale (SURVEY §5).
+    """
+
+    def __init__(self, capacity: int = DEFAULT_INITIAL_CAPACITY,
+                 chunk: int = DEFAULT_CHUNK,
+                 precision: int = hll_ops.DEFAULT_PRECISION):
+        self.interner = Interner()
+        self.capacity = capacity
+        self.chunk = chunk
+        self.precision = precision
+        self.m = hll_ops.num_registers(precision)
+        self.registers = jnp.zeros((capacity, self.m), jnp.int8)
+        self._init_staging()
+
+    def _init_staging(self):
+        self._new_sample_buffers()
+        self._imp_rows: List[int] = []
+        self._imp_regs: List[np.ndarray] = []
+
+    def _new_sample_buffers(self):
+        # Fresh buffers per drain; see DigestGroup._new_sample_buffers.
+        self._rows = np.full(self.chunk, self.capacity, np.int32)
+        self._hi = np.zeros(self.chunk, np.uint32)
+        self._lo = np.zeros(self.chunk, np.uint32)
+        self._fill = 0
+
+    def __len__(self):
+        return len(self.interner)
+
+    def _row(self, key: MetricKey, tags: List[str]) -> int:
+        row = self.interner.intern(key, tags)
+        if row >= self.capacity:
+            self._drain_staging()
+            old = self.capacity
+            self.capacity *= _GROW_FACTOR
+            self.registers = jnp.pad(self.registers,
+                                     ((0, self.capacity - old), (0, 0)))
+            self._rows[self._fill:] = self.capacity
+        return row
+
+    def sample(self, key: MetricKey, tags: List[str], member: str):
+        row = self._row(key, tags)
+        h = hll_ops.hash_member(member.encode("utf-8"))
+        i = self._fill
+        self._rows[i] = row
+        self._hi[i] = h >> 32
+        self._lo[i] = h & 0xFFFFFFFF
+        self._fill = i + 1
+        if self._fill == self.chunk:
+            self._drain_samples()
+
+    def import_registers(self, key: MetricKey, tags: List[str],
+                         registers: np.ndarray):
+        """Merge a forwarded sketch: elementwise register max
+        (samplers.go:423-435). Rejects precision mismatches per import
+        (cf. Set.Combine's error, samplers.go:424-435) rather than
+        poisoning the whole batch."""
+        registers = np.asarray(registers)
+        if registers.shape != (self.m,):
+            raise ValueError(
+                f"HLL precision mismatch: got {registers.shape}, "
+                f"want ({self.m},)")
+        row = self._row(key, tags)
+        self._imp_rows.append(row)
+        self._imp_regs.append(registers)
+        if len(self._imp_rows) >= 256:
+            self._drain_imports()
+
+    def _drain_samples(self):
+        if self._fill == 0:
+            return
+        rows, hi, lo = self._rows, self._hi, self._lo
+        self._new_sample_buffers()
+        self.registers = _ingest_hashes(self.registers, jnp.asarray(rows),
+                                        jnp.asarray(hi), jnp.asarray(lo))
+
+    def _drain_imports(self):
+        if not self._imp_rows:
+            return
+        rows = jnp.asarray(np.asarray(self._imp_rows, np.int32))
+        regs = jnp.asarray(np.stack(self._imp_regs).astype(np.int8))
+        self.registers = _merge_registers(self.registers, rows, regs)
+        self._imp_rows.clear()
+        self._imp_regs.clear()
+
+    def _drain_staging(self):
+        self._drain_samples()
+        self._drain_imports()
+
+    def flush(self):
+        self._drain_staging()
+        n = len(self.interner)
+        interner, self.interner = self.interner, Interner()
+        estimates = np.asarray(_estimate_all(self.registers)[:n])
+        registers = np.asarray(self.registers[:n], np.uint8)
+        self.registers = jnp.zeros((self.capacity, self.m), jnp.int8)
+        self._init_staging()
+        return interner, estimates, registers
+
+
+# ---------------------------------------------------------------------------
+# The store
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class MetricsSummary:
+    """Per-flush tallies (flusher.go:121-132)."""
+
+    counters: int = 0
+    gauges: int = 0
+    histograms: int = 0
+    sets: int = 0
+    timers: int = 0
+    global_counters: int = 0
+    global_gauges: int = 0
+    local_histograms: int = 0
+    local_sets: int = 0
+    local_timers: int = 0
+    local_status_checks: int = 0
+
+
+@dataclass
+class ForwardableState:
+    """Sketch state destined for the global tier (worker.go:161-183):
+    global counters/gauges by value, digests as centroid arrays, sets as
+    register arrays."""
+
+    counters: List[Tuple[str, List[str], int]] = field(default_factory=list)
+    gauges: List[Tuple[str, List[str], float]] = field(default_factory=list)
+    # (name, tags, means, weights, min, max), one per series
+    histograms: List[tuple] = field(default_factory=list)
+    timers: List[tuple] = field(default_factory=list)
+    # (name, tags, registers-uint8, precision)
+    sets: List[tuple] = field(default_factory=list)
+
+    def __len__(self):
+        return (len(self.counters) + len(self.gauges) + len(self.histograms)
+                + len(self.timers) + len(self.sets))
+
+
+_DIGEST_GROUPS = ("histograms", "timers", "local_histograms", "local_timers")
+_SET_GROUPS = ("sets", "local_sets")
+
+
+class MetricStore:
+    """All eleven scope-classes plus dispatch, flush and import logic."""
+
+    def __init__(self, initial_capacity: int = DEFAULT_INITIAL_CAPACITY,
+                 chunk: int = DEFAULT_CHUNK,
+                 compression: float = td_ops.DEFAULT_COMPRESSION,
+                 hll_precision: int = hll_ops.DEFAULT_PRECISION):
+        self._lock = threading.RLock()
+        self.counters = ScalarGroup("counter", initial_capacity)
+        self.global_counters = ScalarGroup("counter", initial_capacity)
+        self.gauges = ScalarGroup("gauge", initial_capacity)
+        self.global_gauges = ScalarGroup("gauge", initial_capacity)
+        self.local_status_checks = ScalarGroup("status", initial_capacity)
+        self.histograms = DigestGroup(initial_capacity, chunk, compression)
+        self.timers = DigestGroup(initial_capacity, chunk, compression)
+        self.local_histograms = DigestGroup(initial_capacity, chunk, compression)
+        self.local_timers = DigestGroup(initial_capacity, chunk, compression)
+        self.sets = SetGroup(initial_capacity, chunk, hll_precision)
+        self.local_sets = SetGroup(initial_capacity, chunk, hll_precision)
+        self.hll_precision = hll_precision
+        self.processed = 0
+        self.imported = 0
+
+    # -- ingest ------------------------------------------------------------
+
+    def process_metric(self, m: UDPMetric):
+        """Dispatch one parsed sample to its scope-class (worker.go:267-310)."""
+        with self._lock:
+            self.processed += 1
+            t = m.key.type
+            if t == "counter":
+                group = self.global_counters if m.scope == GLOBAL_ONLY else self.counters
+                group.sample(m.key, m.tags, m.value, m.sample_rate)
+            elif t == "gauge":
+                group = self.global_gauges if m.scope == GLOBAL_ONLY else self.gauges
+                group.sample(m.key, m.tags, m.value, m.sample_rate)
+            elif t == "histogram":
+                group = self.local_histograms if m.scope == LOCAL_ONLY else self.histograms
+                group.sample(m.key, m.tags, m.value, m.sample_rate)
+            elif t == "timer":
+                group = self.local_timers if m.scope == LOCAL_ONLY else self.timers
+                group.sample(m.key, m.tags, m.value, m.sample_rate)
+            elif t == "set":
+                group = self.local_sets if m.scope == LOCAL_ONLY else self.sets
+                group.sample(m.key, m.tags, str(m.value))
+            elif t == "status":
+                self.local_status_checks.sample(
+                    m.key, m.tags, float(m.value), m.sample_rate,
+                    message=m.message, hostname=m.hostname)
+            # unknown types are dropped, as in the reference
+
+    # -- import (global-aggregator ingest) ---------------------------------
+
+    def import_counter(self, key: MetricKey, tags: List[str], value: int):
+        """Imported counters are global by definition (worker.go:313-326)."""
+        with self._lock:
+            self.imported += 1
+            self.global_counters.combine(key, tags, value)
+
+    def import_gauge(self, key: MetricKey, tags: List[str], value: float):
+        with self._lock:
+            self.imported += 1
+            self.global_gauges.combine(key, tags, value)
+
+    def import_digest(self, key: MetricKey, tags: List[str],
+                      means: np.ndarray, weights: np.ndarray,
+                      dmin: float, dmax: float):
+        with self._lock:
+            self.imported += 1
+            group = self.timers if key.type == "timer" else self.histograms
+            group.import_centroids(key, tags, means, weights, dmin, dmax)
+
+    def import_set(self, key: MetricKey, tags: List[str],
+                   registers: np.ndarray):
+        with self._lock:
+            self.imported += 1
+            self.sets.import_registers(key, tags, registers)
+
+    # -- flush -------------------------------------------------------------
+
+    def summary(self) -> MetricsSummary:
+        return MetricsSummary(
+            counters=len(self.counters),
+            gauges=len(self.gauges),
+            histograms=len(self.histograms),
+            sets=len(self.sets),
+            timers=len(self.timers),
+            global_counters=len(self.global_counters),
+            global_gauges=len(self.global_gauges),
+            local_histograms=len(self.local_histograms),
+            local_sets=len(self.local_sets),
+            local_timers=len(self.local_timers),
+            local_status_checks=len(self.local_status_checks),
+        )
+
+    def flush(self, percentiles: List[float], aggregates: HistogramAggregates,
+              is_local: bool, now: int,
+              forward: bool = True) -> Tuple[List[InterMetric],
+                                             ForwardableState, MetricsSummary]:
+        """Drain everything: returns (final metrics for sinks, forwardable
+        sketch state, tallies) and resets all groups.
+
+        Mirrors generateInterMetrics (flusher.go:189-254): a local instance
+        suppresses percentiles on mixed histograms/timers and does not flush
+        mixed sets or global counters/gauges (those are forwarded instead);
+        local-only groups always flush in full.
+        """
+        with self._lock:
+            ms = self.summary()
+            final: List[InterMetric] = []
+            fwd = ForwardableState()
+
+            # counters & gauges (mixed scope) always flush locally
+            self._flush_scalars(self.counters, MetricType.COUNTER, final, now)
+            self._flush_scalars(self.gauges, MetricType.GAUGE, final, now)
+
+            # mixed histograms/timers: no percentiles on a local instance
+            mixed_pcts = [] if is_local else list(percentiles)
+            self._flush_digest_group(
+                self.histograms, mixed_pcts, aggregates, final, now,
+                fwd_list=fwd.histograms if (is_local and forward) else None)
+            self._flush_digest_group(
+                self.timers, mixed_pcts, aggregates, final, now,
+                fwd_list=fwd.timers if (is_local and forward) else None)
+
+            # local-only histograms/timers: full flush with percentiles
+            self._flush_digest_group(self.local_histograms, list(percentiles),
+                                     aggregates, final, now, fwd_list=None)
+            self._flush_digest_group(self.local_timers, list(percentiles),
+                                     aggregates, final, now, fwd_list=None)
+
+            # local sets always flush; mixed sets flush only on a global
+            # instance (they are forwarded from locals)
+            self._flush_set_group(self.local_sets, final, now, fwd_list=None)
+            self._flush_set_group(
+                self.sets, final if not is_local else None, now,
+                fwd_list=fwd.sets if (is_local and forward) else None)
+
+            # status checks are always local
+            self._flush_status(final, now)
+
+            # global counters/gauges: forwarded by locals, flushed by globals
+            if is_local:
+                if forward:
+                    interner, values, _, _ = self.global_counters.snapshot_and_reset()
+                    for key, row in interner.rows.items():
+                        fwd.counters.append((key.name, interner.tags[row],
+                                             int(values[row])))
+                    interner, values, _, _ = self.global_gauges.snapshot_and_reset()
+                    for key, row in interner.rows.items():
+                        fwd.gauges.append((key.name, interner.tags[row],
+                                           float(values[row])))
+                else:
+                    self.global_counters.snapshot_and_reset()
+                    self.global_gauges.snapshot_and_reset()
+            else:
+                self._flush_scalars(self.global_counters, MetricType.COUNTER,
+                                    final, now)
+                self._flush_scalars(self.global_gauges, MetricType.GAUGE,
+                                    final, now)
+
+            self.processed = 0
+            self.imported = 0
+            return final, fwd, ms
+
+    def _flush_scalars(self, group: ScalarGroup, mtype: MetricType,
+                       out: List[InterMetric], now: int):
+        interner, values, _, _ = group.snapshot_and_reset()
+        for key, row in interner.rows.items():
+            tags = interner.tags[row]
+            out.append(InterMetric(
+                name=key.name, timestamp=now, value=float(values[row]),
+                tags=tags, type=mtype, sinks=route_info(tags)))
+
+    def _flush_status(self, out: List[InterMetric], now: int):
+        interner, values, messages, hostnames = \
+            self.local_status_checks.snapshot_and_reset()
+        for key, row in interner.rows.items():
+            tags = interner.tags[row]
+            out.append(InterMetric(
+                name=key.name, timestamp=now, value=float(values[row]),
+                tags=tags, type=MetricType.STATUS,
+                message=messages[row], hostname=hostnames[row],
+                sinks=route_info(tags)))
+
+    def _flush_digest_group(self, group: DigestGroup, percentiles: List[float],
+                            aggregates: HistogramAggregates,
+                            out: List[InterMetric], now: int,
+                            fwd_list: Optional[list]):
+        interner, r = group.flush(percentiles)
+        agg = aggregates.value
+        for key, row in interner.rows.items():
+            tags = interner.tags[row]
+            sinks = route_info(tags)
+            name = key.name
+
+            def emit(suffix: str, value: float,
+                     mtype: MetricType = MetricType.GAUGE):
+                out.append(InterMetric(
+                    name=f"{name}.{suffix}", timestamp=now, value=value,
+                    tags=list(tags), type=mtype, sinks=sinks))
+
+            # emission rules of Histo.Flush (samplers.go:511-636)
+            vmax, vmin = float(r["max"][row]), float(r["min"][row])
+            vsum, cnt = float(r["sum"][row]), float(r["count"][row])
+            recip = float(r["recip"][row])
+            if (agg & Aggregate.MAX) and math.isfinite(vmax):
+                emit("max", vmax)
+            if (agg & Aggregate.MIN) and math.isfinite(vmin):
+                emit("min", vmin)
+            if (agg & Aggregate.SUM) and vsum != 0:
+                emit("sum", vsum)
+            if (agg & Aggregate.AVERAGE) and vsum != 0 and cnt != 0:
+                emit("avg", vsum / cnt)
+            if (agg & Aggregate.COUNT) and cnt != 0:
+                emit("count", cnt, MetricType.COUNTER)
+            if agg & Aggregate.MEDIAN:
+                emit("median", float(r["median"][row]))
+            if (agg & Aggregate.HARMONIC_MEAN) and recip != 0 and cnt != 0:
+                emit("hmean", cnt / recip)
+            for i, p in enumerate(percentiles):
+                out.append(InterMetric(
+                    name=f"{name}.{int(p * 100)}percentile", timestamp=now,
+                    value=float(r["percentiles"][row, i]), tags=list(tags),
+                    type=MetricType.GAUGE, sinks=sinks))
+
+            if fwd_list is not None:
+                w = r["digest_weight"][row]
+                live = w > 0
+                fwd_list.append((
+                    name, tags,
+                    r["digest_mean"][row][live].astype(np.float64),
+                    w[live].astype(np.float64),
+                    float(r["digest_min"][row]), float(r["digest_max"][row])))
+
+    def _flush_set_group(self, group: SetGroup,
+                         out: Optional[List[InterMetric]], now: int,
+                         fwd_list: Optional[list]):
+        interner, estimates, registers = group.flush()
+        if out is None and fwd_list is None:
+            return
+        for key, row in interner.rows.items():
+            tags = interner.tags[row]
+            if out is not None:
+                out.append(InterMetric(
+                    name=key.name, timestamp=now,
+                    value=float(estimates[row]), tags=tags,
+                    type=MetricType.GAUGE, sinks=route_info(tags)))
+            if fwd_list is not None:
+                fwd_list.append((key.name, tags, registers[row],
+                                 group.precision))

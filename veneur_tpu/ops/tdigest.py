@@ -257,6 +257,127 @@ def cdf(state: TDigest, xs: jax.Array) -> jax.Array:
     return jnp.where(total > 0, est, jnp.nan)
 
 
+def bin_flat_samples(rows: jax.Array, values: jax.Array, weights: jax.Array,
+                     num_series: int, capacity: int,
+                     compression: float = DEFAULT_COMPRESSION):
+    """Pre-cluster a flat batch of (row, value, weight) samples into k-bins.
+
+    The streaming-ingest half of the TPU t-digest: instead of a per-digest
+    temp buffer drained by a sequential scan (merging_digest.go:111-219),
+    a whole chunk of samples — any mix of series, any skew — is
+
+        1. sorted by (row, value),
+        2. given within-row quantiles via one global prefix sum plus a
+           cummax-propagated segment base (no data-dependent shapes),
+        3. assigned cluster id floor(k(q_mid)) under the same k-scale the
+           reference uses, so every bin spans k-width <= 1.
+
+    rows: [N] int32 in [0, num_series); padding entries must use
+    ``rows == num_series`` (they sort to the back and scatter with
+    mode='drop'). Returns (rows, values, weights, bins) sorted by row.
+    """
+    values = values.astype(jnp.float32)
+    weights = weights.astype(jnp.float32)
+    r, v, w = lax.sort((rows, values, weights), dimension=-1, num_keys=2,
+                       is_stable=False)
+    cw = jnp.cumsum(w)
+    excl = cw - w
+    seg_start = jnp.concatenate(
+        [jnp.ones((1,), bool), r[1:] != r[:-1]])
+    base = jnp.where(seg_start, excl, -jnp.inf)
+    base = lax.cummax(base)
+    q_excl = excl - base
+    totals = jnp.zeros((num_series + 1,), w.dtype).at[r].add(w, mode="drop")
+    tot = jnp.maximum(totals[jnp.minimum(r, num_series)], jnp.finfo(w.dtype).tiny)
+    q_mid = (q_excl + 0.5 * w) / tot
+    k = compression * (jnp.arcsin(jnp.clip(2.0 * q_mid - 1.0, -1.0, 1.0)) / jnp.pi + 0.5)
+    bins = jnp.clip(jnp.floor(k), 0, capacity - 1).astype(jnp.int32)
+    return r, v, w, bins
+
+
+class TempCentroids(NamedTuple):
+    """Per-series accumulation of pre-clustered samples: the batched analogue
+    of the reference's tempCentroids list, plus the Histo sampler's local
+    scalar stats (samplers.go:467-494)."""
+
+    sum_w: jax.Array       # [S, K] per-bin weight
+    sum_wm: jax.Array      # [S, K] per-bin weighted mean sum
+    count: jax.Array       # [S] total weight
+    vsum: jax.Array        # [S] weighted sample sum
+    vmin: jax.Array        # [S]
+    vmax: jax.Array        # [S]
+    recip: jax.Array       # [S] weighted reciprocal sum (for hmean)
+
+
+def init_temp(num_series: int, capacity: int | None = None,
+              compression: float = DEFAULT_COMPRESSION) -> TempCentroids:
+    k = capacity if capacity is not None else size_bound(compression)
+    # NB: each field gets its own buffer — ingest donates the whole tuple,
+    # and XLA rejects donating one buffer twice.
+    return TempCentroids(
+        sum_w=jnp.zeros((num_series, k), jnp.float32),
+        sum_wm=jnp.zeros((num_series, k), jnp.float32),
+        count=jnp.zeros((num_series,), jnp.float32),
+        vsum=jnp.zeros((num_series,), jnp.float32),
+        vmin=jnp.full((num_series,), jnp.inf, jnp.float32),
+        vmax=jnp.full((num_series,), -jnp.inf, jnp.float32),
+        recip=jnp.zeros((num_series,), jnp.float32),
+    )
+
+
+def ingest_chunk(temp: TempCentroids, rows: jax.Array, values: jax.Array,
+                 weights: jax.Array,
+                 compression: float = DEFAULT_COMPRESSION,
+                 update_stats: bool = True) -> TempCentroids:
+    """Fold one flat chunk of samples into the temp accumulator.
+
+    All scatters use mode='drop' so padding (rows == S) is free. Repeated
+    chunks accumulate into the same bins; the per-bin mixtures stay within
+    the k-width<=1 invariant per chunk, which is the same granularity the
+    reference's repeated temp-buffer merges produce.
+
+    update_stats=False skips the local scalar stats: used when re-binning
+    *imported* digest centroids, which contribute to percentiles but not to
+    the host-local min/max/sum/avg/count/hmean (samplers.go:473-480).
+    """
+    num_series, capacity = temp.sum_w.shape
+    r, v, w, b = bin_flat_samples(rows, values, weights, num_series, capacity,
+                                  compression)
+    live = w > 0
+    vz = jnp.where(live, v, 0.0)
+    temp = temp._replace(
+        sum_w=temp.sum_w.at[r, b].add(w, mode="drop"),
+        sum_wm=temp.sum_wm.at[r, b].add(w * vz, mode="drop"),
+    )
+    if not update_stats:
+        return temp
+    return temp._replace(
+        count=temp.count.at[r].add(w, mode="drop"),
+        vsum=temp.vsum.at[r].add(w * vz, mode="drop"),
+        vmin=temp.vmin.at[r].min(jnp.where(live, v, jnp.inf), mode="drop"),
+        vmax=temp.vmax.at[r].max(jnp.where(live, v, -jnp.inf), mode="drop"),
+        recip=temp.recip.at[r].add(jnp.where(live, w / v, 0.0), mode="drop"),
+    )
+
+
+def drain_temp(state: TDigest, temp: TempCentroids,
+               compression: float = DEFAULT_COMPRESSION) -> TDigest:
+    """Merge the accumulated temp centroids into the digests (one compress
+    per interval — the batched mergeAllTemps)."""
+    t_live = temp.sum_w > 0
+    t_mean = jnp.where(t_live, temp.sum_wm / jnp.where(t_live, temp.sum_w, 1.0),
+                       jnp.inf)
+    mean = jnp.concatenate([state.mean, t_mean], axis=-1)
+    weight = jnp.concatenate([state.weight, temp.sum_w], axis=-1)
+    new_mean, new_weight = _compress(mean, weight, compression, state.capacity)
+    return TDigest(
+        mean=new_mean,
+        weight=new_weight,
+        min=jnp.minimum(state.min, temp.vmin),
+        max=jnp.maximum(state.max, temp.vmax),
+    )
+
+
 def from_centroids(mean: jax.Array, weight: jax.Array, mins: jax.Array,
                    maxs: jax.Array, compression: float = DEFAULT_COMPRESSION,
                    capacity: int | None = None) -> TDigest:

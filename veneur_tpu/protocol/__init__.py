@@ -1,0 +1,36 @@
+"""Wire protocols: SSF protobuf schema, framed-SSF codec, forwarding schemas.
+
+Generated protobuf modules live in ``gen/`` (regenerate with
+``regen_protos.sh``); they are re-exported here under stable names:
+
+    from veneur_tpu.protocol import ssf_pb2, metricpb_pb2, forward_pb2
+"""
+
+from veneur_tpu.protocol.gen.ssf import sample_pb2 as ssf_pb2
+from veneur_tpu.protocol.gen.tdigestpb import tdigest_pb2 as tdigest_pb2
+from veneur_tpu.protocol.gen.metricpb import metric_pb2 as metricpb_pb2
+from veneur_tpu.protocol.gen.forwardrpc import forward_pb2 as forward_pb2
+from veneur_tpu.protocol.gen.grpsink import grpc_sink_pb2 as grpsink_pb2
+
+from .wire import (  # noqa: E402
+    MAX_FRAME_LENGTH,
+    FramingError,
+    parse_ssf,
+    read_ssf,
+    write_ssf,
+)
+from .addr import resolve_addr  # noqa: E402
+
+__all__ = [
+    "ssf_pb2",
+    "tdigest_pb2",
+    "metricpb_pb2",
+    "forward_pb2",
+    "grpsink_pb2",
+    "MAX_FRAME_LENGTH",
+    "FramingError",
+    "parse_ssf",
+    "read_ssf",
+    "write_ssf",
+    "resolve_addr",
+]

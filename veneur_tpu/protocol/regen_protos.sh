@@ -1,0 +1,19 @@
+#!/bin/sh
+# Regenerate the Python protobuf modules in gen/ from proto/.
+#
+# protoc emits absolute imports ("from metricpb import metric_pb2"); the sed
+# pass rewrites them to package-qualified imports so gen/ needs no sys.path
+# manipulation.
+set -e
+cd "$(dirname "$0")"
+protoc --python_out=gen --proto_path=proto \
+    proto/ssf/sample.proto \
+    proto/tdigestpb/tdigest.proto \
+    proto/metricpb/metric.proto \
+    proto/forwardrpc/forward.proto \
+    proto/grpsink/grpc_sink.proto
+for d in gen gen/ssf gen/tdigestpb gen/metricpb gen/forwardrpc gen/grpsink; do
+    touch "$d/__init__.py"
+done
+sed -i -E 's/^from (ssf|tdigestpb|metricpb|forwardrpc|grpsink) import/from veneur_tpu.protocol.gen.\1 import/' \
+    gen/*/*_pb2.py

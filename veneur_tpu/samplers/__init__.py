@@ -1,1 +1,50 @@
-"""Sampler layer: batched device-resident metric state + scalar references."""
+"""Sampler-layer types: parsing, metric keys, InterMetrics, golden models."""
+
+from .intermetric import (
+    AGGREGATES_LOOKUP,
+    AGGREGATE_SUFFIX,
+    Aggregate,
+    HistogramAggregates,
+    InterMetric,
+    MetricType,
+    route_info,
+)
+from .parser import (
+    GLOBAL_ONLY,
+    LOCAL_ONLY,
+    MIXED_SCOPE,
+    MetricKey,
+    ParseError,
+    UDPMetric,
+    fnv1a_32,
+    parse_event,
+    parse_metric,
+    parse_metric_ssf,
+    parse_service_check,
+    split_lines,
+)
+from .scalar import ScalarHLL, ScalarTDigest
+
+__all__ = [
+    "AGGREGATES_LOOKUP",
+    "AGGREGATE_SUFFIX",
+    "Aggregate",
+    "HistogramAggregates",
+    "InterMetric",
+    "MetricType",
+    "route_info",
+    "GLOBAL_ONLY",
+    "LOCAL_ONLY",
+    "MIXED_SCOPE",
+    "MetricKey",
+    "ParseError",
+    "UDPMetric",
+    "fnv1a_32",
+    "parse_event",
+    "parse_metric",
+    "parse_metric_ssf",
+    "parse_service_check",
+    "split_lines",
+    "ScalarHLL",
+    "ScalarTDigest",
+]
