@@ -1,0 +1,243 @@
+"""End-to-end server tests over real sockets, in-process.
+
+Port of the reference's dominant test pattern (server_test.go:60-231):
+a real server on ephemeral ports with a channel sink, driven by real
+UDP/TCP/UNIX traffic, short flush intervals, assertions on flushed batches.
+"""
+
+import os
+import socket
+import time
+
+import pytest
+
+from veneur_tpu.config import Config
+from veneur_tpu.protocol import ssf_pb2, wire
+from veneur_tpu.server import Server, calculate_tick_delay
+from veneur_tpu.sinks import ChannelMetricSink, ChannelSpanSink
+
+
+def make_server(tmp_path=None, **cfg_kwargs):
+    cfg_kwargs.setdefault("statsd_listen_addresses", ["udp://127.0.0.1:0"])
+    cfg_kwargs.setdefault("interval", "86400s")  # flush manually in tests
+    cfg_kwargs.setdefault("store_initial_capacity", 32)
+    cfg_kwargs.setdefault("store_chunk", 128)
+    cfg_kwargs.setdefault("aggregates", ["min", "max", "count"])
+    config = Config(**cfg_kwargs)
+    sink = ChannelMetricSink()
+    server = Server(config, metric_sinks=[sink])
+    server.start()
+    return server, sink
+
+
+def send_udp(addr, payload: bytes):
+    s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    s.sendto(payload, addr)
+    s.close()
+
+
+def wait_for(predicate, timeout=5.0, interval=0.01):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval)
+    return False
+
+
+class TestUDPMetrics:
+    def test_counter_over_udp(self):
+        server, sink = make_server()
+        try:
+            addr = server.statsd_addrs[0]
+            send_udp(addr, b"a.b.c:1|c")
+            assert wait_for(lambda: server.store.processed >= 1)
+            server.flush()
+            batch = sink.get_flush()
+            assert any(m.name == "a.b.c" and m.value == 1.0 for m in batch)
+        finally:
+            server.shutdown()
+
+    def test_multiline_datagram(self):
+        server, sink = make_server()
+        try:
+            addr = server.statsd_addrs[0]
+            send_udp(addr, b"x:1|c\ny:2|g\nz:3.5|h|#env:dev")
+            assert wait_for(lambda: server.store.processed >= 3)
+            server.flush()
+            names = {m.name for m in sink.get_flush()}
+            assert {"x", "y", "z.count", "z.max", "z.min"} <= names
+        finally:
+            server.shutdown()
+
+    def test_mixed_metrics_local_flush(self):
+        # port of TestLocalServerMixedMetrics (server_test.go:294-408):
+        # a local instance flushes counters + histogram aggregates but
+        # keeps percentiles for the global tier
+        server, sink = make_server(forward_address="http://upstream.invalid",
+                                   percentiles=[0.5, 0.9])
+        try:
+            addr = server.statsd_addrs[0]
+            for v in (1, 2, 3, 4, 5):
+                send_udp(addr, f"a.b.latency:{v}|ms".encode())
+            send_udp(addr, b"a.b.hits:100|c")
+            assert wait_for(lambda: server.store.processed >= 6)
+            server.flush()
+            batch = sink.get_flush()
+            by_name = {m.name: m for m in batch}
+            assert by_name["a.b.hits"].value == 100.0
+            assert by_name["a.b.latency.min"].value == 1.0
+            assert by_name["a.b.latency.max"].value == 5.0
+            assert by_name["a.b.latency.count"].value == 5.0
+            assert "a.b.latency.50percentile" not in by_name
+        finally:
+            server.shutdown()
+
+    def test_multiple_udp_readers_share_port(self):
+        server, sink = make_server(num_readers=4)
+        try:
+            addr = server.statsd_addrs[0]
+            # all readers must be on the same port
+            assert len({a[1] for a in server.statsd_addrs}) == 1
+            for i in range(100):
+                send_udp(addr, f"c{i % 10}:1|c".encode())
+            assert wait_for(lambda: server.store.processed >= 100)
+            server.flush()
+            batch = sink.get_flush()
+            assert sum(m.value for m in batch) == 100.0
+        finally:
+            server.shutdown()
+
+    def test_events_reach_flush_other_samples(self):
+        server, sink = make_server()
+
+        received = []
+        sink.flush_other_samples = received.extend
+        try:
+            addr = server.statsd_addrs[0]
+            send_udp(addr, b"_e{5,4}:title|text")
+            assert wait_for(lambda: len(server.event_worker._samples) >= 1)
+            server.flush()
+            assert received and received[0].name == "title"
+        finally:
+            server.shutdown()
+
+    def test_bad_packets_counted_not_fatal(self):
+        server, sink = make_server()
+        try:
+            addr = server.statsd_addrs[0]
+            send_udp(addr, b"garbage")
+            send_udp(addr, b"ok:1|c")
+            assert wait_for(lambda: server.store.processed >= 1)
+            assert wait_for(lambda: server.packet_errors >= 1)
+            server.flush()
+            assert {m.name for m in sink.get_flush()} == {"ok"}
+        finally:
+            server.shutdown()
+
+
+class TestTCPMetrics:
+    def test_counter_over_tcp(self):
+        server, sink = make_server(
+            statsd_listen_addresses=["tcp://127.0.0.1:0"])
+        try:
+            addr = server.statsd_addrs[0]
+            c = socket.create_connection(addr)
+            c.sendall(b"t.c.p:7|c\n")
+            c.close()
+            assert wait_for(lambda: server.store.processed >= 1)
+            server.flush()
+            assert sink.get_flush()[0].value == 7.0
+        finally:
+            server.shutdown()
+
+
+class TestSSF:
+    def _span(self, with_metric=True):
+        span = ssf_pb2.SSFSpan(
+            id=1, trace_id=1, name="a.span", service="svc",
+            start_timestamp=10**18, end_timestamp=10**18 + 5 * 10**6)
+        if with_metric:
+            span.metrics.add(
+                metric=ssf_pb2.SSFSample.COUNTER, name="ssf.count",
+                value=2.0, sample_rate=1.0)
+        return span
+
+    def test_udp_ssf_metrics_extracted(self):
+        server, sink = make_server(ssf_listen_addresses=["udp://127.0.0.1:0"])
+        try:
+            addr = server.ssf_addrs[0]
+            send_udp(addr, self._span().SerializeToString())
+            assert wait_for(lambda: server.store.processed >= 1)
+            server.flush()
+            by_name = {m.name: m for m in sink.get_flush()}
+            assert by_name["ssf.count"].value == 2.0
+        finally:
+            server.shutdown()
+
+    def test_unix_framed_ssf(self, tmp_path):
+        sock_path = str(tmp_path / "ssf.sock")
+        server, sink = make_server(
+            ssf_listen_addresses=[f"unix://{sock_path}"])
+        try:
+            assert wait_for(lambda: os.path.exists(sock_path))
+            c = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+            c.connect(sock_path)
+            f = c.makefile("wb")
+            for _ in range(3):
+                wire.write_ssf(f, self._span())
+            f.flush()
+            c.close()
+            assert wait_for(lambda: server.store.processed >= 3)
+            server.flush()
+            by_name = {m.name: m for m in sink.get_flush()}
+            assert by_name["ssf.count"].value == 6.0
+        finally:
+            server.shutdown()
+
+    def test_spans_reach_span_sinks(self):
+        span_sink = ChannelSpanSink()
+        config = Config(statsd_listen_addresses=[],
+                        ssf_listen_addresses=["udp://127.0.0.1:0"],
+                        interval="86400s")
+        server = Server(config, metric_sinks=[], span_sinks=[span_sink])
+        server.start()
+        try:
+            addr = server.ssf_addrs[0]
+            send_udp(addr, self._span(with_metric=False).SerializeToString())
+            assert wait_for(lambda: not span_sink.queue.empty())
+            got = span_sink.queue.get_nowait()
+            assert got.name == "a.span"
+        finally:
+            server.shutdown()
+
+    def test_indicator_span_timer(self):
+        server, sink = make_server(
+            ssf_listen_addresses=["udp://127.0.0.1:0"],
+            indicator_span_timer_name="indicator.timer")
+        try:
+            span = self._span(with_metric=False)
+            span.indicator = True
+            send_udp(server.ssf_addrs[0], span.SerializeToString())
+            assert wait_for(lambda: server.store.processed >= 1)
+            server.flush()
+            by_name = {m.name: m for m in sink.get_flush()}
+            # duration is 5e6 ns
+            assert by_name["indicator.timer.max"].value == pytest.approx(5e6)
+        finally:
+            server.shutdown()
+
+
+class TestFlushTicker:
+    def test_tick_delay_alignment(self):
+        assert calculate_tick_delay(10.0, 1000.0) == pytest.approx(10.0)
+        assert calculate_tick_delay(10.0, 1003.5) == pytest.approx(6.5)
+
+    def test_periodic_flush(self):
+        server, sink = make_server(interval="200ms")
+        try:
+            send_udp(server.statsd_addrs[0], b"tick:1|c")
+            batch = sink.get_flush(timeout=5.0)
+            assert batch[0].name == "tick"
+        finally:
+            server.shutdown()

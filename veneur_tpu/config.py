@@ -1,0 +1,296 @@
+"""Configuration: one YAML file + ``VENEUR_*`` environment overrides.
+
+Behavioral port of ``/root/reference/config.go`` + ``config_parse.go``:
+the same key set (plus TPU-specific extensions at the bottom), semi-strict
+YAML parsing that warns on unknown keys instead of failing, envconfig-style
+overrides, defaults and deprecation shims.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import os
+import re
+import socket
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+import yaml
+
+log = logging.getLogger("veneur")
+
+
+class UnknownConfigKeys(Exception):
+    """The file is usable but contains unknown keys (config_parse.go:119-127)."""
+
+    def __init__(self, keys):
+        super().__init__(f"unknown configuration keys: {sorted(keys)}")
+        self.keys = keys
+
+
+@dataclass
+class Config:
+    """Server configuration (config.go:3-89). Field names are the YAML keys."""
+
+    aggregates: List[str] = field(default_factory=list)
+    aws_access_key_id: str = ""
+    aws_region: str = ""
+    aws_s3_bucket: str = ""
+    aws_secret_access_key: str = ""
+    block_profile_rate: int = 0
+    datadog_api_hostname: str = ""
+    datadog_api_key: str = ""
+    datadog_flush_max_per_body: int = 0
+    datadog_span_buffer_size: int = 0
+    datadog_trace_api_address: str = ""
+    debug: bool = False
+    debug_flushed_metrics: bool = False
+    debug_ingested_spans: bool = False
+    enable_profiling: bool = False
+    falconer_address: str = ""
+    flush_file: str = ""
+    flush_max_per_body: int = 0  # deprecated → datadog_flush_max_per_body
+    forward_address: str = ""
+    forward_use_grpc: bool = False
+    grpc_address: str = ""
+    hostname: str = ""
+    http_address: str = ""
+    indicator_span_timer_name: str = ""
+    interval: str = ""
+    kafka_broker: str = ""
+    kafka_check_topic: str = ""
+    kafka_event_topic: str = ""
+    kafka_metric_buffer_bytes: int = 0
+    kafka_metric_buffer_frequency: str = ""
+    kafka_metric_buffer_messages: int = 0
+    kafka_metric_require_acks: str = ""
+    kafka_metric_topic: str = ""
+    kafka_partitioner: str = ""
+    kafka_retry_max: int = 0
+    kafka_span_buffer_bytes: int = 0
+    kafka_span_buffer_frequency: str = ""
+    kafka_span_buffer_mesages: int = 0  # (sic — reference key has the typo)
+    kafka_span_require_acks: str = ""
+    kafka_span_sample_rate_percent: int = 0
+    kafka_span_sample_tag: str = ""
+    kafka_span_serialization_format: str = ""
+    kafka_span_topic: str = ""
+    lightstep_access_token: str = ""
+    lightstep_collector_host: str = ""
+    lightstep_maximum_spans: int = 0
+    lightstep_num_clients: int = 0
+    lightstep_reconnect_period: str = ""
+    metric_max_length: int = 0
+    mutex_profile_fraction: int = 0
+    num_readers: int = 0
+    num_span_workers: int = 0
+    num_workers: int = 0
+    omit_empty_hostname: bool = False
+    percentiles: List[float] = field(default_factory=list)
+    read_buffer_size_bytes: int = 0
+    sentry_dsn: str = ""
+    signalfx_api_key: str = ""
+    signalfx_endpoint_base: str = ""
+    signalfx_hostname_tag: str = ""
+    signalfx_per_tag_api_keys: List[Dict[str, str]] = field(default_factory=list)
+    signalfx_vary_key_by: str = ""
+    span_channel_capacity: int = 0
+    ssf_buffer_size: int = 0  # deprecated → datadog_span_buffer_size
+    ssf_listen_addresses: List[str] = field(default_factory=list)
+    stats_address: str = ""
+    statsd_listen_addresses: List[str] = field(default_factory=list)
+    synchronize_with_interval: bool = False
+    tags: List[str] = field(default_factory=list)
+    tags_exclude: List[str] = field(default_factory=list)
+    tls_authority_certificate: str = ""
+    tls_certificate: str = ""
+    tls_key: str = ""
+    trace_lightstep_access_token: str = ""   # deprecated
+    trace_lightstep_collector_host: str = ""  # deprecated
+    trace_lightstep_maximum_spans: int = 0    # deprecated
+    trace_lightstep_num_clients: int = 0      # deprecated
+    trace_lightstep_reconnect_period: str = ""  # deprecated
+    trace_max_length_bytes: int = 0
+
+    # ---- TPU-framework extensions (not in the reference) -----------------
+    # t-digest compression δ; the reference hard-codes 100 (samplers.go:502)
+    tdigest_compression: float = 100.0
+    # HyperLogLog precision p (2^p registers); the reference hard-codes the
+    # axiomhq default 14 (samplers.go:380-388)
+    hll_precision: int = 14
+    # staging-chunk length for device scatters
+    store_chunk: int = 16384
+    # initial dense-series capacity per scope-class (grows by doubling)
+    store_initial_capacity: int = 4096
+
+    def parse_interval(self) -> float:
+        return parse_duration(self.interval)
+
+    def apply_defaults(self):
+        """Defaults + deprecation shims (config_parse.go:118-185)."""
+        if not self.aggregates:
+            self.aggregates = ["min", "max", "count"]
+        if not self.hostname and not self.omit_empty_hostname:
+            self.hostname = socket.gethostname()
+        if not self.interval:
+            self.interval = "10s"
+        if not self.metric_max_length:
+            self.metric_max_length = 4096
+        if not self.read_buffer_size_bytes:
+            self.read_buffer_size_bytes = 2 * 1048576
+        if self.ssf_buffer_size:
+            log.warning("ssf_buffer_size has been replaced by "
+                        "datadog_span_buffer_size and will be removed")
+            if not self.datadog_span_buffer_size:
+                self.datadog_span_buffer_size = self.ssf_buffer_size
+        if self.flush_max_per_body:
+            log.warning("flush_max_per_body has been replaced by "
+                        "datadog_flush_max_per_body and will be removed")
+            if not self.datadog_flush_max_per_body:
+                self.datadog_flush_max_per_body = self.flush_max_per_body
+        for old, new in (("trace_lightstep_access_token", "lightstep_access_token"),
+                         ("trace_lightstep_collector_host", "lightstep_collector_host"),
+                         ("trace_lightstep_maximum_spans", "lightstep_maximum_spans"),
+                         ("trace_lightstep_num_clients", "lightstep_num_clients"),
+                         ("trace_lightstep_reconnect_period", "lightstep_reconnect_period")):
+            oldv = getattr(self, old)
+            if oldv:
+                log.warning("%s has been replaced by %s and will be removed",
+                            old, new)
+                if not getattr(self, new):
+                    setattr(self, new, oldv)
+        if not self.datadog_flush_max_per_body:
+            self.datadog_flush_max_per_body = 25000
+        if not self.span_channel_capacity:
+            self.span_channel_capacity = 100
+        if not self.num_workers:
+            self.num_workers = 1
+        if not self.num_readers:
+            self.num_readers = 1
+        if not self.num_span_workers:
+            self.num_span_workers = 1
+        if not self.datadog_span_buffer_size:
+            self.datadog_span_buffer_size = 16384
+        if not self.trace_max_length_bytes:
+            self.trace_max_length_bytes = 16 * 1024
+        return self
+
+
+@dataclass
+class ProxyConfig:
+    """Proxy configuration (config_proxy.go:3-18)."""
+
+    consul_forward_service_name: str = ""
+    consul_refresh_interval: str = ""
+    consul_trace_service_name: str = ""
+    debug: bool = False
+    enable_profiling: bool = False
+    forward_address: str = ""
+    forward_timeout: str = ""
+    http_address: str = ""
+    runtime_metrics_interval: str = ""
+    sentry_dsn: str = ""
+    ssf_destination_address: str = ""
+    stats_address: str = ""
+    trace_address: str = ""
+    trace_api_address: str = ""
+    grpc_forward_address: str = ""  # extension: gRPC proxy listener
+
+
+_DURATION_RE = re.compile(r"(\d+(?:\.\d+)?)(ns|us|µs|ms|s|m|h)")
+_DURATION_UNITS = {"ns": 1e-9, "us": 1e-6, "µs": 1e-6, "ms": 1e-3,
+                   "s": 1.0, "m": 60.0, "h": 3600.0}
+
+
+def parse_duration(s: str) -> float:
+    """Go-style duration string → seconds ("10s", "1m30s", "50ms")."""
+    if not s:
+        raise ValueError("empty duration")
+    pos = 0
+    total = 0.0
+    for m in _DURATION_RE.finditer(s):
+        if m.start() != pos:
+            raise ValueError(f"invalid duration {s!r}")
+        total += float(m.group(1)) * _DURATION_UNITS[m.group(2)]
+        pos = m.end()
+    if pos != len(s):
+        raise ValueError(f"invalid duration {s!r}")
+    return total
+
+
+def _coerce(value: str, target_type: Any):
+    if target_type is bool:
+        return value.lower() in ("1", "true", "yes", "on")
+    if target_type is int:
+        return int(value)
+    if target_type is float:
+        return float(value)
+    if target_type in (List[str], List[float], List[Dict[str, str]]):
+        items = [v.strip() for v in value.split(",") if v.strip()]
+        if target_type is List[float]:
+            return [float(v) for v in items]
+        return items
+    return value
+
+
+def _apply_env_overrides(cfg, environ=None):
+    """envconfig-style overrides (config_parse.go:107-115): VENEUR_<FIELD>
+    where <FIELD> is the field name uppercased, with or without underscores
+    (the Go library strips them from struct field names)."""
+    import typing
+
+    environ = environ if environ is not None else os.environ
+    hints = typing.get_type_hints(type(cfg))
+    names = {f.name for f in dataclasses.fields(cfg)}
+    compact = {name.replace("_", "").upper(): name for name in names}
+    for env_key, raw in environ.items():
+        if not env_key.startswith("VENEUR_"):
+            continue
+        suffix = env_key[len("VENEUR_"):]
+        name = (suffix.lower() if suffix.lower() in names
+                else compact.get(suffix.replace("_", "").upper()))
+        if name is None:
+            continue
+        setattr(cfg, name, _coerce(raw, hints[name]))
+    return cfg
+
+
+def _load_semi_strict(text: str, cls):
+    """Strict-then-loose YAML load: unknown keys are reported but do not
+    fail the load (unmarshalSemiStrictly, config_parse.go:83-96)."""
+    data = yaml.safe_load(text) or {}
+    if not isinstance(data, dict):
+        raise ValueError("config must be a YAML mapping")
+    known = {f.name for f in dataclasses.fields(cls)}
+    unknown = set(data) - known
+    cfg = cls(**{k: v for k, v in data.items() if k in known and v is not None})
+    return cfg, unknown
+
+
+def read_config(path: str, environ=None) -> Config:
+    """Load + env-override + defaults (ReadConfig, config_parse.go:66-79).
+    Raises UnknownConfigKeys *after* producing a usable config only when
+    the caller inspects .partial — here we just warn, as the binaries do."""
+    with open(path) as f:
+        text = f.read()
+    cfg, unknown = _load_semi_strict(text, Config)
+    _apply_env_overrides(cfg, environ)
+    cfg.apply_defaults()
+    if unknown:
+        log.warning("config contains unknown keys: %s", sorted(unknown))
+    return cfg
+
+
+def read_proxy_config(path: str, environ=None) -> ProxyConfig:
+    with open(path) as f:
+        text = f.read()
+    cfg, unknown = _load_semi_strict(text, ProxyConfig)
+    _apply_env_overrides(cfg, environ)
+    if unknown:
+        log.warning("proxy config contains unknown keys: %s", sorted(unknown))
+    if not cfg.forward_timeout:
+        cfg.forward_timeout = "10s"
+    if not cfg.consul_refresh_interval:
+        cfg.consul_refresh_interval = "30s"
+    return cfg

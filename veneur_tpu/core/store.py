@@ -49,7 +49,6 @@ import numpy as np
 from veneur_tpu.ops import hll as hll_ops
 from veneur_tpu.ops import tdigest as td_ops
 from veneur_tpu.samplers.intermetric import (
-    AGGREGATE_SUFFIX,
     Aggregate,
     HistogramAggregates,
     InterMetric,
@@ -511,12 +510,17 @@ class SetGroup:
         self._drain_samples()
         self._drain_imports()
 
-    def flush(self):
+    def flush(self, want_estimates: bool = True, want_registers: bool = True):
+        """Estimate/export only what the caller will consume: a local
+        instance forwards registers without estimating; a discarding flush
+        (no sinks, no forwarding) skips both device passes."""
         self._drain_staging()
         n = len(self.interner)
         interner, self.interner = self.interner, Interner()
-        estimates = np.asarray(_estimate_all(self.registers)[:n])
-        registers = np.asarray(self.registers[:n], np.uint8)
+        estimates = (np.asarray(_estimate_all(self.registers)[:n])
+                     if want_estimates else None)
+        registers = (np.asarray(self.registers[:n], np.uint8)
+                     if want_registers else None)
         self.registers = jnp.zeros((self.capacity, self.m), jnp.int8)
         self._init_staging()
         return interner, estimates, registers
@@ -805,7 +809,8 @@ class MetricStore:
     def _flush_set_group(self, group: SetGroup,
                          out: Optional[List[InterMetric]], now: int,
                          fwd_list: Optional[list]):
-        interner, estimates, registers = group.flush()
+        interner, estimates, registers = group.flush(
+            want_estimates=out is not None, want_registers=fwd_list is not None)
         if out is None and fwd_list is None:
             return
         for key, row in interner.rows.items():

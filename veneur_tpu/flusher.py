@@ -1,0 +1,95 @@
+"""Flush orchestration: drain the store, fan out to sinks, forward upstream.
+
+Behavioral port of ``/root/reference/flusher.go:26-132``: events flush to
+every metric sink's ``flush_other_samples``; span sinks flush; the store
+drains into InterMetrics (percentiles suppressed for mixed histograms on a
+local instance); a local instance hands forwardable sketch state to the
+forwarding layer; each metric sink gets the final batch on its own thread;
+plugins run after the sinks.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import TYPE_CHECKING
+
+from veneur_tpu.sinks.base import filter_acceptable
+
+if TYPE_CHECKING:
+    from veneur_tpu.server import Server
+
+log = logging.getLogger("veneur.flusher")
+
+
+def flush_once(server: "Server"):
+    now = int(time.time())
+
+    # events → FlushOtherSamples on each metric sink (flusher.go:42-47)
+    samples = server.event_worker.flush()
+    for sink in server.metric_sinks:
+        try:
+            sink.flush_other_samples(samples)
+        except Exception:
+            log.exception("sink %s flush_other_samples failed", sink.name)
+
+    # span sinks flush concurrently with the metric path (flusher.go:49)
+    span_flusher = threading.Thread(
+        target=_flush_spans, args=(server,), daemon=True)
+    span_flusher.start()
+
+    is_local = server.is_local()
+    if is_local and server.forward_fn is None and not server._warned_no_forward:
+        server._warned_no_forward = True
+        log.warning("forward_address is set but no forwarding layer is "
+                    "registered; global-scope state (sets, digests, global "
+                    "counters/gauges) will be dropped each interval")
+    percentiles = server.histogram_percentiles
+    t0 = time.perf_counter()
+    final_metrics, forwardable, ms = server.store.flush(
+        percentiles, server.histogram_aggregates, is_local=is_local, now=now,
+        forward=is_local and server.forward_fn is not None)
+    flush_elapsed = time.perf_counter() - t0
+    log.debug("store flush took %.1f ms (%s)", flush_elapsed * 1e3, ms)
+
+    # local → global forwarding happens off the flush path (flusher.go:66-75)
+    if is_local and server.forward_fn is not None and len(forwardable):
+        threading.Thread(target=server.forward_fn, args=(forwardable,),
+                         daemon=True).start()
+
+    if not final_metrics:
+        span_flusher.join(timeout=10.0)
+        return
+
+    # one thread per metric sink (flusher.go:82-93)
+    threads = []
+    for sink in server.metric_sinks:
+        t = threading.Thread(target=_flush_sink, args=(sink, final_metrics),
+                             daemon=True)
+        t.start()
+        threads.append(t)
+    for t in threads:
+        t.join(timeout=30.0)
+
+    # plugins run after the sinks (flusher.go:95-109)
+    for plugin in server.plugins:
+        try:
+            plugin.flush(final_metrics)
+        except Exception:
+            log.exception("plugin %s flush failed", plugin.name)
+
+    span_flusher.join(timeout=10.0)
+
+
+def _flush_sink(sink, metrics):
+    try:
+        sink.flush(filter_acceptable(metrics, sink.name))
+    except Exception:
+        log.exception("sink %s flush failed", sink.name)
+
+
+def _flush_spans(server: "Server"):
+    for w in server._span_workers:
+        w.flush()
+        break  # sinks are shared between workers; flush each sink once

@@ -1,0 +1,248 @@
+"""Listener bring-up: UDP (SO_REUSEPORT multi-reader), TCP (+TLS), UNIX SSF.
+
+Behavioral port of ``/root/reference/networking.go`` + ``socket_linux.go``:
+``num_readers`` UDP sockets bound to one port with SO_REUSEPORT so the
+kernel load-balances packets across reader threads (networking.go:37-87,
+socket_linux.go:12-76); TCP listeners with optional TLS client-cert
+authentication (networking.go:93-134); UNIX-domain stream listeners for
+framed SSF (networking.go:162-223).
+"""
+
+from __future__ import annotations
+
+import errno
+import logging
+import os
+import socket
+import ssl
+import threading
+from typing import Callable, List, Optional
+
+from veneur_tpu.protocol.addr import ResolvedAddr, resolve_addr
+
+log = logging.getLogger("veneur.networking")
+
+
+def new_udp_socket(addr: ResolvedAddr, recv_buf: int,
+                   reuse_port: bool) -> socket.socket:
+    """A bound UDP socket with SO_REUSEPORT + SO_RCVBUF
+    (socket_linux.go:12-76)."""
+    sock = socket.socket(addr.socket_family, socket.SOCK_DGRAM)
+    sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    if reuse_port:
+        sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEPORT, 1)
+    if recv_buf:
+        sock.setsockopt(socket.SOL_SOCKET, socket.SO_RCVBUF, recv_buf)
+    sock.bind((addr.host, addr.port))
+    return sock
+
+
+def start_statsd(addr_spec: str, num_readers: int, recv_buf: int,
+                 metric_max_length: int,
+                 handle_packet: Callable[[bytes], None],
+                 stop: threading.Event,
+                 handle_tcp_line: Optional[Callable[[bytes], None]] = None,
+                 tls_config: Optional[ssl.SSLContext] = None,
+                 ):
+    """Start DogStatsD listeners for one address spec (networking.go:18-35).
+
+    UDP: num_readers reader threads each with its own SO_REUSEPORT socket.
+    TCP: an accept loop spawning per-connection line readers.
+    Returns (reader threads — daemons, already started; bound addresses).
+    """
+    addr = resolve_addr(addr_spec)
+    threads: List[threading.Thread] = []
+    bound: List[tuple] = []
+    if addr.family == "udp":
+        for i in range(num_readers):
+            sock = new_udp_socket(addr, recv_buf, reuse_port=num_readers > 1)
+            bound.append(sock.getsockname())
+            # with an ephemeral port (":0"), later readers must share the
+            # port the first one got
+            if addr.port == 0:
+                addr = ResolvedAddr(scheme=addr.scheme, family="udp",
+                                    host=addr.host, port=sock.getsockname()[1])
+            t = threading.Thread(
+                target=_udp_read_loop,
+                args=(sock, metric_max_length, handle_packet, stop),
+                name=f"statsd-udp-reader-{i}", daemon=True)
+            t.start()
+            threads.append(t)
+    elif addr.family == "tcp":
+        listener = socket.socket(addr.socket_family, socket.SOCK_STREAM)
+        listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        listener.bind((addr.host, addr.port))
+        listener.listen(128)
+        bound.append(listener.getsockname())
+        t = threading.Thread(
+            target=_tcp_accept_loop,
+            args=(listener, metric_max_length,
+                  handle_tcp_line or handle_packet, stop, tls_config),
+            name="statsd-tcp-listener", daemon=True)
+        t.start()
+        threads.append(t)
+    else:
+        raise ValueError(f"statsd listen address must be udp or tcp: {addr_spec}")
+    return threads, bound
+
+
+def _udp_read_loop(sock: socket.socket, max_len: int,
+                   handle_packet: Callable[[bytes], None],
+                   stop: threading.Event):
+    """Per-reader receive loop (server.go:795-825). Each datagram may hold
+    several newline-separated metrics; oversize datagrams are truncated by
+    the OS and the tail line is dropped by the parser."""
+    sock.settimeout(0.5)
+    while not stop.is_set():
+        try:
+            data = sock.recv(max_len)
+        except socket.timeout:
+            continue
+        except OSError as e:
+            if stop.is_set() or e.errno in (errno.EBADF,):
+                break
+            log.error("UDP recv error: %s", e)
+            continue
+        if data:
+            handle_packet(data)
+    sock.close()
+
+
+def _tcp_accept_loop(listener: socket.socket, max_len: int,
+                     handle_line: Callable[[bytes], None],
+                     stop: threading.Event,
+                     tls_config: Optional[ssl.SSLContext]):
+    """Accept loop + per-connection readers (server.go:901-1001)."""
+    listener.settimeout(0.5)
+    while not stop.is_set():
+        try:
+            conn, peer = listener.accept()
+        except socket.timeout:
+            continue
+        except OSError:
+            break
+        if tls_config is not None:
+            try:
+                conn = tls_config.wrap_socket(conn, server_side=True)
+            except ssl.SSLError as e:
+                log.warning("TLS handshake failed from %s: %s", peer, e)
+                conn.close()
+                continue
+        t = threading.Thread(target=_tcp_conn_loop,
+                             args=(conn, max_len, handle_line, stop),
+                             daemon=True)
+        t.start()
+    listener.close()
+
+
+def _tcp_conn_loop(conn: socket.socket, max_len: int,
+                   handle_line: Callable[[bytes], None],
+                   stop: threading.Event):
+    """Newline-scan a TCP connection; a single line longer than max_len
+    poisons the connection (server.go:920-983)."""
+    conn.settimeout(0.5)
+    buf = bytearray()
+    while not stop.is_set():
+        try:
+            data = conn.recv(65536)
+        except socket.timeout:
+            continue
+        except OSError:
+            break
+        if not data:
+            break
+        buf.extend(data)
+        while True:
+            nl = buf.find(b"\n")
+            if nl == -1:
+                break
+            line = bytes(buf[:nl])
+            del buf[:nl + 1]
+            if line:
+                handle_line(line)
+        if len(buf) > max_len:
+            log.warning("Line longer than max_length, closing connection")
+            break
+    conn.close()
+
+
+def make_server_tls_context(cert_path: str, key_path: str,
+                            ca_path: str = "") -> ssl.SSLContext:
+    """TLS listener context; a CA cert turns on required client-cert auth
+    (server.go:314-348)."""
+    ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+    ctx.load_cert_chain(cert_path, key_path)
+    if ca_path:
+        ctx.verify_mode = ssl.CERT_REQUIRED
+        ctx.load_verify_locations(ca_path)
+    return ctx
+
+
+def start_ssf(addr_spec: str, num_readers: int, recv_buf: int,
+              trace_max_length: int,
+              handle_ssf_packet: Callable[[bytes], None],
+              handle_ssf_stream: Callable[[socket.socket], None],
+              stop: threading.Event):
+    """Start SSF listeners (networking.go:138-223): UDP datagrams carry one
+    bare SSFSpan protobuf each; UNIX/TCP streams carry framed spans.
+    Returns (threads, bound addresses)."""
+    addr = resolve_addr(addr_spec)
+    threads: List[threading.Thread] = []
+    bound: List = []
+    if addr.family == "udp":
+        for i in range(num_readers):
+            sock = new_udp_socket(addr, recv_buf, reuse_port=num_readers > 1)
+            bound.append(sock.getsockname())
+            if addr.port == 0:
+                addr = ResolvedAddr(scheme=addr.scheme, family="udp",
+                                    host=addr.host, port=sock.getsockname()[1])
+            t = threading.Thread(
+                target=_udp_read_loop,
+                args=(sock, trace_max_length, handle_ssf_packet, stop),
+                name=f"ssf-udp-reader-{i}", daemon=True)
+            t.start()
+            threads.append(t)
+    elif addr.family == "unix":
+        if os.path.exists(addr.path):
+            os.unlink(addr.path)
+        listener = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        listener.bind(addr.path)
+        listener.listen(128)
+        bound.append(addr.path)
+        t = threading.Thread(
+            target=_stream_accept_loop,
+            args=(listener, handle_ssf_stream, stop),
+            name="ssf-unix-listener", daemon=True)
+        t.start()
+        threads.append(t)
+    elif addr.family == "tcp":
+        listener = socket.socket(addr.socket_family, socket.SOCK_STREAM)
+        listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        listener.bind((addr.host, addr.port))
+        listener.listen(128)
+        bound.append(listener.getsockname())
+        t = threading.Thread(
+            target=_stream_accept_loop,
+            args=(listener, handle_ssf_stream, stop),
+            name="ssf-tcp-listener", daemon=True)
+        t.start()
+        threads.append(t)
+    else:
+        raise ValueError(f"unsupported SSF listen address {addr_spec}")
+    return threads, bound
+
+
+def _stream_accept_loop(listener: socket.socket,
+                        handle_stream: Callable[[socket.socket], None],
+                        stop: threading.Event):
+    listener.settimeout(0.5)
+    while not stop.is_set():
+        try:
+            conn, _ = listener.accept()
+        except socket.timeout:
+            continue
+        except OSError:
+            break
+        t = threading.Thread(target=handle_stream, args=(conn,), daemon=True)
+        t.start()
+    listener.close()

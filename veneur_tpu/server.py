@@ -1,0 +1,286 @@
+"""Server lifecycle: config → listeners → store → flush loop.
+
+Behavioral port of ``/root/reference/server.go``: ingest dispatch
+(``handle_metric_packet``, server.go:670-720), SSF handling
+(server.go:722-792), read loops (via ``networking.py``), the
+interval-aligned flush ticker (server.go:638-665, ``calculate_tick_delay``
+server.go:1163-1177), and lifecycle (``start``/``shutdown``,
+server.go:555-666, 1095-1130).
+
+Two process roles share this class (server.go:1132-1137): a **local**
+instance (``forward_address`` set) flushes host-local aggregates to sinks
+and forwards sketch state upstream; a **global** instance merges imported
+sketches and emits percentiles.
+"""
+
+from __future__ import annotations
+
+import logging
+import math
+import queue
+import threading
+import time
+from typing import Callable, List, Optional
+
+from veneur_tpu import networking
+from veneur_tpu.config import Config, parse_duration
+from veneur_tpu.core.store import MetricStore
+from veneur_tpu.protocol import wire
+from veneur_tpu.samplers import parser as p
+from veneur_tpu.samplers.intermetric import HistogramAggregates
+from veneur_tpu.sinks.base import MetricSink, SpanSink
+from veneur_tpu.sinks.ssfmetrics import MetricExtractionSink
+
+log = logging.getLogger("veneur.server")
+
+
+class EventWorker:
+    """Collects events (as SSFSamples) until flush (worker.go:439-485)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._samples: List = []
+
+    def add(self, sample):
+        with self._lock:
+            self._samples.append(sample)
+
+    def flush(self) -> List:
+        with self._lock:
+            out, self._samples = self._samples, []
+        return out
+
+
+class SpanWorker:
+    """Drains the span channel into every span sink (worker.go:487-592)."""
+
+    def __init__(self, sinks: List[SpanSink], span_chan: "queue.Queue",
+                 stop: threading.Event):
+        self.sinks = sinks
+        self.chan = span_chan
+        self.stop = stop
+        self.ingested = 0
+
+    def work(self):
+        while not self.stop.is_set():
+            try:
+                span = self.chan.get(timeout=0.5)
+            except queue.Empty:
+                continue
+            self.ingested += 1
+            for sink in self.sinks:
+                try:
+                    sink.ingest(span)
+                except Exception:
+                    log.exception("span sink %s ingest failed", sink.name)
+
+    def flush(self):
+        for sink in self.sinks:
+            try:
+                sink.flush()
+            except Exception:
+                log.exception("span sink %s flush failed", sink.name)
+
+
+def calculate_tick_delay(interval: float, now: float) -> float:
+    """Seconds until the next interval boundary (server.go:1163-1177)."""
+    return interval - math.fmod(now, interval)
+
+
+class Server:
+    """The aggregation server. Use ``Server(config)`` then ``start()``."""
+
+    def __init__(self, config: Config,
+                 metric_sinks: Optional[List[MetricSink]] = None,
+                 span_sinks: Optional[List[SpanSink]] = None):
+        config.apply_defaults()
+        self.config = config
+        self.interval = parse_duration(config.interval)
+        self.hostname = config.hostname
+        self.tags = list(config.tags)
+        self.tags_exclude = set(config.tags_exclude)
+        self.histogram_percentiles = list(config.percentiles)
+        self.histogram_aggregates = HistogramAggregates.from_names(
+            config.aggregates)
+
+        self.store = MetricStore(
+            initial_capacity=config.store_initial_capacity,
+            chunk=config.store_chunk,
+            compression=config.tdigest_compression,
+            hll_precision=config.hll_precision,
+        )
+        self.event_worker = EventWorker()
+        self.span_chan: "queue.Queue" = queue.Queue(config.span_channel_capacity)
+
+        self.metric_sinks: List[MetricSink] = list(metric_sinks or [])
+        self.span_sinks: List[SpanSink] = list(span_sinks or [])
+        # the extraction sink is how SSF samples reach the store
+        # (server.go:282-290)
+        self.span_sinks.append(MetricExtractionSink(
+            self.store.process_metric, config.indicator_span_timer_name))
+
+        self.plugins: List = []
+        # set by the forwarding layer (veneur_tpu.forward) when local
+        self.forward_fn: Optional[Callable] = None
+
+        self._stop = threading.Event()
+        self._threads: List[threading.Thread] = []
+        self._span_workers: List[SpanWorker] = []
+        self._flush_thread: Optional[threading.Thread] = None
+        self._tls_context = None
+        if config.tls_certificate and config.tls_key:
+            self._tls_context = networking.make_server_tls_context(
+                config.tls_certificate, config.tls_key,
+                config.tls_authority_certificate)
+
+        # ingest error/telemetry counters
+        self.packet_errors = 0
+        self._warned_no_forward = False
+        # bound listener addresses (useful when configured with port 0)
+        self.statsd_addrs: List = []
+        self.ssf_addrs: List = []
+
+    # -- role ---------------------------------------------------------------
+
+    def is_local(self) -> bool:
+        """forward_address set ⇒ local role (server.go:1132-1137)."""
+        return bool(self.config.forward_address)
+
+    # -- ingest dispatch ----------------------------------------------------
+
+    def handle_metric_packet(self, packet: bytes) -> bool:
+        """Parse one line and route it (server.go:670-720). Returns False on
+        a parse error (counted, logged at debug)."""
+        try:
+            if packet.startswith(b"_e{"):
+                self.event_worker.add(p.parse_event(packet))
+            elif packet.startswith(b"_sc"):
+                self.store.process_metric(p.parse_service_check(packet))
+            else:
+                self.store.process_metric(p.parse_metric(packet))
+        except p.ParseError as e:
+            self.packet_errors += 1
+            log.debug("rejected packet %r: %s", packet[:100], e)
+            return False
+        return True
+
+    def handle_packet(self, datagram: bytes):
+        """Split a datagram into metric lines (server.go:806-819)."""
+        for line in p.split_lines(datagram):
+            self.handle_metric_packet(line)
+
+    def handle_ssf_packet(self, datagram: bytes):
+        """One UDP datagram = one bare SSFSpan protobuf (server.go:827-860)."""
+        try:
+            span = wire.parse_ssf(datagram)
+        except Exception as e:
+            self.packet_errors += 1
+            log.debug("rejected SSF packet: %s", e)
+            return
+        self.handle_ssf(span)
+
+    def handle_ssf(self, span):
+        """Route a span to the span workers (server.go:753-792). Spans that
+        aren't valid traces but carry metrics still get their metrics
+        extracted; fully invalid spans are dropped."""
+        try:
+            self.span_chan.put_nowait(span)
+        except queue.Full:
+            log.warning("dropping span; span channel is full")
+
+    def handle_ssf_stream(self, conn):
+        """Framed-SSF stream pump; a framing error poisons the stream and
+        closes the connection (server.go:862-899)."""
+        stream = conn.makefile("rb")
+        try:
+            while not self._stop.is_set():
+                try:
+                    span = wire.read_ssf(stream)
+                except wire.FramingError as e:
+                    log.warning("SSF framing error, closing stream: %s", e)
+                    return
+                except Exception as e:
+                    # a whole frame was consumed, so the stream is at a clean
+                    # boundary — keep reading (server.go:888-895)
+                    self.packet_errors += 1
+                    log.debug("bad SSF message: %s", e)
+                    continue
+                if span is None:
+                    return
+                self.handle_ssf(span)
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def start(self):
+        """Bring up listeners, span workers and the flush ticker
+        (server.go:555-666)."""
+        cfg = self.config
+        for _ in range(max(1, cfg.num_span_workers)):
+            w = SpanWorker(self.span_sinks, self.span_chan, self._stop)
+            t = threading.Thread(target=w.work, name="span-worker", daemon=True)
+            t.start()
+            self._span_workers.append(w)
+            self._threads.append(t)
+
+        for sink in self.metric_sinks + self.span_sinks:
+            sink.start()
+
+        for addr in cfg.statsd_listen_addresses:
+            threads, bound = networking.start_statsd(
+                addr, max(1, cfg.num_readers), cfg.read_buffer_size_bytes,
+                cfg.metric_max_length, self.handle_packet, self._stop,
+                handle_tcp_line=self.handle_metric_packet,
+                tls_config=self._tls_context)
+            self._threads.extend(threads)
+            self.statsd_addrs.extend(bound)
+        for addr in cfg.ssf_listen_addresses:
+            threads, bound = networking.start_ssf(
+                addr, max(1, cfg.num_readers), cfg.read_buffer_size_bytes,
+                cfg.trace_max_length_bytes, self.handle_ssf_packet,
+                self.handle_ssf_stream, self._stop)
+            self._threads.extend(threads)
+            self.ssf_addrs.extend(bound)
+
+        self._flush_thread = threading.Thread(
+            target=self._flush_loop, name="flush-ticker", daemon=True)
+        self._flush_thread.start()
+        log.info("veneur server started (role=%s, interval=%.1fs)",
+                 "local" if self.is_local() else "global", self.interval)
+
+    def _flush_loop(self):
+        """Interval ticker, optionally aligned to wall-clock interval
+        boundaries (server.go:638-665)."""
+        if self.config.synchronize_with_interval:
+            delay = calculate_tick_delay(self.interval, time.time())
+            if self._stop.wait(delay):
+                return
+        while not self._stop.is_set():
+            # tickers fire *after* the interval elapses (server.go:643-665)
+            start = time.time()
+            if self._stop.wait(self.interval):
+                return
+            try:
+                self.flush()
+            except Exception:
+                log.exception("flush failed")
+            flush_took = (time.time() - start) - self.interval
+            if flush_took > self.interval:
+                log.warning("flush took %.2fs, %.2fs longer than the interval",
+                            flush_took, flush_took - self.interval)
+
+    def flush(self):
+        """One flush pass; see veneur_tpu.flusher."""
+        from veneur_tpu.flusher import flush_once
+
+        flush_once(self)
+
+    def shutdown(self):
+        """Graceful stop (server.go:1120-1130)."""
+        self._stop.set()
+        if self._flush_thread is not None:
+            self._flush_thread.join(timeout=5.0)

@@ -1,0 +1,25 @@
+"""Egress: metric sinks and span sinks.
+
+Mirrors ``/root/reference/sinks/sinks.go``: metric sinks receive the full
+``[]InterMetric`` batch once per flush; span sinks ingest spans as they
+arrive and flush periodically.
+"""
+
+from .base import MetricSink, SpanSink, is_acceptable_metric
+from .blackhole import BlackholeMetricSink, BlackholeSpanSink
+from .channel import ChannelMetricSink, ChannelSpanSink
+from .debug import DebugMetricSink, DebugSpanSink
+from .ssfmetrics import MetricExtractionSink
+
+__all__ = [
+    "MetricSink",
+    "SpanSink",
+    "is_acceptable_metric",
+    "BlackholeMetricSink",
+    "BlackholeSpanSink",
+    "ChannelMetricSink",
+    "ChannelSpanSink",
+    "DebugMetricSink",
+    "DebugSpanSink",
+    "MetricExtractionSink",
+]

@@ -1,0 +1,56 @@
+"""Sink interfaces (cf. /root/reference/sinks/sinks.go:31-97)."""
+
+from __future__ import annotations
+
+import abc
+from typing import Iterable, List
+
+from veneur_tpu.samplers.intermetric import InterMetric
+
+# Shared self-telemetry metric names (sinks.go:12-29,59-83)
+METRIC_KEY_TOTAL_SPANS_FLUSHED = "sink.spans_flushed_total"
+METRIC_KEY_TOTAL_SPANS_DROPPED = "sink.spans_dropped_total"
+METRIC_KEY_TOTAL_METRICS_FLUSHED = "sink.metrics_flushed_total"
+METRIC_KEY_TOTAL_METRICS_DROPPED = "sink.metrics_dropped_total"
+
+
+class MetricSink(abc.ABC):
+    """A backend receiving the full flushed-metric batch every interval."""
+
+    @property
+    @abc.abstractmethod
+    def name(self) -> str: ...
+
+    def start(self, trace_client=None) -> None:
+        """Called once at server start."""
+
+    @abc.abstractmethod
+    def flush(self, metrics: List[InterMetric]) -> None: ...
+
+    def flush_other_samples(self, samples: Iterable) -> None:
+        """Receive non-metric samples (events, ...); default: drop."""
+
+
+class SpanSink(abc.ABC):
+    """A backend receiving SSF spans as they arrive (sinks.go:85-97)."""
+
+    @property
+    @abc.abstractmethod
+    def name(self) -> str: ...
+
+    def start(self, trace_client=None) -> None: ...
+
+    @abc.abstractmethod
+    def ingest(self, span) -> None: ...
+
+    def flush(self) -> None: ...
+
+
+def is_acceptable_metric(metric: InterMetric, sink_name: str) -> bool:
+    """Routing check for veneursinkonly: tags (sinks.go:50-56)."""
+    return metric.is_acceptable_to(sink_name)
+
+
+def filter_acceptable(metrics: List[InterMetric],
+                      sink_name: str) -> List[InterMetric]:
+    return [m for m in metrics if m.is_acceptable_to(sink_name)]

@@ -1,0 +1,42 @@
+"""Queue-backed sinks for test assertions (cf. channelMetricSink,
+/root/reference/server_test.go:170-200)."""
+
+from __future__ import annotations
+
+import queue
+from typing import List
+
+from .base import MetricSink, SpanSink
+
+
+class ChannelMetricSink(MetricSink):
+    """Delivers each flush batch to a queue the test can drain."""
+
+    def __init__(self, maxsize: int = 0):
+        self.queue: "queue.Queue[List]" = queue.Queue(maxsize)
+
+    @property
+    def name(self) -> str:
+        return "channel"
+
+    def flush(self, metrics) -> None:
+        self.queue.put(list(metrics))
+
+    def get_flush(self, timeout: float = 5.0):
+        return self.queue.get(timeout=timeout)
+
+
+class ChannelSpanSink(SpanSink):
+    def __init__(self, maxsize: int = 0):
+        self.queue: "queue.Queue" = queue.Queue(maxsize)
+        self.flushes = 0
+
+    @property
+    def name(self) -> str:
+        return "channel"
+
+    def ingest(self, span) -> None:
+        self.queue.put(span)
+
+    def flush(self) -> None:
+        self.flushes += 1

@@ -1,0 +1,46 @@
+"""Metric-extraction span sink: how SSF samples reach the aggregation core.
+
+Behavioral port of ``/root/reference/sinks/ssfmetrics/metrics.go:63-141``:
+a span sink on the *main path* (server.go:282-290) that unpacks each span's
+embedded SSFSamples into UDPMetrics, derives an indicator-span duration
+timer when configured, and feeds everything into the metric store.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Callable
+
+from veneur_tpu.samplers import parser as p
+from .base import MetricSink, SpanSink
+
+log = logging.getLogger("veneur.sinks.ssfmetrics")
+
+
+class MetricExtractionSink(SpanSink):
+    """process_metric: callable accepting a UDPMetric (the store's ingest)."""
+
+    def __init__(self, process_metric: Callable[[p.UDPMetric], None],
+                 indicator_span_timer_name: str = ""):
+        self._process = process_metric
+        self._timer_name = indicator_span_timer_name
+
+    @property
+    def name(self) -> str:
+        return "metric_extraction"
+
+    def ingest(self, span) -> None:
+        metrics, invalid = p.convert_metrics(span)
+        if invalid:
+            log.error("parse errors on %d metrics", len(invalid))
+        if span.indicator and self._timer_name:
+            try:
+                metrics.extend(
+                    p.convert_indicator_metrics(span, self._timer_name))
+            except p.ParseError as e:
+                log.error("couldn't extract indicator metrics: %s", e)
+        for m in metrics:
+            self._process(m)
+
+    def flush(self) -> None:
+        pass
