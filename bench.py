@@ -1,0 +1,99 @@
+"""Headline benchmark: the per-interval flush program at 1M histogram series.
+
+BASELINE.md north-star config #2: 1M active Histo series, t-digest
+compression=100, single-chip batched centroid merge. One interval =
+ingest a flat chunk of samples into the bin accumulators, drain them into
+the digests (one batched compress), and compute 8 percentiles + median for
+every series — the work the reference does per series in ``Histo.Flush``
+(``/root/reference/samplers/samplers.go:511-636``) and ``mergeAllTemps``
+(``tdigest/merging_digest.go:135-219``).
+
+Baseline: the reference publishes no flush benchmark numbers
+(BASELINE.md). We estimate the Go samplers at 10 us/series-flush —
+mergeAllTemps (~158-centroid greedy scan) plus 9 sequential Quantile walks
+per series, consistent with its BenchmarkAdd/BenchmarkQuantile code paths —
+i.e. ~10 s single-core for 1M series. ``vs_baseline`` is the speedup factor
+(estimated-Go-latency / measured-latency); >1 is better.
+
+Prints exactly one JSON line on stdout.
+"""
+
+import json
+import sys
+import time
+
+import numpy as np
+
+GO_US_PER_SERIES_FLUSH = 10.0  # estimated; see module docstring
+QS = (0.01, 0.10, 0.25, 0.50, 0.75, 0.90, 0.95, 0.99)
+CHUNK = 1 << 17
+ITERS = 5
+
+
+def run(num_series: int):
+    import jax
+    import jax.numpy as jnp
+    from functools import partial
+    from veneur_tpu.ops import tdigest as td_ops
+
+    compression = 100.0
+    k = td_ops.size_bound(compression)
+
+    @partial(jax.jit, donate_argnums=(0, 1), static_argnums=())
+    def flush_step(digest, temp, rows, vals, wts, qs):
+        temp = td_ops.ingest_chunk(temp, rows, vals, wts, compression)
+        digest = td_ops.drain_temp(digest, temp, compression)
+        pcts = td_ops.quantile(digest, qs)
+        # checksum forces the whole program; scalar readback avoids timing
+        # the host link instead of the chip (block_until_ready is a no-op
+        # under the axon tunnel, and bulk transfers ride a network).
+        return digest, jnp.sum(pcts)
+
+    rng = np.random.default_rng(0)
+    rows = jnp.asarray(rng.integers(0, num_series, CHUNK).astype(np.int32))
+    vals = jnp.asarray(rng.gamma(2.0, 50.0, CHUNK).astype(np.float32))
+    wts = jnp.ones((CHUNK,), jnp.float32)
+    qs = jnp.asarray(QS, jnp.float32)
+
+    digest = td_ops.init((num_series,), compression, k)
+    temp = td_ops.init_temp(num_series, k, compression)
+
+    # warmup (compile + first run)
+    digest, chk = flush_step(digest, temp, rows, vals, wts, qs)
+    float(chk)
+
+    times = []
+    for _ in range(ITERS):
+        temp = td_ops.init_temp(num_series, k, compression)
+        float(temp.sum_w.sum())  # sync: make sure init isn't in the timing
+        t0 = time.perf_counter()
+        digest, chk = flush_step(digest, temp, rows, vals, wts, qs)
+        float(chk)
+        times.append(time.perf_counter() - t0)
+    return float(np.median(times))
+
+
+def main():
+    num_series = 1 << 20
+    while num_series >= 1 << 16:
+        try:
+            latency_s = run(num_series)
+            break
+        except Exception as e:  # OOM on small parts: halve and retry
+            print(f"bench at {num_series} series failed ({type(e).__name__}); "
+                  f"retrying at {num_series // 2}", file=sys.stderr)
+            num_series //= 2
+    else:
+        raise SystemExit("bench failed at all sizes")
+
+    go_est_s = num_series * GO_US_PER_SERIES_FLUSH / 1e6
+    print(json.dumps({
+        "metric": f"flush_latency_{num_series // 1000}k_histo_series",
+        "value": round(latency_s * 1e3, 3),
+        "unit": "ms",
+        "vs_baseline": round(go_est_s / latency_s, 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()

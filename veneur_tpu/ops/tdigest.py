@@ -100,14 +100,33 @@ def _rowwise_searchsorted(a: jax.Array, v: jax.Array, side: str) -> jax.Array:
     """searchsorted along the last axis for every row of a batch.
 
     a: [..., M] row-sorted values; v: [..., P] (or [P], broadcast) queries.
+
+    Computed as a fused broadcast-compare-reduce (count of elements before
+    the insertion point) rather than a vmapped binary search: the scan-based
+    search lowers to ~1000x slower code on TPU, while the [.., P, M] compare
+    fuses into one VPU reduction and never materializes.
     """
     batch = a.shape[:-1]
     if v.ndim == 1:
         v = jnp.broadcast_to(v, batch + v.shape)
-    a2 = a.reshape((-1, a.shape[-1]))
-    v2 = v.reshape((-1, v.shape[-1]))
-    out = jax.vmap(partial(jnp.searchsorted, side=side))(a2, v2)
-    return out.reshape(batch + (v.shape[-1],))
+    av = a[..., None, :]          # [..., 1, M]
+    vv = v[..., :, None]          # [..., P, 1]
+    before = (av < vv) if side == "left" else (av <= vv)
+    return jnp.sum(before, axis=-1, dtype=jnp.int32)
+
+
+def _select_at(arr: jax.Array, idx: jax.Array) -> jax.Array:
+    """Fused per-row gather: out[..., p] = arr[..., idx[..., p]].
+
+    arr: [..., M]; idx: [..., P] int32. TPU's native row-gather
+    (take_along_axis) runs ~20x slower than this one-hot compare+reduce for
+    small P, which fuses into a single VPU pass and never materializes the
+    [..., P, M] intermediate.
+    """
+    m = arr.shape[-1]
+    pos = jnp.arange(m, dtype=jnp.int32)
+    hit = idx[..., :, None] == pos        # [..., P, M]
+    return jnp.sum(jnp.where(hit, arr[..., None, :], 0), axis=-1)
 
 
 def _compress(mean: jax.Array, weight: jax.Array, compression: float,
@@ -134,15 +153,14 @@ def _compress(mean: jax.Array, weight: jax.Array, compression: float,
     cluster = jnp.clip(jnp.floor(k), 0, out_size - 1).astype(jnp.int32)
     cluster = jnp.where(live, cluster, out_size)  # park empties out of range
 
-    # Segmented sums over monotone cluster ids via prefix sums + binary search.
-    zeros = jnp.zeros(cluster.shape[:-1] + (1,), dtype)
-    cum_w = jnp.concatenate([zeros, incl], axis=-1)
-    cum_wm = jnp.concatenate([zeros, jnp.cumsum(w * m0, axis=-1)], axis=-1)
+    # Segmented sums per cluster id as a fused mask-reduce: the [.., K, M]
+    # compare broadcasts fuse into one VPU reduction. (The boundary-gather
+    # formulation — prefix sums + searchsorted + take_along_axis — is ~20x
+    # slower on TPU because row-gathers don't vectorize.)
     targets = jnp.arange(out_size, dtype=jnp.int32)
-    left = _rowwise_searchsorted(cluster, targets, "left")
-    right = _rowwise_searchsorted(cluster, targets, "right")
-    sum_w = jnp.take_along_axis(cum_w, right, axis=-1) - jnp.take_along_axis(cum_w, left, axis=-1)
-    sum_wm = jnp.take_along_axis(cum_wm, right, axis=-1) - jnp.take_along_axis(cum_wm, left, axis=-1)
+    hit = cluster[..., None, :] == targets[:, None]          # [.., K, M]
+    sum_w = jnp.sum(jnp.where(hit, w[..., None, :], 0), axis=-1)
+    sum_wm = jnp.sum(jnp.where(hit, (w * m0)[..., None, :], 0), axis=-1)
 
     new_live = sum_w > 0
     new_mean = jnp.where(new_live, sum_wm / jnp.where(new_live, sum_w, 1.0), jnp.inf)
@@ -221,11 +239,11 @@ def quantile(state: TDigest, qs: jax.Array) -> jax.Array:
     # First centroid i with incl[i] >= target  <=>  Go's q <= weightSoFar + c.W
     idx = jnp.clip(_rowwise_searchsorted(incl, target, "left"), 0, state.capacity - 1)
     lb0 = state.min[..., None]
-    prev_ub = jnp.take_along_axis(ub, jnp.maximum(idx - 1, 0), axis=-1)
+    prev_ub = _select_at(ub, jnp.maximum(idx - 1, 0))
     lb = jnp.where(idx == 0, lb0, prev_ub)
-    ub_i = jnp.take_along_axis(ub, idx, axis=-1)
-    w_i = jnp.take_along_axis(w, idx, axis=-1)
-    excl_i = jnp.take_along_axis(excl, idx, axis=-1)
+    ub_i = _select_at(ub, idx)
+    w_i = _select_at(w, idx)
+    excl_i = _select_at(excl, idx)
     prop = (target - excl_i) / jnp.where(w_i > 0, w_i, 1.0)
     out = lb + prop * (ub_i - lb)
     return jnp.where(total > 0, out, jnp.nan)
@@ -244,11 +262,11 @@ def cdf(state: TDigest, xs: jax.Array) -> jax.Array:
     idx = jnp.clip(_rowwise_searchsorted(ub, xs, "right"), 0, state.capacity - 1)
     mn = state.min[..., None]
     mx = state.max[..., None]
-    prev_ub = jnp.take_along_axis(ub, jnp.maximum(idx - 1, 0), axis=-1)
+    prev_ub = _select_at(ub, jnp.maximum(idx - 1, 0))
     lb = jnp.where(idx == 0, mn, prev_ub)
-    ub_i = jnp.take_along_axis(ub, idx, axis=-1)
-    w_i = jnp.take_along_axis(w, idx, axis=-1)
-    excl_i = jnp.take_along_axis(excl, idx, axis=-1)
+    ub_i = _select_at(ub, idx)
+    w_i = _select_at(w, idx)
+    excl_i = _select_at(excl, idx)
     span = ub_i - lb
     frac = jnp.where(span > 0, (xs - lb) / jnp.where(span > 0, span, 1.0), 0.0)
     est = (excl_i + w_i * frac) / jnp.maximum(total, jnp.finfo(w.dtype).tiny)

@@ -1,0 +1,35 @@
+"""Multi-chip parallelism: device meshes + global-aggregation collectives.
+
+The reference scales its global tier with a consistent-hash proxy fanning
+imports out over many single-threaded Go workers
+(``/root/reference/proxy.go:437-505``, ``importsrv/server.go:101-132``).
+Here the same two axes become a ``jax.sharding.Mesh``:
+
+* ``series`` — data parallelism over metric series (the worker-shard axis:
+  each device owns a contiguous slab of rows, the TPU analogue of
+  ``Workers[digest % N]``, ``server.go:704``);
+* ``hosts`` — the hierarchical-aggregation axis (the local→global forward
+  fan-in, ``flusher.go:292-473``): per-host sketch contributions merge
+  across devices with XLA collectives over ICI — ``psum`` for counters and
+  t-digest bin accumulators, ``pmax`` for HLL registers, and a ppermute
+  butterfly for pre-compressed centroid state.
+"""
+
+from veneur_tpu.parallel.mesh import fleet_mesh, series_sharding
+from veneur_tpu.parallel.collectives import (
+    merge_counters,
+    merge_registers,
+    merge_temp,
+    allmerge_digest,
+)
+from veneur_tpu.parallel.global_agg import GlobalAggregator
+
+__all__ = [
+    "fleet_mesh",
+    "series_sharding",
+    "merge_counters",
+    "merge_registers",
+    "merge_temp",
+    "allmerge_digest",
+    "GlobalAggregator",
+]
