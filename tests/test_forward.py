@@ -1,0 +1,284 @@
+"""Forwarding-tier tests: conversion round-trips and in-process
+local → global pipelines over real gRPC and HTTP transports.
+
+Port of the reference's multi-node-without-a-cluster pattern
+(forward_test.go:18-143, flusher_test.go:13-77, importsrv/server_test.go,
+http_test.go:127-258).
+"""
+
+import json
+import time
+import urllib.error
+import urllib.request
+import zlib
+
+import numpy as np
+import pytest
+
+from veneur_tpu.config import Config
+from veneur_tpu.core.store import MetricStore
+from veneur_tpu.forward import (
+    GRPCForwarder,
+    HTTPForwarder,
+    ImportServer,
+    apply_metric,
+    decode_hll,
+    encode_hll,
+    json_metrics_from_state,
+    metric_list_from_state,
+)
+from veneur_tpu.forward.convert import apply_json_metric
+from veneur_tpu.httpserv import OpsServer
+from veneur_tpu.samplers.intermetric import HistogramAggregates
+from veneur_tpu.server import Server
+from veneur_tpu.sinks import ChannelMetricSink
+
+
+AGG = HistogramAggregates.from_names(["min", "max", "count"])
+
+
+def local_store_with_data(n_hist=50):
+    """A local-role store with one of everything forwardable."""
+    from veneur_tpu.samplers import parser as p
+
+    store = MetricStore(initial_capacity=32, chunk=128)
+    for line in (b"gctr:5|c|#veneurglobalonly", b"gg:2.5|g|#veneurglobalonly"):
+        store.process_metric(p.parse_metric(line))
+    for v in range(n_hist):
+        store.process_metric(p.parse_metric(f"lat:{v}|ms".encode()))
+    for member in ("a", "b", "c"):
+        store.process_metric(p.parse_metric(f"users:{member}|s".encode()))
+    return store
+
+
+def flush_local(store):
+    final, fwd, _ = store.flush([0.5], AGG, is_local=True,
+                                now=int(time.time()))
+    return final, fwd
+
+
+class TestHLLCodec:
+    def test_roundtrip(self):
+        regs = np.random.default_rng(0).integers(0, 50, 1 << 14).astype(np.uint8)
+        back, precision = decode_hll(encode_hll(regs, 14))
+        assert precision == 14
+        np.testing.assert_array_equal(back, regs)
+
+    def test_bad_magic(self):
+        with pytest.raises(ValueError):
+            decode_hll(b"XX\x01\x0e" + b"\x00" * (1 << 14))
+
+
+class TestConversionRoundtrip:
+    def assert_global_side(self, gstore, n_hist):
+        final, _, _ = gstore.flush([0.5], AGG, is_local=False,
+                                   now=int(time.time()))
+        by_name = {m.name: m for m in final}
+        assert by_name["gctr"].value == 5.0
+        assert by_name["gg"].value == 2.5
+        # min/max/count come only from samples ingested *locally* on this
+        # instance (samplers.go:471-476, 572-590); imported digests feed
+        # only the percentile/median path.
+        assert "lat.count" not in by_name
+        assert "lat.min" not in by_name
+        assert "lat.max" not in by_name
+        # the median of 0..n-1 within t-digest error
+        assert by_name["lat.50percentile"].value == pytest.approx(
+            (n_hist - 1) / 2, rel=0.15)
+        assert by_name["users"].value == pytest.approx(3, abs=0.1)
+
+    def test_protobuf_roundtrip(self):
+        _, fwd = flush_local(local_store_with_data())
+        mlist = metric_list_from_state(fwd)
+        # 1 counter + 1 gauge + 1 histogram + 1 set
+        assert len(mlist.metrics) == 4
+        gstore = MetricStore(initial_capacity=32, chunk=128)
+        for m in mlist.metrics:
+            apply_metric(gstore, m)
+        self.assert_global_side(gstore, 50)
+
+    def test_json_roundtrip(self):
+        _, fwd = flush_local(local_store_with_data())
+        blobs = json.loads(json.dumps(json_metrics_from_state(fwd)))
+        gstore = MetricStore(initial_capacity=32, chunk=128)
+        for d in blobs:
+            apply_json_metric(gstore, d)
+        self.assert_global_side(gstore, 50)
+
+    def test_timer_type_preserved(self):
+        _, fwd = flush_local(local_store_with_data())
+        mlist = metric_list_from_state(fwd)
+        hist = [m for m in mlist.metrics if m.WhichOneof("value") == "histogram"]
+        assert hist and hist[0].name == "lat"
+        from veneur_tpu.protocol import metricpb_pb2
+        assert hist[0].type == metricpb_pb2.Type.Value("Timer")
+
+
+class TestGRPCPipeline:
+    """local store → GRPCForwarder → ImportServer → global store."""
+
+    def test_e2e(self):
+        gstore = MetricStore(initial_capacity=32, chunk=128)
+        srv = ImportServer(gstore)
+        port = srv.start("127.0.0.1:0")
+        try:
+            _, fwd = flush_local(local_store_with_data())
+            client = GRPCForwarder(f"127.0.0.1:{port}")
+            client.forward(fwd)
+            assert client.errors == 0 and client.forwarded == 4
+            assert srv.received == 4
+            TestConversionRoundtrip().assert_global_side(gstore, 50)
+        finally:
+            srv.stop()
+
+    def test_merge_from_two_locals(self):
+        gstore = MetricStore(initial_capacity=32, chunk=128)
+        srv = ImportServer(gstore)
+        port = srv.start("127.0.0.1:0")
+        try:
+            client = GRPCForwarder(f"127.0.0.1:{port}")
+            for _ in range(2):
+                _, fwd = flush_local(local_store_with_data())
+                client.forward(fwd)
+            final, _, _ = gstore.flush([0.5], AGG, is_local=False,
+                                       now=int(time.time()))
+            by_name = {m.name: m for m in final}
+            # counters add across locals, digests merge
+            assert by_name["gctr"].value == 10.0
+            assert by_name["lat.50percentile"].value == pytest.approx(
+                24.5, rel=0.15)
+            # same members in both → cardinality stays 3
+            assert by_name["users"].value == pytest.approx(3, abs=0.1)
+        finally:
+            srv.stop()
+
+    def test_unreachable_destination_is_counted(self):
+        client = GRPCForwarder("127.0.0.1:1", timeout=0.5)
+        _, fwd = flush_local(local_store_with_data(n_hist=5))
+        client.forward(fwd)  # must not raise
+        assert client.errors == 1
+
+
+class TestHTTPPipeline:
+    def test_e2e_via_ops_server(self):
+        cfg = Config(statsd_listen_addresses=[], interval="86400s",
+                     http_address="127.0.0.1:0",
+                     aggregates=["min", "max", "count"], percentiles=[0.5],
+                     store_initial_capacity=32, store_chunk=128)
+        sink = ChannelMetricSink()
+        gserver = Server(cfg, metric_sinks=[sink])
+        gserver.start()
+        try:
+            _, fwd = flush_local(local_store_with_data())
+            client = HTTPForwarder(f"127.0.0.1:{gserver.ops_server.port}")
+            client.forward(fwd)
+            assert client.errors == 0 and client.forwarded == 4
+            gserver.flush()
+            by_name = {m.name: m for m in sink.get_flush()}
+            assert by_name["gctr"].value == 5.0
+            assert by_name["lat.50percentile"].value == pytest.approx(
+                24.5, rel=0.15)
+        finally:
+            gserver.shutdown()
+
+    def test_unreachable_destination_is_counted(self):
+        client = HTTPForwarder("127.0.0.1:1", timeout=0.5)
+        _, fwd = flush_local(local_store_with_data(n_hist=5))
+        client.forward(fwd)
+        assert client.errors == 1
+
+
+class TestOpsServer:
+    @pytest.fixture()
+    def ops(self):
+        seen = []
+        server = OpsServer("127.0.0.1:0", import_fn=seen.extend)
+        server.start()
+        yield server, seen
+        server.stop()
+
+    def get(self, ops, path):
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{ops.port}{path}") as r:
+            return r.status, r.read().decode()
+
+    def post(self, ops, body, headers=None):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{ops.port}/import", data=body,
+            headers=headers or {}, method="POST")
+        try:
+            with urllib.request.urlopen(req) as r:
+                return r.status, r.read().decode()
+        except urllib.error.HTTPError as e:
+            return e.code, e.read().decode()
+
+    def test_healthcheck_and_version(self, ops):
+        server, _ = ops
+        assert self.get(server, "/healthcheck") == (200, "ok")
+        status, version = self.get(server, "/version")
+        assert status == 200 and version.count(".") == 2
+        assert self.get(server, "/builddate")[0] == 200
+
+    def test_unknown_path_404(self, ops):
+        server, _ = ops
+        with pytest.raises(urllib.error.HTTPError) as e:
+            self.get(server, "/nope")
+        assert e.value.code == 404
+
+    def test_import_deflate_and_plain(self, ops):
+        server, seen = ops
+        body = json.dumps([{"name": "x", "type": "counter", "tags": [],
+                            "value": 1}]).encode()
+        assert self.post(server, body)[0] == 202
+        assert self.post(server, zlib.compress(body),
+                         {"Content-Encoding": "deflate"})[0] == 202
+        assert len(seen) == 2
+
+    def test_import_error_cases(self, ops):
+        # handlers_global.go:60-213's 400 matrix
+        server, _ = ops
+        assert self.post(server, b"")[0] == 400
+        assert self.post(server, b"not json")[0] == 400
+        assert self.post(server, b"{}")[0] == 400  # not a list
+        assert self.post(server, b"[]")[0] == 400  # empty batch
+        assert self.post(server, b"x", {"Content-Encoding": "deflate"})[0] == 400
+        assert self.post(server, b"[]", {"Content-Encoding": "gzip"})[0] == 400
+
+
+class TestServerWiring:
+    def test_local_server_forwards_on_flush(self):
+        """Full chain: local Server → HTTP forward → global Server."""
+        gcfg = Config(statsd_listen_addresses=[], interval="86400s",
+                      http_address="127.0.0.1:0", percentiles=[0.5],
+                      aggregates=["count"], store_initial_capacity=32,
+                      store_chunk=128)
+        gsink = ChannelMetricSink()
+        gserver = Server(gcfg, metric_sinks=[gsink])
+        gserver.start()
+        try:
+            lcfg = Config(
+                statsd_listen_addresses=[], interval="86400s",
+                forward_address=f"http://127.0.0.1:{gserver.ops_server.port}",
+                aggregates=["count"], store_initial_capacity=32,
+                store_chunk=128)
+            lsink = ChannelMetricSink()
+            lserver = Server(lcfg, metric_sinks=[lsink])
+            lserver.start()
+            try:
+                from veneur_tpu.samplers import parser as p
+                for v in range(10):
+                    lserver.store.process_metric(
+                        p.parse_metric(f"e2e.lat:{v}|ms".encode()))
+                lserver.flush()
+                deadline = time.time() + 5
+                while time.time() < deadline and gserver.store.imported == 0:
+                    time.sleep(0.02)
+                assert gserver.store.imported > 0
+                gserver.flush()
+                by_name = {m.name: m for m in gsink.get_flush()}
+                assert by_name["e2e.lat.50percentile"].value == pytest.approx(
+                    4.5, rel=0.2)
+            finally:
+                lserver.shutdown()
+        finally:
+            gserver.shutdown()

@@ -1,0 +1,48 @@
+"""Forwarding tier: local → (proxy) → global sketch-state transport.
+
+The reference ships two transports (SURVEY §2.2): HTTP ``POST /import``
+with deflate-compressed JSON-wrapped gob sketches (``flusher.go:292-385``,
+``http.go:41-143``) and gRPC ``Forward.SendMetrics`` with protobuf sketch
+state (``flusher.go:424-473``, ``importsrv/server.go:101-132``). Both are
+rebuilt here over the same ``metricpb``-compatible schema; the gob payload
+is replaced by structured JSON (we are not wire-compatible with Go gob by
+design — the sketch state itself is protobuf/JSON, SURVEY §5 "checkpoint").
+"""
+
+from veneur_tpu.forward.convert import (
+    decode_hll,
+    encode_hll,
+    json_metrics_from_state,
+    metric_list_from_state,
+    apply_json_metric,
+    apply_metric,
+)
+from veneur_tpu.forward.grpc_forward import GRPCForwarder, ImportServer
+from veneur_tpu.forward.http_forward import HTTPForwarder
+
+__all__ = [
+    "decode_hll",
+    "encode_hll",
+    "json_metrics_from_state",
+    "metric_list_from_state",
+    "apply_json_metric",
+    "apply_metric",
+    "GRPCForwarder",
+    "ImportServer",
+    "HTTPForwarder",
+    "configure_forwarding",
+]
+
+
+def configure_forwarding(server):
+    """Attach the configured forwarding client to a local server
+    (server.go:626-635 for the gRPC dial; flusher.go:66-75 for use)."""
+    cfg = server.config
+    if not cfg.forward_address:
+        return None
+    if cfg.forward_use_grpc:
+        fwd = GRPCForwarder(cfg.forward_address)
+    else:
+        fwd = HTTPForwarder(cfg.forward_address)
+    server.forward_fn = fwd.forward
+    return fwd

@@ -1,0 +1,179 @@
+"""ForwardableState ↔ wire schemas (protobuf ``metricpb`` and JSON).
+
+Protobuf side mirrors ``/root/reference/samplers/metricpb/metric.proto``
+and the per-sampler ``Metric()`` exporters (``samplers/samplers.go``:
+Counter:196, Gauge:283, Histo:666, Set:441); JSON side replaces the
+reference's gob-in-JSON ``JSONMetric`` (``samplers/samplers.go:102-108``)
+with structured fields.
+"""
+
+from __future__ import annotations
+
+import base64
+import struct
+from typing import Dict, List
+
+import numpy as np
+
+from veneur_tpu.protocol import forward_pb2, metricpb_pb2, tdigest_pb2
+
+_HLL_MAGIC = b"VH"
+_HLL_VERSION = 1
+
+_PB_TYPE = {
+    "counter": metricpb_pb2.Type.Value("Counter"),
+    "gauge": metricpb_pb2.Type.Value("Gauge"),
+    "histogram": metricpb_pb2.Type.Value("Histogram"),
+    "timer": metricpb_pb2.Type.Value("Timer"),
+    "set": metricpb_pb2.Type.Value("Set"),
+}
+_TYPE_PB = {v: k for k, v in _PB_TYPE.items()}
+
+
+def encode_hll(registers: np.ndarray, precision: int) -> bytes:
+    """Serialize dense HLL registers for the ``SetValue.hyper_log_log``
+    bytes field. Layout: magic ``VH``, version, precision, raw registers.
+    (The reference stores the vendored axiomhq binary format here —
+    samplers.go:441-465; ours is the dense-register equivalent.)"""
+    regs = np.asarray(registers, np.uint8)
+    if regs.shape != (1 << precision,):
+        raise ValueError(f"want {1 << precision} registers, got {regs.shape}")
+    return _HLL_MAGIC + struct.pack("BB", _HLL_VERSION, precision) + regs.tobytes()
+
+
+def decode_hll(blob: bytes) -> tuple[np.ndarray, int]:
+    if blob[:2] != _HLL_MAGIC:
+        raise ValueError("bad HLL magic")
+    version, precision = struct.unpack_from("BB", blob, 2)
+    if version != _HLL_VERSION:
+        raise ValueError(f"unsupported HLL version {version}")
+    regs = np.frombuffer(blob, np.uint8, count=1 << precision, offset=4)
+    return regs, precision
+
+
+# ---------------------------------------------------------------------------
+# protobuf (gRPC forward path)
+# ---------------------------------------------------------------------------
+
+
+def metric_list_from_state(state, compression: float = 100.0,
+                           hll_precision: int = 14) -> forward_pb2.MetricList:
+    """ForwardableState → MetricList (worker.go:161-183's
+    ForwardableMetrics + each sampler's Metric())."""
+    out = forward_pb2.MetricList()
+
+    for name, tags, value in state.counters:
+        m = out.metrics.add(name=name, tags=tags, type=_PB_TYPE["counter"])
+        m.counter.value = int(value)
+    for name, tags, value in state.gauges:
+        m = out.metrics.add(name=name, tags=tags, type=_PB_TYPE["gauge"])
+        m.gauge.value = float(value)
+    for kind in ("histograms", "timers"):
+        for name, tags, means, weights, dmin, dmax in getattr(state, kind):
+            m = out.metrics.add(
+                name=name, tags=tags,
+                type=_PB_TYPE["histogram" if kind == "histograms" else "timer"])
+            td = m.histogram.t_digest
+            td.compression = compression
+            td.min = float(dmin)
+            td.max = float(dmax)
+            for mean, w in zip(means, weights):
+                td.main_centroids.add(mean=float(mean), weight=float(w))
+    for name, tags, registers, precision in state.sets:
+        m = out.metrics.add(name=name, tags=tags, type=_PB_TYPE["set"])
+        m.set.hyper_log_log = encode_hll(registers, precision)
+    return out
+
+
+def apply_metric(store, m: metricpb_pb2.Metric):
+    """Merge one imported protobuf metric into the store — the moral of
+    ``Worker.ImportMetricGRPC`` + per-sampler ``Merge``
+    (worker.go:354-398)."""
+    from veneur_tpu.samplers.parser import MetricKey
+
+    tags = list(m.tags)
+    tname = _TYPE_PB.get(m.type)
+    if tname is None:
+        raise ValueError(f"unknown metric type {m.type}")
+    key = MetricKey(name=m.name, type=tname, joined_tags=",".join(tags))
+    which = m.WhichOneof("value")
+    if which == "counter":
+        store.import_counter(key, tags, m.counter.value)
+    elif which == "gauge":
+        store.import_gauge(key, tags, m.gauge.value)
+    elif which == "histogram":
+        td = m.histogram.t_digest
+        means = np.array([c.mean for c in td.main_centroids], np.float64)
+        weights = np.array([c.weight for c in td.main_centroids], np.float64)
+        store.import_digest(key, tags, means, weights,
+                            td.min if td.main_centroids else float("inf"),
+                            td.max if td.main_centroids else float("-inf"))
+    elif which == "set":
+        registers, _precision = decode_hll(m.set.hyper_log_log)
+        store.import_set(key, tags, registers)
+    else:
+        raise ValueError(f"metric {m.name} has no value")
+
+
+# ---------------------------------------------------------------------------
+# JSON (HTTP forward path)
+# ---------------------------------------------------------------------------
+
+
+def json_metrics_from_state(state, compression: float = 100.0) -> List[Dict]:
+    """ForwardableState → list of JSON-metric dicts, the structured
+    replacement for ``JSONMetric``'s gob blob (flusher.go:292-385)."""
+    out: List[Dict] = []
+
+    def base(name, tags, mtype):
+        return {"name": name, "tags": tags, "type": mtype}
+
+    for name, tags, value in state.counters:
+        d = base(name, tags, "counter")
+        d["value"] = int(value)
+        out.append(d)
+    for name, tags, value in state.gauges:
+        d = base(name, tags, "gauge")
+        d["value"] = float(value)
+        out.append(d)
+    for kind, mtype in (("histograms", "histogram"), ("timers", "timer")):
+        for name, tags, means, weights, dmin, dmax in getattr(state, kind):
+            d = base(name, tags, mtype)
+            d["digest"] = {
+                "compression": compression,
+                "min": float(dmin), "max": float(dmax),
+                "centroids": [[float(m), float(w)]
+                              for m, w in zip(means, weights)],
+            }
+            out.append(d)
+    for name, tags, registers, precision in state.sets:
+        d = base(name, tags, "set")
+        d["hll"] = base64.b64encode(encode_hll(registers, precision)).decode()
+        out.append(d)
+    return out
+
+
+def apply_json_metric(store, d: Dict):
+    """Merge one imported JSON metric (handlers_global.go:60-213 +
+    Worker.ImportMetric/Combine, worker.go:313-351)."""
+    from veneur_tpu.samplers.parser import MetricKey
+
+    name, tags, mtype = d["name"], list(d.get("tags") or []), d["type"]
+    key = MetricKey(name=name, type=mtype, joined_tags=",".join(tags))
+    if mtype == "counter":
+        store.import_counter(key, tags, int(d["value"]))
+    elif mtype == "gauge":
+        store.import_gauge(key, tags, float(d["value"]))
+    elif mtype in ("histogram", "timer"):
+        td = d["digest"]
+        cents = td.get("centroids") or []
+        means = np.array([c[0] for c in cents], np.float64)
+        weights = np.array([c[1] for c in cents], np.float64)
+        store.import_digest(key, tags, means, weights,
+                            td.get("min", float("inf")),
+                            td.get("max", float("-inf")))
+    elif mtype == "set":
+        registers, _ = decode_hll(base64.b64decode(d["hll"]))
+        store.import_set(key, tags, registers)
+    else:
+        raise ValueError(f"unknown JSON metric type {mtype!r}")

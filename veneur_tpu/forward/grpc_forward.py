@@ -1,0 +1,124 @@
+"""gRPC forwarding: ``Forward.SendMetrics`` client and import server.
+
+Client mirrors ``forwardGRPC`` (``/root/reference/flusher.go:424-473``;
+channel dialed once at startup, server.go:626-635). Server mirrors
+``importsrv.Server`` (``importsrv/server.go:37-147``): receive a
+MetricList, merge every metric into the aggregation state. The reference
+groups metrics by fnv1a hash across worker goroutines to keep one series
+on one worker (importsrv/server.go:99-132); the dense store already
+guarantees that — the interner maps a series to exactly one row — so the
+grouping step disappears.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from concurrent import futures
+from typing import Callable, Optional
+
+import grpc
+from google.protobuf import empty_pb2
+
+from veneur_tpu.forward.convert import apply_metric, metric_list_from_state
+from veneur_tpu.protocol import forward_pb2
+
+log = logging.getLogger("veneur.forward.grpc")
+
+_METHOD = "/forwardrpc.Forward/SendMetrics"
+
+
+class GRPCForwarder:
+    """Per-flush gRPC forward of ForwardableState (flusher.go:424-473)."""
+
+    def __init__(self, addr: str, timeout: float = 10.0,
+                 compression: float = 100.0):
+        if addr.startswith(("http://", "grpc://")):
+            addr = addr.split("://", 1)[1]
+        self.addr = addr
+        self.timeout = timeout
+        self.compression = compression
+        self._channel = grpc.insecure_channel(addr)
+        self._send = self._channel.unary_unary(
+            _METHOD,
+            request_serializer=forward_pb2.MetricList.SerializeToString,
+            response_deserializer=empty_pb2.Empty.FromString,
+        )
+        # telemetry counters (flusher.go:440-470 metric names); the flusher
+        # calls forward() from a fresh thread each interval, so guard them
+        self._lock = threading.Lock()
+        self.forwarded = 0
+        self.errors = 0
+
+    def forward(self, state):
+        mlist = metric_list_from_state(state, self.compression)
+        if not mlist.metrics:
+            return
+        try:
+            self._send(mlist, timeout=self.timeout)
+            with self._lock:
+                self.forwarded += len(mlist.metrics)
+        except grpc.RpcError as e:
+            with self._lock:
+                self.errors += 1
+            log.warning("failed to forward %d metrics to %s: %s",
+                        len(mlist.metrics), self.addr, e)
+
+    def close(self):
+        self._channel.close()
+
+
+class ImportServer:
+    """The global tier's gRPC ingest (importsrv/server.go:37-147).
+
+    ``apply`` defaults to merging into a server's MetricStore; tests can
+    pass any callable taking a metricpb.Metric.
+    """
+
+    def __init__(self, store=None,
+                 apply: Optional[Callable] = None, workers: int = 4):
+        if apply is None:
+            if store is None:
+                raise ValueError("need a store or an apply callable")
+            apply = lambda m: apply_metric(store, m)  # noqa: E731
+        self._apply = apply
+        self.received = 0
+        self.import_errors = 0
+        self._lock = threading.Lock()
+        self._grpc = grpc.server(
+            futures.ThreadPoolExecutor(max_workers=workers))
+        handler = grpc.method_handlers_generic_handler(
+            "forwardrpc.Forward",
+            {"SendMetrics": grpc.unary_unary_rpc_method_handler(
+                self._send_metrics,
+                request_deserializer=forward_pb2.MetricList.FromString,
+                response_serializer=empty_pb2.Empty.SerializeToString)})
+        self._grpc.add_generic_rpc_handlers((handler,))
+        self.port: Optional[int] = None
+
+    def _send_metrics(self, request: forward_pb2.MetricList, context):
+        n_ok = 0
+        for m in request.metrics:
+            try:
+                self._apply(m)
+                n_ok += 1
+            except Exception as e:  # one bad metric must not drop the batch
+                with self._lock:
+                    self.import_errors += 1
+                log.debug("failed to import metric %s: %s", m.name, e)
+        with self._lock:
+            self.received += n_ok
+        return empty_pb2.Empty()
+
+    def start(self, addr: str = "[::]:0") -> int:
+        """Bind + serve; returns the bound port (server.go:1079-1093)."""
+        self.port = self._grpc.add_insecure_port(addr)
+        if self.port == 0:
+            raise RuntimeError(f"could not bind gRPC import server to {addr}")
+        self._grpc.start()
+        log.info("gRPC import server listening on %s (port %d)",
+                 addr, self.port)
+        return self.port
+
+    def stop(self, grace: float = 1.0):
+        self._grpc.stop(grace).wait(timeout=grace + 1.0)

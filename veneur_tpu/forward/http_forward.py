@@ -1,0 +1,71 @@
+"""HTTP forwarding client: deflate-compressed JSON ``POST /import``.
+
+Mirrors ``flushForward`` + ``PostHelper`` (``/root/reference/
+flusher.go:292-385``, ``http/http.go:123-247``): JSON body, zlib deflate
+``Content-Encoding``, success = any 2xx (the reference expects 202).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+import urllib.error
+import urllib.request
+import zlib
+
+from veneur_tpu.forward.convert import json_metrics_from_state
+
+log = logging.getLogger("veneur.forward.http")
+
+
+def post_helper(url: str, payload, timeout: float = 10.0,
+                compress: bool = True, headers: dict = None) -> int:
+    """POST a JSON payload, optionally deflated (http/http.go:123-247).
+    Returns the HTTP status; raises on transport errors."""
+    body = json.dumps(payload).encode("utf-8")
+    hdrs = {"Content-Type": "application/json"}
+    if compress:
+        body = zlib.compress(body)
+        hdrs["Content-Encoding"] = "deflate"
+    if headers:
+        hdrs.update(headers)
+    req = urllib.request.Request(url, data=body, headers=hdrs, method="POST")
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return resp.status
+
+
+class HTTPForwarder:
+    """Per-flush HTTP forward of ForwardableState (flusher.go:292-385)."""
+
+    def __init__(self, addr: str, timeout: float = 10.0,
+                 compression: float = 100.0):
+        self.base = addr.rstrip("/")
+        if not self.base.startswith(("http://", "https://")):
+            self.base = "http://" + self.base
+        self.timeout = timeout
+        self.compression = compression
+        # forward() runs on a fresh thread each flush; guard the counters
+        self._lock = threading.Lock()
+        self.forwarded = 0
+        self.errors = 0
+
+    def forward(self, state):
+        metrics = json_metrics_from_state(state, self.compression)
+        if not metrics:
+            return
+        url = self.base + "/import"
+        try:
+            status = post_helper(url, metrics, timeout=self.timeout)
+            if 200 <= status < 300:
+                with self._lock:
+                    self.forwarded += len(metrics)
+            else:
+                with self._lock:
+                    self.errors += 1
+                log.warning("forward to %s returned HTTP %d", url, status)
+        except (urllib.error.URLError, OSError) as e:
+            with self._lock:
+                self.errors += 1
+            log.warning("failed to forward %d metrics to %s: %s",
+                        len(metrics), url, e)

@@ -1,0 +1,175 @@
+"""The operational HTTP server: healthcheck, version, import ingest.
+
+Mirrors the goji mux in ``/root/reference/http.go:21-51`` and the global
+import handler ``handlers_global.go:60-213``:
+
+    GET  /healthcheck   → "ok"
+    GET  /version       → version string
+    GET  /builddate     → build date (import time here)
+    POST /import        → JSON (optionally deflate) list of forwarded
+                          metrics, merged into the store; 202 on success
+
+Error behavior follows ``unmarshalMetricsFromHTTP``: empty body, invalid
+encoding and invalid JSON are 400s; an unexpected merge failure is a 500.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+import time
+import zlib
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, List, Optional
+
+from veneur_tpu import __version__
+from veneur_tpu.forward.convert import apply_json_metric
+
+log = logging.getLogger("veneur.http")
+
+BUILD_DATE = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+
+
+class ImportError400(ValueError):
+    pass
+
+
+def unmarshal_metrics_from_http(headers, body: bytes) -> List[dict]:
+    """Decode an /import body (handlers_global.go:147-213)."""
+    if not body:
+        raise ImportError400("empty request body")
+    encoding = (headers.get("Content-Encoding") or "").lower()
+    if encoding == "deflate":
+        try:
+            body = zlib.decompress(body)
+        except zlib.error as e:
+            raise ImportError400(f"invalid deflate body: {e}")
+    elif encoding not in ("", "identity"):
+        raise ImportError400(f"unknown Content-Encoding {encoding!r}")
+    try:
+        metrics = json.loads(body)
+    except json.JSONDecodeError as e:
+        raise ImportError400(f"invalid JSON: {e}")
+    if not isinstance(metrics, list):
+        raise ImportError400("body must be a JSON array of metrics")
+    if not metrics:
+        raise ImportError400("empty import batch")
+    return metrics
+
+
+class _Handler(BaseHTTPRequestHandler):
+    server_version = f"veneur-tpu/{__version__}"
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, fmt, *args):  # route to logging, not stderr
+        log.debug("http: " + fmt, *args)
+
+    def _reply(self, status: int, body: str = "", content_type="text/plain"):
+        data = body.encode("utf-8")
+        self.send_response(status)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def do_GET(self):
+        if self.path == "/healthcheck":
+            self._reply(200, "ok")
+        elif self.path == "/version":
+            self._reply(200, __version__)
+        elif self.path == "/builddate":
+            self._reply(200, BUILD_DATE)
+        else:
+            extra = self.server.veneur_get_routes.get(self.path)
+            if extra is not None:
+                try:
+                    status, body, ctype = extra()
+                    self._reply(status, body, ctype)
+                except Exception as e:
+                    log.exception("handler for %s failed", self.path)
+                    self._reply(500, str(e))
+            else:
+                self._reply(404, "not found")
+
+    def do_POST(self):
+        if self.path != "/import":
+            self._reply(404, "not found")
+            return
+        handle = self.server.veneur_import
+        if handle is None:
+            self._reply(404, "import not enabled on this instance")
+            return
+        length = int(self.headers.get("Content-Length") or 0)
+        body = self.rfile.read(length) if length else b""
+        try:
+            metrics = unmarshal_metrics_from_http(self.headers, body)
+        except ImportError400 as e:
+            self._reply(400, str(e))
+            return
+        try:
+            handle(metrics)
+        except Exception as e:
+            log.exception("import failed")
+            self._reply(500, f"import failed: {e}")
+            return
+        self._reply(202, "accepted")
+
+
+class OpsServer:
+    """The /healthcheck,/version,/import endpoint bundle (http.go:21-51).
+
+    ``import_fn`` receives the decoded JSON metric list; when constructed
+    via ``for_server`` it merges into the store asynchronously, matching
+    the reference's ``go ImportMetrics`` (http.go:54-60).
+    """
+
+    def __init__(self, addr: str = "127.0.0.1:0",
+                 import_fn: Optional[Callable[[List[dict]], None]] = None):
+        host, _, port = addr.rpartition(":")
+        self._httpd = ThreadingHTTPServer((host or "127.0.0.1", int(port)),
+                                          _Handler)
+        self._httpd.daemon_threads = True
+        self._httpd.veneur_import = import_fn
+        self._httpd.veneur_get_routes = {}
+        self._thread: Optional[threading.Thread] = None
+
+    @classmethod
+    def for_server(cls, server, addr: str) -> "OpsServer":
+        def import_metrics(metrics: List[dict]):
+            errs = 0
+            for d in metrics:
+                try:
+                    apply_json_metric(server.store, d)
+                except Exception as e:
+                    errs += 1
+                    log.debug("failed to import metric %r: %s",
+                              d.get("name"), e)
+            if errs:
+                log.warning("failed to import %d/%d metrics",
+                            errs, len(metrics))
+
+        ops = cls(addr, import_fn=import_metrics)
+        ops.add_route("/config", lambda: (
+            200, json.dumps({k: v for k, v in vars(server.config).items()
+                             if "key" not in k and "secret" not in k
+                             and "token" not in k and "dsn" not in k}),
+            "application/json"))
+        return ops
+
+    def add_route(self, path: str, fn: Callable):
+        self._httpd.veneur_get_routes[path] = fn
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1]
+
+    def start(self):
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="http-serve", daemon=True)
+        self._thread.start()
+        log.info("http server listening on port %d", self.port)
+
+    def stop(self):
+        self._httpd.shutdown()
+        self._httpd.server_close()

@@ -93,11 +93,7 @@ def allmerge_digest(digest: TDigest, axis: str, axis_size: int,
     weight = lax.all_gather(digest.weight, axis, axis=-2)
     flat_mean = mean.reshape(mean.shape[:-2] + (axis_size * mean.shape[-1],))
     flat_w = weight.reshape(flat_mean.shape)
-    new_mean, new_w = td_ops._compress(flat_mean, flat_w, compression,
-                                       digest.capacity)
-    return TDigest(
-        mean=new_mean,
-        weight=new_w,
-        min=lax.pmin(digest.min, axis),
-        max=lax.pmax(digest.max, axis),
-    )
+    return td_ops.from_centroids(
+        flat_mean, flat_w,
+        lax.pmin(digest.min, axis), lax.pmax(digest.max, axis),
+        compression, digest.capacity)

@@ -101,6 +101,24 @@ class GlobalAggregator:
                 check_vma=False),
             donate_argnums=(0,))
 
+        # the forwarded-digest butterfly merge, compiled once (calling
+        # jax.jit on a fresh closure per flush would retrace every interval)
+        hk = P(HOSTS_AXIS, None, None)
+        hs = P(HOSTS_AXIS, None)
+
+        def _merge_local(mean, weight, mins, maxs):
+            d = TDigest(mean=mean[0], weight=weight[0], min=mins[0],
+                        max=maxs[0])
+            d = collectives.allmerge_digest(d, HOSTS_AXIS, self.hosts,
+                                            self.compression)
+            return d.mean, d.weight, d.min, d.max
+
+        self._merge_forwarded = jax.jit(shard_map(
+            _merge_local, mesh=mesh,
+            in_specs=(hk, hk, hs, hs),
+            out_specs=(P(None, None), P(None, None), P(None), P(None)),
+            check_vma=False))
+
     # -- state construction -------------------------------------------------
 
     def init_state(self) -> AggState:
@@ -179,30 +197,13 @@ class GlobalAggregator:
         (Histo.Merge, samplers.go:676-691). Inputs [H, S, K] / [H, S],
         sharded over hosts; returns the merged [S, K] digest replicated
         across the hosts axis (butterfly ppermute, log2(H) rounds)."""
-        hk = P(HOSTS_AXIS, None, None)
-        hs = P(HOSTS_AXIS, None)
-        out_sk = P(None, None)
-        out_s = P(None)
-
-        def local(mean, weight, mins, maxs):
-            d = TDigest(mean=mean[0], weight=weight[0], min=mins[0],
-                        max=maxs[0])
-            d = collectives.allmerge_digest(d, HOSTS_AXIS, self.hosts,
-                                            self.compression)
-            return d.mean, d.weight, d.min, d.max
-
-        fn = jax.jit(shard_map(
-            local, mesh=self.mesh,
-            in_specs=(hk, hk, hs, hs),
-            out_specs=(out_sk, out_sk, out_s, out_s),
-            check_vma=False))
-        sharding_hk = NamedSharding(self.mesh, hk)
-        sharding_hs = NamedSharding(self.mesh, hs)
+        sharding_hk = NamedSharding(self.mesh, P(HOSTS_AXIS, None, None))
+        sharding_hs = NamedSharding(self.mesh, P(HOSTS_AXIS, None))
         args = (jax.device_put(jnp.asarray(mean, jnp.float32), sharding_hk),
                 jax.device_put(jnp.asarray(weight, jnp.float32), sharding_hk),
                 jax.device_put(jnp.asarray(mins, jnp.float32), sharding_hs),
                 jax.device_put(jnp.asarray(maxs, jnp.float32), sharding_hs))
-        m, w, mn, mx = fn(*args)
+        m, w, mn, mx = self._merge_forwarded(*args)
         return TDigest(mean=m, weight=w, min=mn, max=mx)
 
 

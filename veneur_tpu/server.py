@@ -122,6 +122,9 @@ class Server:
         self.plugins: List = []
         # set by the forwarding layer (veneur_tpu.forward) when local
         self.forward_fn: Optional[Callable] = None
+        self._forwarder = None
+        self.ops_server = None      # HTTP /healthcheck,/version,/import
+        self.import_server = None   # gRPC Forward.SendMetrics ingest
 
         self._stop = threading.Event()
         self._threads: List[threading.Thread] = []
@@ -246,6 +249,25 @@ class Server:
             self._threads.extend(threads)
             self.ssf_addrs.extend(bound)
 
+        # ops HTTP server; on a global instance it also serves POST /import
+        # (server.go:1005-1077, http.go:21-51)
+        if cfg.http_address:
+            from veneur_tpu.httpserv import OpsServer
+
+            self.ops_server = OpsServer.for_server(self, cfg.http_address)
+            self.ops_server.start()
+        # gRPC import ingest (server.go:536-546, importsrv/)
+        if cfg.grpc_address:
+            from veneur_tpu.forward.grpc_forward import ImportServer
+
+            self.import_server = ImportServer(self.store)
+            self.import_server.start(cfg.grpc_address)
+        # local → global forwarding client (server.go:626-635)
+        if self.forward_fn is None:
+            from veneur_tpu.forward import configure_forwarding
+
+            self._forwarder = configure_forwarding(self)
+
         self._flush_thread = threading.Thread(
             target=self._flush_loop, name="flush-ticker", daemon=True)
         self._flush_thread.start()
@@ -284,3 +306,9 @@ class Server:
         self._stop.set()
         if self._flush_thread is not None:
             self._flush_thread.join(timeout=5.0)
+        if self.ops_server is not None:
+            self.ops_server.stop()
+        if self.import_server is not None:
+            self.import_server.stop()
+        if self._forwarder is not None and hasattr(self._forwarder, "close"):
+            self._forwarder.close()
