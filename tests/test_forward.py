@@ -232,6 +232,10 @@ class TestOpsServer:
         assert self.post(server, body)[0] == 202
         assert self.post(server, zlib.compress(body),
                          {"Content-Encoding": "deflate"})[0] == 202
+        # the merge runs off the request thread (http.go:54-60)
+        deadline = time.time() + 5
+        while time.time() < deadline and len(seen) < 2:
+            time.sleep(0.01)
         assert len(seen) == 2
 
     def test_import_error_cases(self, ops):

@@ -1,0 +1,251 @@
+"""Proxy + discovery tests.
+
+Port of the reference's patterns: ring consistency (stathat semantics),
+proxy behavior incl. unreachable destinations (proxysrv/server_test.go:38-223,
+proxy_test.go:123-231), mocked Consul via a local HTTP fixture
+(consul_discovery_test.go:63-111), and the full local → proxy → global
+chain composed in-process (forward_test.go:18-143).
+"""
+
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, HTTPServer
+
+import pytest
+
+from veneur_tpu.config import Config, ProxyConfig
+from veneur_tpu.core.store import MetricStore
+from veneur_tpu.discovery import ConsulDiscoverer, StaticDiscoverer
+from veneur_tpu.forward import GRPCForwarder, HTTPForwarder, ImportServer
+from veneur_tpu.proxy import ConsistentRing, GRPCProxyServer, Proxy
+from veneur_tpu.proxy.consistent import EmptyRingError
+from veneur_tpu.server import Server
+from veneur_tpu.sinks import ChannelMetricSink
+
+from tests.test_forward import AGG, flush_local, local_store_with_data
+
+
+class TestConsistentRing:
+    def test_empty_ring_raises(self):
+        with pytest.raises(EmptyRingError):
+            ConsistentRing().get("key")
+
+    def test_stable_assignment(self):
+        ring = ConsistentRing(["a", "b", "c"])
+        assert all(ring.get(f"k{i}") == ring.get(f"k{i}") for i in range(100))
+
+    def test_all_members_used(self):
+        ring = ConsistentRing(["a", "b", "c"])
+        owners = {ring.get(f"key{i}") for i in range(1000)}
+        assert owners == {"a", "b", "c"}
+
+    def test_minimal_remap_on_removal(self):
+        ring = ConsistentRing(["a", "b", "c", "d"])
+        before = {f"k{i}": ring.get(f"k{i}") for i in range(1000)}
+        ring.remove("d")
+        moved = sum(1 for k, owner in before.items()
+                    if owner != "d" and ring.get(k) != owner)
+        assert moved == 0  # only keys owned by the removed member remap
+        # and the removed member's keys all land somewhere valid
+        assert all(ring.get(k) in ("a", "b", "c") for k in before)
+
+    def test_set_members_is_incremental(self):
+        ring = ConsistentRing(["a", "b"])
+        before = {f"k{i}": ring.get(f"k{i}") for i in range(500)}
+        ring.set_members(["a", "b", "c"])
+        changed = sum(1 for k, o in before.items() if ring.get(k) != o)
+        # ~1/3 of the space moves to the new member, not everything
+        assert 0 < changed < 350
+
+
+class _FakeConsul(BaseHTTPRequestHandler):
+    def log_message(self, *a):
+        pass
+
+    def do_GET(self):
+        payload = self.server.consul_payload
+        if isinstance(payload, int):
+            self.send_response(payload)
+            self.end_headers()
+            return
+        body = json.dumps(payload).encode()
+        self.send_response(200)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+
+@pytest.fixture()
+def fake_consul():
+    httpd = HTTPServer(("127.0.0.1", 0), _FakeConsul)
+    httpd.consul_payload = []
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    yield httpd
+    httpd.shutdown()
+    httpd.server_close()
+
+
+class TestConsulDiscoverer:
+    def test_parses_health_entries(self, fake_consul):
+        fake_consul.consul_payload = [
+            {"Node": {"Address": "10.0.0.1"},
+             "Service": {"Address": "10.1.1.1", "Port": 8127}},
+            {"Node": {"Address": "10.0.0.2"},
+             "Service": {"Address": "", "Port": 8127}},
+        ]
+        d = ConsulDiscoverer(
+            f"http://127.0.0.1:{fake_consul.server_address[1]}")
+        assert d.get_destinations_for_service("veneur-global") == [
+            "http://10.1.1.1:8127", "http://10.0.0.2:8127"]
+
+    def test_error_propagates(self, fake_consul):
+        fake_consul.consul_payload = 500
+        d = ConsulDiscoverer(
+            f"http://127.0.0.1:{fake_consul.server_address[1]}")
+        with pytest.raises(Exception):
+            d.get_destinations_for_service("veneur-global")
+
+
+def make_global(**kw):
+    cfg = Config(statsd_listen_addresses=[], interval="86400s",
+                 http_address="127.0.0.1:0", percentiles=[0.5],
+                 aggregates=["count"], store_initial_capacity=32,
+                 store_chunk=128, **kw)
+    sink = ChannelMetricSink()
+    server = Server(cfg, metric_sinks=[sink])
+    server.start()
+    return server, sink
+
+
+class TestProxyLifecycle:
+    def test_refuses_zero_destinations(self):
+        proxy = Proxy(ProxyConfig(http_address="127.0.0.1:0"),
+                      discoverer=StaticDiscoverer([]))
+        with pytest.raises(RuntimeError):
+            proxy.start()
+
+    def test_refresh_keeps_last_good_ring(self):
+        class Flaky:
+            def __init__(self):
+                self.calls = 0
+
+            def get_destinations_for_service(self, name):
+                self.calls += 1
+                if self.calls > 1:
+                    raise OSError("consul down")
+                return ["http://10.0.0.1:8127"]
+
+        proxy = Proxy(ProxyConfig(http_address="127.0.0.1:0",
+                                  consul_forward_service_name="veneur"),
+                      discoverer=Flaky())
+        proxy.refresh_destinations()
+        assert len(proxy.ring) == 1
+        proxy.refresh_destinations()  # fails → keeps ring
+        assert len(proxy.ring) == 1 and proxy.refresh_failures == 1
+
+
+class TestHTTPProxyPipeline:
+    def test_local_to_proxy_to_two_globals(self):
+        g1, sink1 = make_global()
+        g2, sink2 = make_global()
+        try:
+            dests = [f"http://127.0.0.1:{g.ops_server.port}"
+                     for g in (g1, g2)]
+            proxy = Proxy(ProxyConfig(http_address="127.0.0.1:0",
+                                      forward_timeout="5s"),
+                          discoverer=StaticDiscoverer(dests))
+            proxy.start()
+            try:
+                # a local store with many series so both globals get some
+                store = MetricStore(initial_capacity=64, chunk=128)
+                from veneur_tpu.samplers import parser as p
+                for i in range(40):
+                    store.process_metric(
+                        p.parse_metric(f"series{i}:1|c|#veneurglobalonly"
+                                       .encode()))
+                _, fwd = flush_local(store)
+                client = HTTPForwarder(f"127.0.0.1:{proxy.port}")
+                client.forward(fwd)
+                assert client.errors == 0
+
+                deadline = time.time() + 5
+                while (time.time() < deadline
+                       and g1.store.imported + g2.store.imported < 40):
+                    time.sleep(0.02)
+                # every metric reached exactly one global, and both were used
+                assert g1.store.imported + g2.store.imported == 40
+                assert g1.store.imported > 0 and g2.store.imported > 0
+                assert proxy.proxied == 40
+            finally:
+                proxy.shutdown()
+        finally:
+            g1.shutdown()
+            g2.shutdown()
+
+    def test_unreachable_destination_counted(self):
+        proxy = Proxy(ProxyConfig(http_address="127.0.0.1:0",
+                                  forward_timeout="500ms"),
+                      discoverer=StaticDiscoverer(["http://127.0.0.1:1"]))
+        proxy.start()
+        try:
+            proxy.proxy_metrics([{"name": "x", "type": "counter",
+                                  "tags": [], "value": 1}])
+            assert proxy.forward_errors == 1
+        finally:
+            proxy.shutdown()
+
+
+class TestGRPCProxyPipeline:
+    def test_local_to_grpc_proxy_to_two_globals(self):
+        stores = [MetricStore(initial_capacity=64, chunk=128)
+                  for _ in range(2)]
+        servers = [ImportServer(s) for s in stores]
+        ports = [s.start("127.0.0.1:0") for s in servers]
+        proxy = GRPCProxyServer([f"127.0.0.1:{p}" for p in ports],
+                                forward_timeout=5.0)
+        pport = proxy.start("127.0.0.1:0")
+        try:
+            store = MetricStore(initial_capacity=64, chunk=128)
+            from veneur_tpu.samplers import parser as p
+            for i in range(40):
+                store.process_metric(
+                    p.parse_metric(f"g{i}:1|c|#veneurglobalonly".encode()))
+            _, fwd = flush_local(store)
+            client = GRPCForwarder(f"127.0.0.1:{pport}")
+            client.forward(fwd)
+            assert client.errors == 0
+
+            deadline = time.time() + 5
+            while (time.time() < deadline
+                   and sum(s.received for s in servers) < 40):
+                time.sleep(0.02)
+            assert sum(s.received for s in servers) == 40
+            assert all(s.received > 0 for s in servers)
+        finally:
+            proxy.stop()
+            for s in servers:
+                s.stop()
+
+    def test_series_consistency(self):
+        """The same metric key always lands on the same destination —
+        the invariant that makes global aggregation correct
+        (importsrv/server.go:34-36)."""
+        proxy = GRPCProxyServer(["127.0.0.1:1", "127.0.0.1:2", "127.0.0.1:3"])
+        key = "latency" + "timer" + "env:prod"
+        assert len({proxy.ring.get(key) for _ in range(50)}) == 1
+
+    def test_http_and_grpc_ring_keys_match(self):
+        """Both proxy transports must hash one series identically, or a
+        mixed/migrating fleet splits the series across global nodes."""
+        from veneur_tpu.forward.convert import type_name
+        from veneur_tpu.proxy.proxy import metric_ring_key
+        from veneur_tpu.protocol import metricpb_pb2
+
+        m = metricpb_pb2.Metric(name="lat", tags=["env:prod", "svc:a"],
+                                type=metricpb_pb2.Type.Value("Timer"))
+        grpc_key = m.name + type_name(m.type) + ",".join(m.tags)
+        json_key = metric_ring_key({"name": "lat", "type": "timer",
+                                    "tags": ["env:prod", "svc:a"]})
+        assert grpc_key == json_key

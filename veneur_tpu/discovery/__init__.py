@@ -1,0 +1,112 @@
+"""Service discovery for the proxy ring (SURVEY §2.2 L9).
+
+``Discoverer.get_destinations_for_service(name)`` returns the currently
+healthy global-veneur destinations, mirroring ``/root/reference/
+discoverer.go:5-7`` with the Consul (``consul.go:16-55``) and Kubernetes
+(``kubernetes.go:14-91``) implementations.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import ssl
+import urllib.request
+from typing import List, Protocol, Sequence
+
+log = logging.getLogger("veneur.discovery")
+
+
+class Discoverer(Protocol):
+    def get_destinations_for_service(self, service_name: str) -> List[str]:
+        ...
+
+
+class StaticDiscoverer:
+    """A fixed destination list (the no-Consul configuration, where
+    forward_address is the single destination — proxy.go:121-133)."""
+
+    def __init__(self, destinations: Sequence[str]):
+        self._destinations = list(destinations)
+
+    def get_destinations_for_service(self, service_name: str) -> List[str]:
+        return list(self._destinations)
+
+
+class ConsulDiscoverer:
+    """Healthy-instance query against the Consul HTTP API
+    (consul.go:16-55): GET /v1/health/service/{name}?passing, one
+    destination per passing instance at http://{address}:{port}."""
+
+    def __init__(self, consul_url: str = "http://127.0.0.1:8500",
+                 timeout: float = 10.0, scheme: str = "http"):
+        self.base = consul_url.rstrip("/")
+        self.timeout = timeout
+        self.scheme = scheme
+
+    def get_destinations_for_service(self, service_name: str) -> List[str]:
+        url = f"{self.base}/v1/health/service/{service_name}?passing"
+        with urllib.request.urlopen(url, timeout=self.timeout) as resp:
+            entries = json.load(resp)
+        destinations = []
+        for entry in entries:
+            svc = entry.get("Service") or {}
+            node = entry.get("Node") or {}
+            # the service address wins; fall back to the node address
+            # (consul.go:43-52)
+            address = svc.get("Address") or node.get("Address")
+            port = svc.get("Port")
+            if not address:
+                continue
+            if port:
+                destinations.append(f"{self.scheme}://{address}:{port}")
+            else:
+                destinations.append(f"{self.scheme}://{address}")
+        return destinations
+
+
+class KubernetesDiscoverer:
+    """In-cluster pod query (kubernetes.go:14-91): list pods labelled
+    ``app=veneur-global`` in the current namespace via the API server,
+    authenticated with the mounted service-account token."""
+
+    TOKEN_PATH = "/var/run/secrets/kubernetes.io/serviceaccount/token"
+    CA_PATH = "/var/run/secrets/kubernetes.io/serviceaccount/ca.crt"
+    NS_PATH = "/var/run/secrets/kubernetes.io/serviceaccount/namespace"
+
+    def __init__(self, timeout: float = 10.0, label: str = "app=veneur-global",
+                 pod_port: str = "8127"):
+        host = os.environ.get("KUBERNETES_SERVICE_HOST")
+        port = os.environ.get("KUBERNETES_SERVICE_PORT", "443")
+        if not host:
+            raise RuntimeError(
+                "not running in a Kubernetes cluster "
+                "(KUBERNETES_SERVICE_HOST unset)")
+        self.base = f"https://{host}:{port}"
+        self.timeout = timeout
+        self.label = label
+        self.pod_port = pod_port
+        with open(self.TOKEN_PATH) as f:
+            self._token = f.read().strip()
+        self._ctx = ssl.create_default_context(cafile=self.CA_PATH)
+        with open(self.NS_PATH) as f:
+            self.namespace = f.read().strip()
+
+    def get_destinations_for_service(self, service_name: str) -> List[str]:
+        url = (f"{self.base}/api/v1/namespaces/{self.namespace}/pods"
+               f"?labelSelector={urllib.request.quote(self.label)}")
+        req = urllib.request.Request(
+            url, headers={"Authorization": f"Bearer {self._token}"})
+        with urllib.request.urlopen(req, timeout=self.timeout,
+                                    context=self._ctx) as resp:
+            pods = json.load(resp)
+        destinations = []
+        for pod in pods.get("items", []):
+            status = pod.get("status") or {}
+            if status.get("phase") != "Running":
+                continue
+            ip = status.get("podIP")
+            if ip:
+                destinations.append(f"http://{ip}:{self.pod_port}")
+        return destinations

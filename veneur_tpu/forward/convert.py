@@ -30,6 +30,15 @@ _PB_TYPE = {
 _TYPE_PB = {v: k for k, v in _PB_TYPE.items()}
 
 
+def type_name(pb_type: int) -> str:
+    """metricpb.Type enum value → the lowercase type string used in
+    MetricKey / JSON metrics ("counter", "timer", ...)."""
+    name = _TYPE_PB.get(pb_type)
+    if name is None:
+        raise ValueError(f"unknown metric type {pb_type}")
+    return name
+
+
 def encode_hll(registers: np.ndarray, precision: int) -> bytes:
     """Serialize dense HLL registers for the ``SetValue.hyper_log_log``
     bytes field. Layout: magic ``VH``, version, precision, raw registers.

@@ -22,7 +22,8 @@ log = logging.getLogger("veneur.forward.http")
 def post_helper(url: str, payload, timeout: float = 10.0,
                 compress: bool = True, headers: dict = None) -> int:
     """POST a JSON payload, optionally deflated (http/http.go:123-247).
-    Returns the HTTP status; raises on transport errors."""
+    Returns the HTTP status (including non-2xx); raises only on transport
+    errors."""
     body = json.dumps(payload).encode("utf-8")
     hdrs = {"Content-Type": "application/json"}
     if compress:
@@ -31,8 +32,12 @@ def post_helper(url: str, payload, timeout: float = 10.0,
     if headers:
         hdrs.update(headers)
     req = urllib.request.Request(url, data=body, headers=hdrs, method="POST")
-    with urllib.request.urlopen(req, timeout=timeout) as resp:
-        return resp.status
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status
+    except urllib.error.HTTPError as e:
+        e.close()
+        return e.code
 
 
 class HTTPForwarder:

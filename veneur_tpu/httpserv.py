@@ -73,7 +73,14 @@ class _Handler(BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(data)
 
+    def _drain_body(self) -> bytes:
+        """Always consume the request body: on keep-alive connections an
+        unread body desyncs the next request on the stream."""
+        length = int(self.headers.get("Content-Length") or 0)
+        return self.rfile.read(length) if length else b""
+
     def do_GET(self):
+        self._drain_body()
         if self.path == "/healthcheck":
             self._reply(200, "ok")
         elif self.path == "/version":
@@ -93,6 +100,7 @@ class _Handler(BaseHTTPRequestHandler):
                 self._reply(404, "not found")
 
     def do_POST(self):
+        body = self._drain_body()
         if self.path != "/import":
             self._reply(404, "not found")
             return
@@ -100,20 +108,24 @@ class _Handler(BaseHTTPRequestHandler):
         if handle is None:
             self._reply(404, "import not enabled on this instance")
             return
-        length = int(self.headers.get("Content-Length") or 0)
-        body = self.rfile.read(length) if length else b""
         try:
             metrics = unmarshal_metrics_from_http(self.headers, body)
         except ImportError400 as e:
             self._reply(400, str(e))
             return
+        # accept, then merge off the request thread — the reference's
+        # ``go s.ImportMetrics`` (http.go:54-60); a merge blocked behind a
+        # long flush must not hold the forwarder's POST open
+        self._reply(202, "accepted")
+        threading.Thread(target=self._merge, args=(handle, metrics),
+                         daemon=True).start()
+
+    @staticmethod
+    def _merge(handle, metrics):
         try:
             handle(metrics)
-        except Exception as e:
+        except Exception:
             log.exception("import failed")
-            self._reply(500, f"import failed: {e}")
-            return
-        self._reply(202, "accepted")
 
 
 class OpsServer:

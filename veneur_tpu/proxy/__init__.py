@@ -1,0 +1,13 @@
+"""The availability tier: consistent-hash proxying of forwarded metrics.
+
+Rebuild of ``/root/reference/proxy.go`` + ``proxysrv/``: a stateless proxy
+that hashes every forwarded metric onto a ring of discovered global veneur
+instances, so a given series always merges on the same global node
+(SURVEY §2.2 "parallelism strategy" 6).
+"""
+
+from veneur_tpu.proxy.consistent import ConsistentRing
+from veneur_tpu.proxy.proxy import Proxy
+from veneur_tpu.proxy.grpc_proxy import GRPCProxyServer
+
+__all__ = ["ConsistentRing", "Proxy", "GRPCProxyServer"]

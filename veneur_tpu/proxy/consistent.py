@@ -1,0 +1,88 @@
+"""Consistent hashing ring.
+
+Same contract as the vendored ``stathat.com/c/consistent`` the reference
+proxies with (``proxy.go:437-478``): members are replicated onto a ring of
+CRC32 points; ``get(key)`` walks clockwise to the first point. Adding or
+removing one member only remaps ~1/N of the keyspace.
+"""
+
+from __future__ import annotations
+
+import bisect
+import threading
+import zlib
+from typing import Dict, List, Optional, Sequence
+
+
+class EmptyRingError(Exception):
+    pass
+
+
+class ConsistentRing:
+    """Thread-safe consistent hash ring with virtual replicas."""
+
+    def __init__(self, members: Optional[Sequence[str]] = None,
+                 replicas: int = 20):
+        self.replicas = replicas
+        self._lock = threading.RLock()
+        self._points: List[int] = []
+        self._owner: Dict[int, str] = {}
+        self._members: set = set()
+        if members:
+            self.set_members(members)
+
+    @staticmethod
+    def _hash(key: str) -> int:
+        return zlib.crc32(key.encode("utf-8")) & 0xFFFFFFFF
+
+    def members(self) -> List[str]:
+        with self._lock:
+            return sorted(self._members)
+
+    def __len__(self) -> int:
+        return len(self._members)
+
+    def add(self, member: str):
+        with self._lock:
+            if member in self._members:
+                return
+            self._members.add(member)
+            for i in range(self.replicas):
+                h = self._hash(f"{member}{i}")
+                # last-write-wins on the (rare) collision, like the original
+                if h not in self._owner:
+                    bisect.insort(self._points, h)
+                self._owner[h] = member
+
+    def remove(self, member: str):
+        with self._lock:
+            if member not in self._members:
+                return
+            self._members.discard(member)
+            for i in range(self.replicas):
+                h = self._hash(f"{member}{i}")
+                if self._owner.get(h) == member:
+                    del self._owner[h]
+                    idx = bisect.bisect_left(self._points, h)
+                    if idx < len(self._points) and self._points[idx] == h:
+                        self._points.pop(idx)
+
+    def set_members(self, members: Sequence[str]):
+        """Replace the membership (RefreshDestinations, proxy.go:337-371)."""
+        with self._lock:
+            want = set(members)
+            for m in self._members - want:
+                self.remove(m)
+            for m in want - self._members:
+                self.add(m)
+
+    def get(self, key: str) -> str:
+        """The member owning ``key`` (clockwise walk)."""
+        with self._lock:
+            if not self._points:
+                raise EmptyRingError("ring has no members")
+            h = self._hash(key)
+            idx = bisect.bisect_right(self._points, h)
+            if idx == len(self._points):
+                idx = 0
+            return self._owner[self._points[idx]]

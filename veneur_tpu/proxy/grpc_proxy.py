@@ -1,0 +1,152 @@
+"""The gRPC proxy: Forward.SendMetrics fan-out over the consistent ring.
+
+Behavioral port of ``/root/reference/proxysrv/server.go``: receive a
+MetricList, hash each metric to a destination (``destForMetric``,
+proxysrv/server.go:272-286), forward each group in parallel with error
+aggregation (``sendMetrics``, :189-269), prune stale connections on
+membership change (``SetDestinations``, :147-177). The reference answers
+the RPC before forwarding completes (fire-and-forget, :179-187).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from collections import defaultdict
+from concurrent import futures
+from typing import Dict, List, Optional, Sequence
+
+import grpc
+from google.protobuf import empty_pb2
+
+from veneur_tpu.forward.convert import type_name
+from veneur_tpu.protocol import forward_pb2
+from veneur_tpu.proxy.consistent import ConsistentRing, EmptyRingError
+
+log = logging.getLogger("veneur.proxy.grpc")
+
+_METHOD = "/forwardrpc.Forward/SendMetrics"
+
+
+class _ConnMap:
+    """Destination → channel + stub, pruned on membership change
+    (proxysrv/client_conn_map.go:13-60)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._conns: Dict[str, tuple] = {}
+
+    def get(self, dest: str):
+        with self._lock:
+            entry = self._conns.get(dest)
+            if entry is None:
+                addr = dest.split("://", 1)[-1]
+                channel = grpc.insecure_channel(addr)
+                send = channel.unary_unary(
+                    _METHOD,
+                    request_serializer=(
+                        forward_pb2.MetricList.SerializeToString),
+                    response_deserializer=empty_pb2.Empty.FromString)
+                entry = (channel, send)
+                self._conns[dest] = entry
+            return entry[1]
+
+    def prune(self, keep: Sequence[str]):
+        with self._lock:
+            for dest in list(self._conns):
+                if dest not in keep:
+                    channel, _ = self._conns.pop(dest)
+                    channel.close()
+
+    def close(self):
+        self.prune([])
+
+
+class GRPCProxyServer:
+    """gRPC flavor of veneur-proxy (proxysrv.Server)."""
+
+    def __init__(self, destinations: Optional[Sequence[str]] = None,
+                 forward_timeout: float = 10.0, workers: int = 8):
+        self.ring = ConsistentRing()
+        self.conns = _ConnMap()
+        self.forward_timeout = forward_timeout
+        self.proxied = 0
+        self.forward_errors = 0
+        self._lock = threading.Lock()
+        if destinations:
+            self.set_destinations(destinations)
+
+        self._grpc = grpc.server(futures.ThreadPoolExecutor(workers))
+        handler = grpc.method_handlers_generic_handler(
+            "forwardrpc.Forward",
+            {"SendMetrics": grpc.unary_unary_rpc_method_handler(
+                self._recv,
+                request_deserializer=forward_pb2.MetricList.FromString,
+                response_serializer=empty_pb2.Empty.SerializeToString)})
+        self._grpc.add_generic_rpc_handlers((handler,))
+        self.port: Optional[int] = None
+
+    def set_destinations(self, destinations: Sequence[str]):
+        """Replace membership and drop connections to departed nodes
+        (proxysrv/server.go:147-177)."""
+        self.ring.set_members(destinations)
+        self.conns.prune(list(destinations))
+
+    # -- rpc ----------------------------------------------------------------
+
+    def _recv(self, request: forward_pb2.MetricList, context):
+        # answer immediately; forward on a worker thread (server.go:179-187)
+        threading.Thread(target=self.send_metrics, args=(request,),
+                         daemon=True).start()
+        return empty_pb2.Empty()
+
+    def send_metrics(self, mlist: forward_pb2.MetricList):
+        by_dest = defaultdict(list)
+        dropped = 0
+        for m in mlist.metrics:
+            # the SAME key as the HTTP proxy's metric_ring_key /
+            # MetricKey.String(), so both transports route one series to
+            # one global node (importsrv/server.go:34-36)
+            try:
+                key = m.name + type_name(m.type) + ",".join(m.tags)
+                by_dest[self.ring.get(key)].append(m)
+            except (EmptyRingError, ValueError):
+                dropped += 1
+        if dropped:
+            log.warning("dropped %d unroutable metrics", dropped)
+        threads = []
+        for dest, batch in by_dest.items():
+            t = threading.Thread(target=self._forward, args=(dest, batch),
+                                 daemon=True)
+            t.start()
+            threads.append(t)
+        for t in threads:
+            t.join(timeout=self.forward_timeout + 1.0)
+
+    def _forward(self, dest: str, batch: List):
+        out = forward_pb2.MetricList()
+        out.metrics.extend(batch)
+        try:
+            self.conns.get(dest)(out, timeout=self.forward_timeout)
+            with self._lock:
+                self.proxied += len(batch)
+        except grpc.RpcError as e:
+            with self._lock:
+                self.forward_errors += 1
+            log.warning("failed to forward %d metrics to %s: %s",
+                        len(batch), dest, e)
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def start(self, addr: str = "[::]:0") -> int:
+        self.port = self._grpc.add_insecure_port(addr)
+        if self.port == 0:
+            raise RuntimeError(f"could not bind gRPC proxy to {addr}")
+        self._grpc.start()
+        log.info("gRPC proxy listening on port %d with %d destinations",
+                 self.port, len(self.ring))
+        return self.port
+
+    def stop(self, grace: float = 1.0):
+        self._grpc.stop(grace).wait(timeout=grace + 1.0)
+        self.conns.close()

@@ -1,0 +1,208 @@
+"""The HTTP proxy: POST /import fan-out over the consistent ring.
+
+Behavioral port of ``/root/reference/proxy.go``: discovery-driven ring
+refresh (``Start``/``RefreshDestinations``, proxy.go:206-371), per-metric
+consistent hashing on ``MetricKey.String()`` and parallel per-destination
+POSTs (``ProxyMetrics``, proxy.go:437-505). The proxy is stateless: a
+refresh failure keeps the last good ring (proxy.go:351-361), and starting
+with zero destinations is fatal (proxy.go:232-243).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from collections import defaultdict
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, List, Optional
+
+from veneur_tpu.config import ProxyConfig, parse_duration
+from veneur_tpu.discovery import ConsulDiscoverer, Discoverer, StaticDiscoverer
+from veneur_tpu.forward.http_forward import post_helper
+from veneur_tpu.httpserv import ImportError400, unmarshal_metrics_from_http
+from veneur_tpu.proxy.consistent import ConsistentRing, EmptyRingError
+
+log = logging.getLogger("veneur.proxy")
+
+
+def metric_ring_key(d: dict) -> str:
+    """The hash key for one JSON metric — MetricKey.String()
+    (samplers/parser.go:50-56): name + type + joined sorted tags."""
+    return d["name"] + d["type"] + ",".join(d.get("tags") or [])
+
+
+class _ProxyHandler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, fmt, *args):
+        log.debug("proxy http: " + fmt, *args)
+
+    def _reply(self, status: int, body: str = ""):
+        data = body.encode()
+        self.send_response(status)
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def _drain_body(self) -> bytes:
+        # always consume the body: leftovers desync keep-alive connections
+        length = int(self.headers.get("Content-Length") or 0)
+        return self.rfile.read(length) if length else b""
+
+    def do_GET(self):
+        self._drain_body()
+        if self.path == "/healthcheck":
+            self._reply(200, "ok")
+        else:
+            self._reply(404, "not found")
+
+    def do_POST(self):
+        body = self._drain_body()
+        if self.path != "/import":
+            self._reply(404, "not found")
+            return
+        try:
+            metrics = unmarshal_metrics_from_http(self.headers, body)
+        except ImportError400 as e:
+            self._reply(400, str(e))
+            return
+        # accept, then fan out off the request thread
+        # (handlers_global.go:28-43: "go p.ProxyMetrics")
+        self._reply(202, "accepted")
+        threading.Thread(target=self.server.veneur_proxy.proxy_metrics,
+                         args=(metrics,), daemon=True).start()
+
+
+class Proxy:
+    """veneur-proxy: consistent-hash availability layer for the global tier."""
+
+    def __init__(self, config: ProxyConfig,
+                 discoverer: Optional[Discoverer] = None):
+        self.config = config
+        self.forward_timeout = parse_duration(config.forward_timeout or "10s")
+        self.refresh_interval = parse_duration(
+            config.consul_refresh_interval or "30s")
+        self.service_name = config.consul_forward_service_name
+        if discoverer is not None:
+            self.discoverer = discoverer
+        elif self.service_name:
+            self.discoverer = ConsulDiscoverer()
+        elif config.forward_address:
+            self.discoverer = StaticDiscoverer([config.forward_address])
+            self.service_name = "static"
+        else:
+            raise ValueError(
+                "proxy needs consul_forward_service_name or forward_address")
+
+        self.ring = ConsistentRing()
+        self._stop = threading.Event()
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._threads: List[threading.Thread] = []
+        # telemetry
+        self.proxied = 0
+        self.forward_errors = 0
+        self.refresh_failures = 0
+        self._lock = threading.Lock()
+
+    # -- discovery ----------------------------------------------------------
+
+    def refresh_destinations(self):
+        """Re-resolve membership; a failure or empty result keeps the
+        previous ring (proxy.go:337-371)."""
+        try:
+            destinations = self.discoverer.get_destinations_for_service(
+                self.service_name)
+        except Exception as e:
+            with self._lock:
+                self.refresh_failures += 1
+            log.warning("destination refresh failed, keeping %d known: %s",
+                        len(self.ring), e)
+            return
+        if not destinations:
+            with self._lock:
+                self.refresh_failures += 1
+            log.warning("discovery returned zero destinations, keeping %d",
+                        len(self.ring))
+            return
+        self.ring.set_members(destinations)
+
+    def _refresh_loop(self):
+        while not self._stop.wait(self.refresh_interval):
+            self.refresh_destinations()
+
+    # -- proxying -----------------------------------------------------------
+
+    def proxy_metrics(self, metrics: List[dict]):
+        """Hash each metric to its destination, batch, POST in parallel
+        (proxy.go:437-505)."""
+        by_dest: Dict[str, List[dict]] = defaultdict(list)
+        dropped = 0
+        for d in metrics:
+            try:
+                by_dest[self.ring.get(metric_ring_key(d))].append(d)
+            except (EmptyRingError, KeyError):
+                dropped += 1
+        if dropped:
+            log.warning("dropped %d unroutable metrics", dropped)
+        threads = []
+        for dest, batch in by_dest.items():
+            t = threading.Thread(target=self._post_batch, args=(dest, batch),
+                                 daemon=True)
+            t.start()
+            threads.append(t)
+        for t in threads:
+            t.join(timeout=self.forward_timeout + 1.0)
+
+    def _post_batch(self, dest: str, batch: List[dict]):
+        url = dest.rstrip("/")
+        if not url.startswith(("http://", "https://")):
+            url = "http://" + url
+        try:
+            status = post_helper(url + "/import", batch,
+                                 timeout=self.forward_timeout)
+            if not 200 <= status < 300:
+                raise OSError(f"destination returned HTTP {status}")
+            with self._lock:
+                self.proxied += len(batch)
+        except Exception as e:
+            with self._lock:
+                self.forward_errors += 1
+            log.warning("failed to proxy %d metrics to %s: %s",
+                        len(batch), dest, e)
+
+    # -- lifecycle ----------------------------------------------------------
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1] if self._httpd else 0
+
+    def start(self):
+        """Initial refresh (fatal on empty), refresh loop, HTTP listener
+        (proxy.go:206-287)."""
+        self.refresh_destinations()
+        if len(self.ring) == 0:
+            raise RuntimeError(
+                "refusing to start with zero destinations (proxy.go:232-243)")
+        if not isinstance(self.discoverer, StaticDiscoverer):
+            t = threading.Thread(target=self._refresh_loop,
+                                 name="proxy-refresh", daemon=True)
+            t.start()
+            self._threads.append(t)
+        host, _, port = (self.config.http_address or "0.0.0.0:8127"
+                         ).rpartition(":")
+        self._httpd = ThreadingHTTPServer((host or "0.0.0.0", int(port)),
+                                          _ProxyHandler)
+        self._httpd.daemon_threads = True
+        self._httpd.veneur_proxy = self
+        t = threading.Thread(target=self._httpd.serve_forever,
+                             name="proxy-http", daemon=True)
+        t.start()
+        self._threads.append(t)
+        log.info("veneur-proxy listening on port %d with %d destinations",
+                 self.port, len(self.ring))
+
+    def shutdown(self):
+        self._stop.set()
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
