@@ -20,7 +20,8 @@ log = logging.getLogger("veneur.forward.http")
 
 
 def post_helper(url: str, payload, timeout: float = 10.0,
-                compress: bool = True, headers: dict = None) -> int:
+                compress: bool = True, headers: dict = None,
+                method: str = "POST") -> int:
     """POST a JSON payload, optionally deflated (http/http.go:123-247).
     Returns the HTTP status (including non-2xx); raises only on transport
     errors."""
@@ -31,7 +32,7 @@ def post_helper(url: str, payload, timeout: float = 10.0,
         hdrs["Content-Encoding"] = "deflate"
     if headers:
         hdrs.update(headers)
-    req = urllib.request.Request(url, data=body, headers=hdrs, method="POST")
+    req = urllib.request.Request(url, data=body, headers=hdrs, method=method)
     try:
         with urllib.request.urlopen(req, timeout=timeout) as resp:
             return resp.status

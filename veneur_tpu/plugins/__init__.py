@@ -1,0 +1,23 @@
+"""Flush-time archival plugins (``/root/reference/plugins/plugins.go:16-19``).
+
+Plugins receive the full ``[InterMetric]`` batch after the sinks each
+flush (flusher.go:95-109) and archive it (S3, local file).
+"""
+
+from __future__ import annotations
+
+import abc
+from typing import List
+
+from veneur_tpu.samplers.intermetric import InterMetric
+
+
+class Plugin(abc.ABC):
+    """plugins.Plugin (plugins/plugins.go:16-19)."""
+
+    @property
+    @abc.abstractmethod
+    def name(self) -> str: ...
+
+    @abc.abstractmethod
+    def flush(self, metrics: List[InterMetric]) -> None: ...

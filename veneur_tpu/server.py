@@ -112,14 +112,19 @@ class Server:
         self.event_worker = EventWorker()
         self.span_chan: "queue.Queue" = queue.Queue(config.span_channel_capacity)
 
-        self.metric_sinks: List[MetricSink] = list(metric_sinks or [])
-        self.span_sinks: List[SpanSink] = list(span_sinks or [])
+        # config-driven backends (server.go:350-519) plus any injected ones
+        from veneur_tpu.sinks.factory import create_sinks
+        cfg_metric_sinks, cfg_span_sinks, cfg_plugins = create_sinks(config)
+        self.metric_sinks: List[MetricSink] = (list(metric_sinks or [])
+                                               + cfg_metric_sinks)
+        self.span_sinks: List[SpanSink] = (list(span_sinks or [])
+                                           + cfg_span_sinks)
         # the extraction sink is how SSF samples reach the store
         # (server.go:282-290)
         self.span_sinks.append(MetricExtractionSink(
             self.store.process_metric, config.indicator_span_timer_name))
 
-        self.plugins: List = []
+        self.plugins: List = cfg_plugins
         # set by the forwarding layer (veneur_tpu.forward) when local
         self.forward_fn: Optional[Callable] = None
         self._forwarder = None

@@ -1,0 +1,277 @@
+"""Datadog sinks: series/check/event metric sink + trace-agent span sink.
+
+Behavioral port of ``/root/reference/sinks/datadog/datadog.go``:
+
+- ``DatadogMetricSink.flush`` finalizes InterMetrics (magic ``host:`` /
+  ``device:`` tags, counters→rates, status→service check;
+  datadog.go:245-322) and POSTs them to ``/api/v1/series`` in
+  approximately equal chunks of ≤ ``flush_max_per_body``, in parallel
+  (datadog.go:324-330). Service checks go to ``/api/v1/check_run``
+  uncompressed; DogStatsD events arrive via ``flush_other_samples`` and
+  go to ``/intake`` (datadog.go:155-243).
+- ``DatadogSpanSink`` keeps the newest ``buffer_size`` spans in a ring
+  (datadog.go:387-397), and each flush groups them by trace id and PUTs
+  ``[[span…]…]`` to the trace agent's ``/v0.3/traces`` (datadog.go:460-530).
+
+Transport is injectable (``post``) so tests run against a local fixture,
+the role ``httptest.Server`` plays in the reference's tests
+(datadog_test.go).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from collections import deque
+from typing import Callable, Dict, List, Optional, Sequence
+
+from veneur_tpu.forward.http_forward import post_helper
+from veneur_tpu.protocol import constants as dogstatsd
+from veneur_tpu.protocol import wire
+from veneur_tpu.samplers.intermetric import InterMetric, MetricType
+from veneur_tpu.sinks.base import MetricSink, SpanSink
+
+log = logging.getLogger("veneur.sinks.datadog")
+
+DATADOG_NAME_KEY = "name"
+DATADOG_RESOURCE_KEY = "resource"
+DATADOG_SPAN_TYPE = "web"
+
+# post(url, payload, compress, method) -> status
+PostFn = Callable[..., int]
+
+
+def _default_post(url: str, payload, compress: bool = True,
+                  method: str = "POST") -> int:
+    return post_helper(url, payload, compress=compress, method=method)
+
+
+def _ok(status: int) -> bool:
+    """Success statuses per the reference's PostHelper
+    (http/http.go:230-236): 200 or 202."""
+    return status in (200, 202)
+
+
+class DatadogMetricSink(MetricSink):
+    """Flushes InterMetrics to the Datadog v1 series API
+    (datadog.go:34-357)."""
+
+    def __init__(self, interval: float, flush_max_per_body: int,
+                 hostname: str, tags: Sequence[str], dd_hostname: str,
+                 api_key: str, post: Optional[PostFn] = None):
+        self.interval = interval
+        self.flush_max_per_body = max(1, flush_max_per_body)
+        self.hostname = hostname
+        self.tags = list(tags)
+        self.dd_hostname = dd_hostname.rstrip("/")
+        self.api_key = api_key
+        self.post = post or _default_post
+        self.metrics_flushed = 0
+        self.flush_errors = 0
+
+    @property
+    def name(self) -> str:
+        return "datadog"
+
+    def flush(self, metrics: List[InterMetric]) -> None:
+        dd_metrics, checks = self.finalize_metrics(metrics)
+        if checks:
+            # check_run takes an array but not deflate (datadog.go:113-116)
+            try:
+                status = self.post(
+                    f"{self.dd_hostname}/api/v1/check_run"
+                    f"?api_key={self.api_key}", checks, compress=False)
+                if not _ok(status):
+                    log.warning("Datadog check_run returned HTTP %d", status)
+                    self.flush_errors += 1
+            except OSError:
+                log.warning("error flushing checks to Datadog", exc_info=True)
+                self.flush_errors += 1
+        if not dd_metrics:
+            return
+        # equal-size chunks under flush_max_per_body, rounding-up division
+        # (datadog.go:127-146)
+        workers = ((len(dd_metrics) - 1) // self.flush_max_per_body) + 1
+        chunk_size = ((len(dd_metrics) - 1) // workers) + 1
+        threads = []
+        for i in range(workers):
+            chunk = dd_metrics[i * chunk_size:(i + 1) * chunk_size]
+            t = threading.Thread(target=self._flush_part, args=(chunk,),
+                                 daemon=True)
+            t.start()
+            threads.append(t)
+        for t in threads:
+            t.join()
+        self.metrics_flushed += len(dd_metrics)
+
+    def _flush_part(self, chunk: List[dict]) -> None:
+        try:
+            status = self.post(f"{self.dd_hostname}/api/v1/series"
+                               f"?api_key={self.api_key}", {"series": chunk})
+            if not _ok(status):
+                log.warning("Datadog series flush returned HTTP %d", status)
+                self.flush_errors += 1
+        except OSError:
+            log.warning("error flushing metrics to Datadog", exc_info=True)
+            self.flush_errors += 1
+
+    def finalize_metrics(self, metrics: List[InterMetric]):
+        """InterMetric → DDMetric/DDServiceCheck dicts (datadog.go:245-322)."""
+        dd_metrics: List[dict] = []
+        checks: List[dict] = []
+        for m in metrics:
+            if not m.is_acceptable_to(self.name):
+                continue
+            tags = list(self.tags)
+            hostname = ""
+            devicename = ""
+            for tag in m.tags:
+                if tag.startswith("host:"):
+                    hostname = tag[5:]
+                elif tag.startswith("device:"):
+                    devicename = tag[7:]
+                else:
+                    tags.append(tag)
+            if not hostname:
+                hostname = m.hostname or self.hostname
+
+            if m.type == MetricType.STATUS:
+                checks.append({
+                    "check": m.name,
+                    "status": int(m.value),
+                    "timestamp": m.timestamp,
+                    "message": m.message,
+                    "host_name": hostname,
+                    "tags": tags,
+                })
+                continue
+
+            if m.type == MetricType.COUNTER:
+                # counters become rates for Datadog (datadog.go:295-297)
+                metric_type = "rate"
+                value = m.value / self.interval
+            elif m.type == MetricType.GAUGE:
+                metric_type = "gauge"
+                value = m.value
+            else:
+                log.warning("unknown metric type %s", m.type)
+                continue
+
+            dd_metrics.append({
+                "metric": m.name,
+                "points": [[float(m.timestamp), value]],
+                "tags": tags,
+                "type": metric_type,
+                "interval": int(self.interval),
+                "host": hostname,
+                "device_name": devicename,
+            })
+        return dd_metrics, checks
+
+    def flush_other_samples(self, samples) -> None:
+        """DogStatsD events → ``/intake`` (datadog.go:155-243)."""
+        events = []
+        for sample in samples:
+            tags = dict(sample.tags)
+            if dogstatsd.EVENT_IDENTIFIER_KEY not in tags:
+                log.warning("received a non-event SSF sample in "
+                            "flush_other_samples")
+                continue
+            del tags[dogstatsd.EVENT_IDENTIFIER_KEY]
+            event = {
+                "msg_title": sample.name,
+                "msg_text": sample.message,
+                "timestamp": sample.timestamp,
+                "priority": "normal",
+                "alert_type": "info",
+            }
+            if dogstatsd.EVENT_AGGREGATION_KEY_TAG in tags:
+                event["aggregation_key"] = tags.pop(
+                    dogstatsd.EVENT_AGGREGATION_KEY_TAG)
+            if dogstatsd.EVENT_PRIORITY_TAG in tags:
+                event["priority"] = tags.pop(dogstatsd.EVENT_PRIORITY_TAG)
+            if dogstatsd.EVENT_SOURCE_TYPE_TAG in tags:
+                event["source_type_name"] = tags.pop(
+                    dogstatsd.EVENT_SOURCE_TYPE_TAG)
+            if dogstatsd.EVENT_ALERT_TYPE_TAG in tags:
+                event["alert_type"] = tags.pop(dogstatsd.EVENT_ALERT_TYPE_TAG)
+            if dogstatsd.EVENT_HOSTNAME_TAG in tags:
+                event["host"] = tags.pop(dogstatsd.EVENT_HOSTNAME_TAG)
+            else:
+                event["host"] = self.hostname
+            event["tags"] = [f"{k}:{v}" for k, v in tags.items()] + self.tags
+            events.append(event)
+        if not events:
+            return
+        try:
+            status = self.post(
+                f"{self.dd_hostname}/intake?api_key={self.api_key}",
+                {"events": {"api": events}})
+            if not _ok(status):
+                log.warning("Datadog event intake returned HTTP %d", status)
+                self.flush_errors += 1
+        except OSError:
+            log.warning("error flushing events to Datadog", exc_info=True)
+            self.flush_errors += 1
+
+
+class DatadogSpanSink(SpanSink):
+    """Ring-buffered span sink for the Datadog trace agent
+    (datadog.go:359-530)."""
+
+    def __init__(self, trace_address: str, buffer_size: int = 16384,
+                 post: Optional[PostFn] = None):
+        self.trace_address = trace_address.rstrip("/")
+        self.buffer_size = buffer_size
+        # deque(maxlen) == the reference's container/ring: newest
+        # buffer_size spans win (datadog.go:395-397)
+        self._buffer: deque = deque(maxlen=buffer_size)
+        self._lock = threading.Lock()
+        self.post = post or _default_post
+        self.spans_flushed = 0
+
+    @property
+    def name(self) -> str:
+        return "datadog"
+
+    def ingest(self, span) -> None:
+        if not wire.valid_trace(span):
+            raise ValueError("invalid span for datadog sink")
+        with self._lock:
+            self._buffer.append(span)
+
+    def flush(self) -> None:
+        with self._lock:
+            spans = list(self._buffer)
+            self._buffer.clear()
+        if not spans:
+            return
+        trace_map: Dict[int, List[dict]] = {}
+        for span in spans:
+            tags = dict(span.tags)
+            resource = tags.pop(DATADOG_RESOURCE_KEY, "") or "unknown"
+            trace_map.setdefault(span.trace_id, []).append({
+                "trace_id": span.trace_id,
+                "span_id": span.id,
+                "parent_id": max(span.parent_id, 0),
+                "service": span.service,
+                "name": span.name or "unknown",
+                "resource": resource,
+                "start": span.start_timestamp,
+                "duration": span.end_timestamp - span.start_timestamp,
+                "type": DATADOG_SPAN_TYPE,
+                "error": 2 if span.error else 0,
+                "meta": tags,
+            })
+        # two-dimensional: spans grouped per trace (datadog.go:503-508)
+        final_traces = list(trace_map.values())
+        try:
+            # /v0.3/traces takes PUT without deflate (datadog.go:510-515)
+            status = self.post(f"{self.trace_address}/v0.3/traces",
+                               final_traces, compress=False, method="PUT")
+            if _ok(status):
+                self.spans_flushed += len(spans)
+            else:
+                log.warning("Datadog trace flush returned HTTP %d", status)
+        except OSError:
+            log.warning("error flushing traces to Datadog", exc_info=True)

@@ -1,0 +1,136 @@
+"""Sink/plugin construction from config.
+
+Mirrors the sink-construction section of ``NewFromConfig``
+(``/root/reference/server.go:350-519``): each backend comes up iff its
+config keys are set — SignalFx (server.go:350-390), Datadog metric +
+span sinks (:392-419), LightStep (:421-437), Falconer (:439-449), Kafka
+(:451-472), debug sinks under ``debug_flushed_metrics`` /
+``debug_ingested_spans``, and the S3/localfile plugins (:477-519).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import List, Tuple
+
+from veneur_tpu.config import Config, parse_duration
+from veneur_tpu.plugins import Plugin
+from veneur_tpu.plugins.localfile import LocalFilePlugin
+from veneur_tpu.plugins.s3 import S3Plugin
+from veneur_tpu.sinks.base import MetricSink, SpanSink
+from veneur_tpu.sinks.datadog import DatadogMetricSink, DatadogSpanSink
+from veneur_tpu.sinks.debug import DebugMetricSink, DebugSpanSink
+from veneur_tpu.sinks.falconer import new_falconer_span_sink
+from veneur_tpu.sinks.kafka import (KafkaMetricSink, KafkaSpanSink,
+                                    ProducerConfig)
+from veneur_tpu.sinks.lightstep import LightStepSpanSink
+from veneur_tpu.sinks.signalfx import SignalFxClient, SignalFxSink
+
+log = logging.getLogger("veneur.sinks.factory")
+
+
+def create_sinks(config: Config) -> Tuple[List[MetricSink], List[SpanSink],
+                                          List[Plugin]]:
+    metric_sinks: List[MetricSink] = []
+    span_sinks: List[SpanSink] = []
+    plugins: List[Plugin] = []
+    interval = parse_duration(config.interval)
+
+    if config.signalfx_api_key and config.signalfx_endpoint_base:
+        per_tag = {}
+        for entry in config.signalfx_per_tag_api_keys:
+            # list of {name:, api_key:} maps (config.go signalfx keys)
+            per_tag[entry.get("name", "")] = SignalFxClient(
+                config.signalfx_endpoint_base, entry.get("api_key", ""))
+        metric_sinks.append(SignalFxSink(
+            hostname_tag=config.signalfx_hostname_tag or "host",
+            hostname=config.hostname,
+            client=SignalFxClient(config.signalfx_endpoint_base,
+                                  config.signalfx_api_key),
+            vary_by=config.signalfx_vary_key_by,
+            per_tag_clients=per_tag,
+            excluded_tags=config.tags_exclude))
+
+    if config.datadog_api_key and config.datadog_api_hostname:
+        metric_sinks.append(DatadogMetricSink(
+            interval=interval,
+            flush_max_per_body=config.datadog_flush_max_per_body,
+            hostname=config.hostname, tags=config.tags,
+            dd_hostname=config.datadog_api_hostname,
+            api_key=config.datadog_api_key))
+    if config.datadog_trace_api_address:
+        span_sinks.append(DatadogSpanSink(
+            trace_address=config.datadog_trace_api_address,
+            buffer_size=config.datadog_span_buffer_size))
+
+    if config.lightstep_collector_host:
+        span_sinks.append(LightStepSpanSink(
+            collector=config.lightstep_collector_host,
+            reconnect_period=parse_duration(config.lightstep_reconnect_period)
+            if config.lightstep_reconnect_period else 0.0,
+            maximum_spans=config.lightstep_maximum_spans or 1024,
+            num_clients=config.lightstep_num_clients,
+            access_token=config.lightstep_access_token))
+
+    if config.falconer_address:
+        span_sinks.append(new_falconer_span_sink(config.falconer_address))
+
+    if config.kafka_broker:
+        if config.kafka_metric_topic:
+            metric_sinks.append(KafkaMetricSink(
+                brokers=config.kafka_broker,
+                metric_topic=config.kafka_metric_topic,
+                check_topic=config.kafka_check_topic,
+                event_topic=config.kafka_event_topic,
+                config=ProducerConfig(
+                    ack_requirement=config.kafka_metric_require_acks or "all",
+                    partitioner=config.kafka_partitioner or "hash",
+                    retries=config.kafka_retry_max,
+                    buffer_bytes=config.kafka_metric_buffer_bytes,
+                    buffer_messages=config.kafka_metric_buffer_messages,
+                    buffer_frequency=parse_duration(
+                        config.kafka_metric_buffer_frequency)
+                    if config.kafka_metric_buffer_frequency else 0.0)))
+        if config.kafka_span_topic:
+            span_sinks.append(KafkaSpanSink(
+                brokers=config.kafka_broker,
+                topic=config.kafka_span_topic,
+                serialization_format=(
+                    config.kafka_span_serialization_format or "protobuf"),
+                sample_tag=config.kafka_span_sample_tag,
+                sample_rate_percentage=(
+                    config.kafka_span_sample_rate_percent or 100),
+                config=ProducerConfig(
+                    ack_requirement=config.kafka_span_require_acks or "all",
+                    partitioner=config.kafka_partitioner or "hash",
+                    retries=config.kafka_retry_max,
+                    buffer_bytes=config.kafka_span_buffer_bytes,
+                    buffer_messages=config.kafka_span_buffer_mesages,
+                    buffer_frequency=parse_duration(
+                        config.kafka_span_buffer_frequency)
+                    if config.kafka_span_buffer_frequency else 0.0)))
+
+    if config.debug_flushed_metrics:
+        metric_sinks.append(DebugMetricSink())
+    if config.debug_ingested_spans:
+        span_sinks.append(DebugSpanSink())
+
+    if config.aws_s3_bucket:
+        svc = None
+        try:
+            import boto3  # optional, not bundled
+            svc = boto3.client("s3", region_name=config.aws_region or None)
+        except ImportError:
+            log.warning("aws_s3_bucket configured but boto3 is unavailable; "
+                        "S3 plugin will error on flush until a client is "
+                        "injected")
+        plugins.append(S3Plugin(hostname=config.hostname,
+                                bucket=config.aws_s3_bucket,
+                                interval=int(interval), svc=svc))
+
+    if config.flush_file:
+        plugins.append(LocalFilePlugin(file_path=config.flush_file,
+                                       hostname=config.hostname,
+                                       interval=int(interval)))
+
+    return metric_sinks, span_sinks, plugins

@@ -1,0 +1,10 @@
+"""Falconer span sink: a named wrapper over the generic gRPC span sink
+(``/root/reference/sinks/falconer/falconer.go:11-17``)."""
+
+from __future__ import annotations
+
+from veneur_tpu.sinks.grpsink import GRPCSpanSink
+
+
+def new_falconer_span_sink(target: str, timeout: float = 10.0) -> GRPCSpanSink:
+    return GRPCSpanSink(target, name="falconer", timeout=timeout)

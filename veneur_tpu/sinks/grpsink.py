@@ -1,0 +1,132 @@
+"""Generic gRPC span sink: stream each span via ``SpanSink.SendSpan``.
+
+Behavioral port of ``/root/reference/sinks/grpsink/grpsink.go``: each
+ingested span is validated and sent as one unary RPC
+(``/grpsink.SpanSink/SendSpan``, grpc_sink.proto:8-10); errors increment
+the drop counter and are logged once per connection-state transition to
+avoid log spew under duress (grpsink.go:98-137); ``flush`` reports the
+sent/dropped totals since the last flush (grpsink.go:139-160).
+
+Also provides ``SpanSinkServer``, the in-process receiving end the
+reference builds for its tests (grpsink_test.go) — and the Falconer
+service this sink fronts in production.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from concurrent import futures
+from typing import Callable, List, Optional
+
+import grpc
+
+from veneur_tpu.protocol import wire
+from veneur_tpu.protocol.gen.grpsink import grpc_sink_pb2
+from veneur_tpu.protocol.gen.ssf import sample_pb2
+from veneur_tpu.sinks.base import SpanSink
+
+log = logging.getLogger("veneur.sinks.grpc")
+
+_METHOD = "/grpsink.SpanSink/SendSpan"
+
+
+class GRPCSpanSink(SpanSink):
+    """Streams spans to a remote gRPC SpanSink service
+    (grpsink.go:30-160)."""
+
+    def __init__(self, target: str, name: str = "grpc",
+                 timeout: float = 10.0):
+        self.target = target
+        self._name = name
+        self.timeout = timeout
+        self._channel = grpc.insecure_channel(target)
+        self._send = self._channel.unary_unary(
+            _METHOD,
+            request_serializer=sample_pb2.SSFSpan.SerializeToString,
+            response_deserializer=grpc_sink_pb2.Empty.FromString,
+        )
+        self._lock = threading.Lock()
+        self.sent_count = 0
+        self.drop_count = 0
+        # log one error per connection-state transition (grpsink.go:115-127)
+        self._logged_since_transition = False
+        self._channel.subscribe(self._on_state_change)
+
+    @property
+    def name(self) -> str:
+        return self._name
+
+    def _on_state_change(self, connectivity) -> None:
+        with self._lock:
+            self._logged_since_transition = False
+
+    def ingest(self, span) -> None:
+        if not wire.valid_trace(span):
+            raise ValueError("invalid span for gRPC sink")
+        try:
+            self._send(span, timeout=self.timeout)
+            with self._lock:
+                self.sent_count += 1
+        except grpc.RpcError as e:
+            # count the drop but don't propagate: re-raising would make the
+            # span worker log a traceback per span — the log spew under
+            # duress grpsink.go:115-127 exists to avoid
+            with self._lock:
+                self.drop_count += 1
+                should_log = not self._logged_since_transition
+                self._logged_since_transition = True
+            if should_log:
+                log.error("Error sending span to gRPC sink target %s "
+                          "(name=%s): %s", self.target, self._name, e)
+
+    def flush(self) -> None:
+        """Report + reset sent/dropped totals (grpsink.go:139-160)."""
+        with self._lock:
+            sent, dropped = self.sent_count, self.drop_count
+            self.sent_count = 0
+            self.drop_count = 0
+        if sent or dropped:
+            log.info("gRPC span sink %s: %d sent, %d dropped since last "
+                     "flush", self._name, sent, dropped)
+
+    def close(self) -> None:
+        self._channel.close()
+
+
+class SpanSinkServer:
+    """In-process gRPC SpanSink service — the receiving end
+    (grpsink_test.go's MockSpanSinkServer; production: Falconer)."""
+
+    def __init__(self, handler: Optional[Callable] = None, workers: int = 4):
+        self.spans: List = []
+        self._handler = handler
+        self._lock = threading.Lock()
+        self._grpc = grpc.server(
+            futures.ThreadPoolExecutor(max_workers=workers))
+        h = grpc.method_handlers_generic_handler(
+            "grpsink.SpanSink",
+            {"SendSpan": grpc.unary_unary_rpc_method_handler(
+                self._send_span,
+                request_deserializer=sample_pb2.SSFSpan.FromString,
+                response_serializer=grpc_sink_pb2.Empty.SerializeToString)})
+        self._grpc.add_generic_rpc_handlers((h,))
+        self.port: Optional[int] = None
+
+    def _send_span(self, span, context):
+        if self._handler is not None:
+            self._handler(span)
+        else:
+            with self._lock:
+                self.spans.append(span)
+        return grpc_sink_pb2.Empty()
+
+    def start(self, addr: str = "[::]:0") -> int:
+        self.port = self._grpc.add_insecure_port(addr)
+        if self.port == 0:
+            raise RuntimeError(f"could not bind span sink server to {addr}")
+        self._grpc.start()
+        return self.port
+
+    def stop(self, grace: float = 1.0):
+        self._grpc.stop(grace).wait(timeout=grace + 1.0)

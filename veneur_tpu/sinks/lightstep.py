@@ -1,0 +1,141 @@
+"""LightStep span sink: a tracer pool round-robined by trace id.
+
+Behavioral port of ``/root/reference/sinks/lightstep/lightstep.go``:
+``num_clients`` tracer clients are created against the collector URL
+(http scheme ⇒ plaintext, default port 8080; lightstep.go:41-110) and
+each span is routed to ``tracers[trace_id % len(tracers)]``
+(lightstep.go:146-148), translated to an OpenTracing-style span — parent
+id clamped to 0, ``error-code`` / ``indicator`` / component tags, error
+flag — and finished with the SSF end timestamp (lightstep.go:124-175).
+``flush`` reports and resets the per-service counts (lightstep.go:203+).
+
+The proprietary LightStep transport is not bundled; a ``tracer_factory``
+returning objects with ``report(span_dict)`` (and optionally ``close()``)
+is injected — the production factory would wrap the LightStep gRPC
+collector protocol.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Callable, Dict, List, Optional
+from urllib.parse import urlparse
+
+from veneur_tpu.protocol import wire
+from veneur_tpu.sinks.base import SpanSink
+
+log = logging.getLogger("veneur.sinks.lightstep")
+
+LIGHTSTEP_DEFAULT_PORT = 8080
+LIGHTSTEP_DEFAULT_INTERVAL = 300.0  # 5 minutes (lightstep.go:29)
+INDICATOR_SPAN_TAG_NAME = "indicator"
+RESOURCE_KEY = "resource"
+
+
+class BufferingTracer:
+    """Default tracer: buffers up to ``max_spans`` converted spans for an
+    external shipper (the role the LightStep client's in-memory span
+    buffer plays, lightstep.go:96-101)."""
+
+    def __init__(self, max_spans: int = 1024):
+        self.max_spans = max_spans
+        self.spans: List[dict] = []
+        self._lock = threading.Lock()
+        self.dropped = 0
+
+    def report(self, span: dict) -> None:
+        with self._lock:
+            if len(self.spans) >= self.max_spans:
+                self.dropped += 1
+                self.spans.pop(0)
+            self.spans.append(span)
+
+    def drain(self) -> List[dict]:
+        with self._lock:
+            out, self.spans = self.spans, []
+            return out
+
+    def close(self) -> None:
+        pass
+
+
+class LightStepSpanSink(SpanSink):
+    """Round-robin tracer-pool span sink (lightstep.go:30-210)."""
+
+    def __init__(self, collector: str, reconnect_period: float = 0.0,
+                 maximum_spans: int = 1024, num_clients: int = 1,
+                 access_token: str = "",
+                 tracer_factory: Optional[Callable[..., object]] = None):
+        host = urlparse(collector if "//" in collector
+                        else "//" + collector)
+        try:
+            self.port = host.port or LIGHTSTEP_DEFAULT_PORT
+        except ValueError:
+            log.warning("Error parsing LightStep port, using default %d",
+                        LIGHTSTEP_DEFAULT_PORT)
+            self.port = LIGHTSTEP_DEFAULT_PORT
+        self.host = host.hostname or "localhost"
+        self.plaintext = host.scheme == "http"
+        self.access_token = access_token
+        self.reconnect_period = reconnect_period or LIGHTSTEP_DEFAULT_INTERVAL
+        n = num_clients if num_clients > 0 else 1  # lightstep.go:77-81
+        factory = tracer_factory or (
+            lambda **kw: BufferingTracer(max_spans=maximum_spans))
+        self.tracers = [
+            factory(host=self.host, port=self.port,
+                    plaintext=self.plaintext, access_token=access_token,
+                    max_spans=maximum_spans,
+                    reconnect_period=self.reconnect_period)
+            for _ in range(n)
+        ]
+        self._lock = threading.Lock()
+        self._service_count: Dict[str, int] = {}
+
+    @property
+    def name(self) -> str:
+        return "lightstep"
+
+    def ingest(self, span) -> None:
+        if not wire.valid_trace(span):
+            raise ValueError("invalid span for lightstep sink")
+        if not self.tracers:
+            raise RuntimeError("No lightstep tracer clients initialized")
+        parent_id = max(span.parent_id, 0)
+        error_code = 1 if span.error else 0
+        tags = dict(span.tags)
+        tags[RESOURCE_KEY] = tags.get(RESOURCE_KEY, "")
+        tags["component"] = span.service
+        tags[INDICATOR_SPAN_TAG_NAME] = str(span.indicator).lower()
+        tags["type"] = "http"
+        tags["error-code"] = error_code
+        if error_code:
+            tags["error"] = True  # OT-standard error flag
+        tracer = self.tracers[span.trace_id % len(self.tracers)]
+        tracer.report({
+            "operation_name": span.name,
+            "trace_id": span.trace_id,
+            "span_id": span.id,
+            "parent_span_id": parent_id,
+            "start_timestamp": span.start_timestamp,
+            "end_timestamp": span.end_timestamp,
+            "tags": tags,
+        })
+        service = span.service or "unknown"
+        with self._lock:
+            self._service_count[service] = (
+                self._service_count.get(service, 0) + 1)
+
+    def flush(self) -> None:
+        """Report + reset per-service counts (lightstep.go:203+)."""
+        with self._lock:
+            counts, self._service_count = self._service_count, {}
+        for service, count in counts.items():
+            log.info("lightstep sink: %d spans flushed for service %s",
+                     count, service)
+
+    def close(self) -> None:
+        for t in self.tracers:
+            close = getattr(t, "close", None)
+            if close:
+                close()

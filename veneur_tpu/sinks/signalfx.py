@@ -1,0 +1,202 @@
+"""SignalFx sink: dimension-based datapoints with per-tag API-key fanout.
+
+Behavioral port of ``/root/reference/sinks/signalfx/signalfx.go``:
+
+- InterMetrics become SignalFx datapoints — gauges stay gauges, counters
+  stay counters, status checks are emitted as gauges
+  (signalfx.go:195-210); every tag becomes a dimension, the hostname is a
+  dimension too since SFx has no first-class host field
+  (signalfx.go:169-184), common dimensions are merged and excluded tags
+  dropped (signalfx.go:185-192, SetExcludedTags :255).
+- ``vary_key_by``: when set, the value of that tag selects a per-key
+  client (its own API token); unmatched values use the default client
+  (signalfx.go:135-143, :31-66). Each client's batch is submitted in
+  parallel.
+- DogStatsD events (``flush_other_samples``) are sent as SFx events to
+  ``/v2/event`` (signalfx.go:227-253, reportEvent :272+).
+
+The HTTP client is injectable for tests (the reference's tests swap the
+``DPClient``; signalfx_test.go).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+import urllib.error
+import urllib.request
+from typing import Dict, List, Optional, Sequence
+
+from veneur_tpu.protocol import constants as dogstatsd
+from veneur_tpu.samplers.intermetric import InterMetric, MetricType
+from veneur_tpu.sinks.base import MetricSink
+
+log = logging.getLogger("veneur.sinks.signalfx")
+
+EVENT_CATEGORY_USER_DEFINED = "USER_DEFINED"
+
+
+class SignalFxClient:
+    """One SignalFx ingest endpoint + token (signalfx.go:97-106).
+
+    ``submit(datapoints)`` posts ``{"gauge": [...], "counter": [...]}`` to
+    ``/v2/datapoint``; ``submit_event(event)`` posts to ``/v2/event``.
+    """
+
+    def __init__(self, endpoint: str, api_key: str, timeout: float = 10.0):
+        self.endpoint = endpoint.rstrip("/")
+        self.api_key = api_key
+        self.timeout = timeout
+
+    def _post(self, path: str, payload) -> int:
+        body = json.dumps(payload).encode("utf-8")
+        req = urllib.request.Request(
+            self.endpoint + path, data=body,
+            headers={"Content-Type": "application/json",
+                     "X-Sf-Token": self.api_key},
+            method="POST")
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+                return resp.status
+        except urllib.error.HTTPError as e:
+            e.close()
+            return e.code
+
+    def submit(self, datapoints: List[dict]) -> int:
+        body: Dict[str, List[dict]] = {}
+        for dp in datapoints:
+            body.setdefault(dp.pop("_sfx_type"), []).append(dp)
+        return self._post("/v2/datapoint", body)
+
+    def submit_event(self, event: dict) -> int:
+        return self._post("/v2/event", [event])
+
+
+class SignalFxSink(MetricSink):
+    """Dimension-based metric sink with vary-by-tag client fanout
+    (signalfx.go:79-225)."""
+
+    def __init__(self, hostname_tag: str, hostname: str,
+                 common_dimensions: Optional[Dict[str, str]] = None,
+                 client: Optional[SignalFxClient] = None,
+                 vary_by: str = "",
+                 per_tag_clients: Optional[Dict[str, SignalFxClient]] = None,
+                 excluded_tags: Optional[Sequence[str]] = None):
+        self.hostname_tag = hostname_tag
+        self.hostname = hostname
+        self.common_dimensions = dict(common_dimensions or {})
+        self.default_client = client
+        self.vary_by = vary_by
+        self.clients_by_tag_value = dict(per_tag_clients or {})
+        self.excluded_tags = set(excluded_tags or ())
+        self.metrics_flushed = 0
+        self.metrics_skipped = 0
+        self.events_reported = 0
+
+    @property
+    def name(self) -> str:
+        return "signalfx"
+
+    def set_excluded_tags(self, excludes: Sequence[str]) -> None:
+        """SetExcludedTags (signalfx.go:255-262)."""
+        self.excluded_tags = set(excludes)
+
+    def _client(self, key: str) -> SignalFxClient:
+        return self.clients_by_tag_value.get(key, self.default_client)
+
+    def _dimensions(self, metric: InterMetric):
+        dims = {self.hostname_tag: metric.hostname or self.hostname}
+        for tag in metric.tags:
+            k, sep, v = tag.partition(":")
+            dims[k] = v if sep else ""
+        dims.update(self.common_dimensions)
+        metric_key = dims.get(self.vary_by, "") if self.vary_by else ""
+        for k in self.excluded_tags:
+            dims.pop(k, None)
+        dims.pop("veneursinkonly", None)
+        return dims, metric_key
+
+    def flush(self, metrics: List[InterMetric]) -> None:
+        points_by_key: Dict[str, List[dict]] = {"": []}
+        for m in metrics:
+            if not m.is_acceptable_to(self.name):
+                self.metrics_skipped += 1
+                continue
+            dims, metric_key = self._dimensions(m)
+            if m.type == MetricType.COUNTER:
+                point = {"_sfx_type": "counter", "metric": m.name,
+                         "dimensions": dims, "value": int(m.value),
+                         "timestamp": m.timestamp * 1000}
+            else:
+                # gauges and status checks both flush as gauges
+                # (signalfx.go:195-207)
+                point = {"_sfx_type": "gauge", "metric": m.name,
+                         "dimensions": dims, "value": m.value,
+                         "timestamp": m.timestamp * 1000}
+            points_by_key.setdefault(metric_key, []).append(point)
+            self.metrics_flushed += 1
+        if self.default_client is None:
+            return
+        # one parallel submission per client (signalfx.go:44-66)
+        threads = []
+        for key, points in points_by_key.items():
+            if not points:
+                continue
+            t = threading.Thread(target=self._submit_one,
+                                 args=(self._client(key), points),
+                                 daemon=True)
+            t.start()
+            threads.append(t)
+        for t in threads:
+            t.join()
+
+    def _submit_one(self, client: SignalFxClient, points: List[dict]) -> None:
+        try:
+            status = client.submit(points)
+            if status >= 300:
+                log.warning("signalfx datapoint submit returned HTTP %d "
+                            "(%d points dropped)", status, len(points))
+        except OSError:
+            log.warning("could not submit to signalfx", exc_info=True)
+
+    def flush_other_samples(self, samples) -> None:
+        """Events only; other sample kinds are ignored
+        (signalfx.go:227-253)."""
+        if self.default_client is None:
+            return
+        for sample in samples:
+            if dogstatsd.EVENT_IDENTIFIER_KEY not in sample.tags:
+                continue
+            dims = dict(sample.tags)
+            del dims[dogstatsd.EVENT_IDENTIFIER_KEY]
+            for magic in (dogstatsd.EVENT_AGGREGATION_KEY_TAG,
+                          dogstatsd.EVENT_ALERT_TYPE_TAG,
+                          dogstatsd.EVENT_PRIORITY_TAG,
+                          dogstatsd.EVENT_SOURCE_TYPE_TAG):
+                dims.pop(magic, None)
+            if dogstatsd.EVENT_HOSTNAME_TAG in dims:
+                dims[self.hostname_tag] = dims.pop(
+                    dogstatsd.EVENT_HOSTNAME_TAG)
+            else:
+                dims[self.hostname_tag] = self.hostname
+            dims.update(self.common_dimensions)
+            for k in self.excluded_tags:
+                dims.pop(k, None)
+            event = {
+                "eventType": sample.name,
+                "category": EVENT_CATEGORY_USER_DEFINED,
+                "dimensions": dims,
+                "properties": {"description": sample.message},
+                "timestamp": sample.timestamp * 1000,
+            }
+            try:
+                status = self.default_client.submit_event(event)
+                if status >= 300:
+                    log.warning("signalfx event submit returned HTTP %d",
+                                status)
+                else:
+                    self.events_reported += 1
+            except OSError:
+                log.warning("could not submit event to signalfx",
+                            exc_info=True)
