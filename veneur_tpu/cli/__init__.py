@@ -1,0 +1,3 @@
+"""Operator CLIs (``/root/reference/cmd/``): veneur, veneur-proxy,
+veneur-emit, veneur-prometheus — run as ``python -m veneur_tpu.cli.<name>``.
+"""

@@ -1,0 +1,53 @@
+"""The proxy binary (``/root/reference/cmd/veneur-proxy/main.go:20-58``):
+``-f proxy.yaml``, bring up the consistent-hashing proxy.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import signal
+import sys
+import threading
+
+from veneur_tpu.config import read_proxy_config
+from veneur_tpu.proxy.proxy import Proxy
+
+log = logging.getLogger("veneur-proxy")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="veneur-proxy")
+    ap.add_argument("-f", dest="config", required=True,
+                    help="The config file to read for settings.")
+    args = ap.parse_args(argv)
+
+    try:
+        config = read_proxy_config(args.config)
+    except Exception as e:
+        log.error("Error reading config file: %s", e)
+        return 1
+
+    logging.basicConfig(
+        level=logging.DEBUG if config.debug else logging.INFO,
+        format="%(asctime)s %(levelname)s %(name)s %(message)s")
+
+    proxy = Proxy(config)
+    proxy.start()
+    log.info("Starting proxy on %s", config.http_address)
+
+    done = threading.Event()
+
+    def handle_signal(signum, frame):
+        log.info("Received signal %d, shutting down", signum)
+        done.set()
+
+    signal.signal(signal.SIGTERM, handle_signal)
+    signal.signal(signal.SIGINT, handle_signal)
+    done.wait()
+    proxy.shutdown()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

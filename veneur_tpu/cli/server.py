@@ -1,0 +1,57 @@
+"""The main server binary (``/root/reference/cmd/veneur/main.go:22-88``):
+``-f config.yaml``, bring up the server, serve until signalled.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import signal
+import sys
+import threading
+
+from veneur_tpu.config import read_config
+from veneur_tpu.server import Server
+
+log = logging.getLogger("veneur")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="veneur")
+    ap.add_argument("-f", dest="config", required=True,
+                    help="The config file to read for settings.")
+    args = ap.parse_args(argv)
+
+    try:
+        config = read_config(args.config)
+    except Exception as e:
+        log.error("Error reading config file: %s", e)
+        return 1
+
+    logging.basicConfig(
+        level=logging.DEBUG if config.debug else logging.INFO,
+        format="%(asctime)s %(levelname)s %(name)s %(message)s")
+
+    server = Server(config)
+    server.start()
+    log.info("Starting server on %s (statsd) / %s (ssf)",
+             server.statsd_addrs, server.ssf_addrs)
+
+    done = threading.Event()
+
+    def handle_signal(signum, frame):
+        log.info("Received signal %d, shutting down", signum)
+        done.set()
+
+    signal.signal(signal.SIGTERM, handle_signal)
+    signal.signal(signal.SIGINT, handle_signal)
+
+    # HTTPServe/gRPCServe when configured, else block forever
+    # (cmd/veneur/main.go:66-88)
+    done.wait()
+    server.shutdown()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

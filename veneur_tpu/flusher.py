@@ -24,6 +24,18 @@ log = logging.getLogger("veneur.flusher")
 
 
 def flush_once(server: "Server"):
+    """One interval flush, wrapped in a self-trace span (flusher.go:26-29)."""
+    from veneur_tpu.trace import Trace
+    span = Trace.start_trace("veneur.flush")
+    span.name = "flush"
+    try:
+        _flush_once(server, span)
+    finally:
+        span.client_record(getattr(server, "trace_client", None))
+
+
+def _flush_once(server: "Server", span):
+    from veneur_tpu.trace import samples as ssf_samples
     now = int(time.time())
 
     # events → FlushOtherSamples on each metric sink (flusher.go:42-47)
@@ -52,6 +64,13 @@ def flush_once(server: "Server"):
         forward=is_local and server.forward_fn is not None)
     flush_elapsed = time.perf_counter() - t0
     log.debug("store flush took %.1f ms (%s)", flush_elapsed * 1e3, ms)
+    # flush self-metrics ride on the flush span (flusher.go:134-187's
+    # flush_total_duration_ns / flushed-metric tallies)
+    span.add(
+        ssf_samples.timing("flush.total_duration_ns", flush_elapsed,
+                           {"part": "store"}),
+        ssf_samples.count("flush.intermetrics_total",
+                          float(len(final_metrics)), None))
 
     # local → global forwarding happens off the flush path (flusher.go:66-75)
     if is_local and server.forward_fn is not None and len(forwardable):

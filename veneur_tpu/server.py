@@ -125,6 +125,12 @@ class Server:
             self.store.process_metric, config.indicator_span_timer_name))
 
         self.plugins: List = cfg_plugins
+
+        # self-telemetry: a channel trace client feeding our own span
+        # channel, so internal spans re-enter the pipeline
+        # (server.go:196-202)
+        from veneur_tpu.trace import new_channel_client
+        self.trace_client = new_channel_client(self.span_chan)
         # set by the forwarding layer (veneur_tpu.forward) when local
         self.forward_fn: Optional[Callable] = None
         self._forwarder = None
@@ -236,7 +242,7 @@ class Server:
             self._threads.append(t)
 
         for sink in self.metric_sinks + self.span_sinks:
-            sink.start()
+            sink.start(self.trace_client)
 
         for addr in cfg.statsd_listen_addresses:
             threads, bound = networking.start_statsd(
@@ -317,3 +323,4 @@ class Server:
             self.import_server.stop()
         if self._forwarder is not None and hasattr(self._forwarder, "close"):
             self._forwarder.close()
+        self.trace_client.close()

@@ -68,6 +68,12 @@ class DatadogMetricSink(MetricSink):
         self.post = post or _default_post
         self.metrics_flushed = 0
         self.flush_errors = 0
+        # _flush_part runs on one thread per chunk; guard the counter
+        self._err_lock = threading.Lock()
+
+    def _count_error(self) -> None:
+        with self._err_lock:
+            self.flush_errors += 1
 
     @property
     def name(self) -> str:
@@ -83,10 +89,10 @@ class DatadogMetricSink(MetricSink):
                     f"?api_key={self.api_key}", checks, compress=False)
                 if not _ok(status):
                     log.warning("Datadog check_run returned HTTP %d", status)
-                    self.flush_errors += 1
+                    self._count_error()
             except OSError:
                 log.warning("error flushing checks to Datadog", exc_info=True)
-                self.flush_errors += 1
+                self._count_error()
         if not dd_metrics:
             return
         # equal-size chunks under flush_max_per_body, rounding-up division
@@ -110,10 +116,10 @@ class DatadogMetricSink(MetricSink):
                                f"?api_key={self.api_key}", {"series": chunk})
             if not _ok(status):
                 log.warning("Datadog series flush returned HTTP %d", status)
-                self.flush_errors += 1
+                self._count_error()
         except OSError:
             log.warning("error flushing metrics to Datadog", exc_info=True)
-            self.flush_errors += 1
+            self._count_error()
 
     def finalize_metrics(self, metrics: List[InterMetric]):
         """InterMetric → DDMetric/DDServiceCheck dicts (datadog.go:245-322)."""
@@ -209,10 +215,10 @@ class DatadogMetricSink(MetricSink):
                 {"events": {"api": events}})
             if not _ok(status):
                 log.warning("Datadog event intake returned HTTP %d", status)
-                self.flush_errors += 1
+                self._count_error()
         except OSError:
             log.warning("error flushing events to Datadog", exc_info=True)
-            self.flush_errors += 1
+            self._count_error()
 
 
 class DatadogSpanSink(SpanSink):

@@ -42,9 +42,12 @@ def create_sinks(config: Config) -> Tuple[List[MetricSink], List[SpanSink],
             # list of {name:, api_key:} maps (config.go signalfx keys)
             per_tag[entry.get("name", "")] = SignalFxClient(
                 config.signalfx_endpoint_base, entry.get("api_key", ""))
+        # config tags become common dimensions (server.go:356's TagsAsMap)
+        common_dims = dict(t.partition(":")[::2] for t in config.tags)
         metric_sinks.append(SignalFxSink(
             hostname_tag=config.signalfx_hostname_tag or "host",
             hostname=config.hostname,
+            common_dimensions=common_dims,
             client=SignalFxClient(config.signalfx_endpoint_base,
                                   config.signalfx_api_key),
             vary_by=config.signalfx_vary_key_by,

@@ -21,13 +21,11 @@ The HTTP client is injectable for tests (the reference's tests swap the
 
 from __future__ import annotations
 
-import json
 import logging
 import threading
-import urllib.error
-import urllib.request
 from typing import Dict, List, Optional, Sequence
 
+from veneur_tpu.forward.http_forward import post_helper
 from veneur_tpu.protocol import constants as dogstatsd
 from veneur_tpu.samplers.intermetric import InterMetric, MetricType
 from veneur_tpu.sinks.base import MetricSink
@@ -50,18 +48,9 @@ class SignalFxClient:
         self.timeout = timeout
 
     def _post(self, path: str, payload) -> int:
-        body = json.dumps(payload).encode("utf-8")
-        req = urllib.request.Request(
-            self.endpoint + path, data=body,
-            headers={"Content-Type": "application/json",
-                     "X-Sf-Token": self.api_key},
-            method="POST")
-        try:
-            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
-                return resp.status
-        except urllib.error.HTTPError as e:
-            e.close()
-            return e.code
+        return post_helper(self.endpoint + path, payload,
+                           timeout=self.timeout, compress=False,
+                           headers={"X-Sf-Token": self.api_key})
 
     def submit(self, datapoints: List[dict]) -> int:
         body: Dict[str, List[dict]] = {}

@@ -1,0 +1,34 @@
+"""One-off metric reporting through a trace client.
+
+Port of ``/root/reference/trace/metrics/client.go:21-58``: batches of
+SSF samples ride in a metrics-only SSF span.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from veneur_tpu.protocol.gen.ssf import sample_pb2
+from veneur_tpu.trace.client import Client, record
+from veneur_tpu.trace.samples import Samples
+
+
+class NoMetricsError(Exception):
+    """No metrics were included in the batch (metrics/client.go:12-16)."""
+
+
+def report(cl: Optional[Client], samples: Samples) -> None:
+    report_batch(cl, samples.batch)
+
+
+def report_batch(cl: Optional[Client],
+                 samples: List[sample_pb2.SSFSample]) -> None:
+    if not samples:
+        raise NoMetricsError("No metrics to send.")
+    span = sample_pb2.SSFSpan()
+    span.metrics.extend(samples)
+    record(cl, span)
+
+
+def report_one(cl: Optional[Client], metric: sample_pb2.SSFSample) -> None:
+    report_batch(cl, [metric])

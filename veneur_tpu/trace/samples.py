@@ -1,0 +1,107 @@
+"""SSF sample constructors (``/root/reference/ssf/samples.go:136-205``).
+
+``count/gauge/histogram/set_sample/timing/status`` build ``SSFSample``
+protobufs with ``sample_rate=1`` and the global ``NAME_PREFIX``
+prepended (samples.go:100-106); ``randomly_sample`` thins a batch and
+scales the surviving samples' rates (samples.go:112-134).
+"""
+
+from __future__ import annotations
+
+import random
+import time as time_mod
+from typing import Dict, List, Optional
+
+from veneur_tpu.protocol.gen.ssf import sample_pb2
+
+# Prefix prepended to every generated sample name (samples.go:35-39);
+# veneur sets it to "veneur." for its own internal metrics.
+NAME_PREFIX = ""
+
+OK = sample_pb2.SSFSample.OK
+WARNING = sample_pb2.SSFSample.WARNING
+CRITICAL = sample_pb2.SSFSample.CRITICAL
+UNKNOWN = sample_pb2.SSFSample.UNKNOWN
+
+
+class Samples:
+    """A batch of samples to report together (samples.go:23-32)."""
+
+    def __init__(self):
+        self.batch: List[sample_pb2.SSFSample] = []
+
+    def add(self, *samples: sample_pb2.SSFSample) -> None:
+        self.batch.extend(samples)
+
+
+def _create(metric, name: str, value: float = 0.0,
+            tags: Optional[Dict[str, str]] = None, message: str = "",
+            unit: str = "", status=None,
+            timestamp: Optional[int] = None) -> sample_pb2.SSFSample:
+    s = sample_pb2.SSFSample(metric=metric, name=NAME_PREFIX + name,
+                             value=value, message=message, unit=unit,
+                             sample_rate=1.0)
+    if status is not None:
+        s.status = status
+    if timestamp is not None:
+        s.timestamp = timestamp
+    for k, v in (tags or {}).items():
+        s.tags[k] = v
+    return s
+
+
+def count(name: str, value: float,
+          tags: Optional[Dict[str, str]] = None, **kw) -> sample_pb2.SSFSample:
+    return _create(sample_pb2.SSFSample.COUNTER, name, value, tags, **kw)
+
+
+def gauge(name: str, value: float,
+          tags: Optional[Dict[str, str]] = None, **kw) -> sample_pb2.SSFSample:
+    return _create(sample_pb2.SSFSample.GAUGE, name, value, tags, **kw)
+
+
+def histogram(name: str, value: float,
+              tags: Optional[Dict[str, str]] = None,
+              **kw) -> sample_pb2.SSFSample:
+    return _create(sample_pb2.SSFSample.HISTOGRAM, name, value, tags, **kw)
+
+
+def set_sample(name: str, value: str,
+               tags: Optional[Dict[str, str]] = None,
+               **kw) -> sample_pb2.SSFSample:
+    """A set-membership sample; the member rides in ``message``
+    (samples.go:176-186)."""
+    return _create(sample_pb2.SSFSample.SET, name, 0.0, tags,
+                   message=value, **kw)
+
+
+def timing(name: str, seconds: float,
+           tags: Optional[Dict[str, str]] = None,
+           resolution: float = 1e-9, **kw) -> sample_pb2.SSFSample:
+    """A timer expressed in ``resolution`` units (default nanoseconds,
+    matching the reference call sites; samples.go:188-193)."""
+    unit = {1e-9: "ns", 1e-6: "us", 1e-3: "ms", 1.0: "s"}.get(resolution, "")
+    return histogram(name, seconds / resolution, tags, unit=unit, **kw)
+
+
+def status(name: str, state,
+           tags: Optional[Dict[str, str]] = None, **kw) -> sample_pb2.SSFSample:
+    return _create(sample_pb2.SSFSample.STATUS, name, 0.0, tags,
+                   status=state, **kw)
+
+
+def randomly_sample(rate: float,
+                    *samples: sample_pb2.SSFSample) -> List[sample_pb2.SSFSample]:
+    """Thin a batch to ~rate, scaling survivors' sample_rate
+    (samples.go:112-134)."""
+    out = []
+    for s in samples:
+        if random.random() <= rate:
+            if 0 < rate <= 1:
+                s.sample_rate = s.sample_rate * rate
+            out.append(s)
+    return out
+
+
+def now_timestamp() -> int:
+    return int(time_mod.time())
