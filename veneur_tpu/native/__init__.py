@@ -1,0 +1,255 @@
+"""ctypes bindings for the C++ native ingest library.
+
+Builds ``libveneur_ingest.so`` from ``veneur_ingest.cpp`` on first use
+(g++ -O2, cached beside the source) and exposes:
+
+- ``parse_lines(data)`` — parse a byte buffer of DogStatsD lines into a
+  ``ParsedBatch`` of numpy arrays + arena (one FFI call per batch).
+- ``NativeUDPReader`` — the SO_REUSEPORT reader pool: N kernel-balanced
+  sockets drained with recvmmsg on C++ threads, handing Python packed
+  parsed batches via double-buffer swaps.
+- ``frame_scan(buf)`` — framed-SSF boundary scanner (wire.go:42-108).
+
+``available()`` gates everything: without a compiler the pure-Python
+path (veneur_tpu.samplers.parser + veneur_tpu.networking) is used.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+import threading
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+log = logging.getLogger("veneur.native")
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "veneur_ingest.cpp")
+_SO = os.path.join(_HERE, "libveneur_ingest.so")
+
+# record types (RecordType in veneur_ingest.cpp)
+TYPE_NAMES = ["counter", "gauge", "histogram", "timer", "set", "raw"]
+RAW = 5
+
+_lib = None
+_lib_lock = threading.Lock()
+_build_error: Optional[str] = None
+
+
+class _VtBatch(ctypes.Structure):
+    _fields_ = [
+        ("capacity", ctypes.c_uint32),
+        ("arena_cap", ctypes.c_uint32),
+        ("count", ctypes.c_uint32),
+        ("arena_len", ctypes.c_uint32),
+        ("parse_errors", ctypes.c_uint64),
+        ("type", ctypes.POINTER(ctypes.c_uint8)),
+        ("scope", ctypes.POINTER(ctypes.c_uint8)),
+        ("value", ctypes.POINTER(ctypes.c_double)),
+        ("sample_rate", ctypes.POINTER(ctypes.c_float)),
+        ("digest", ctypes.POINTER(ctypes.c_uint32)),
+        ("name_off", ctypes.POINTER(ctypes.c_uint32)),
+        ("name_len", ctypes.POINTER(ctypes.c_uint32)),
+        ("tags_off", ctypes.POINTER(ctypes.c_uint32)),
+        ("tags_len", ctypes.POINTER(ctypes.c_uint32)),
+        ("aux_off", ctypes.POINTER(ctypes.c_uint32)),
+        ("aux_len", ctypes.POINTER(ctypes.c_uint32)),
+        ("arena", ctypes.POINTER(ctypes.c_char)),
+    ]
+
+
+def _build() -> Optional[str]:
+    """Compile the shared library; returns an error string on failure."""
+    try:
+        subprocess.run(
+            ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-pthread",
+             "-o", _SO, _SRC],
+            check=True, capture_output=True, timeout=120)
+        return None
+    except FileNotFoundError:
+        return "g++ not found"
+    except subprocess.TimeoutExpired:
+        return "native build timed out"
+    except subprocess.CalledProcessError as e:
+        return f"native build failed: {e.stderr.decode(errors='replace')}"
+
+
+def _load():
+    global _lib, _build_error
+    with _lib_lock:
+        if _lib is not None or _build_error is not None:
+            return _lib
+        if not os.path.exists(_SO) or (
+                os.path.getmtime(_SO) < os.path.getmtime(_SRC)):
+            err = _build()
+            if err is not None:
+                _build_error = err
+                log.warning("native ingest unavailable: %s", err)
+                return None
+        lib = ctypes.CDLL(_SO)
+        lib.vt_batch_new.restype = ctypes.POINTER(_VtBatch)
+        lib.vt_batch_new.argtypes = [ctypes.c_uint32, ctypes.c_uint32]
+        lib.vt_batch_free.argtypes = [ctypes.POINTER(_VtBatch)]
+        lib.vt_batch_reset.argtypes = [ctypes.POINTER(_VtBatch)]
+        lib.vt_parse_lines.restype = ctypes.c_uint32
+        lib.vt_parse_lines.argtypes = [ctypes.c_char_p, ctypes.c_size_t,
+                                       ctypes.POINTER(_VtBatch)]
+        lib.vt_frame_scan.restype = ctypes.c_uint32
+        lib.vt_frame_scan.argtypes = [
+            ctypes.c_char_p, ctypes.c_size_t,
+            ctypes.POINTER(ctypes.c_uint32), ctypes.POINTER(ctypes.c_uint32),
+            ctypes.c_uint32, ctypes.POINTER(ctypes.c_size_t),
+            ctypes.POINTER(ctypes.c_int)]
+        lib.vt_reader_start.restype = ctypes.c_void_p
+        lib.vt_reader_start.argtypes = [
+            ctypes.c_char_p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+            ctypes.c_uint32, ctypes.c_uint32]
+        lib.vt_reader_port.restype = ctypes.c_int
+        lib.vt_reader_port.argtypes = [ctypes.c_void_p]
+        lib.vt_reader_count.restype = ctypes.c_int
+        lib.vt_reader_count.argtypes = [ctypes.c_void_p]
+        lib.vt_reader_swap.restype = ctypes.POINTER(_VtBatch)
+        lib.vt_reader_swap.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        lib.vt_reader_packets.restype = ctypes.c_uint64
+        lib.vt_reader_packets.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        lib.vt_reader_drops.restype = ctypes.c_uint64
+        lib.vt_reader_drops.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        lib.vt_reader_stop.argtypes = [ctypes.c_void_p]
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+class ParsedBatch:
+    """numpy views over a VtBatch. Arrays are COPIES (safe after the
+    underlying batch is reused); the arena is one bytes object."""
+
+    __slots__ = ("count", "parse_errors", "type", "scope", "value",
+                 "sample_rate", "digest", "name_off", "name_len",
+                 "tags_off", "tags_len", "aux_off", "aux_len", "arena")
+
+    def __init__(self, b: "_VtBatch"):
+        n = b.count
+        self.count = n
+        self.parse_errors = b.parse_errors
+
+        def arr(ptr, dtype):
+            if n == 0:
+                return np.empty(0, dtype)
+            return np.ctypeslib.as_array(ptr, shape=(n,)).astype(dtype,
+                                                                 copy=True)
+
+        self.type = arr(b.type, np.uint8)
+        self.scope = arr(b.scope, np.uint8)
+        self.value = arr(b.value, np.float64)
+        self.sample_rate = arr(b.sample_rate, np.float32)
+        self.digest = arr(b.digest, np.uint32)
+        self.name_off = arr(b.name_off, np.uint32)
+        self.name_len = arr(b.name_len, np.uint32)
+        self.tags_off = arr(b.tags_off, np.uint32)
+        self.tags_len = arr(b.tags_len, np.uint32)
+        self.aux_off = arr(b.aux_off, np.uint32)
+        self.aux_len = arr(b.aux_len, np.uint32)
+        self.arena = ctypes.string_at(b.arena, b.arena_len)
+
+    def name(self, i: int) -> str:
+        o, l = self.name_off[i], self.name_len[i]
+        return self.arena[o:o + l].decode("utf-8", "replace")
+
+    def joined_tags(self, i: int) -> str:
+        o, l = self.tags_off[i], self.tags_len[i]
+        return self.arena[o:o + l].decode("utf-8", "replace")
+
+    def aux(self, i: int) -> bytes:
+        o, l = self.aux_off[i], self.aux_len[i]
+        return self.arena[o:o + l]
+
+
+def parse_lines(data: bytes, max_records: int = 0,
+                arena_cap: int = 0) -> ParsedBatch:
+    """Parse a buffer of newline-separated DogStatsD lines natively."""
+    lib = _load()
+    if lib is None:
+        raise RuntimeError(f"native ingest unavailable: {_build_error}")
+    max_records = max_records or max(16, data.count(b"\n") + 1)
+    arena_cap = arena_cap or (len(data) + 64)
+    b = lib.vt_batch_new(max_records, arena_cap)
+    try:
+        lib.vt_parse_lines(data, len(data), b)
+        return ParsedBatch(b.contents)
+    finally:
+        lib.vt_batch_free(b)
+
+
+def frame_scan(buf: bytes, max_frames: int = 4096
+               ) -> Tuple[List[Tuple[int, int]], int, bool]:
+    """Scan for complete SSF frames: returns ([(payload_off, payload_len)],
+    bytes_consumed, poisoned)."""
+    lib = _load()
+    if lib is None:
+        raise RuntimeError(f"native ingest unavailable: {_build_error}")
+    offs = (ctypes.c_uint32 * max_frames)()
+    lens = (ctypes.c_uint32 * max_frames)()
+    consumed = ctypes.c_size_t(0)
+    poisoned = ctypes.c_int(0)
+    n = lib.vt_frame_scan(buf, len(buf), offs, lens, max_frames,
+                          ctypes.byref(consumed), ctypes.byref(poisoned))
+    return ([(offs[i], lens[i]) for i in range(n)], consumed.value,
+            bool(poisoned.value))
+
+
+class NativeUDPReader:
+    """The C++ SO_REUSEPORT reader pool (networking.go:37-87 rebuilt
+    native). ``drain()`` swaps every reader's batch and returns the
+    non-empty ones."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 num_readers: int = 1, rcvbuf: int = 2 * 1024 * 1024,
+                 batch_records: int = 65536,
+                 batch_arena: int = 8 * 1024 * 1024):
+        lib = _load()
+        if lib is None:
+            raise RuntimeError(f"native ingest unavailable: {_build_error}")
+        self._lib = lib
+        self._handle = lib.vt_reader_start(
+            host.encode(), port, num_readers, rcvbuf, batch_records,
+            batch_arena)
+        if not self._handle:
+            raise OSError(f"could not bind native UDP readers on "
+                          f"{host}:{port}")
+        self.port = lib.vt_reader_port(self._handle)
+        self.num_readers = lib.vt_reader_count(self._handle)
+
+    def drain(self) -> List[ParsedBatch]:
+        out = []
+        for i in range(self.num_readers):
+            b = self._lib.vt_reader_swap(self._handle, i)
+            if b.contents.count or b.contents.parse_errors:
+                out.append(ParsedBatch(b.contents))
+        return out
+
+    def packets(self) -> int:
+        return sum(self._lib.vt_reader_packets(self._handle, i)
+                   for i in range(self.num_readers))
+
+    def drops(self) -> int:
+        return sum(self._lib.vt_reader_drops(self._handle, i)
+                   for i in range(self.num_readers))
+
+    def stop(self) -> None:
+        if self._handle:
+            self._lib.vt_reader_stop(self._handle)
+            self._handle = None
+
+    def __del__(self):
+        try:
+            self.stop()
+        except Exception:
+            pass

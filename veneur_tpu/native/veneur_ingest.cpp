@@ -1,0 +1,549 @@
+// Native ingest hot path: SO_REUSEPORT UDP reader pool + DogStatsD parser
+// + framed-SSF scanner.
+//
+// The reference reaches native ingest performance with Go + raw syscalls
+// (/root/reference/socket_linux.go:12-76 SO_REUSEPORT/SO_RCVBUF,
+// server.go:795-825 read loop, samplers/parser.go:232-363 parser,
+// samplers/split_bytes.go splitter). This file is the C++ equivalent for
+// the TPU build: N reader threads each own a SO_REUSEPORT socket, drain
+// it with recvmmsg, split datagrams on '\n', and parse each DogStatsD
+// line into a packed struct-of-arrays batch that Python drains wholesale
+// — one FFI call per batch instead of one parse per line.
+//
+// Parsed-record grammar and validation mirror parser.go:232-363 exactly:
+//   name:value|type[|@rate][|#tag1,tag2]   (sections in any order, once)
+// with byte-wise tag sorting (Go sort.Strings), first-match
+// veneurlocalonly/veneurglobalonly scope-tag extraction
+// (parser.go:326-342), the fnv1a-32 digest over name+type+joined-tags
+// (parser.go:259-354), NaN/Inf rejection, and (0,1] sample rates.
+// Events (_e{) and service checks (_sc) are surfaced as RAW records for
+// the Python parser — they are rare control-plane packets.
+//
+// The framed-SSF scanner mirrors protocol/wire.go:42-108: frames are
+// 1 version byte (0x00) + 4-byte big-endian length + protobuf, 16 MiB
+// cap; a bad version/length is a poison framing error.
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <poll.h>
+#include <pthread.h>
+#include <stdint.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <algorithm>
+#include <atomic>
+#include <cmath>
+#include <cstring>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+constexpr uint32_t kFnvInit = 0x811C9DC5u;
+constexpr uint32_t kFnvPrime = 0x01000193u;
+
+inline uint32_t fnv1a(const char* data, size_t len, uint32_t h) {
+  for (size_t i = 0; i < len; i++) {
+    h = (h ^ static_cast<unsigned char>(data[i])) * kFnvPrime;
+  }
+  return h;
+}
+
+// Record types (order matches veneur_tpu/native/__init__.py)
+enum RecordType : uint8_t {
+  kCounter = 0,
+  kGauge = 1,
+  kHistogram = 2,
+  kTimer = 3,
+  kSet = 4,
+  kRaw = 5,  // _e{ / _sc lines, passed through for the Python parser
+};
+
+const char* kTypeNames[5] = {"counter", "gauge", "histogram", "timer", "set"};
+const size_t kTypeNameLens[5] = {7, 5, 9, 5, 3};
+
+// Scopes (parser.go:34-40)
+enum Scope : uint8_t { kMixed = 0, kLocalOnly = 1, kGlobalOnly = 2 };
+
+}  // namespace
+
+// One batch of parsed records, struct-of-arrays. All offsets index into
+// `arena`. Python mirrors this layout with ctypes.
+extern "C" struct VtBatch {
+  uint32_t capacity;     // max records
+  uint32_t arena_cap;    // arena bytes
+  uint32_t count;        // records filled
+  uint32_t arena_len;    // arena bytes used
+  uint64_t parse_errors; // lines rejected since batch reset
+  uint8_t* type;
+  uint8_t* scope;
+  double* value;
+  float* sample_rate;
+  uint32_t* digest;
+  uint32_t* name_off;
+  uint32_t* name_len;
+  uint32_t* tags_off;    // comma-joined sorted tags
+  uint32_t* tags_len;
+  uint32_t* aux_off;     // set member / raw line bytes
+  uint32_t* aux_len;
+  char* arena;
+};
+
+extern "C" VtBatch* vt_batch_new(uint32_t capacity, uint32_t arena_cap) {
+  VtBatch* b = static_cast<VtBatch*>(calloc(1, sizeof(VtBatch)));
+  b->capacity = capacity;
+  b->arena_cap = arena_cap;
+  b->type = static_cast<uint8_t*>(malloc(capacity));
+  b->scope = static_cast<uint8_t*>(malloc(capacity));
+  b->value = static_cast<double*>(malloc(capacity * sizeof(double)));
+  b->sample_rate = static_cast<float*>(malloc(capacity * sizeof(float)));
+  b->digest = static_cast<uint32_t*>(malloc(capacity * sizeof(uint32_t)));
+  b->name_off = static_cast<uint32_t*>(malloc(capacity * sizeof(uint32_t)));
+  b->name_len = static_cast<uint32_t*>(malloc(capacity * sizeof(uint32_t)));
+  b->tags_off = static_cast<uint32_t*>(malloc(capacity * sizeof(uint32_t)));
+  b->tags_len = static_cast<uint32_t*>(malloc(capacity * sizeof(uint32_t)));
+  b->aux_off = static_cast<uint32_t*>(malloc(capacity * sizeof(uint32_t)));
+  b->aux_len = static_cast<uint32_t*>(malloc(capacity * sizeof(uint32_t)));
+  b->arena = static_cast<char*>(malloc(arena_cap));
+  return b;
+}
+
+extern "C" void vt_batch_free(VtBatch* b) {
+  if (!b) return;
+  free(b->type); free(b->scope); free(b->value); free(b->sample_rate);
+  free(b->digest); free(b->name_off); free(b->name_len);
+  free(b->tags_off); free(b->tags_len); free(b->aux_off); free(b->aux_len);
+  free(b->arena);
+  free(b);
+}
+
+extern "C" void vt_batch_reset(VtBatch* b) {
+  b->count = 0;
+  b->arena_len = 0;
+  b->parse_errors = 0;
+}
+
+namespace {
+
+// Append bytes to the batch arena; returns offset or UINT32_MAX when full.
+inline uint32_t arena_put(VtBatch* b, const char* data, size_t len) {
+  if (b->arena_len + len > b->arena_cap) return UINT32_MAX;
+  memcpy(b->arena + b->arena_len, data, len);
+  uint32_t off = b->arena_len;
+  b->arena_len += static_cast<uint32_t>(len);
+  return off;
+}
+
+struct TagView {
+  const char* p;
+  size_t len;
+  bool operator<(const TagView& o) const {
+    int c = memcmp(p, o.p, std::min(len, o.len));
+    if (c != 0) return c < 0;
+    return len < o.len;
+  }
+};
+
+inline bool has_prefix(const TagView& t, const char* pre, size_t n) {
+  return t.len >= n && memcmp(t.p, pre, n) == 0;
+}
+
+// Parse one line into the batch. Returns false on a parse error (counted
+// by the caller). Mirrors parse_metric (parser.go:232-363).
+bool parse_line(const char* line, size_t len, VtBatch* b) {
+  if (b->count >= b->capacity) return false;
+  uint32_t idx = b->count;
+
+  // events / service checks pass through as raw records
+  if ((len >= 3 && memcmp(line, "_e{", 3) == 0) ||
+      (len >= 3 && memcmp(line, "_sc", 3) == 0)) {
+    uint32_t off = arena_put(b, line, len);
+    if (off == UINT32_MAX) return false;
+    b->type[idx] = kRaw;
+    b->scope[idx] = kMixed;
+    b->value[idx] = 0.0;
+    b->sample_rate[idx] = 1.0f;
+    b->digest[idx] = 0;
+    b->name_off[idx] = b->name_len[idx] = 0;
+    b->tags_off[idx] = b->tags_len[idx] = 0;
+    b->aux_off[idx] = off;
+    b->aux_len[idx] = static_cast<uint32_t>(len);
+    b->count++;
+    return true;
+  }
+
+  // a trailing pipe is an empty final section (parser.go rejects it)
+  if (line[len - 1] == '|') return false;
+
+  // head section: name:value
+  const char* pipe = static_cast<const char*>(memchr(line, '|', len));
+  if (!pipe) return false;
+  size_t head_len = pipe - line;
+  const char* colon =
+      static_cast<const char*>(memchr(line, ':', head_len));
+  if (!colon) return false;
+  size_t name_len = colon - line;
+  if (name_len == 0) return false;
+  const char* value_p = colon + 1;
+  size_t value_len = head_len - name_len - 1;
+
+  // type section
+  const char* rest = pipe + 1;
+  size_t rest_len = len - head_len - 1;
+  const char* type_end =
+      static_cast<const char*>(memchr(rest, '|', rest_len));
+  size_t type_len = type_end ? static_cast<size_t>(type_end - rest)
+                             : rest_len;
+  if (type_len == 0) return false;
+  uint8_t rtype;
+  switch (rest[0]) {  // only the first byte is inspected (parser.go:281)
+    case 'c': rtype = kCounter; break;
+    case 'g': rtype = kGauge; break;
+    case 'h': rtype = kHistogram; break;
+    case 'm': rtype = kTimer; break;
+    case 's': rtype = kSet; break;
+    default: return false;
+  }
+
+  double value = 0.0;
+  if (rtype != kSet) {
+    char tmp[64];
+    if (value_len == 0 || value_len >= sizeof(tmp)) return false;
+    memcpy(tmp, value_p, value_len);
+    tmp[value_len] = 0;
+    char* endp = nullptr;
+    value = strtod(tmp, &endp);
+    if (endp != tmp + value_len) return false;
+    if (std::isnan(value) || std::isinf(value)) return false;
+  }
+
+  // optional sections: @rate and #tags, any order, at most once
+  float sample_rate = 1.0f;
+  bool found_rate = false;
+  TagView tags[64];
+  size_t ntags = 0;
+  bool found_tags = false;
+  uint8_t scope = kMixed;
+
+  const char* p = type_end ? type_end + 1 : rest + rest_len;
+  const char* end = line + len;
+  while (p < end) {
+    const char* next = static_cast<const char*>(memchr(p, '|', end - p));
+    size_t sec_len = next ? static_cast<size_t>(next - p)
+                          : static_cast<size_t>(end - p);
+    if (sec_len == 0) return false;  // empty string between pipes
+    if (p[0] == '@') {
+      if (found_rate) return false;
+      char tmp[32];
+      if (sec_len - 1 == 0 || sec_len - 1 >= sizeof(tmp)) return false;
+      memcpy(tmp, p + 1, sec_len - 1);
+      tmp[sec_len - 1] = 0;
+      char* endp = nullptr;
+      double r = strtod(tmp, &endp);
+      if (endp != tmp + sec_len - 1) return false;
+      if (!(r > 0.0 && r <= 1.0)) return false;
+      sample_rate = static_cast<float>(r);
+      found_rate = true;
+    } else if (p[0] == '#') {
+      if (found_tags) return false;
+      found_tags = true;
+      const char* tp = p + 1;
+      const char* tend = p + sec_len;
+      while (tp <= tend && ntags < 64) {
+        const char* comma =
+            static_cast<const char*>(memchr(tp, ',', tend - tp));
+        size_t tlen = comma ? static_cast<size_t>(comma - tp)
+                            : static_cast<size_t>(tend - tp);
+        tags[ntags].p = tp;
+        tags[ntags].len = tlen;
+        ntags++;
+        if (!comma) break;
+        tp = comma + 1;
+      }
+      std::sort(tags, tags + ntags);
+      // first-match scope-tag extraction (parser.go:326-342)
+      for (size_t i = 0; i < ntags; i++) {
+        bool local = has_prefix(tags[i], "veneurlocalonly", 15);
+        bool global = has_prefix(tags[i], "veneurglobalonly", 16);
+        if (local || global) {
+          scope = local ? kLocalOnly : kGlobalOnly;
+          for (size_t j = i + 1; j < ntags; j++) tags[j - 1] = tags[j];
+          ntags--;
+          break;
+        }
+      }
+    } else {
+      return false;  // unknown section
+    }
+    p = next ? next + 1 : end;
+    if (!next) break;
+  }
+
+  // write the record
+  uint32_t noff = arena_put(b, line, name_len);
+  if (noff == UINT32_MAX) return false;
+
+  uint32_t h = fnv1a(line, name_len, kFnvInit);
+  h = fnv1a(kTypeNames[rtype], kTypeNameLens[rtype], h);
+
+  uint32_t toff = b->arena_len;
+  uint32_t tlen = 0;
+  if (found_tags) {
+    for (size_t i = 0; i < ntags; i++) {
+      if (i > 0) {
+        if (arena_put(b, ",", 1) == UINT32_MAX) return false;
+        tlen += 1;
+      }
+      if (arena_put(b, tags[i].p, tags[i].len) == UINT32_MAX) return false;
+      tlen += static_cast<uint32_t>(tags[i].len);
+    }
+    h = fnv1a(b->arena + toff, tlen, h);
+  }
+
+  uint32_t aoff = 0, alen = 0;
+  if (rtype == kSet) {
+    aoff = arena_put(b, value_p, value_len);
+    if (aoff == UINT32_MAX) return false;
+    alen = static_cast<uint32_t>(value_len);
+  }
+
+  b->type[idx] = rtype;
+  b->scope[idx] = scope;
+  b->value[idx] = value;
+  b->sample_rate[idx] = sample_rate;
+  b->digest[idx] = h;
+  b->name_off[idx] = noff;
+  b->name_len[idx] = static_cast<uint32_t>(name_len);
+  b->tags_off[idx] = toff;
+  b->tags_len[idx] = tlen;
+  b->aux_off[idx] = aoff;
+  b->aux_len[idx] = alen;
+  b->count++;
+  return true;
+}
+
+}  // namespace
+
+// Split a buffer on '\n' and parse every non-empty line
+// (split_bytes.go:17-56). Returns records appended.
+extern "C" uint32_t vt_parse_lines(const char* buf, size_t len, VtBatch* b) {
+  uint32_t before = b->count;
+  const char* p = buf;
+  const char* end = buf + len;
+  while (p < end) {
+    const char* nl = static_cast<const char*>(memchr(p, '\n', end - p));
+    size_t line_len = nl ? static_cast<size_t>(nl - p)
+                         : static_cast<size_t>(end - p);
+    if (line_len > 0) {
+      if (!parse_line(p, line_len, b)) b->parse_errors++;
+    }
+    p = nl ? nl + 1 : end;
+  }
+  return b->count - before;
+}
+
+// ---------------------------------------------------------------------------
+// Framed-SSF scanner (protocol/wire.go:42-108)
+
+// Scans `buf` for complete frames. Writes (offset,length) pairs of the
+// protobuf payloads into out_off/out_len (up to out_cap). Returns the
+// number of complete frames; *consumed is the byte count of whole frames
+// scanned past; *poisoned is set on a framing error (bad version or
+// oversized length) — the stream must be closed (wire.go:26-28).
+extern "C" uint32_t vt_frame_scan(const char* buf, size_t len,
+                                  uint32_t* out_off, uint32_t* out_len,
+                                  uint32_t out_cap, size_t* consumed,
+                                  int* poisoned) {
+  constexpr size_t kMaxFrame = 16 * 1024 * 1024;
+  uint32_t n = 0;
+  size_t pos = 0;
+  *poisoned = 0;
+  while (n < out_cap && pos + 5 <= len) {
+    if (buf[pos] != 0) {  // version byte (wire.go:31-40)
+      *poisoned = 1;
+      break;
+    }
+    uint32_t flen = (static_cast<uint32_t>(
+                         static_cast<unsigned char>(buf[pos + 1])) << 24) |
+                    (static_cast<uint32_t>(
+                         static_cast<unsigned char>(buf[pos + 2])) << 16) |
+                    (static_cast<uint32_t>(
+                         static_cast<unsigned char>(buf[pos + 3])) << 8) |
+                    static_cast<uint32_t>(
+                        static_cast<unsigned char>(buf[pos + 4]));
+    if (flen > kMaxFrame) {
+      *poisoned = 1;
+      break;
+    }
+    if (pos + 5 + flen > len) break;  // incomplete frame: wait for more
+    out_off[n] = static_cast<uint32_t>(pos + 5);
+    out_len[n] = flen;
+    n++;
+    pos += 5 + flen;
+  }
+  *consumed = pos;
+  return n;
+}
+
+// ---------------------------------------------------------------------------
+// SO_REUSEPORT UDP reader pool (networking.go:37-87, socket_linux.go:12-76)
+
+namespace {
+
+struct Reader {
+  int fd = -1;
+  std::thread thread;
+  std::mutex mu;
+  VtBatch* active;   // parser writes here under mu
+  VtBatch* standby;  // handed to Python on swap
+  std::atomic<uint64_t> packets{0};
+  std::atomic<uint64_t> dropped_batches{0};
+};
+
+struct ReaderPool {
+  std::vector<Reader*> readers;
+  std::atomic<bool> stop{false};
+  int port = 0;
+};
+
+int make_udp_socket(const char* ip, int port, int rcvbuf) {
+  int fd = socket(AF_INET, SOCK_DGRAM, 0);
+  if (fd < 0) return -1;
+  int one = 1;
+  // SO_REUSEPORT kernel load-balancing (socket_linux.go:25-31)
+  setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  setsockopt(fd, SOL_SOCKET, SO_REUSEPORT, &one, sizeof(one));
+  if (rcvbuf > 0) {
+    setsockopt(fd, SOL_SOCKET, SO_RCVBUF, &rcvbuf, sizeof(rcvbuf));
+  }
+  sockaddr_in addr;
+  memset(&addr, 0, sizeof(addr));
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  addr.sin_addr.s_addr = ip && *ip ? inet_addr(ip) : INADDR_ANY;
+  if (bind(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0) {
+    close(fd);
+    return -1;
+  }
+  return fd;
+}
+
+constexpr int kVlen = 64;       // datagrams per recvmmsg
+constexpr int kDgramMax = 8192; // max datagram size we accept
+
+void reader_loop(ReaderPool* pool, Reader* r) {
+  std::vector<char> bufs(kVlen * kDgramMax);
+  mmsghdr msgs[kVlen];
+  iovec iovs[kVlen];
+  for (int i = 0; i < kVlen; i++) {
+    iovs[i].iov_base = bufs.data() + i * kDgramMax;
+    iovs[i].iov_len = kDgramMax;
+    memset(&msgs[i], 0, sizeof(mmsghdr));
+    msgs[i].msg_hdr.msg_iov = &iovs[i];
+    msgs[i].msg_hdr.msg_iovlen = 1;
+  }
+  pollfd pfd = {r->fd, POLLIN, 0};
+  while (!pool->stop.load(std::memory_order_relaxed)) {
+    int pr = poll(&pfd, 1, 100);
+    if (pr <= 0) continue;
+    int got = recvmmsg(r->fd, msgs, kVlen, MSG_DONTWAIT, nullptr);
+    if (got <= 0) continue;
+    std::lock_guard<std::mutex> lock(r->mu);
+    for (int i = 0; i < got; i++) {
+      const char* data = bufs.data() + i * kDgramMax;
+      size_t dlen = msgs[i].msg_len;
+      if (r->active->count >= r->active->capacity ||
+          r->active->arena_len + dlen > r->active->arena_cap) {
+        // batch full and Python hasn't swapped: drop the datagram
+        // (the kernel socket buffer is the real backpressure here,
+        // like the reference's packet drops under overload)
+        r->dropped_batches.fetch_add(1, std::memory_order_relaxed);
+        continue;
+      }
+      vt_parse_lines(data, dlen, r->active);
+    }
+    r->packets.fetch_add(got, std::memory_order_relaxed);
+  }
+}
+
+}  // namespace
+
+extern "C" void* vt_reader_start(const char* ip, int port, int nreaders,
+                                 int rcvbuf, uint32_t batch_records,
+                                 uint32_t batch_arena) {
+  ReaderPool* pool = new ReaderPool();
+  for (int i = 0; i < nreaders; i++) {
+    int fd = make_udp_socket(ip, port, rcvbuf);
+    if (fd < 0) {
+      delete pool;
+      return nullptr;
+    }
+    if (pool->port == 0) {
+      sockaddr_in bound;
+      socklen_t blen = sizeof(bound);
+      getsockname(fd, reinterpret_cast<sockaddr*>(&bound), &blen);
+      pool->port = ntohs(bound.sin_port);
+      port = pool->port;  // later readers share the resolved port
+    }
+    Reader* r = new Reader();
+    r->fd = fd;
+    r->active = vt_batch_new(batch_records, batch_arena);
+    r->standby = vt_batch_new(batch_records, batch_arena);
+    pool->readers.push_back(r);
+  }
+  for (Reader* r : pool->readers) {
+    r->thread = std::thread(reader_loop, pool, r);
+  }
+  return pool;
+}
+
+extern "C" int vt_reader_port(void* handle) {
+  return static_cast<ReaderPool*>(handle)->port;
+}
+
+extern "C" int vt_reader_count(void* handle) {
+  return static_cast<int>(static_cast<ReaderPool*>(handle)->readers.size());
+}
+
+// Swap a reader's active batch for its (reset) standby and return the
+// filled batch. Python owns the returned pointer until the next swap of
+// the same reader.
+extern "C" VtBatch* vt_reader_swap(void* handle, int idx) {
+  ReaderPool* pool = static_cast<ReaderPool*>(handle);
+  Reader* r = pool->readers[idx];
+  std::lock_guard<std::mutex> lock(r->mu);
+  VtBatch* filled = r->active;
+  vt_batch_reset(r->standby);
+  r->active = r->standby;
+  r->standby = filled;
+  return filled;
+}
+
+extern "C" uint64_t vt_reader_packets(void* handle, int idx) {
+  return static_cast<ReaderPool*>(handle)
+      ->readers[idx]->packets.load(std::memory_order_relaxed);
+}
+
+extern "C" uint64_t vt_reader_drops(void* handle, int idx) {
+  return static_cast<ReaderPool*>(handle)
+      ->readers[idx]->dropped_batches.load(std::memory_order_relaxed);
+}
+
+extern "C" void vt_reader_stop(void* handle) {
+  ReaderPool* pool = static_cast<ReaderPool*>(handle);
+  pool->stop.store(true);
+  for (Reader* r : pool->readers) {
+    if (r->thread.joinable()) r->thread.join();
+    close(r->fd);
+    vt_batch_free(r->active);
+    vt_batch_free(r->standby);
+    delete r;
+  }
+  delete pool;
+}
