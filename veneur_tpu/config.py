@@ -123,6 +123,11 @@ class Config:
     store_chunk: int = 16384
     # initial dense-series capacity per scope-class (grows by doubling)
     store_initial_capacity: int = 4096
+    # shard the global-tier store over a (series, hosts) device mesh;
+    # only meaningful on a global instance (forward_address unset)
+    mesh_enabled: bool = False
+    # mesh fan-in axis width (0 = auto: 2 when the device count is even)
+    mesh_hosts: int = 0
 
     def parse_interval(self) -> float:
         return parse_duration(self.interval)

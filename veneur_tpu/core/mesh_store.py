@@ -1,0 +1,298 @@
+"""Mesh-sharded scope-class groups: the global tier's store on many chips.
+
+This wires the sharded global-aggregation design (``parallel/global_agg.py``)
+into the *serving* store: a global instance whose import servers
+(``forward/grpc_forward.py`` gRPC ``SendMetrics``, ``httpserv.py`` HTTP
+``/import``) feed device state sharded over a ``(series, hosts)`` mesh — the
+TPU form of the reference's global veneur merging forwarded sketches across
+its worker shards (``/root/reference/importsrv/server.go:101-132`` +
+``flusher.go:56-58``).
+
+Layout (cf. ``parallel/mesh.py``):
+
+- **series axis** — every device owns a contiguous slab of rows, exactly
+  like one reference worker owns its ``map[MetricKey]*sampler``
+  (``worker.go:54-91``). Staged host chunks scatter with ``mode='drop'``
+  after re-localizing row ids, so each device keeps only its own rows.
+- **hosts axis** — staged chunks are *sharded* over this axis, so the
+  expensive chunk binning (sort + prefix sums in ``ops/tdigest.py``)
+  parallelizes across it; one ``psum``/``pmax`` per drain completes the
+  merge over ICI (``parallel/collectives.py``).
+
+The groups subclass the single-device ones and override only device-state
+placement and the jitted programs; all interning/staging/flush-assembly
+logic is shared. Programs are cached per (mesh, dtype-params) so the four
+digest groups of one store share compilations.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+try:  # JAX >= 0.4.35 exports shard_map at top level
+    from jax import shard_map  # type: ignore
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map  # type: ignore
+
+from veneur_tpu.core.store import IMPORT_DRAIN_BATCH, DigestGroup, SetGroup
+from veneur_tpu.ops import hll as hll_ops
+from veneur_tpu.ops import tdigest as td_ops
+from veneur_tpu.parallel import collectives
+from veneur_tpu.parallel.mesh import HOSTS_AXIS, SERIES_AXIS
+
+_PROGRAMS: Dict[Tuple, tuple] = {}
+
+
+def _round_up(n: int, mult: int) -> int:
+    return -(-n // mult) * mult
+
+
+def _relocal(rows: jax.Array, s_loc: int) -> jax.Array:
+    """Global row ids → this device's local ids; out-of-slab rows map to
+    s_loc so scatters drop them (the proxy's destForMetric invariant,
+    reshaped: a series belongs to exactly one shard)."""
+    r = rows.astype(jnp.int32)
+    start = lax.axis_index(SERIES_AXIS) * s_loc
+    return jnp.where((r >= start) & (r < start + s_loc), r - start, s_loc)
+
+
+def _add_temp(a: td_ops.TempCentroids,
+              b: td_ops.TempCentroids) -> td_ops.TempCentroids:
+    """Elementwise accumulate: all TempCentroids fields are associative."""
+    return td_ops.TempCentroids(
+        sum_w=a.sum_w + b.sum_w, sum_wm=a.sum_wm + b.sum_wm,
+        count=a.count + b.count, vsum=a.vsum + b.vsum,
+        vmin=jnp.minimum(a.vmin, b.vmin), vmax=jnp.maximum(a.vmax, b.vmax),
+        recip=a.recip + b.recip)
+
+
+def _digest_programs(mesh: Mesh, compression: float, k: int):
+    key = ("digest", mesh, compression, k)
+    if key in _PROGRAMS:
+        return _PROGRAMS[key]
+    hosts = mesh.shape.get(HOSTS_AXIS, 1)
+    sk, s, h, rep = P(SERIES_AXIS, None), P(SERIES_AXIS), P(HOSTS_AXIS), P()
+    temp_spec = td_ops.TempCentroids(sum_w=sk, sum_wm=sk, count=s, vsum=s,
+                                     vmin=s, vmax=s, recip=s)
+    dig_spec = td_ops.TDigest(mean=sk, weight=sk, min=s, max=s)
+
+    def local_ingest(temp, rows, vals, wts):
+        s_loc = temp.sum_w.shape[0]
+        binned = td_ops.ingest_chunk(
+            td_ops.init_temp(s_loc, k, compression),
+            _relocal(rows, s_loc), vals, wts, compression)
+        if hosts > 1:
+            binned = collectives.merge_temp(binned, HOSTS_AXIS)
+        return _add_temp(temp, binned)
+
+    ingest = jax.jit(
+        shard_map(local_ingest, mesh=mesh, in_specs=(temp_spec, h, h, h),
+                  out_specs=temp_spec, check_vma=False),
+        donate_argnums=(0,))
+
+    def local_import(temp, dmin, dmax, rows, means, wts, srows, smins, smaxs):
+        # NB: the import chunk is REPLICATED (not hosts-sharded): imported
+        # centroid arrays arrive sorted by mean and staged sequentially, so
+        # a hosts-axis split would hand each shard a systematically skewed
+        # slice and the per-shard quantile binning would collapse different
+        # quantile bands into the same bin. Every device bins the full
+        # chunk and keeps its own rows; no collective is needed.
+        s_loc = temp.sum_w.shape[0]
+        binned = td_ops.ingest_chunk(
+            td_ops.init_temp(s_loc, k, compression),
+            _relocal(rows, s_loc), means, wts, compression,
+            update_stats=False)
+        # imported centroids feed percentiles only, never local stats
+        # (samplers.go:473-480)
+        temp = temp._replace(sum_w=temp.sum_w + binned.sum_w,
+                             sum_wm=temp.sum_wm + binned.sum_wm)
+        sr = _relocal(srows, s_loc)
+        dmin = dmin.at[sr].min(smins, mode="drop")
+        dmax = dmax.at[sr].max(smaxs, mode="drop")
+        return temp, dmin, dmax
+
+    import_ = jax.jit(
+        shard_map(local_import, mesh=mesh,
+                  in_specs=(temp_spec, s, s, rep, rep, rep, rep, rep, rep),
+                  out_specs=(temp_spec, s, s), check_vma=False),
+        donate_argnums=(0, 1, 2))
+
+    def local_flush(digest, temp, dmin, dmax, qs):
+        drained = td_ops.drain_temp(digest, temp, compression)
+        drained = drained._replace(min=jnp.minimum(drained.min, dmin),
+                                   max=jnp.maximum(drained.max, dmax))
+        pcts = td_ops.quantile(drained, qs)
+        return (drained, pcts, temp.count, temp.vsum, temp.vmin, temp.vmax,
+                temp.recip)
+
+    flush = jax.jit(
+        shard_map(local_flush, mesh=mesh,
+                  in_specs=(dig_spec, temp_spec, s, s, rep),
+                  out_specs=(dig_spec, sk, s, s, s, s, s), check_vma=False),
+        donate_argnums=(0, 1))
+
+    _PROGRAMS[key] = (ingest, import_, flush)
+    return _PROGRAMS[key]
+
+
+def _set_programs(mesh: Mesh, precision: int):
+    key = ("set", mesh, precision)
+    if key in _PROGRAMS:
+        return _PROGRAMS[key]
+    hosts = mesh.shape.get(HOSTS_AXIS, 1)
+    sk, s, h, rep = P(SERIES_AXIS, None), P(SERIES_AXIS), P(HOSTS_AXIS), P()
+
+    def local_hash(regs, rows, hi, lo):
+        s_loc = regs.shape[0]
+        idx, rho = hll_ops.idx_rho(hi, lo, precision)
+        regs = regs.at[_relocal(rows, s_loc), idx].max(
+            rho.astype(regs.dtype), mode="drop")
+        if hosts > 1:
+            regs = lax.pmax(regs, HOSTS_AXIS)
+        return regs
+
+    hash_ingest = jax.jit(
+        shard_map(local_hash, mesh=mesh, in_specs=(sk, h, h, h),
+                  out_specs=sk, check_vma=False),
+        donate_argnums=(0,))
+
+    def local_reg_merge(regs, rows, updates):
+        s_loc = regs.shape[0]
+        return regs.at[_relocal(rows, s_loc)].max(
+            updates.astype(regs.dtype), mode="drop")
+
+    reg_merge = jax.jit(
+        shard_map(local_reg_merge, mesh=mesh, in_specs=(sk, rep, rep),
+                  out_specs=sk, check_vma=False),
+        donate_argnums=(0,))
+
+    def local_estimate(regs):
+        return hll_ops.estimate(regs.astype(jnp.int32), precision)
+
+    estimate = jax.jit(
+        shard_map(local_estimate, mesh=mesh, in_specs=(sk,), out_specs=s,
+                  check_vma=False))
+
+    _PROGRAMS[key] = (hash_ingest, reg_merge, estimate)
+    return _PROGRAMS[key]
+
+
+class MeshDigestGroup(DigestGroup):
+    """A DigestGroup whose device state is sharded over a fleet mesh."""
+
+    def __init__(self, mesh: Mesh, capacity: int, chunk: int,
+                 compression: float):
+        self.mesh = mesh
+        self.shards = mesh.shape[SERIES_AXIS]
+        self.hosts = mesh.shape.get(HOSTS_AXIS, 1)
+        self._sk = NamedSharding(mesh, P(SERIES_AXIS, None))
+        self._s = NamedSharding(mesh, P(SERIES_AXIS))
+        super().__init__(_round_up(capacity, self.shards),
+                         _round_up(chunk, self.hosts), compression)
+        self._ingest_p, self._import_p, self._flush_p = _digest_programs(
+            mesh, self.compression, self.k)
+
+    def _place(self):
+        temp_sh = td_ops.TempCentroids(
+            sum_w=self._sk, sum_wm=self._sk, count=self._s, vsum=self._s,
+            vmin=self._s, vmax=self._s, recip=self._s)
+        dig_sh = td_ops.TDigest(mean=self._sk, weight=self._sk, min=self._s,
+                                max=self._s)
+        self.temp = jax.device_put(self.temp, temp_sh)
+        self.digest = jax.device_put(self.digest, dig_sh)
+        self.dmin = jax.device_put(self.dmin, self._s)
+        self.dmax = jax.device_put(self.dmax, self._s)
+
+    def _init_device(self):
+        super()._init_device()
+        self._place()
+
+    def _grow(self):
+        super()._grow()  # x2 growth keeps capacity % shards == 0
+        self._place()
+
+    def _drain_samples(self):
+        if self._fill == 0:
+            return
+        rows, vals, wts = self._rows, self._vals, self._wts
+        self._new_sample_buffers()
+        self.temp = self._ingest_p(self.temp, rows, vals, wts)
+
+    def _drain_imports(self):
+        if self._imp_fill == 0 and not self._imp_stat_rows:
+            return
+        # fixed-size stat scatter so import drains never retrace
+        ns = len(self._imp_stat_rows)
+        stat_rows = np.full(self.chunk, self.capacity, np.int32)
+        stat_mins = np.full(self.chunk, np.inf, np.float32)
+        stat_maxs = np.full(self.chunk, -np.inf, np.float32)
+        if ns:
+            stat_rows[:ns] = self._imp_stat_rows
+            stat_mins[:ns] = self._imp_stat_mins
+            stat_maxs[:ns] = self._imp_stat_maxs
+        imp = (self._imp_rows, self._imp_means, self._imp_wts)
+        self._new_import_buffers()
+        self._imp_stat_rows = []
+        self._imp_stat_mins = []
+        self._imp_stat_maxs = []
+        self.temp, self.dmin, self.dmax = self._import_p(
+            self.temp, self.dmin, self.dmax, *imp,
+            stat_rows, stat_mins, stat_maxs)
+
+    def _run_flush(self, qs):
+        return self._flush_p(self.digest, self.temp, self.dmin, self.dmax,
+                             jnp.asarray(qs, jnp.float32))
+
+
+class MeshSetGroup(SetGroup):
+    """A SetGroup whose [S, 2^p] register tensor is series-sharded — the
+    scaling story for HLL HBM cost (16 KiB/series at p=14)."""
+
+    def __init__(self, mesh: Mesh, capacity: int, chunk: int, precision: int):
+        self.mesh = mesh
+        self.shards = mesh.shape[SERIES_AXIS]
+        self.hosts = mesh.shape.get(HOSTS_AXIS, 1)
+        self._sk = NamedSharding(mesh, P(SERIES_AXIS, None))
+        super().__init__(_round_up(capacity, self.shards),
+                         _round_up(chunk, self.hosts), precision)
+        self._hash_p, self._reg_merge_p, self._estimate_p = _set_programs(
+            mesh, precision)
+        self.registers = jax.device_put(self.registers, self._sk)
+
+    def _grow(self):
+        super()._grow()
+        self.registers = jax.device_put(self.registers, self._sk)
+
+    def _reset_registers(self):
+        self.registers = jax.device_put(
+            jnp.zeros((self.capacity, self.m), jnp.int8), self._sk)
+
+    def _drain_samples(self):
+        if self._fill == 0:
+            return
+        rows, hi, lo = self._rows, self._hi, self._lo
+        self._new_sample_buffers()
+        self.registers = self._hash_p(self.registers, rows, hi, lo)
+
+    def _drain_imports(self):
+        if not self._imp_rows:
+            return
+        # pad to a fixed batch so import drains never retrace
+        n = len(self._imp_rows)
+        cap = IMPORT_DRAIN_BATCH
+        rows = np.full(cap, self.capacity, np.int32)
+        regs = np.zeros((cap, self.m), np.int8)
+        rows[:n] = self._imp_rows
+        regs[:n] = np.stack(self._imp_regs).astype(np.int8)
+        self._imp_rows.clear()
+        self._imp_regs.clear()
+        self.registers = self._reg_merge_p(self.registers, rows, regs)
+
+    def _estimates(self):
+        return self._estimate_p(self.registers)

@@ -65,6 +65,9 @@ from veneur_tpu.samplers.parser import (
 DEFAULT_CHUNK = 1 << 14
 DEFAULT_INITIAL_CAPACITY = 1 << 10
 _GROW_FACTOR = 2
+# HLL register imports drain in fixed batches of this size; the mesh store's
+# scatter buffers are sized to it, so both sites must agree
+IMPORT_DRAIN_BATCH = 256
 
 
 class Interner:
@@ -309,20 +312,26 @@ class DigestGroup:
         the shuffle."""
         row = self._row(key, tags)
         n = len(means)
-        if n > self.chunk:  # absurd, but stay safe
-            means, weights = means[:self.chunk], weights[:self.chunk]
-            n = self.chunk
-        if self._imp_fill + n > self.chunk:
-            self._drain_imports()
-        i = self._imp_fill
-        self._imp_rows[i:i + n] = row
-        self._imp_means[i:i + n] = means
-        self._imp_wts[i:i + n] = weights
-        self._imp_fill = i + n
+        start = 0
+        while start < n:  # digests larger than one chunk span several drains
+            if self._imp_fill == self.chunk:
+                self._drain_imports()
+            take = min(self.chunk - self._imp_fill, n - start)
+            i = self._imp_fill
+            self._imp_rows[i:i + take] = row
+            self._imp_means[i:i + take] = means[start:start + take]
+            self._imp_wts[i:i + take] = weights[start:start + take]
+            self._imp_fill = i + take
+            start += take
         if math.isfinite(dmin):
             self._imp_stat_rows.append(row)
             self._imp_stat_mins.append(dmin)
             self._imp_stat_maxs.append(dmax)
+            # zero-centroid imports never advance _imp_fill, so the stat
+            # lists need their own drain bound (the mesh drain scatters
+            # them through fixed chunk-sized buffers)
+            if len(self._imp_stat_rows) >= self.chunk:
+                self._drain_imports()
 
     def _drain_samples(self):
         if self._fill == 0:
@@ -361,6 +370,12 @@ class DigestGroup:
         self._drain_samples()
         self._drain_imports()
 
+    def _run_flush(self, qs):
+        """Execute the jitted flush program (override point for the
+        mesh-sharded store)."""
+        return _flush_digests(self.digest, self.temp, self.dmin, self.dmax,
+                              qs, self.compression)
+
     def flush(self, percentiles: List[float]):
         """Run the flush program; returns (interner, host result dict) and
         resets the group."""
@@ -368,8 +383,7 @@ class DigestGroup:
         n = len(self.interner)
         interner, self.interner = self.interner, Interner()
         qs = jnp.asarray(list(percentiles) + [0.5], jnp.float32)
-        digest, pcts, count, vsum, vmin, vmax, recip = _flush_digests(
-            self.digest, self.temp, self.dmin, self.dmax, qs, self.compression)
+        digest, pcts, count, vsum, vmin, vmax, recip = self._run_flush(qs)
         out = {
             "digest_mean": np.asarray(digest.mean[:n]),
             "digest_weight": np.asarray(digest.weight[:n]),
@@ -453,13 +467,16 @@ class SetGroup:
     def _row(self, key: MetricKey, tags: List[str]) -> int:
         row = self.interner.intern(key, tags)
         if row >= self.capacity:
-            self._drain_staging()
-            old = self.capacity
-            self.capacity *= _GROW_FACTOR
-            self.registers = jnp.pad(self.registers,
-                                     ((0, self.capacity - old), (0, 0)))
-            self._rows[self._fill:] = self.capacity
+            self._grow()
         return row
+
+    def _grow(self):
+        self._drain_staging()
+        old = self.capacity
+        self.capacity *= _GROW_FACTOR
+        self.registers = jnp.pad(self.registers,
+                                 ((0, self.capacity - old), (0, 0)))
+        self._rows[self._fill:] = self.capacity
 
     def sample(self, key: MetricKey, tags: List[str], member: str):
         row = self._row(key, tags)
@@ -486,7 +503,7 @@ class SetGroup:
         row = self._row(key, tags)
         self._imp_rows.append(row)
         self._imp_regs.append(registers)
-        if len(self._imp_rows) >= 256:
+        if len(self._imp_rows) >= IMPORT_DRAIN_BATCH:
             self._drain_imports()
 
     def _drain_samples(self):
@@ -517,13 +534,20 @@ class SetGroup:
         self._drain_staging()
         n = len(self.interner)
         interner, self.interner = self.interner, Interner()
-        estimates = (np.asarray(_estimate_all(self.registers)[:n])
+        estimates = (np.asarray(self._estimates()[:n])
                      if want_estimates else None)
         registers = (np.asarray(self.registers[:n], np.uint8)
                      if want_registers else None)
-        self.registers = jnp.zeros((self.capacity, self.m), jnp.int8)
+        self._reset_registers()
         self._init_staging()
         return interner, estimates, registers
+
+    def _estimates(self):
+        """Batched cardinality estimates (override point for the mesh store)."""
+        return _estimate_all(self.registers)
+
+    def _reset_registers(self):
+        self.registers = jnp.zeros((self.capacity, self.m), jnp.int8)
 
 
 # ---------------------------------------------------------------------------
@@ -577,18 +601,33 @@ class MetricStore:
     def __init__(self, initial_capacity: int = DEFAULT_INITIAL_CAPACITY,
                  chunk: int = DEFAULT_CHUNK,
                  compression: float = td_ops.DEFAULT_COMPRESSION,
-                 hll_precision: int = hll_ops.DEFAULT_PRECISION):
+                 hll_precision: int = hll_ops.DEFAULT_PRECISION,
+                 mesh=None):
         self._lock = threading.RLock()
+        self.mesh = mesh
         self.counters = ScalarGroup("counter", initial_capacity)
         self.global_counters = ScalarGroup("counter", initial_capacity)
         self.gauges = ScalarGroup("gauge", initial_capacity)
         self.global_gauges = ScalarGroup("gauge", initial_capacity)
         self.local_status_checks = ScalarGroup("status", initial_capacity)
-        self.histograms = DigestGroup(initial_capacity, chunk, compression)
-        self.timers = DigestGroup(initial_capacity, chunk, compression)
+        if mesh is not None:
+            # Global-tier mode: the mixed (fleet-merged) groups live sharded
+            # over the device mesh; local-only groups stay single-device
+            # (they hold only this instance's own telemetry).
+            from veneur_tpu.core.mesh_store import (MeshDigestGroup,
+                                                    MeshSetGroup)
+            self.histograms = MeshDigestGroup(mesh, initial_capacity, chunk,
+                                              compression)
+            self.timers = MeshDigestGroup(mesh, initial_capacity, chunk,
+                                          compression)
+            self.sets = MeshSetGroup(mesh, initial_capacity, chunk,
+                                     hll_precision)
+        else:
+            self.histograms = DigestGroup(initial_capacity, chunk, compression)
+            self.timers = DigestGroup(initial_capacity, chunk, compression)
+            self.sets = SetGroup(initial_capacity, chunk, hll_precision)
         self.local_histograms = DigestGroup(initial_capacity, chunk, compression)
         self.local_timers = DigestGroup(initial_capacity, chunk, compression)
-        self.sets = SetGroup(initial_capacity, chunk, hll_precision)
         self.local_sets = SetGroup(initial_capacity, chunk, hll_precision)
         self.hll_precision = hll_precision
         self.processed = 0

@@ -103,11 +103,31 @@ class Server:
         self.histogram_aggregates = HistogramAggregates.from_names(
             config.aggregates)
 
+        # A global instance can shard its store over every visible chip
+        # (the reference scales its global tier with more worker goroutines
+        # + proxy hash rings; here the series axis shards over the mesh,
+        # importsrv/server.go:101-132 → parallel/mesh.py)
+        mesh = None
+        if config.mesh_enabled and config.forward_address:
+            log.warning("mesh_enabled ignored: this is a local instance "
+                        "(forward_address is set); only the global tier "
+                        "shards its store")
+        elif config.mesh_enabled:
+            import jax
+
+            from veneur_tpu.parallel.mesh import fleet_mesh
+
+            n = len(jax.devices())
+            hosts = config.mesh_hosts or (2 if n % 2 == 0 else 1)
+            mesh = fleet_mesh(jax.devices(), hosts=hosts)
+            log.info("global store sharded over %d devices (%s)", n,
+                     dict(mesh.shape))
         self.store = MetricStore(
             initial_capacity=config.store_initial_capacity,
             chunk=config.store_chunk,
             compression=config.tdigest_compression,
             hll_precision=config.hll_precision,
+            mesh=mesh,
         )
         self.event_worker = EventWorker()
         self.span_chan: "queue.Queue" = queue.Queue(config.span_channel_capacity)
