@@ -1,0 +1,307 @@
+"""Native (C++) ingest vs the pure-Python reference path.
+
+The native library must be a drop-in for the Python parser: identical
+record fields, identical fnv1a digests, identical rejects — and
+``MetricStore.process_batch`` must produce the same flushed output as
+per-sample ``process_metric``. Mirrors the reference's parser tables
+(``/root/reference/samplers/parser_test.go:404-690``) plus the framed-SSF
+scanner (``protocol/wire.go:42-108``) and the SO_REUSEPORT reader pool
+(``networking.go:37-87``, ``socket_linux.go:12-76``).
+"""
+
+import socket
+import time
+
+import numpy as np
+import pytest
+
+from veneur_tpu import native
+from veneur_tpu.core.store import MetricStore
+from veneur_tpu.ops import hll as hll_ops
+from veneur_tpu.samplers import parser as p
+from veneur_tpu.samplers.intermetric import HistogramAggregates
+
+pytestmark = pytest.mark.skipif(not native.available(),
+                                reason="native library unavailable (no g++)")
+
+AGG = HistogramAggregates.from_names(["min", "max", "count", "sum"])
+
+VALID_LINES = [
+    b"a.b.c:1|c",
+    b"gauge.x:3.1415|g",
+    b"timer.y:21.5|ms",
+    b"histo.z:7|h",
+    b"a.b:1|c|@0.25",
+    b"a.b:5|c|#foo:bar,baz:qux",
+    b"a.b:5|c|#zz,aa,mm",
+    b"t.h:9.5|h|@0.5|#b:2,a:1",
+    b"t.h:9.5|h|#b:2,a:1|@0.5",
+    b"local.h:1|h|#veneurlocalonly,foo:bar",
+    b"global.c:2|c|#veneurglobalonly",
+    b"set.s:some-member|s|#k:v",
+    b"neg.g:-42.5|g",
+    b"exp.g:1e3|g",
+]
+
+INVALID_LINES = [
+    b"a.b.c",
+    b":1|c",
+    b"a.b.c:1",
+    b"foo:1||",
+    b"a.b.c:1|x",
+    b"a.b.c:fail|c",
+    b"a.b.c:nan|g",
+    b"a.b.c:inf|g",
+    b"a.b.c:1|c|@0.5|@0.2",
+    b"a.b.c:1|c|#a|#b",
+    b"a.b.c:1|c|",
+    b"a.b.c:1|c||@0.1",
+    b"a.b.c:1|c|bad",
+    b"a.b.c:1|c|@1.5",
+    b"a.b.c:1|c|@0",
+]
+
+
+class TestParserParity:
+    @pytest.mark.parametrize("line", VALID_LINES)
+    def test_valid_line_fields_match(self, line):
+        want = p.parse_metric(line)
+        b = native.parse_lines(line)
+        assert b.count == 1 and b.parse_errors == 0
+        assert b.name(0) == want.name
+        assert native.TYPE_NAMES[b.type[0]] == want.type
+        assert b.joined_tags(0) == want.joined_tags
+        assert int(b.scope[0]) == want.scope
+        assert b.sample_rate[0] == pytest.approx(want.sample_rate)
+        assert int(b.digest[0]) == want.digest
+        if want.type == "set":
+            assert b.aux(0).decode() == want.value
+            assert (int(b.member_hashes()[0])
+                    == hll_ops.hash_member(str(want.value).encode()))
+        else:
+            assert b.value[0] == pytest.approx(float(want.value))
+
+    @pytest.mark.parametrize("line", INVALID_LINES)
+    def test_invalid_line_rejected_by_both(self, line):
+        with pytest.raises(p.ParseError):
+            p.parse_metric(line)
+        b = native.parse_lines(line)
+        assert b.count == 0
+        assert b.parse_errors == 1
+
+    def test_many_tags_no_cap(self):
+        tags = ",".join(f"t{i:03d}:v{i}" for i in range(200))
+        line = f"m.x:1|c|#{tags}".encode()
+        want = p.parse_metric(line)
+        b = native.parse_lines(line)
+        assert b.count == 1
+        assert b.joined_tags(0) == want.joined_tags
+        assert int(b.digest[0]) == want.digest
+
+    def test_raw_passthrough(self):
+        buf = (b"_e{5,4}:title|text\n"
+               b"_sc|my.check|1|#a:b\n"
+               b"ok.c:1|c\n")
+        b = native.parse_lines(buf)
+        assert b.count == 3
+        raws = [b.aux(i) for i in range(b.count) if b.type[i] == native.RAW]
+        assert raws == [b"_e{5,4}:title|text", b"_sc|my.check|1|#a:b"]
+
+    def test_mixed_buffer_counts(self):
+        buf = b"\n".join(VALID_LINES + INVALID_LINES) + b"\n\n"
+        b = native.parse_lines(buf)
+        assert b.count == len(VALID_LINES)
+        assert b.parse_errors == len(INVALID_LINES)
+
+
+class TestFrameScanParity:
+    def test_frames_and_partial(self):
+        from veneur_tpu.protocol import wire
+
+        payloads = [b"x" * 7, b"y" * 130, b""]
+        buf = b"".join(bytes([0]) + len(pl).to_bytes(4, "big") + pl
+                       for pl in payloads)
+        tail = bytes([0]) + (50).to_bytes(4, "big") + b"z" * 10  # incomplete
+        frames, consumed, poisoned = native.frame_scan(buf + tail)
+        assert not poisoned
+        assert consumed == len(buf)
+        assert [buf[o:o + l] for o, l in frames] == payloads
+        assert wire is not None  # framing constants shared with wire.py
+
+    def test_bad_version_poisons(self):
+        frames, consumed, poisoned = native.frame_scan(
+            bytes([9]) + (3).to_bytes(4, "big") + b"abc")
+        assert poisoned and not frames
+
+    def test_oversized_poisons(self):
+        frames, consumed, poisoned = native.frame_scan(
+            bytes([0]) + (17 * 1024 * 1024).to_bytes(4, "big"))
+        assert poisoned
+
+
+def _feed_python(store, lines):
+    for line in lines:
+        store.process_metric(p.parse_metric(line))
+
+
+class TestProcessBatchEquivalence:
+    """store.process_batch(native batch) == per-sample process_metric."""
+
+    def _lines(self, rng):
+        lines = []
+        for i in range(30):
+            for v in rng.normal(50 + i, 4, 40):
+                lines.append(f"pb.h{i % 7}:{v:.4f}|h|#k:{i % 3}".encode())
+        for i in range(25):
+            lines.append(f"pb.c{i % 5}:{i}|c|@0.5".encode())
+            lines.append(f"pb.g{i % 4}:{i * 1.5}|g".encode())
+            lines.append(f"pb.s{i % 3}:member{i}|s".encode())
+            lines.append(f"pb.t{i % 2}:{i * 0.3}|ms".encode())
+        lines.append(b"pb.gc:3|c|#veneurglobalonly")
+        lines.append(b"pb.lh:4.5|h|#veneurlocalonly")
+        rng.shuffle(lines)
+        return lines
+
+    def test_flush_equivalence(self):
+        rng = np.random.default_rng(13)
+        lines = self._lines(rng)
+        # capacity ≥ series count: growth-triggered partial drains happen
+        # at different stream positions on the two paths (the batch path
+        # interns a whole batch before staging), which changes centroid
+        # layout but not digest validity — test_flush_with_growth covers
+        # that case with a quantile-level oracle
+        nstore = MetricStore(initial_capacity=64, chunk=256)
+        pstore = MetricStore(initial_capacity=64, chunk=256)
+        # several small batches, exercising cache reuse + chunk spanning
+        for i in range(0, len(lines), 97):
+            buf = b"\n".join(lines[i:i + 97])
+            raws = nstore.process_batch(native.parse_lines(buf))
+            assert raws == []
+        _feed_python(pstore, lines)
+        assert nstore.processed == pstore.processed
+        now = int(time.time())
+        nfinal, nfwd, _ = nstore.flush([0.5, 0.99], AGG, is_local=True,
+                                       now=now)
+        pfinal, pfwd, _ = pstore.flush([0.5, 0.99], AGG, is_local=True,
+                                       now=now)
+        nby = {(m.name, ",".join(m.tags)): m.value for m in nfinal}
+        pby = {(m.name, ",".join(m.tags)): m.value for m in pfinal}
+        assert set(nby) == set(pby)
+        for k, want in pby.items():
+            assert nby[k] == pytest.approx(want, rel=1e-5), k
+        # forwarded digests match exactly too
+        nh = {(n, tuple(t)): (m.tolist(), w.tolist(), mn, mx)
+              for n, t, m, w, mn, mx in nfwd.histograms}
+        ph = {(n, tuple(t)): (m.tolist(), w.tolist(), mn, mx)
+              for n, t, m, w, mn, mx in pfwd.histograms}
+        assert nh == ph
+
+    def test_flush_with_growth(self):
+        """Under capacity growth the two paths drain at different points;
+        the digests differ in layout but agree on quantiles."""
+        rng = np.random.default_rng(17)
+        nstore = MetricStore(initial_capacity=8, chunk=128)
+        pstore = MetricStore(initial_capacity=8, chunk=128)
+        lines, vals_by = [], {}
+        for i in range(40):
+            vals = rng.normal(10 * (i % 9), 3, 60)
+            vals_by.setdefault(i % 9, []).extend(vals)
+            lines.extend(f"gr.h{i % 9}:{v:.4f}|h".encode() for v in vals)
+        nstore.process_batch(native.parse_lines(b"\n".join(lines)))
+        _feed_python(pstore, lines)
+        now = int(time.time())
+        nby = {m.name: m.value
+               for m in nstore.flush([0.5, 0.99], AGG, False, now)[0]}
+        pby = {m.name: m.value
+               for m in pstore.flush([0.5, 0.99], AGG, False, now)[0]}
+        assert set(nby) == set(pby)
+        for i, vals in vals_by.items():
+            vals = np.asarray(vals)
+            span = vals.max() - vals.min()
+            for q in (50, 99):
+                n = nby[f"gr.h{i}.{q}percentile"]
+                want = np.quantile(vals, q / 100)
+                assert abs(n - want) / span < 0.05, (i, q)
+                assert abs(n - pby[f"gr.h{i}.{q}percentile"]) / span < 0.05
+
+    def test_gauge_last_write_wins_in_batch(self):
+        store = MetricStore(initial_capacity=8, chunk=64)
+        buf = b"g.x:1|g\ng.x:2|g\ng.x:3|g"
+        store.process_batch(native.parse_lines(buf))
+        final, _, _ = store.flush([], AGG, is_local=True,
+                                  now=int(time.time()))
+        assert {m.name: m.value for m in final}["g.x"] == 3.0
+
+    def test_counter_go_truncation(self):
+        store = MetricStore(initial_capacity=8, chunk=64)
+        store.process_batch(native.parse_lines(b"c.x:2.9|c|@0.3"))
+        pstore = MetricStore(initial_capacity=8, chunk=64)
+        pstore.process_metric(p.parse_metric(b"c.x:2.9|c|@0.3"))
+        now = int(time.time())
+        n = {m.name: m.value for m in store.flush([], AGG, True, now)[0]}
+        q = {m.name: m.value for m in pstore.flush([], AGG, True, now)[0]}
+        assert n["c.x"] == q["c.x"] == 2 * 3  # int(2.9) * int(1/0.3)
+
+    def test_raw_records_returned(self):
+        store = MetricStore(initial_capacity=8, chunk=64)
+        raws = store.process_batch(
+            native.parse_lines(b"_sc|chk|0\nok:1|c"))
+        assert raws == [b"_sc|chk|0"]
+        assert store.processed == 1  # raw line counted by its re-parse
+
+
+class TestNativeUDPReader:
+    def test_reader_pool_e2e(self):
+        reader = native.NativeUDPReader(host="127.0.0.1", port=0,
+                                        num_readers=2)
+        try:
+            sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+            for i in range(500):
+                sock.sendto(f"udp.h:{i}|h\nudp.c:1|c".encode(),
+                            ("127.0.0.1", reader.port))
+            deadline = time.time() + 10
+            got = 0
+            batches = []
+            while time.time() < deadline and got < 1000:
+                for b in reader.drain():
+                    got += b.count
+                    batches.append(b)
+                time.sleep(0.01)
+            assert got == 1000
+            assert reader.packets() == 500
+            assert reader.drops() == 0
+            names = {b.name(i) for b in batches for i in range(b.count)}
+            assert names == {"udp.h", "udp.c"}
+        finally:
+            reader.stop()
+
+    def test_server_uses_native_reader(self):
+        from veneur_tpu.config import Config
+        from veneur_tpu.server import Server
+        from veneur_tpu.sinks import ChannelMetricSink
+
+        cfg = Config(statsd_listen_addresses=["udp://127.0.0.1:0"],
+                     interval="86400s", aggregates=["count"],
+                     num_readers=2)
+        sink = ChannelMetricSink()
+        server = Server(cfg, metric_sinks=[sink])
+        server.start()
+        try:
+            assert server._native_readers, "native reader not engaged"
+            port = server.statsd_addrs[0][1]
+            sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+            for i in range(200):
+                sock.sendto(f"nat.h:{i}|h|#a:b".encode(), ("127.0.0.1", port))
+            sock.sendto(b"_sc|native.check|0", ("127.0.0.1", port))
+            sock.sendto(b"not a metric", ("127.0.0.1", port))
+            deadline = time.time() + 10
+            while time.time() < deadline and server.store.processed < 201:
+                time.sleep(0.02)
+            assert server.store.processed == 201
+            assert server.packet_errors == 1
+            server.flush()
+            by = {m.name: m.value for m in sink.get_flush()}
+            assert by["nat.h.count"] == 200
+            assert "native.check" in by
+        finally:
+            server.shutdown()

@@ -123,6 +123,9 @@ class Config:
     store_chunk: int = 16384
     # initial dense-series capacity per scope-class (grows by doubling)
     store_initial_capacity: int = 4096
+    # drain plain-IPv4 UDP statsd listeners with the C++ recvmmsg reader
+    # pool + batch parser when the native library is available
+    native_ingest: bool = True
     # shard the global-tier store over a (series, hosts) device mesh;
     # only meaningful on a global instance (forward_address unset)
     mesh_enabled: bool = False

@@ -90,37 +90,68 @@ def _load():
                 _build_error = err
                 log.warning("native ingest unavailable: %s", err)
                 return None
-        lib = ctypes.CDLL(_SO)
-        lib.vt_batch_new.restype = ctypes.POINTER(_VtBatch)
-        lib.vt_batch_new.argtypes = [ctypes.c_uint32, ctypes.c_uint32]
-        lib.vt_batch_free.argtypes = [ctypes.POINTER(_VtBatch)]
-        lib.vt_batch_reset.argtypes = [ctypes.POINTER(_VtBatch)]
-        lib.vt_parse_lines.restype = ctypes.c_uint32
-        lib.vt_parse_lines.argtypes = [ctypes.c_char_p, ctypes.c_size_t,
-                                       ctypes.POINTER(_VtBatch)]
-        lib.vt_frame_scan.restype = ctypes.c_uint32
-        lib.vt_frame_scan.argtypes = [
-            ctypes.c_char_p, ctypes.c_size_t,
-            ctypes.POINTER(ctypes.c_uint32), ctypes.POINTER(ctypes.c_uint32),
-            ctypes.c_uint32, ctypes.POINTER(ctypes.c_size_t),
-            ctypes.POINTER(ctypes.c_int)]
-        lib.vt_reader_start.restype = ctypes.c_void_p
-        lib.vt_reader_start.argtypes = [
-            ctypes.c_char_p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
-            ctypes.c_uint32, ctypes.c_uint32]
-        lib.vt_reader_port.restype = ctypes.c_int
-        lib.vt_reader_port.argtypes = [ctypes.c_void_p]
-        lib.vt_reader_count.restype = ctypes.c_int
-        lib.vt_reader_count.argtypes = [ctypes.c_void_p]
-        lib.vt_reader_swap.restype = ctypes.POINTER(_VtBatch)
-        lib.vt_reader_swap.argtypes = [ctypes.c_void_p, ctypes.c_int]
-        lib.vt_reader_packets.restype = ctypes.c_uint64
-        lib.vt_reader_packets.argtypes = [ctypes.c_void_p, ctypes.c_int]
-        lib.vt_reader_drops.restype = ctypes.c_uint64
-        lib.vt_reader_drops.argtypes = [ctypes.c_void_p, ctypes.c_int]
-        lib.vt_reader_stop.argtypes = [ctypes.c_void_p]
+        try:
+            lib = _bind(ctypes.CDLL(_SO))
+        except OSError as e:
+            # a stale or foreign-platform .so (git preserves no mtimes, so
+            # the staleness check above can miss): rebuild once, then give
+            # up — available() must never raise
+            log.warning("native library load failed (%s); rebuilding", e)
+            err = _build()
+            if err is None:
+                try:
+                    lib = _bind(ctypes.CDLL(_SO))
+                except OSError as e2:
+                    err = f"rebuilt library still unloadable: {e2}"
+            if err is not None:
+                _build_error = err
+                log.warning("native ingest unavailable: %s", err)
+                return None
         _lib = lib
         return _lib
+
+
+def _bind(lib):
+    lib.vt_batch_new.restype = ctypes.POINTER(_VtBatch)
+    lib.vt_batch_new.argtypes = [ctypes.c_uint32, ctypes.c_uint32]
+    lib.vt_batch_free.argtypes = [ctypes.POINTER(_VtBatch)]
+    lib.vt_batch_reset.argtypes = [ctypes.POINTER(_VtBatch)]
+    lib.vt_parse_lines.restype = ctypes.c_uint32
+    lib.vt_parse_lines.argtypes = [ctypes.c_char_p, ctypes.c_size_t,
+                                   ctypes.POINTER(_VtBatch)]
+    lib.vt_frame_scan.restype = ctypes.c_uint32
+    lib.vt_frame_scan.argtypes = [
+        ctypes.c_char_p, ctypes.c_size_t,
+        ctypes.POINTER(ctypes.c_uint32), ctypes.POINTER(ctypes.c_uint32),
+        ctypes.c_uint32, ctypes.POINTER(ctypes.c_size_t),
+        ctypes.POINTER(ctypes.c_int)]
+    lib.vt_reader_start.restype = ctypes.c_void_p
+    lib.vt_reader_start.argtypes = [
+        ctypes.c_char_p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+        ctypes.c_uint32, ctypes.c_uint32, ctypes.c_int]
+    lib.vt_reader_port.restype = ctypes.c_int
+    lib.vt_reader_port.argtypes = [ctypes.c_void_p]
+    lib.vt_reader_count.restype = ctypes.c_int
+    lib.vt_reader_count.argtypes = [ctypes.c_void_p]
+    lib.vt_reader_swap.restype = ctypes.POINTER(_VtBatch)
+    lib.vt_reader_swap.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    lib.vt_reader_packets.restype = ctypes.c_uint64
+    lib.vt_reader_packets.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    lib.vt_reader_drops.restype = ctypes.c_uint64
+    lib.vt_reader_drops.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    lib.vt_reader_stop.argtypes = [ctypes.c_void_p]
+    lib.vt_intern_new.restype = ctypes.c_void_p
+    lib.vt_intern_free.argtypes = [ctypes.c_void_p]
+    lib.vt_intern_reset.argtypes = [ctypes.c_void_p]
+    lib.vt_intern_put.argtypes = [
+        ctypes.c_void_p, ctypes.c_uint8, ctypes.c_char_p, ctypes.c_uint32,
+        ctypes.c_char_p, ctypes.c_uint32, ctypes.c_uint32]
+    lib.vt_intern_assign.restype = ctypes.c_uint32
+    lib.vt_intern_assign.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(_VtBatch),
+        ctypes.POINTER(ctypes.c_uint32), ctypes.POINTER(ctypes.c_uint8),
+        ctypes.POINTER(ctypes.c_uint32)]
+    return lib
 
 
 def available() -> bool:
@@ -171,6 +202,29 @@ class ParsedBatch:
         o, l = self.aux_off[i], self.aux_len[i]
         return self.arena[o:o + l]
 
+    def member_hashes(self) -> np.ndarray:
+        """uint64 set-member hashes carried in the value slot's bit
+        pattern (only meaningful for records of type set)."""
+        return self.value.view(np.uint64)
+
+    def raw_view(self) -> "_VtBatch":
+        """A VtBatch struct pointing at this batch's numpy arrays/arena,
+        for C calls that re-read the batch (vt_intern_assign). The struct
+        only borrows; keep the ParsedBatch alive across the call."""
+        b = _VtBatch()
+        b.count = self.count
+        b.arena_len = len(self.arena)
+        u8, u32 = ctypes.c_uint8, ctypes.c_uint32
+        b.type = self.type.ctypes.data_as(ctypes.POINTER(u8))
+        b.scope = self.scope.ctypes.data_as(ctypes.POINTER(u8))
+        b.name_off = self.name_off.ctypes.data_as(ctypes.POINTER(u32))
+        b.name_len = self.name_len.ctypes.data_as(ctypes.POINTER(u32))
+        b.tags_off = self.tags_off.ctypes.data_as(ctypes.POINTER(u32))
+        b.tags_len = self.tags_len.ctypes.data_as(ctypes.POINTER(u32))
+        b.arena = ctypes.cast(ctypes.c_char_p(self.arena),
+                              ctypes.POINTER(ctypes.c_char))
+        return b
+
 
 def parse_lines(data: bytes, max_records: int = 0,
                 arena_cap: int = 0) -> ParsedBatch:
@@ -205,6 +259,55 @@ def frame_scan(buf: bytes, max_frames: int = 4096
             bool(poisoned.value))
 
 
+MISS = 0xFFFFFFFF  # vt_intern_assign's "unknown series" row sentinel
+
+
+class InternTable:
+    """The C++ series-interning table: (kind, name, tags) -> row. Only
+    memoizes rows the Python Interner assigned; unknown keys come back as
+    MISS for the caller to resolve and teach back with put()."""
+
+    def __init__(self):
+        lib = _load()
+        if lib is None:
+            raise RuntimeError(f"native ingest unavailable: {_build_error}")
+        self._lib = lib
+        self._handle = lib.vt_intern_new()
+
+    def assign(self, batch: "ParsedBatch"):
+        """Returns (rows uint32[count], kinds uint8[count],
+        miss_indices uint32[nmiss]); misses hold MISS in rows."""
+        count = batch.count
+        rows = np.empty(count, np.uint32)
+        kinds = np.empty(count, np.uint8)
+        miss = np.empty(count, np.uint32)
+        view = batch.raw_view()
+        nmiss = self._lib.vt_intern_assign(
+            self._handle, ctypes.byref(view),
+            rows.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
+            kinds.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+            miss.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)))
+        return rows, kinds, miss[:nmiss]
+
+    def put(self, kind: int, name: bytes, tags: bytes, row: int):
+        self._lib.vt_intern_put(self._handle, kind, name, len(name),
+                                tags, len(tags), row)
+
+    def reset(self):
+        self._lib.vt_intern_reset(self._handle)
+
+    def close(self):
+        if self._handle:
+            self._lib.vt_intern_free(self._handle)
+            self._handle = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
 class NativeUDPReader:
     """The C++ SO_REUSEPORT reader pool (networking.go:37-87 rebuilt
     native). ``drain()`` swaps every reader's batch and returns the
@@ -212,15 +315,16 @@ class NativeUDPReader:
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
                  num_readers: int = 1, rcvbuf: int = 2 * 1024 * 1024,
-                 batch_records: int = 65536,
-                 batch_arena: int = 8 * 1024 * 1024):
+                 batch_records: int = 262144,
+                 batch_arena: int = 32 * 1024 * 1024,
+                 dgram_max: int = 8192):
         lib = _load()
         if lib is None:
             raise RuntimeError(f"native ingest unavailable: {_build_error}")
         self._lib = lib
         self._handle = lib.vt_reader_start(
             host.encode(), port, num_readers, rcvbuf, batch_records,
-            batch_arena)
+            batch_arena, dgram_max)
         if not self._handle:
             raise OSError(f"could not bind native UDP readers on "
                           f"{host}:{port}")

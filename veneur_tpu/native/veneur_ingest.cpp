@@ -226,8 +226,9 @@ bool parse_line(const char* line, size_t len, VtBatch* b) {
   // optional sections: @rate and #tags, any order, at most once
   float sample_rate = 1.0f;
   bool found_rate = false;
-  TagView tags[64];
-  size_t ntags = 0;
+  // tags grow without bound, matching the pure-Python parser (the Go
+  // reference imposes no tag-count limit either)
+  std::vector<TagView> tags;
   bool found_tags = false;
   uint8_t scope = kMixed;
 
@@ -255,26 +256,23 @@ bool parse_line(const char* line, size_t len, VtBatch* b) {
       found_tags = true;
       const char* tp = p + 1;
       const char* tend = p + sec_len;
-      while (tp <= tend && ntags < 64) {
+      while (tp <= tend) {
         const char* comma =
             static_cast<const char*>(memchr(tp, ',', tend - tp));
         size_t tlen = comma ? static_cast<size_t>(comma - tp)
                             : static_cast<size_t>(tend - tp);
-        tags[ntags].p = tp;
-        tags[ntags].len = tlen;
-        ntags++;
+        tags.push_back(TagView{tp, tlen});
         if (!comma) break;
         tp = comma + 1;
       }
-      std::sort(tags, tags + ntags);
+      std::sort(tags.begin(), tags.end());
       // first-match scope-tag extraction (parser.go:326-342)
-      for (size_t i = 0; i < ntags; i++) {
+      for (size_t i = 0; i < tags.size(); i++) {
         bool local = has_prefix(tags[i], "veneurlocalonly", 15);
         bool global = has_prefix(tags[i], "veneurglobalonly", 16);
         if (local || global) {
           scope = local ? kLocalOnly : kGlobalOnly;
-          for (size_t j = i + 1; j < ntags; j++) tags[j - 1] = tags[j];
-          ntags--;
+          tags.erase(tags.begin() + i);
           break;
         }
       }
@@ -295,7 +293,7 @@ bool parse_line(const char* line, size_t len, VtBatch* b) {
   uint32_t toff = b->arena_len;
   uint32_t tlen = 0;
   if (found_tags) {
-    for (size_t i = 0; i < ntags; i++) {
+    for (size_t i = 0; i < tags.size(); i++) {
       if (i > 0) {
         if (arena_put(b, ",", 1) == UINT32_MAX) return false;
         tlen += 1;
@@ -311,6 +309,18 @@ bool parse_line(const char* line, size_t len, VtBatch* b) {
     aoff = arena_put(b, value_p, value_len);
     if (aoff == UINT32_MAX) return false;
     alen = static_cast<uint32_t>(value_len);
+    // 64-bit member hash (FNV-1a core + murmur3 fmix64), bit-identical to
+    // ops/hll.py hash_member; carried through the value slot's bit pattern
+    uint64_t mh = 14695981039346656037ULL;
+    for (size_t vi = 0; vi < value_len; vi++) {
+      mh = (mh ^ static_cast<uint8_t>(value_p[vi])) * 1099511628211ULL;
+    }
+    mh ^= mh >> 33;
+    mh *= 0xFF51AFD7ED558CCDULL;
+    mh ^= mh >> 33;
+    mh *= 0xC4CEB9FE1A85EC53ULL;
+    mh ^= mh >> 33;
+    memcpy(&value, &mh, sizeof(value));
   }
 
   b->type[idx] = rtype;
@@ -392,6 +402,189 @@ extern "C" uint32_t vt_frame_scan(const char* buf, size_t len,
 }
 
 // ---------------------------------------------------------------------------
+// Series interning table: (scope-class kind, name, tags) -> dense row id.
+// The host-side hot hash path (string-keyed series -> row indices) that
+// the reference pays inside map[MetricKey]*sampler lookups per sample
+// (worker.go:96-157). The table only MEMOIZES rows assigned by the Python
+// Interner: vt_intern_assign leaves unknown keys as misses (row =
+// UINT32_MAX) for Python to resolve and teach back via vt_intern_put, so
+// both sides always agree on row numbering.
+
+namespace {
+
+// scope-class kinds, mirroring veneur_tpu/core/store.py _K_* constants
+inline uint8_t kind_of(uint8_t rtype, uint8_t scope) {
+  switch (rtype) {
+    case kCounter: return scope == kGlobalOnly ? 1 : 0;
+    case kGauge: return scope == kGlobalOnly ? 3 : 2;
+    case kHistogram: return scope == kLocalOnly ? 5 : 4;
+    case kTimer: return scope == kLocalOnly ? 7 : 6;
+    case kSet: return scope == kLocalOnly ? 9 : 8;
+    default: return 255;  // raw
+  }
+}
+
+struct InternEntry {
+  uint64_t hash;
+  uint32_t key_off;
+  uint32_t key_len;
+  uint32_t row;
+  uint32_t used;
+};
+
+struct InternTable {
+  InternEntry* slots;
+  size_t cap;  // power of two
+  size_t count;
+  char* arena;
+  size_t arena_len;
+  size_t arena_cap;
+};
+
+inline uint64_t fnv1a64(const char* data, size_t len, uint64_t h) {
+  for (size_t i = 0; i < len; i++) {
+    h = (h ^ static_cast<unsigned char>(data[i])) * 1099511628211ULL;
+  }
+  return h;
+}
+
+inline uint64_t intern_hash(uint8_t kind, const char* name, size_t nlen,
+                            const char* tags, size_t tlen) {
+  uint64_t h = 14695981039346656037ULL;
+  char k = static_cast<char>(kind);
+  h = fnv1a64(&k, 1, h);
+  h = fnv1a64(name, nlen, h);
+  char sep = 0x1f;
+  h = fnv1a64(&sep, 1, h);
+  return fnv1a64(tags, tlen, h);
+}
+
+inline bool intern_key_eq(const InternTable* t, const InternEntry* e,
+                          uint8_t kind, const char* name, size_t nlen,
+                          const char* tags, size_t tlen) {
+  if (e->key_len != 1 + nlen + 1 + tlen) return false;
+  const char* k = t->arena + e->key_off;
+  if (static_cast<uint8_t>(k[0]) != kind) return false;
+  if (memcmp(k + 1, name, nlen) != 0) return false;
+  if (k[1 + nlen] != 0x1f) return false;
+  return memcmp(k + 2 + nlen, tags, tlen) == 0;
+}
+
+void intern_grow(InternTable* t) {
+  size_t ncap = t->cap * 2;
+  InternEntry* ns = static_cast<InternEntry*>(
+      calloc(ncap, sizeof(InternEntry)));
+  for (size_t i = 0; i < t->cap; i++) {
+    InternEntry* e = &t->slots[i];
+    if (!e->used) continue;
+    size_t j = e->hash & (ncap - 1);
+    while (ns[j].used) j = (j + 1) & (ncap - 1);
+    ns[j] = *e;
+  }
+  free(t->slots);
+  t->slots = ns;
+  t->cap = ncap;
+}
+
+}  // namespace
+
+extern "C" InternTable* vt_intern_new() {
+  InternTable* t = new InternTable();
+  t->cap = 1 << 12;
+  t->slots = static_cast<InternEntry*>(calloc(t->cap, sizeof(InternEntry)));
+  t->count = 0;
+  t->arena_cap = 1 << 16;
+  t->arena = static_cast<char*>(malloc(t->arena_cap));
+  t->arena_len = 0;
+  return t;
+}
+
+extern "C" void vt_intern_free(InternTable* t) {
+  free(t->slots);
+  free(t->arena);
+  delete t;
+}
+
+// Flush-time reset: rows restart from zero (the Python interners were
+// swapped out), allocations are kept.
+extern "C" void vt_intern_reset(InternTable* t) {
+  memset(t->slots, 0, t->cap * sizeof(InternEntry));
+  t->count = 0;
+  t->arena_len = 0;
+}
+
+extern "C" void vt_intern_put(InternTable* t, uint8_t kind,
+                              const char* name, uint32_t nlen,
+                              const char* tags, uint32_t tlen,
+                              uint32_t row) {
+  if (t->count * 10 >= t->cap * 7) intern_grow(t);
+  uint64_t h = intern_hash(kind, name, nlen, tags, tlen);
+  size_t j = h & (t->cap - 1);
+  while (t->slots[j].used) {
+    InternEntry* e = &t->slots[j];
+    if (e->hash == h && intern_key_eq(t, e, kind, name, nlen, tags, tlen)) {
+      e->row = row;  // overwrite (python is authoritative)
+      return;
+    }
+    j = (j + 1) & (t->cap - 1);
+  }
+  size_t klen = 1 + nlen + 1 + tlen;
+  if (t->arena_len + klen > t->arena_cap) {
+    while (t->arena_len + klen > t->arena_cap) t->arena_cap *= 2;
+    t->arena = static_cast<char*>(realloc(t->arena, t->arena_cap));
+  }
+  char* k = t->arena + t->arena_len;
+  k[0] = static_cast<char>(kind);
+  memcpy(k + 1, name, nlen);
+  k[1 + nlen] = 0x1f;
+  memcpy(k + 2 + nlen, tags, tlen);
+  InternEntry* e = &t->slots[j];
+  e->hash = h;
+  e->key_off = static_cast<uint32_t>(t->arena_len);
+  e->key_len = static_cast<uint32_t>(klen);
+  e->row = row;
+  e->used = 1;
+  t->arena_len += klen;
+  t->count++;
+}
+
+// For every record: out_kinds[i] = scope-class kind (255 for raw),
+// out_rows[i] = memoized row or UINT32_MAX on miss. Miss record indices
+// are appended to out_miss; returns the miss count.
+extern "C" uint32_t vt_intern_assign(InternTable* t, const VtBatch* b,
+                                     uint32_t* out_rows, uint8_t* out_kinds,
+                                     uint32_t* out_miss) {
+  uint32_t nmiss = 0;
+  for (uint32_t i = 0; i < b->count; i++) {
+    uint8_t kind = kind_of(b->type[i], b->scope[i]);
+    out_kinds[i] = kind;
+    if (kind == 255) {
+      out_rows[i] = UINT32_MAX;
+      continue;
+    }
+    const char* name = b->arena + b->name_off[i];
+    size_t nlen = b->name_len[i];
+    const char* tags = b->arena + b->tags_off[i];
+    size_t tlen = b->tags_len[i];
+    uint64_t h = intern_hash(kind, name, nlen, tags, tlen);
+    size_t j = h & (t->cap - 1);
+    uint32_t row = UINT32_MAX;
+    while (t->slots[j].used) {
+      InternEntry* e = &t->slots[j];
+      if (e->hash == h &&
+          intern_key_eq(t, e, kind, name, nlen, tags, tlen)) {
+        row = e->row;
+        break;
+      }
+      j = (j + 1) & (t->cap - 1);
+    }
+    out_rows[i] = row;
+    if (row == UINT32_MAX) out_miss[nmiss++] = i;
+  }
+  return nmiss;
+}
+
+// ---------------------------------------------------------------------------
 // SO_REUSEPORT UDP reader pool (networking.go:37-87, socket_linux.go:12-76)
 
 namespace {
@@ -434,16 +627,15 @@ int make_udp_socket(const char* ip, int port, int rcvbuf) {
   return fd;
 }
 
-constexpr int kVlen = 64;       // datagrams per recvmmsg
-constexpr int kDgramMax = 8192; // max datagram size we accept
+constexpr int kVlen = 64;  // datagrams per recvmmsg
 
-void reader_loop(ReaderPool* pool, Reader* r) {
-  std::vector<char> bufs(kVlen * kDgramMax);
+void reader_loop(ReaderPool* pool, Reader* r, int dgram_max) {
+  std::vector<char> bufs(static_cast<size_t>(kVlen) * dgram_max);
   mmsghdr msgs[kVlen];
   iovec iovs[kVlen];
   for (int i = 0; i < kVlen; i++) {
-    iovs[i].iov_base = bufs.data() + i * kDgramMax;
-    iovs[i].iov_len = kDgramMax;
+    iovs[i].iov_base = bufs.data() + static_cast<size_t>(i) * dgram_max;
+    iovs[i].iov_len = dgram_max;
     memset(&msgs[i], 0, sizeof(mmsghdr));
     msgs[i].msg_hdr.msg_iov = &iovs[i];
     msgs[i].msg_hdr.msg_iovlen = 1;
@@ -456,7 +648,7 @@ void reader_loop(ReaderPool* pool, Reader* r) {
     if (got <= 0) continue;
     std::lock_guard<std::mutex> lock(r->mu);
     for (int i = 0; i < got; i++) {
-      const char* data = bufs.data() + i * kDgramMax;
+      const char* data = bufs.data() + static_cast<size_t>(i) * dgram_max;
       size_t dlen = msgs[i].msg_len;
       if (r->active->count >= r->active->capacity ||
           r->active->arena_len + dlen > r->active->arena_cap) {
@@ -476,11 +668,19 @@ void reader_loop(ReaderPool* pool, Reader* r) {
 
 extern "C" void* vt_reader_start(const char* ip, int port, int nreaders,
                                  int rcvbuf, uint32_t batch_records,
-                                 uint32_t batch_arena) {
+                                 uint32_t batch_arena, int dgram_max) {
+  if (dgram_max <= 0) dgram_max = 8192;
   ReaderPool* pool = new ReaderPool();
   for (int i = 0; i < nreaders; i++) {
     int fd = make_udp_socket(ip, port, rcvbuf);
     if (fd < 0) {
+      // threads are not started yet: release every reader created so far
+      for (Reader* r : pool->readers) {
+        close(r->fd);
+        vt_batch_free(r->active);
+        vt_batch_free(r->standby);
+        delete r;
+      }
       delete pool;
       return nullptr;
     }
@@ -498,7 +698,7 @@ extern "C" void* vt_reader_start(const char* ip, int port, int nreaders,
     pool->readers.push_back(r);
   }
   for (Reader* r : pool->readers) {
-    r->thread = std::thread(reader_loop, pool, r);
+    r->thread = std::thread(reader_loop, pool, r, dgram_max);
   }
   return pool;
 }

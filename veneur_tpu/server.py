@@ -159,6 +159,8 @@ class Server:
 
         self._stop = threading.Event()
         self._threads: List[threading.Thread] = []
+        self._native_readers: List = []
+        self._native_pumps: List[threading.Thread] = []
         self._span_workers: List[SpanWorker] = []
         self._flush_thread: Optional[threading.Thread] = None
         self._tls_context = None
@@ -169,6 +171,7 @@ class Server:
 
         # ingest error/telemetry counters
         self.packet_errors = 0
+        self.packet_drops = 0
         self._warned_no_forward = False
         # bound listener addresses (useful when configured with port 0)
         self.statsd_addrs: List = []
@@ -265,6 +268,8 @@ class Server:
             sink.start(self.trace_client)
 
         for addr in cfg.statsd_listen_addresses:
+            if self._try_native_statsd(addr):
+                continue
             threads, bound = networking.start_statsd(
                 addr, max(1, cfg.num_readers), cfg.read_buffer_size_bytes,
                 cfg.metric_max_length, self.handle_packet, self._stop,
@@ -326,6 +331,71 @@ class Server:
                 log.warning("flush took %.2fs, %.2fs longer than the interval",
                             flush_took, flush_took - self.interval)
 
+    def _try_native_statsd(self, addr_spec: str) -> bool:
+        """Bring up the C++ SO_REUSEPORT reader pool for a plain IPv4 UDP
+        listener (socket_linux.go:12-76 + networking.go:37-87 rebuilt
+        native); returns False to fall back to the Python readers."""
+        cfg = self.config
+        if not cfg.native_ingest:
+            return False
+        from veneur_tpu.protocol.addr import resolve_addr
+
+        try:
+            resolved = resolve_addr(addr_spec)
+        except ValueError:
+            return False
+        if (resolved.family != "udp" or resolved.scheme.endswith("6")
+                or ":" in (resolved.host or "")):
+            return False  # the native pool is AF_INET only
+        from veneur_tpu import native
+
+        if not native.available():
+            return False
+        try:
+            reader = native.NativeUDPReader(
+                host=resolved.host or "0.0.0.0", port=resolved.port,
+                num_readers=max(1, cfg.num_readers),
+                rcvbuf=cfg.read_buffer_size_bytes,
+                dgram_max=cfg.metric_max_length)
+        except OSError as e:
+            log.warning("native UDP readers failed (%s); using Python "
+                        "readers", e)
+            return False
+        self._native_readers.append(reader)
+        self.statsd_addrs.append((resolved.host or "0.0.0.0", reader.port))
+        t = threading.Thread(target=self._native_pump, args=(reader,),
+                             name="native-udp-pump", daemon=True)
+        t.start()
+        self._native_pumps.append(t)
+        log.info("native ingest on udp port %d (%d readers)", reader.port,
+                 reader.num_readers)
+        return True
+
+    def _native_pump(self, reader):
+        """Drain the reader pool's parsed batches into the store; raw
+        event/service-check records re-enter the Python parse path."""
+        last_drops = 0
+        while not self._stop.is_set():
+            try:
+                batches = reader.drain()
+                drops = reader.drops()
+                if drops != last_drops:
+                    self.packet_drops += drops - last_drops
+                    log.warning("native ingest dropped %d datagrams "
+                                "(pump falling behind)", drops - last_drops)
+                    last_drops = drops
+                if not batches:
+                    self._stop.wait(0.005)
+                    continue
+                for b in batches:
+                    self.packet_errors += int(b.parse_errors)
+                    for line in self.store.process_batch(b):
+                        self.handle_metric_packet(line)
+            except Exception:
+                # one bad batch must not kill the sole ingest thread
+                log.exception("native pump iteration failed")
+                self._stop.wait(0.05)
+
     def flush(self):
         """One flush pass; see veneur_tpu.flusher."""
         from veneur_tpu.flusher import flush_once
@@ -335,6 +405,11 @@ class Server:
     def shutdown(self):
         """Graceful stop (server.go:1120-1130)."""
         self._stop.set()
+        # pump threads must leave drain() before the reader pool is freed
+        for t in self._native_pumps:
+            t.join(timeout=2.0)
+        for reader in self._native_readers:
+            reader.stop()
         if self._flush_thread is not None:
             self._flush_thread.join(timeout=5.0)
         if self.ops_server is not None:
