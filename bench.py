@@ -42,8 +42,9 @@ def run(num_series: int):
     @partial(jax.jit, donate_argnums=(0, 1), static_argnums=())
     def flush_step(digest, temp, rows, vals, wts, qs):
         temp = td_ops.ingest_chunk(temp, rows, vals, wts, compression)
-        digest = td_ops.drain_temp(digest, temp, compression)
-        pcts = td_ops.quantile(digest, qs)
+        inf = jnp.full(digest.min.shape, jnp.inf, digest.min.dtype)
+        digest, pcts = td_ops.drain_and_quantile(digest, temp, inf, -inf,
+                                                 qs, compression)
         # checksum forces the whole program; scalar readback avoids timing
         # the host link instead of the chip (block_until_ready is a no-op
         # under the axon tunnel, and bulk transfers ride a network).

@@ -124,10 +124,8 @@ def _digest_programs(mesh: Mesh, compression: float, k: int):
         donate_argnums=(0, 1, 2))
 
     def local_flush(digest, temp, dmin, dmax, qs):
-        drained = td_ops.drain_temp(digest, temp, compression)
-        drained = drained._replace(min=jnp.minimum(drained.min, dmin),
-                                   max=jnp.maximum(drained.max, dmax))
-        pcts = td_ops.quantile(drained, qs)
+        drained, pcts = td_ops.drain_and_quantile(digest, temp, dmin, dmax,
+                                                  qs, compression)
         return (drained, pcts, temp.count, temp.vsum, temp.vmin, temp.vmax,
                 temp.recip)
 

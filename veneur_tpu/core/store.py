@@ -237,12 +237,8 @@ def _flush_digests(digest: td_ops.TDigest, temp: td_ops.TempCentroids,
     """The per-interval flush program: one compress + one batched quantile
     gather for the whole group (the Histo.Flush hot loop of
     samplers.go:511-636 over all series at once)."""
-    drained = td_ops.drain_temp(digest, temp, compression)
-    drained = drained._replace(
-        min=jnp.minimum(drained.min, dmin),
-        max=jnp.maximum(drained.max, dmax),
-    )
-    pcts = td_ops.quantile(drained, qs)
+    drained, pcts = td_ops.drain_and_quantile(digest, temp, dmin, dmax, qs,
+                                              compression)
     return (drained, pcts, temp.count, temp.vsum, temp.vmin, temp.vmax,
             temp.recip)
 

@@ -61,8 +61,11 @@ def temp_buffer_size(compression: float) -> int:
 class TDigest(NamedTuple):
     """A batch of t-digests as dense arrays.
 
-    mean / weight: ``[..., K]``; slots with weight == 0 are empty and keep
-    mean == +inf so that live centroids sort to the front in ascending order.
+    mean / weight: ``[..., K]``; liveness is defined SOLELY by
+    weight > 0. Live means ascend within a row, but dead slots may sit
+    anywhere with any placeholder mean (+inf from the sort-based compress,
+    gap-filled running-max values or -inf from the Pallas compress) —
+    consumers must mask on weight, never on the mean.
     min / max: ``[...]`` observed extrema (+inf/-inf when empty).
     """
 
@@ -96,6 +99,47 @@ def init(batch_shape: Sequence[int] = (), compression: float = DEFAULT_COMPRESSI
     )
 
 
+def _shift_last(x: jax.Array, d: int, fill) -> jax.Array:
+    """out[..., i] = x[..., i-d], left-filled — building block for the
+    log-step cumulative ops below."""
+    pad_shape = x.shape[:-1] + (d,)
+    pad = jnp.full(pad_shape, fill, x.dtype)
+    return jnp.concatenate([pad, x[..., :-d]], axis=-1)
+
+
+def _cumsum(x: jax.Array) -> jax.Array:
+    """Inclusive prefix sum along the last axis via log-step shifted adds.
+    XLA lowers cumsum through reduce-window on TPU, which for the short
+    trailing axes used here costs ~10x more than these O(log n) passes."""
+    d, n = 1, x.shape[-1]
+    while d < n:
+        x = x + _shift_last(x, d, 0)
+        d *= 2
+    return x
+
+
+def _cummax(x: jax.Array) -> jax.Array:
+    """Inclusive running max along the last axis (log-step)."""
+    d, n = 1, x.shape[-1]
+    while d < n:
+        x = jnp.maximum(x, _shift_last(x, d, -jnp.inf))
+        d *= 2
+    return x
+
+
+def _cummin_rev(x: jax.Array) -> jax.Array:
+    """Suffix (right-to-left) running min along the last axis, without the
+    flip-materializing lax.cummin formulation."""
+    d, n = 1, x.shape[-1]
+    while d < n:
+        shifted = jnp.concatenate(
+            [x[..., d:], jnp.full(x.shape[:-1] + (d,), jnp.inf, x.dtype)],
+            axis=-1)
+        x = jnp.minimum(x, shifted)
+        d *= 2
+    return x
+
+
 def _rowwise_searchsorted(a: jax.Array, v: jax.Array, side: str) -> jax.Array:
     """searchsorted along the last axis for every row of a batch.
 
@@ -123,10 +167,17 @@ def _select_at(arr: jax.Array, idx: jax.Array) -> jax.Array:
     small P, which fuses into a single VPU pass and never materializes the
     [..., P, M] intermediate.
     """
-    m = arr.shape[-1]
+    return _select_many_at([arr], idx)[0]
+
+
+def _select_many_at(arrs: Sequence[jax.Array], idx: jax.Array):
+    """_select_at for several arrays sharing one index set: the one-hot
+    compare is computed once and reused for every gather."""
+    m = arrs[0].shape[-1]
     pos = jnp.arange(m, dtype=jnp.int32)
     hit = idx[..., :, None] == pos        # [..., P, M]
-    return jnp.sum(jnp.where(hit, arr[..., None, :], 0), axis=-1)
+    return [jnp.sum(jnp.where(hit, a[..., None, :], 0), axis=-1)
+            for a in arrs]
 
 
 def _compress(mean: jax.Array, weight: jax.Array, compression: float,
@@ -144,8 +195,8 @@ def _compress(mean: jax.Array, weight: jax.Array, compression: float,
     live = w > 0
     m0 = jnp.where(live, key, 0.0)  # inf*0 would poison the weighted sums
 
-    total = jnp.sum(w, axis=-1, keepdims=True)
-    incl = jnp.cumsum(w, axis=-1)
+    incl = _cumsum(w)
+    total = incl[..., -1:]
     safe_total = jnp.maximum(total, jnp.finfo(dtype).tiny)
     q_mid = (incl - 0.5 * w) / safe_total
     # k-scale (merging_digest.go:254-257); arcsin arg clipped for fp safety.
@@ -168,6 +219,21 @@ def _compress(mean: jax.Array, weight: jax.Array, compression: float,
     # compacts live centroids (already in ascending mean order) to the front.
     new_mean, new_w = lax.sort((new_mean, sum_w), dimension=-1, num_keys=1, is_stable=True)
     return new_mean, new_w
+
+
+def _dispatch_compress_presorted(mean_a, weight_a, mean_b, weight_b,
+                                 compression: float, out_size: int):
+    """Compress the union of two row-ASCENDING centroid lists: the fused
+    Pallas merge kernel on TPU, the sort-based _compress elsewhere (the
+    same hand-rolled bitonic stages lower poorly through plain XLA)."""
+    from veneur_tpu.ops import tdigest_pallas
+
+    if tdigest_pallas.pallas_ok(mean_a):
+        return tdigest_pallas.compress_presorted(
+            mean_a, weight_a, mean_b, weight_b, compression, out_size)
+    mean = jnp.concatenate([mean_a, mean_b], axis=-1)
+    weight = jnp.concatenate([weight_a, weight_b], axis=-1)
+    return _compress(mean, weight, compression, out_size)
 
 
 def merge_samples(state: TDigest, values: jax.Array, weights: jax.Array,
@@ -200,9 +266,8 @@ def merge(a: TDigest, b: TDigest, compression: float = DEFAULT_COMPRESSION) -> T
     Deterministic (sorted merge order) unlike the reference's shuffled re-add
     (merging_digest.go:358-370); accuracy bound is the same.
     """
-    mean = jnp.concatenate([a.mean, b.mean], axis=-1)
-    weight = jnp.concatenate([a.weight, b.weight], axis=-1)
-    new_mean, new_weight = _compress(mean, weight, compression, a.capacity)
+    new_mean, new_weight = _dispatch_compress_presorted(
+        a.mean, a.weight, b.mean, b.weight, compression, a.capacity)
     return TDigest(
         mean=new_mean,
         weight=new_weight,
@@ -213,14 +278,24 @@ def merge(a: TDigest, b: TDigest, compression: float = DEFAULT_COMPRESSION) -> T
 
 def _upper_bounds(state: TDigest) -> jax.Array:
     """Per-centroid upper bound: midpoint to the next live centroid, or max
-    for the last live one (merging_digest.go:339-354). [..., K]."""
+    for the last live one (merging_digest.go:339-354). [..., K].
+
+    Rows may contain weight==0 gap slots anywhere (the compress skips the
+    compaction sort), so "next" means the next LIVE centroid: a reversed
+    running min over masked means, which the ascending-row invariant makes
+    exact."""
     m, w = state.mean, state.weight
-    next_m = jnp.concatenate([m[..., 1:], jnp.full_like(m[..., :1], jnp.inf)], axis=-1)
-    next_live = jnp.concatenate([w[..., 1:] > 0, jnp.zeros_like(w[..., :1], bool)], axis=-1)
+    live = w > 0
+    masked = jnp.where(live, m, jnp.inf)
+    suffix = _cummin_rev(masked)
+    next_m = jnp.concatenate(
+        [suffix[..., 1:], jnp.full_like(suffix[..., :1], jnp.inf)], axis=-1)
     mx = state.max[..., None]
-    ub = jnp.where(next_live, 0.5 * (m + next_m), mx)
-    # Empty slots get ub == max so cumulative searches stay monotone.
-    return jnp.where(w > 0, ub, mx)
+    live_ub = jnp.where(jnp.isfinite(next_m), 0.5 * (m + next_m), mx)
+    # gaps inherit the previous live slot's bound (leading gaps get -inf,
+    # below every query) so cumulative searches stay monotone
+    gapped = jnp.where(live, live_ub, -jnp.inf)
+    return _cummax(gapped)
 
 
 def quantile(state: TDigest, qs: jax.Array) -> jax.Array:
@@ -231,19 +306,20 @@ def quantile(state: TDigest, qs: jax.Array) -> jax.Array:
     """
     qs = jnp.asarray(qs, state.mean.dtype)
     w = state.weight
-    total = jnp.sum(w, axis=-1, keepdims=True)          # [..., 1]
-    incl = jnp.cumsum(w, axis=-1)                       # [..., K]
+    incl = _cumsum(w)                                   # [..., K]
+    total = incl[..., -1:]                              # [..., 1]
     excl = incl - w
     ub = _upper_bounds(state)
     target = qs * total                                  # [..., P]
     # First centroid i with incl[i] >= target  <=>  Go's q <= weightSoFar + c.W
     idx = jnp.clip(_rowwise_searchsorted(incl, target, "left"), 0, state.capacity - 1)
     lb0 = state.min[..., None]
-    prev_ub = _select_at(ub, jnp.maximum(idx - 1, 0))
-    lb = jnp.where(idx == 0, lb0, prev_ub)
-    ub_i = _select_at(ub, idx)
-    w_i = _select_at(w, idx)
-    excl_i = _select_at(excl, idx)
+    # ub shifted right one slot: gathering it at idx yields ub[idx-1]
+    ub_prev = jnp.concatenate([ub[..., :1], ub[..., :-1]], axis=-1)
+    ub_i, prev_ub, w_i, excl_i = _select_many_at([ub, ub_prev, w, excl], idx)
+    # leading gap slots carry ub == -inf; a query landing in the first
+    # live centroid must fall back to min, not -inf
+    lb = jnp.where(idx == 0, lb0, jnp.maximum(prev_ub, lb0))
     prop = (target - excl_i) / jnp.where(w_i > 0, w_i, 1.0)
     out = lb + prop * (ub_i - lb)
     return jnp.where(total > 0, out, jnp.nan)
@@ -254,19 +330,17 @@ def cdf(state: TDigest, xs: jax.Array) -> jax.Array:
     Returns [..., P]; NaN for empty digests."""
     xs = jnp.asarray(xs, state.mean.dtype)
     w = state.weight
-    total = jnp.sum(w, axis=-1, keepdims=True)
-    incl = jnp.cumsum(w, axis=-1)
+    incl = _cumsum(w)
+    total = incl[..., -1:]
     excl = incl - w
     ub = _upper_bounds(state)
     # First centroid whose upper bound exceeds x (the one x falls inside).
     idx = jnp.clip(_rowwise_searchsorted(ub, xs, "right"), 0, state.capacity - 1)
     mn = state.min[..., None]
     mx = state.max[..., None]
-    prev_ub = _select_at(ub, jnp.maximum(idx - 1, 0))
-    lb = jnp.where(idx == 0, mn, prev_ub)
-    ub_i = _select_at(ub, idx)
-    w_i = _select_at(w, idx)
-    excl_i = _select_at(excl, idx)
+    ub_prev = jnp.concatenate([ub[..., :1], ub[..., :-1]], axis=-1)
+    ub_i, prev_ub, w_i, excl_i = _select_many_at([ub, ub_prev, w, excl], idx)
+    lb = jnp.where(idx == 0, mn, jnp.maximum(prev_ub, mn))
     span = ub_i - lb
     frac = jnp.where(span > 0, (xs - lb) / jnp.where(span > 0, span, 1.0), 0.0)
     est = (excl_i + w_i * frac) / jnp.maximum(total, jnp.finfo(w.dtype).tiny)
@@ -298,12 +372,12 @@ def bin_flat_samples(rows: jax.Array, values: jax.Array, weights: jax.Array,
     weights = weights.astype(jnp.float32)
     r, v, w = lax.sort((rows, values, weights), dimension=-1, num_keys=2,
                        is_stable=False)
-    cw = jnp.cumsum(w)
+    cw = _cumsum(w)
     excl = cw - w
     seg_start = jnp.concatenate(
         [jnp.ones((1,), bool), r[1:] != r[:-1]])
     base = jnp.where(seg_start, excl, -jnp.inf)
-    base = lax.cummax(base)
+    base = _cummax(base)
     q_excl = excl - base
     totals = jnp.zeros((num_series + 1,), w.dtype).at[r].add(w, mode="drop")
     tot = jnp.maximum(totals[jnp.minimum(r, num_series)], jnp.finfo(w.dtype).tiny)
@@ -382,18 +456,59 @@ def drain_temp(state: TDigest, temp: TempCentroids,
                compression: float = DEFAULT_COMPRESSION) -> TDigest:
     """Merge the accumulated temp centroids into the digests (one compress
     per interval — the batched mergeAllTemps)."""
+    from veneur_tpu.ops import tdigest_pallas
+
     t_live = temp.sum_w > 0
     t_mean = jnp.where(t_live, temp.sum_wm / jnp.where(t_live, temp.sum_w, 1.0),
                        jnp.inf)
-    mean = jnp.concatenate([state.mean, t_mean], axis=-1)
-    weight = jnp.concatenate([state.weight, temp.sum_w], axis=-1)
-    new_mean, new_weight = _compress(mean, weight, compression, state.capacity)
+    if tdigest_pallas.pallas_ok(state.mean):
+        # bin means are NOT monotone in bin index once several chunks with
+        # shifting distributions accumulate, so the temp half needs a real
+        # sort; it is only K wide, and the fused kernel then replaces the
+        # far costlier [.., 2K] sort + segmented reduce
+        t_mean, t_w = lax.sort((t_mean, temp.sum_w), dimension=-1,
+                               num_keys=1, is_stable=False)
+        new_mean, new_weight = tdigest_pallas.compress_presorted(
+            state.mean, state.weight, t_mean, t_w, compression,
+            state.capacity)
+    else:
+        mean = jnp.concatenate([state.mean, t_mean], axis=-1)
+        weight = jnp.concatenate([state.weight, temp.sum_w], axis=-1)
+        new_mean, new_weight = _compress(mean, weight, compression,
+                                         state.capacity)
     return TDigest(
         mean=new_mean,
         weight=new_weight,
         min=jnp.minimum(state.min, temp.vmin),
         max=jnp.maximum(state.max, temp.vmax),
     )
+
+
+def drain_and_quantile(state: TDigest, temp: TempCentroids, dmin, dmax,
+                       qs: jax.Array,
+                       compression: float = DEFAULT_COMPRESSION):
+    """The whole per-interval digest flush as one op: drain the temp bins
+    into the digests, fold in the imported extrema (dmin/dmax), and return
+    (drained digests, per-series percentiles). On TPU this is a single
+    fused Pallas program; elsewhere it composes drain_temp + quantile."""
+    from veneur_tpu.ops import tdigest_pallas
+
+    mn = jnp.minimum(jnp.minimum(state.min, temp.vmin), dmin)
+    mx = jnp.maximum(jnp.maximum(state.max, temp.vmax), dmax)
+    if tdigest_pallas.pallas_ok(state.mean):
+        t_live = temp.sum_w > 0
+        t_mean = jnp.where(
+            t_live, temp.sum_wm / jnp.where(t_live, temp.sum_w, 1.0),
+            jnp.inf)
+        t_mean, t_w = lax.sort((t_mean, temp.sum_w), dimension=-1,
+                               num_keys=1, is_stable=False)
+        nm, nw, pcts = tdigest_pallas.drain_quantile(
+            state.mean, state.weight, t_mean, t_w, mn, mx,
+            jnp.asarray(qs, state.mean.dtype), compression, state.capacity)
+        return TDigest(mean=nm, weight=nw, min=mn, max=mx), pcts
+    drained = drain_temp(state, temp, compression)
+    drained = drained._replace(min=mn, max=mx)
+    return drained, quantile(drained, qs)
 
 
 def from_centroids(mean: jax.Array, weight: jax.Array, mins: jax.Array,
