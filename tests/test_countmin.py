@@ -1,0 +1,183 @@
+"""Count-min + top-k heavy hitters (BASELINE config #5).
+
+Golden-tested against an exact python Counter: count-min estimates are
+upward-biased only, and with table width far above distinct-key count the
+top-k must match the exact top-k identically.
+"""
+
+import collections
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from veneur_tpu.core.store import MetricStore
+from veneur_tpu.ops import countmin as cm
+from veneur_tpu.ops import hll as hll_ops
+from veneur_tpu.samplers import parser as p
+from veneur_tpu.samplers.intermetric import HistogramAggregates
+
+AGG = HistogramAggregates.from_names(["count"])
+
+
+def _split(keys):
+    keys = np.asarray(keys, np.uint64)
+    return (jnp.asarray((keys >> np.uint64(32)).astype(np.uint32)),
+            jnp.asarray((keys & np.uint64(0xFFFFFFFF)).astype(np.uint32)))
+
+
+class TestCountMinKernel:
+    def test_estimates_upper_bound_exact(self):
+        rng = np.random.default_rng(0)
+        ids = rng.integers(1, 1 << 62, 500, dtype=np.uint64)
+        reps = rng.integers(1, 50, 500)
+        stream = np.repeat(ids, reps)
+        rng.shuffle(stream)
+        sk = cm.init(1, depth=4, width=1 << 14, k=32)
+        rows = jnp.zeros(len(stream), jnp.int32)
+        hi, lo = _split(stream)
+        sk = cm.update(sk, rows, hi, lo, jnp.ones(len(stream), jnp.float32))
+        qhi, qlo = _split(ids)
+        est = np.asarray(cm.estimate(sk, jnp.zeros(500, jnp.int32), qhi, qlo))
+        exact = collections.Counter(stream.tolist())
+        want = np.array([exact[int(i)] for i in ids], np.float32)
+        assert (est >= want - 1e-3).all()          # never underestimates
+        assert (est <= want + len(stream) / (1 << 14) * 4 + 1).all()
+
+    def test_topk_matches_exact_counter(self):
+        rng = np.random.default_rng(1)
+        # heavy hitters with clearly separated counts + background noise
+        heavy = rng.integers(1, 1 << 62, 16, dtype=np.uint64)
+        stream = []
+        for i, h in enumerate(heavy):
+            stream.extend([int(h)] * (1000 - 50 * i))
+        noise = rng.integers(1, 1 << 62, 3000, dtype=np.uint64)
+        stream.extend(noise.tolist())
+        stream = np.array(stream, np.uint64)
+        rng.shuffle(stream)
+        sk = cm.init(1, depth=4, width=1 << 15, k=16)
+        # several drains, as the store produces
+        for part in np.array_split(stream, 7):
+            hi, lo = _split(part)
+            sk = cm.update(sk, jnp.zeros(len(part), jnp.int32), hi, lo,
+                           jnp.ones(len(part), jnp.float32))
+        got_ids = {(int(h) << 32) | int(l)
+                   for h, l, c in zip(np.asarray(sk.topk_hi[0]),
+                                      np.asarray(sk.topk_lo[0]),
+                                      np.asarray(sk.topk_counts[0]))
+                   if c > 0}
+        assert got_ids == {int(h) for h in heavy}
+        # counts within the count-min slack of exact
+        exact = collections.Counter(stream.tolist())
+        by_id = {(int(h) << 32) | int(l): float(c)
+                 for h, l, c in zip(np.asarray(sk.topk_hi[0]),
+                                    np.asarray(sk.topk_lo[0]),
+                                    np.asarray(sk.topk_counts[0]))}
+        slack = len(stream) / (1 << 15) * 4 + 1
+        for hid, c in by_id.items():
+            assert exact[hid] <= c <= exact[hid] + slack
+
+    def test_per_series_isolation(self):
+        """The shared table is salted by series row: two series counting
+        the same keys keep independent top-k lists."""
+        sk = cm.init(2, depth=4, width=1 << 14, k=8)
+        keys = np.arange(1, 9, dtype=np.uint64) * 12345
+        hi, lo = _split(np.tile(keys, 10))
+        rows0 = jnp.zeros(80, jnp.int32)
+        rows1 = jnp.ones(80, jnp.int32)
+        sk = cm.update(sk, rows0, hi, lo, jnp.ones(80, jnp.float32))
+        sk = cm.update(sk, rows1, hi, lo,
+                       jnp.full(80, 3.0, jnp.float32))
+        c0 = np.sort(np.asarray(sk.topk_counts[0]))[-8:]
+        c1 = np.sort(np.asarray(sk.topk_counts[1]))[-8:]
+        assert np.allclose(c0, 10.0)
+        assert np.allclose(c1, 30.0)
+
+
+class TestHeavyHitterStore:
+    def test_end_to_end_topk_emission(self):
+        store = MetricStore(initial_capacity=16, chunk=256)
+        rng = np.random.default_rng(4)
+        exact = collections.Counter()
+        users = [f"user{i}" for i in range(40)]
+        weights = np.linspace(60, 2, 40)
+        draws = rng.choice(40, 5000, p=weights / weights.sum())
+        for d in draws:
+            exact[users[d]] += 1
+            store.process_metric(p.parse_metric(
+                f"api.by_user:{users[d]}|s|#veneurtopk,env:prod".encode()))
+        final, _, _ = store.flush([], AGG, is_local=True, now=7)
+        topk = {m.tags[-1].split(":", 1)[1]: m.value for m in final
+                if m.name == "api.by_user.topk"}
+        assert 0 < len(topk) <= 32
+        # the exact heaviest keys must all be present with close counts
+        for user, cnt in exact.most_common(10):
+            assert user in topk
+            assert topk[user] >= cnt
+            assert topk[user] <= cnt + 5000 / (1 << 16) * 4 + 1
+        # plain sets are unaffected
+        store.process_metric(p.parse_metric(b"plain.set:m1|s"))
+        final2, _, _ = store.flush([], AGG, is_local=False, now=8)
+        by = {m.name: m.value for m in final2}
+        assert by["plain.set"] == pytest.approx(1.0, rel=0.01)
+
+    def test_native_batch_routing(self):
+        native = pytest.importorskip("veneur_tpu.native")
+        if not native.available():
+            pytest.skip("no g++")
+        store = MetricStore(initial_capacity=16, chunk=256)
+        lines = []
+        for i in range(300):
+            lines.append(f"hh.keys:k{i % 5}|s|#veneurtopk")
+            lines.append(f"hh.card:k{i}|s")
+        batch = native.parse_lines("\n".join(lines).encode())
+        store.process_batch(batch)
+        final, _, _ = store.flush([], AGG, is_local=False, now=9)
+        topk = {m.tags[-1].split(":", 1)[1]: m.value for m in final
+                if m.name == "hh.keys.topk"}
+        assert set(topk) == {f"k{i}" for i in range(5)}
+        for v in topk.values():
+            assert v >= 60.0
+        by = {m.name: m.value for m in final}
+        assert abs(by["hh.card"] - 300) / 300 < 0.05  # HLL estimate
+
+    def test_topk_tag_does_not_clobber_other_types_scope(self):
+        """veneurtopk only reroutes SETS; a global counter carrying the
+        tag must stay global on the native path (round-2 review
+        regression)."""
+        native = pytest.importorskip("veneur_tpu.native")
+        if not native.available():
+            pytest.skip("no g++")
+        b = native.parse_lines(b"c.x:1|c|#veneurglobalonly,veneurtopk")
+        assert b.count == 1
+        assert int(b.scope[0]) == p.GLOBAL_ONLY
+        store = MetricStore(initial_capacity=8, chunk=32)
+        store.process_batch(b)
+        assert len(store.global_counters) == 1
+        assert len(store.heavy_hitters) == 0
+
+    def test_member_memo_bound_falls_back_to_hex(self):
+        store = MetricStore(initial_capacity=8, chunk=64)
+        g = store.heavy_hitters
+        g.MEMO_LIMIT = 3  # tiny bound for the test
+        for i in range(10):
+            for _ in range(10 - i):
+                store.process_metric(p.parse_metric(
+                    f"m.k:member{i}|s|#veneurtopk".encode()))
+        final, _, _ = store.flush([], AGG, is_local=True, now=1)
+        names = [m.tags[-1] for m in final if m.name == "m.k.topk"]
+        assert len(names) == 10
+        hexed = [t for t in names if t.startswith("key:0x")]
+        memoed = [t for t in names if not t.startswith("key:0x")]
+        assert len(memoed) == 3 and len(hexed) == 7
+
+    def test_growth(self):
+        store = MetricStore(initial_capacity=2, chunk=32)
+        for i in range(20):
+            store.process_metric(p.parse_metric(
+                f"grow.h{i}:k|s|#veneurtopk".encode()))
+        final, _, _ = store.flush([], AGG, is_local=True, now=1)
+        topk = [m for m in final if m.name.endswith(".topk")]
+        assert len(topk) == 20
+        for m in topk:
+            assert m.value == 1.0

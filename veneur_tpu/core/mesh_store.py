@@ -218,6 +218,7 @@ class MeshDigestGroup(DigestGroup):
     def _drain_samples(self):
         if self._fill == 0:
             return
+        self._device_dirty = True
         rows, vals, wts = self._rows, self._vals, self._wts
         self._new_sample_buffers()
         self.temp = self._ingest_p(self.temp, rows, vals, wts)
@@ -225,6 +226,7 @@ class MeshDigestGroup(DigestGroup):
     def _drain_imports(self):
         if self._imp_fill == 0 and not self._imp_stat_rows:
             return
+        self._device_dirty = True
         # fixed-size stat scatter so import drains never retrace
         ns = len(self._imp_stat_rows)
         stat_rows = np.full(self.chunk, self.capacity, np.int32)
@@ -270,10 +272,12 @@ class MeshSetGroup(SetGroup):
     def _reset_registers(self):
         self.registers = jax.device_put(
             jnp.zeros((self.capacity, self.m), jnp.int8), self._sk)
+        self._device_dirty = False
 
     def _drain_samples(self):
         if self._fill == 0:
             return
+        self._device_dirty = True
         rows, hi, lo = self._rows, self._hi, self._lo
         self._new_sample_buffers()
         self.registers = self._hash_p(self.registers, rows, hi, lo)
@@ -281,6 +285,7 @@ class MeshSetGroup(SetGroup):
     def _drain_imports(self):
         if not self._imp_rows:
             return
+        self._device_dirty = True
         # pad to a fixed batch so import drains never retrace
         n = len(self._imp_rows)
         cap = IMPORT_DRAIN_BATCH

@@ -74,7 +74,9 @@ _NATIVE_TYPE_NAMES = ("counter", "gauge", "histogram", "timer", "set")
 # scope-class kinds for the native batch dispatch; must mirror kind_of()
 # in native/veneur_ingest.cpp
 (_K_COUNTER, _K_GLOBAL_COUNTER, _K_GAUGE, _K_GLOBAL_GAUGE, _K_HISTO,
- _K_LOCAL_HISTO, _K_TIMER, _K_LOCAL_TIMER, _K_SET, _K_LOCAL_SET) = range(10)
+ _K_LOCAL_HISTO, _K_TIMER, _K_LOCAL_TIMER, _K_SET, _K_LOCAL_SET,
+ _K_TOPK) = range(11)
+_TOPK_SCOPE = 3  # veneur_ingest.cpp Scope::kTopK
 _KIND_RAW = 255  # kind_of()'s sentinel for event/service-check records
 
 
@@ -262,6 +264,7 @@ class DigestGroup:
         self.digest = td_ops.init((self.capacity,), self.compression, self.k)
         self.dmin = jnp.full((self.capacity,), jnp.inf, jnp.float32)
         self.dmax = jnp.full((self.capacity,), -jnp.inf, jnp.float32)
+        self._device_dirty = False
 
     def _init_staging(self):
         self._new_sample_buffers()
@@ -390,6 +393,7 @@ class DigestGroup:
     def _drain_samples(self):
         if self._fill == 0:
             return
+        self._device_dirty = True
         rows, vals, wts = self._rows, self._vals, self._wts
         self._new_sample_buffers()
         self.temp = _ingest_samples(self.temp, jnp.asarray(rows),
@@ -399,6 +403,7 @@ class DigestGroup:
     def _drain_imports(self):
         if self._imp_fill == 0 and not self._imp_stat_rows:
             return
+        self._device_dirty = True
         ns = len(self._imp_stat_rows)
         stat_rows = np.full(max(ns, 1), self.capacity, np.int32)
         stat_mins = np.full(max(ns, 1), np.inf, np.float32)
@@ -436,20 +441,36 @@ class DigestGroup:
         self._drain_staging()
         n = len(self.interner)
         interner, self.interner = self.interner, Interner()
+        if n == 0:
+            if self._device_dirty:
+                # bulk paths can stage data without interning; never let
+                # it leak into the next interval's rows
+                self._init_device()
+                self._init_staging()
+            # device state is pristine: skip the flush program AND the
+            # device->host fetches (each fetch is a full round trip when
+            # the chip sits behind a network tunnel)
+            return interner, {}
         qs = jnp.asarray(list(percentiles) + [0.5], jnp.float32)
         digest, pcts, count, vsum, vmin, vmax, recip = self._run_flush(qs)
+        # one batched transfer instead of eleven round trips
+        (d_mean, d_weight, d_min, d_max, pcts, count, vsum, vmin, vmax,
+         recip) = jax.device_get(
+            (digest.mean[:n], digest.weight[:n], digest.min[:n],
+             digest.max[:n], pcts[:n], count[:n], vsum[:n], vmin[:n],
+             vmax[:n], recip[:n]))
         out = {
-            "digest_mean": np.asarray(digest.mean[:n]),
-            "digest_weight": np.asarray(digest.weight[:n]),
-            "digest_min": np.asarray(digest.min[:n]),
-            "digest_max": np.asarray(digest.max[:n]),
-            "percentiles": np.asarray(pcts[:n, :-1]),
-            "median": np.asarray(pcts[:n, -1]),
-            "count": np.asarray(count[:n]),
-            "sum": np.asarray(vsum[:n]),
-            "min": np.asarray(vmin[:n]),
-            "max": np.asarray(vmax[:n]),
-            "recip": np.asarray(recip[:n]),
+            "digest_mean": d_mean,
+            "digest_weight": d_weight,
+            "digest_min": d_min,
+            "digest_max": d_max,
+            "percentiles": pcts[:, :-1],
+            "median": pcts[:, -1],
+            "count": count,
+            "sum": vsum,
+            "min": vmin,
+            "max": vmax,
+            "recip": recip,
         }
         self._init_device()
         self._init_staging()
@@ -501,6 +522,7 @@ class SetGroup:
         self.precision = precision
         self.m = hll_ops.num_registers(precision)
         self.registers = jnp.zeros((capacity, self.m), jnp.int8)
+        self._device_dirty = False
         self._init_staging()
 
     def _init_staging(self):
@@ -588,6 +610,7 @@ class SetGroup:
     def _drain_samples(self):
         if self._fill == 0:
             return
+        self._device_dirty = True
         rows, hi, lo = self._rows, self._hi, self._lo
         self._new_sample_buffers()
         self.registers = _ingest_hashes(self.registers, jnp.asarray(rows),
@@ -596,6 +619,7 @@ class SetGroup:
     def _drain_imports(self):
         if not self._imp_rows:
             return
+        self._device_dirty = True
         rows = jnp.asarray(np.asarray(self._imp_rows, np.int32))
         regs = jnp.asarray(np.stack(self._imp_regs).astype(np.int8))
         self.registers = _merge_registers(self.registers, rows, regs)
@@ -613,6 +637,11 @@ class SetGroup:
         self._drain_staging()
         n = len(self.interner)
         interner, self.interner = self.interner, Interner()
+        if n == 0:
+            if self._device_dirty:
+                self._reset_registers()
+                self._init_staging()
+            return interner, None, None
         estimates = (np.asarray(self._estimates()[:n])
                      if want_estimates else None)
         registers = (np.asarray(self.registers[:n], np.uint8)
@@ -627,6 +656,155 @@ class SetGroup:
 
     def _reset_registers(self):
         self.registers = jnp.zeros((self.capacity, self.m), jnp.int8)
+        self._device_dirty = False
+
+
+# ---------------------------------------------------------------------------
+# Heavy hitters (count-min + top-k) — BASELINE config #5, a sampler type
+# the reference does not have
+# ---------------------------------------------------------------------------
+
+
+class HeavyHitterGroup:
+    """Set-type metrics tagged ``veneurtopk``: instead of cardinality,
+    count per-member frequencies in one shared salted count-min table
+    (veneur_tpu/ops/countmin.py) and keep a per-series top-k list.
+
+    Flush emits ``{name}.topk`` counters tagged ``key:<member>`` for each
+    surviving heavy hitter. Member strings are memoized host-side (the
+    sketch itself only sees 64-bit hashes); the memo is bounded and
+    unknown hashes emit as hex, so unbounded key cardinality cannot
+    exhaust host memory. Local-only for now: tables are psum-mergeable,
+    but cross-instance forwarding is not wired in this round.
+    """
+
+    MEMO_LIMIT = 1 << 20
+
+    def __init__(self, capacity: int = DEFAULT_INITIAL_CAPACITY,
+                 chunk: int = DEFAULT_CHUNK, depth: int = 4,
+                 width: int = 1 << 16, k: int = 32):
+        from veneur_tpu.ops import countmin as cm_ops
+
+        self._cm = cm_ops
+        self.interner = Interner()
+        self.capacity = capacity
+        self.chunk = chunk
+        self.depth, self.width, self.k = depth, width, k
+        self.sketch = cm_ops.init(capacity, depth, width, k)
+        self._device_dirty = False
+        self._members: Dict[int, str] = {}
+        self._update = jax.jit(cm_ops.update, donate_argnums=(0,))
+        self._new_sample_buffers()
+
+    def _new_sample_buffers(self):
+        self._rows = np.full(self.chunk, self.capacity, np.int32)
+        self._hi = np.zeros(self.chunk, np.uint32)
+        self._lo = np.zeros(self.chunk, np.uint32)
+        self._wts = np.zeros(self.chunk, np.float32)
+        self._fill = 0
+
+    def __len__(self):
+        return len(self.interner)
+
+    def _row(self, key: MetricKey, tags: List[str]) -> int:
+        row = self.interner.intern(key, tags)
+        if row >= self.capacity:
+            self.ensure_capacity(row)
+        return row
+
+    def ensure_capacity(self, max_row: int):
+        while max_row >= self.capacity:
+            self._drain_samples()
+            old = self.capacity
+            self.capacity *= _GROW_FACTOR
+            pad = ((0, self.capacity - old), (0, 0))
+            self.sketch = self.sketch._replace(
+                topk_hi=jnp.pad(self.sketch.topk_hi, pad),
+                topk_lo=jnp.pad(self.sketch.topk_lo, pad),
+                topk_counts=jnp.pad(self.sketch.topk_counts, pad))
+            self._rows[self._fill:] = self.capacity
+
+    def _memoize(self, h: int, member: str):
+        if len(self._members) < self.MEMO_LIMIT:
+            self._members[h] = member
+
+    def sample(self, key: MetricKey, tags: List[str], member: str,
+               weight: float = 1.0):
+        row = self._row(key, tags)
+        h = hll_ops.hash_member(member.encode("utf-8"))
+        self._memoize(h, member)
+        i = self._fill
+        self._rows[i] = row
+        self._hi[i] = h >> 32
+        self._lo[i] = h & 0xFFFFFFFF
+        self._wts[i] = weight
+        self._fill = i + 1
+        if self._fill == self.chunk:
+            self._drain_samples()
+
+    def sample_many(self, rows: np.ndarray, hashes: np.ndarray,
+                    members=None):
+        """Bulk append from the native batch path; members (bytes) feed
+        the host-side memo when provided."""
+        if members is not None:
+            for h, mb in zip(hashes, members):
+                self._memoize(int(h), mb.decode("utf-8", "replace"))
+        his = (hashes >> np.uint64(32)).astype(np.uint32)
+        los = (hashes & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+        n = len(rows)
+        start = 0
+        while start < n:
+            if self._fill == self.chunk:
+                self._drain_samples()
+            take = min(self.chunk - self._fill, n - start)
+            i = self._fill
+            self._rows[i:i + take] = rows[start:start + take]
+            self._hi[i:i + take] = his[start:start + take]
+            self._lo[i:i + take] = los[start:start + take]
+            self._wts[i:i + take] = 1.0
+            self._fill = i + take
+            start += take
+        if self._fill == self.chunk:
+            self._drain_samples()
+
+    def _drain_samples(self):
+        if self._fill == 0:
+            return
+        self._device_dirty = True
+        rows, hi, lo, wts = self._rows, self._hi, self._lo, self._wts
+        self._new_sample_buffers()
+        self.sketch = self._update(self.sketch, rows, hi, lo, wts)
+
+    def _drain_staging(self):
+        self._drain_samples()
+
+    def flush(self):
+        """Returns (interner, [(row, member, count), ...]) and resets."""
+        self._drain_samples()
+        n = len(self.interner)
+        interner, self.interner = self.interner, Interner()
+        if n == 0 and not self._device_dirty:
+            # pristine sketch: skip the device reallocation entirely
+            return interner, []
+        out = []
+        if n:
+            hi, lo, ct = jax.device_get(
+                (self.sketch.topk_hi[:n], self.sketch.topk_lo[:n],
+                 self.sketch.topk_counts[:n]))
+            for row in range(n):
+                for j in range(self.k):
+                    c = float(ct[row, j])
+                    if c <= 0:
+                        continue
+                    h = (int(hi[row, j]) << 32) | int(lo[row, j])
+                    member = self._members.get(h, f"0x{h:016x}")
+                    out.append((row, member, c))
+        self.sketch = self._cm.init(self.capacity, self.depth, self.width,
+                                    self.k)
+        self._device_dirty = False
+        self._members.clear()
+        self._new_sample_buffers()
+        return interner, out
 
 
 # ---------------------------------------------------------------------------
@@ -708,6 +886,7 @@ class MetricStore:
         self.local_histograms = DigestGroup(initial_capacity, chunk, compression)
         self.local_timers = DigestGroup(initial_capacity, chunk, compression)
         self.local_sets = SetGroup(initial_capacity, chunk, hll_precision)
+        self.heavy_hitters = HeavyHitterGroup(initial_capacity, chunk)
         self.hll_precision = hll_precision
         self.processed = 0
         self.imported = 0
@@ -736,8 +915,12 @@ class MetricStore:
                 group = self.local_timers if m.scope == LOCAL_ONLY else self.timers
                 group.sample(m.key, m.tags, m.value, m.sample_rate)
             elif t == "set":
-                group = self.local_sets if m.scope == LOCAL_ONLY else self.sets
-                group.sample(m.key, m.tags, str(m.value))
+                if "veneurtopk" in m.tags:
+                    self.heavy_hitters.sample(m.key, m.tags, str(m.value))
+                else:
+                    group = (self.local_sets if m.scope == LOCAL_ONLY
+                             else self.sets)
+                    group.sample(m.key, m.tags, str(m.value))
             elif t == "status":
                 self.local_status_checks.sample(
                     m.key, m.tags, float(m.value), m.sample_rate,
@@ -814,6 +997,14 @@ class MetricStore:
                     if member_hashes is None:
                         member_hashes = batch.member_hashes()
                     group.sample_many(grp_rows, member_hashes[sel])
+                elif kind == _K_TOPK:
+                    if member_hashes is None:
+                        member_hashes = batch.member_hashes()
+                    aoffs, alens = batch.aux_off, batch.aux_len
+                    members = [arena[aoffs[j]:aoffs[j] + alens[j]]
+                               for j in sel]
+                    group.sample_many(grp_rows, member_hashes[sel],
+                                      members)
                 else:
                     group.sample_many(
                         grp_rows, values[sel].astype(np.float32),
@@ -825,7 +1016,8 @@ class MetricStore:
             self._kind_groups = (
                 self.counters, self.global_counters, self.gauges,
                 self.global_gauges, self.histograms, self.local_histograms,
-                self.timers, self.local_timers, self.sets, self.local_sets)
+                self.timers, self.local_timers, self.sets, self.local_sets,
+                self.heavy_hitters)
         return self._kind_groups[kind]
 
     def _intern_native(self, t: int, sc: int, name_b: bytes,
@@ -858,7 +1050,9 @@ class MetricStore:
             else:
                 kind, group = _K_TIMER, self.timers
         else:
-            if sc == LOCAL_ONLY:
+            if sc == _TOPK_SCOPE:
+                kind, group = _K_TOPK, self.heavy_hitters
+            elif sc == LOCAL_ONLY:
                 kind, group = _K_LOCAL_SET, self.local_sets
             else:
                 kind, group = _K_SET, self.sets
@@ -950,6 +1144,16 @@ class MetricStore:
             self._flush_set_group(
                 self.sets, final if not is_local else None, now,
                 fwd_list=fwd.sets if (is_local and forward) else None)
+
+            # heavy hitters emit locally on every instance (tables are
+            # psum-mergeable but not forwarded in this round)
+            hh_interner, hh = self.heavy_hitters.flush()
+            for row, member, count in hh:
+                tags = hh_interner.tags[row]
+                final.append(InterMetric(
+                    name=f"{hh_interner.names[row]}.topk", timestamp=now,
+                    value=count, tags=list(tags) + [f"key:{member}"],
+                    type=MetricType.COUNTER, sinks=route_info(tags)))
 
             # status checks are always local
             self._flush_status(final, now)

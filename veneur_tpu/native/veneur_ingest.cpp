@@ -68,8 +68,10 @@ enum RecordType : uint8_t {
 const char* kTypeNames[5] = {"counter", "gauge", "histogram", "timer", "set"};
 const size_t kTypeNameLens[5] = {7, 5, 9, 5, 3};
 
-// Scopes (parser.go:34-40)
-enum Scope : uint8_t { kMixed = 0, kLocalOnly = 1, kGlobalOnly = 2 };
+// Scopes (parser.go:34-40); kTopK marks a set carrying the veneurtopk
+// magic tag (heavy-hitter sampler, this framework's extension)
+enum Scope : uint8_t { kMixed = 0, kLocalOnly = 1, kGlobalOnly = 2,
+                       kTopK = 3 };
 
 }  // namespace
 
@@ -276,6 +278,18 @@ bool parse_line(const char* line, size_t len, VtBatch* b) {
           break;
         }
       }
+      // heavy-hitter routing tag: stays in the tag list (and digest),
+      // and only flips the scope byte for SETS — other types keep their
+      // local/global scope even if the tag is present
+      if (rtype == kSet) {
+        for (size_t i = 0; i < tags.size(); i++) {
+          if (tags[i].len == 10 &&
+              memcmp(tags[i].p, "veneurtopk", 10) == 0) {
+            scope = kTopK;
+            break;
+          }
+        }
+      }
     } else {
       return false;  // unknown section
     }
@@ -419,7 +433,9 @@ inline uint8_t kind_of(uint8_t rtype, uint8_t scope) {
     case kGauge: return scope == kGlobalOnly ? 3 : 2;
     case kHistogram: return scope == kLocalOnly ? 5 : 4;
     case kTimer: return scope == kLocalOnly ? 7 : 6;
-    case kSet: return scope == kLocalOnly ? 9 : 8;
+    case kSet:
+      if (scope == kTopK) return 10;  // heavy hitters
+      return scope == kLocalOnly ? 9 : 8;
     default: return 255;  // raw
   }
 }

@@ -1,0 +1,206 @@
+"""Batched count-min sketch + top-k heavy hitters as dense XLA ops.
+
+BASELINE.md config #5 asks for a streaming heavy-hitter sampler the
+reference does not have: count-min (Cormode-Muthukrishnan) for frequency
+estimates over an unbounded key space, plus a fixed-size top-k list.
+TPU-first design:
+
+- ONE shared ``[depth, width]`` float32 table serves every series: the
+  per-row hash mixes the series row id in as a salt, so series never
+  need per-series tables (the classic shared-sketch trick). Updates are
+  scatter-adds; estimates are a min over ``depth`` gathered rows.
+- the top-k list is per series, ``[S, K]`` id/count planes. Each drain
+  concatenates (current top-k ++ batch candidates), deduplicates by id
+  with a sort + segment-head mask (fixed shapes, no data-dependent
+  control flow), and keeps the K largest counts via ``lax.top_k``.
+- keys are 64-bit hashes carried as (hi, lo) uint32 pairs — uint64 is
+  unavailable without jax x64 — and every mixing step is a murmur3
+  finalizer, matching ops/hll.py's member hashing so the native parser's
+  member hash feeds both sketches.
+
+Estimates are upward-biased only (count-min guarantee); the top-k
+therefore never misses a true heavy hitter whose count clears the
+threshold, the property the golden tests assert against an exact dict.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+DEFAULT_DEPTH = 4
+DEFAULT_WIDTH = 1 << 16
+DEFAULT_TOPK = 32
+
+# distinct odd constants per hash row (splitmix64-derived)
+_ROW_SALTS = (0x9E3779B9, 0x85EBCA6B, 0xC2B2AE35, 0x27D4EB2F,
+              0x165667B1, 0xD3A2646C, 0xFD7046C5, 0xB55A4F09)
+
+
+class CountMin(NamedTuple):
+    """table: [depth, width] f32 shared across series.
+    topk_hi/lo: [S, K] uint32 key-id halves (0/0 = empty slot).
+    topk_counts: [S, K] f32 estimated counts (0 = empty)."""
+
+    table: jax.Array
+    topk_hi: jax.Array
+    topk_lo: jax.Array
+    topk_counts: jax.Array
+
+    @property
+    def depth(self) -> int:
+        return self.table.shape[0]
+
+    @property
+    def width(self) -> int:
+        return self.table.shape[1]
+
+
+def init(num_series: int = 1, depth: int = DEFAULT_DEPTH,
+         width: int = DEFAULT_WIDTH, k: int = DEFAULT_TOPK) -> CountMin:
+    assert depth <= len(_ROW_SALTS)
+    return CountMin(
+        table=jnp.zeros((depth, width), jnp.float32),
+        topk_hi=jnp.zeros((num_series, k), jnp.uint32),
+        topk_lo=jnp.zeros((num_series, k), jnp.uint32),
+        topk_counts=jnp.zeros((num_series, k), jnp.float32),
+    )
+
+
+def _mix32(x: jax.Array) -> jax.Array:
+    """murmur3 32-bit finalizer."""
+    x = x.astype(jnp.uint32)
+    x = x ^ (x >> 16)
+    x = x * jnp.uint32(0x85EBCA6B)
+    x = x ^ (x >> 13)
+    x = x * jnp.uint32(0xC2B2AE35)
+    x = x ^ (x >> 16)
+    return x
+
+
+def _row_index(rows: jax.Array, hi: jax.Array, lo: jax.Array, salt: int,
+               width: int) -> jax.Array:
+    """Table column for one depth row: mixes (series row, key hash, row
+    salt) so one table serves every series and depth row independently."""
+    h = _mix32(hi ^ jnp.uint32(salt))
+    h = _mix32(h ^ lo)
+    h = _mix32(h ^ rows.astype(jnp.uint32) * jnp.uint32(0x9E3779B1))
+    return (h % jnp.uint32(width)).astype(jnp.int32)
+
+
+def update(sk: CountMin, rows: jax.Array, hi: jax.Array, lo: jax.Array,
+           counts: jax.Array) -> CountMin:
+    """Fold one flat batch of (series row, key hash, count) increments
+    into the table and refresh each touched series' top-k.
+
+    rows: [N] int32; padding uses counts == 0 (its updates add zero and
+    its candidates lose every top-k comparison).
+    """
+    depth, width = sk.depth, sk.width
+    s, k = sk.topk_counts.shape
+    counts = counts.astype(jnp.float32)
+    table = sk.table
+    idxs = []
+    for d in range(depth):
+        idx = _row_index(rows, hi, lo, _ROW_SALTS[d], width)
+        idxs.append(idx)
+        table = table.at[d, idx].add(counts)
+    # conservative estimate after the adds: min over depth rows
+    est = jnp.full(rows.shape, jnp.inf, jnp.float32)
+    for d in range(depth):
+        est = jnp.minimum(est, table[d, idxs[d]])
+    est = jnp.where(counts > 0, est, 0.0)
+
+    # refresh the standing top-k entries from the table: their counts
+    # must track later increments even when the key loses its candidate
+    # slot to a ring collision this drain
+    cur_ct = jnp.full(sk.topk_counts.shape, jnp.inf, jnp.float32)
+    series = jnp.arange(s, dtype=jnp.int32)[:, None]
+    for d in range(depth):
+        idx = _row_index(jnp.broadcast_to(series, sk.topk_hi.shape),
+                         sk.topk_hi, sk.topk_lo, _ROW_SALTS[d], width)
+        cur_ct = jnp.minimum(cur_ct, table[d, idx])
+    cur_ct = jnp.where(sk.topk_counts > 0, cur_ct, 0.0)
+
+    # merge batch candidates into the per-series top-k lists:
+    # scatter each candidate's (id, est) into its series' candidate slot
+    # ring, then dedupe + select per series. A batch can carry more
+    # candidates than ring slots per series; colliding candidates
+    # overwrite (they re-enter on a later drain — top-k convergence only
+    # needs repeated exposure, not completeness per batch; standing
+    # members never rely on candidacy thanks to the refresh above).
+    ring = 4 * k  # candidate slots per series this drain
+    # salt the slot hash with the (monotonically growing) table mass so a
+    # pair of keys colliding this drain lands apart on a later one —
+    # a fixed slot hash would starve one of them forever
+    rsalt = _mix32(jnp.sum(table[0]).astype(jnp.uint32))
+    slot = _mix32(hi ^ lo ^ rsalt) % jnp.uint32(ring)
+    srows = jnp.where(counts > 0, rows, s).astype(jnp.int32)
+    cand_hi = jnp.zeros((s, ring), jnp.uint32).at[srows, slot].set(
+        hi, mode="drop")
+    cand_lo = jnp.zeros((s, ring), jnp.uint32).at[srows, slot].set(
+        lo, mode="drop")
+    cand_ct = jnp.zeros((s, ring), jnp.float32).at[srows, slot].set(
+        est, mode="drop")
+
+    all_hi = jnp.concatenate([sk.topk_hi, cand_hi], axis=1)
+    all_lo = jnp.concatenate([sk.topk_lo, cand_lo], axis=1)
+    all_ct = jnp.concatenate([cur_ct, cand_ct], axis=1)
+    # dedupe by id per series: sort by (hi, lo), keep each id's max count
+    # at its first occurrence, zero the duplicates
+    shi, slo, sct = lax.sort((all_hi, all_lo, all_ct), dimension=-1,
+                             num_keys=2, is_stable=False)
+    same = jnp.concatenate(
+        [jnp.zeros_like(shi[:, :1], bool),
+         (shi[:, 1:] == shi[:, :-1]) & (slo[:, 1:] == slo[:, :-1])], axis=1)
+    # max count within each equal-id run, propagated left to the head
+    run_max = _rev_seg_max(sct, same)
+    sct = jnp.where(same, 0.0, run_max)
+    sct = jnp.where((shi == 0) & (slo == 0), 0.0, sct)  # empty slots
+    top_ct, top_i = lax.top_k(sct, k)
+    top_hi = jnp.take_along_axis(shi, top_i, axis=1)
+    top_lo = jnp.take_along_axis(slo, top_i, axis=1)
+    live = top_ct > 0
+    return CountMin(
+        table=table,
+        topk_hi=jnp.where(live, top_hi, 0),
+        topk_lo=jnp.where(live, top_lo, 0),
+        topk_counts=top_ct,
+    )
+
+
+def _rev_seg_max(x: jax.Array, same: jax.Array) -> jax.Array:
+    """Per segment (runs where ``same`` is True continue the previous
+    element's segment), the max of the whole run written at every element,
+    via a right-to-left log-step segmented scan.
+
+    same[i] says element i belongs to i-1's segment; prop[i] tracks
+    whether position i can absorb from i+1 (initially same[i+1]), and
+    composes as prop'[i] = prop[i] & prop[i+d] so absorption never
+    crosses a segment boundary."""
+    def shl(a, d, fill):
+        pad = jnp.full(a.shape[:-1] + (d,), fill, a.dtype)
+        return jnp.concatenate([a[:, d:], pad], axis=1)
+
+    n = x.shape[-1]
+    prop = shl(same, 1, False)
+    val = x
+    d = 1
+    while d < n:
+        val = jnp.where(prop, jnp.maximum(val, shl(val, d, 0.0)), val)
+        prop = prop & shl(prop, d, False)
+        d *= 2
+    return val
+
+
+def estimate(sk: CountMin, rows: jax.Array, hi: jax.Array,
+             lo: jax.Array) -> jax.Array:
+    """Point-query frequency estimates for (series, key) pairs."""
+    est = jnp.full(rows.shape, jnp.inf, jnp.float32)
+    for d in range(sk.depth):
+        idx = _row_index(rows, hi, lo, _ROW_SALTS[d], sk.width)
+        est = jnp.minimum(est, sk.table[d, idx])
+    return est
