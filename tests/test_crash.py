@@ -1,0 +1,150 @@
+"""Crash reporting, profiling, graceful drain, dead-key rejection.
+
+The reference wraps every goroutine in ConsumePanic (report to Sentry,
+block, re-panic — ``/root/reference/sentry.go:17-52``), starts a
+profiler under ``enable_profiling`` (``server.go:1039-1047``), and its
+graceful restart guarantees at most one interval of loss
+(``server.go:1048-1076``).
+"""
+
+import http.server
+import json
+import os
+import threading
+import time
+
+import pytest
+
+from veneur_tpu import crash
+from veneur_tpu.config import Config
+from veneur_tpu.server import Server
+from veneur_tpu.sinks import ChannelMetricSink
+
+
+class _SentryCapture(http.server.BaseHTTPRequestHandler):
+    events = []
+
+    def do_POST(self):
+        body = self.rfile.read(int(self.headers["Content-Length"]))
+        _SentryCapture.events.append(
+            (self.path, dict(self.headers), json.loads(body)))
+        self.send_response(200)
+        self.end_headers()
+        self.wfile.write(b"{}")
+
+    def log_message(self, *a):
+        pass
+
+
+@pytest.fixture
+def sentry_server():
+    _SentryCapture.events = []
+    srv = http.server.HTTPServer(("127.0.0.1", 0), _SentryCapture)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    yield srv, f"http://pubkey@127.0.0.1:{srv.server_port}/42"
+    srv.shutdown()
+
+
+class TestSentryReporter:
+    def test_report_posts_event(self, sentry_server):
+        srv, dsn = sentry_server
+        rep = crash.SentryReporter(dsn)
+        try:
+            raise RuntimeError("boom in flush")
+        except RuntimeError as e:
+            assert rep.report(e, "flush-ticker")
+        path, headers, event = _SentryCapture.events[0]
+        assert path == "/api/42/store/"
+        assert "sentry_key=pubkey" in headers["X-Sentry-Auth"]
+        exc = event["exception"]["values"][0]
+        assert exc["type"] == "RuntimeError"
+        assert exc["value"] == "boom in flush"
+        assert exc["stacktrace"]["frames"]
+        assert event["tags"]["thread"] == "flush-ticker"
+        assert event["level"] == "fatal"
+
+    def test_malformed_dsn_rejected(self):
+        with pytest.raises(ValueError):
+            crash.SentryReporter("not-a-dsn")
+
+    def test_guarded_reports_then_rethrows(self, sentry_server):
+        srv, dsn = sentry_server
+        rep = crash.SentryReporter(dsn)
+
+        def bad():
+            raise KeyError("panic")
+
+        with pytest.raises(KeyError):
+            crash.guarded(bad, rep)()
+        assert len(_SentryCapture.events) == 1
+
+    def test_guarded_without_reporter_rethrows(self):
+        with pytest.raises(ZeroDivisionError):
+            crash.guarded(lambda: 1 // 0, None)()
+
+
+class TestConfigRejection:
+    def test_go_only_profile_keys_rejected(self):
+        for key in ("block_profile_rate", "mutex_profile_fraction"):
+            cfg = Config(**{key: 5})
+            with pytest.raises(ValueError, match=key):
+                cfg.validate()
+
+    def test_bad_sentry_dsn_rejected_at_validate(self):
+        cfg = Config(sentry_dsn="garbage")
+        with pytest.raises(ValueError):
+            cfg.validate()
+
+    def test_clean_config_validates(self):
+        Config().validate()
+
+
+class TestServerOps:
+    def test_thread_panic_reaches_sentry(self, sentry_server):
+        srv, dsn = sentry_server
+        cfg = Config(statsd_listen_addresses=[], interval="86400s",
+                     sentry_dsn=dsn, aggregates=["count"])
+        server = Server(cfg, metric_sinks=[ChannelMetricSink()])
+        server.start()
+        try:
+            # a spawned veneur thread that panics must report first
+            t = threading.Thread(
+                target=server._guard(lambda: (_ for _ in ()).throw(
+                    RuntimeError("worker died"))),
+                name="test-worker", daemon=True)
+            t.start()
+            t.join(5)
+            deadline = time.time() + 5
+            while time.time() < deadline and not _SentryCapture.events:
+                time.sleep(0.05)
+            assert _SentryCapture.events
+            _, _, event = _SentryCapture.events[0]
+            assert event["exception"]["values"][0]["value"] == "worker died"
+        finally:
+            server.shutdown()
+
+    def test_profiling_writes_stats(self, tmp_path, monkeypatch):
+        monkeypatch.chdir(tmp_path)
+        cfg = Config(statsd_listen_addresses=[], interval="86400s",
+                     enable_profiling=True, aggregates=["count"])
+        server = Server(cfg, metric_sinks=[ChannelMetricSink()])
+        server.start()
+        server.shutdown()
+        assert os.path.exists(tmp_path / "veneur-profile.pstats")
+        import pstats
+
+        pstats.Stats(str(tmp_path / "veneur-profile.pstats"))  # parseable
+
+    def test_shutdown_drains_final_flush(self):
+        from veneur_tpu.samplers import parser as p
+
+        cfg = Config(statsd_listen_addresses=[], interval="86400s",
+                     aggregates=["count"])
+        sink = ChannelMetricSink()
+        server = Server(cfg, metric_sinks=[sink])
+        server.start()
+        server.store.process_metric(p.parse_metric(b"drain.me:7|c"))
+        server.shutdown()
+        by = {m.name: m.value for m in sink.get_flush(timeout=5)}
+        assert by["drain.me"] == 7.0

@@ -38,6 +38,9 @@ class Config:
     aws_region: str = ""
     aws_s3_bucket: str = ""
     aws_secret_access_key: str = ""
+    # accepted for reference-config compatibility but REJECTED when set:
+    # Go-runtime block/mutex profiling has no Python equivalent, and a key
+    # that parses-and-does-nothing is worse than an error
     block_profile_rate: int = 0
     datadog_api_hostname: str = ""
     datadog_api_key: str = ""
@@ -134,6 +137,24 @@ class Config:
 
     def parse_interval(self) -> float:
         return parse_duration(self.interval)
+
+    def validate(self):
+        """Reject keys that cannot take effect in this runtime (the
+        round-1 audit flagged silently-dead keys as worse than absent)."""
+        if self.block_profile_rate:
+            raise ValueError(
+                "block_profile_rate is a Go-runtime profile knob with no "
+                "equivalent here; remove it (enable_profiling drives the "
+                "Python profiler)")
+        if self.mutex_profile_fraction:
+            raise ValueError(
+                "mutex_profile_fraction is a Go-runtime profile knob with "
+                "no equivalent here; remove it (enable_profiling drives "
+                "the Python profiler)")
+        if self.sentry_dsn:
+            from veneur_tpu.crash import SentryReporter
+
+            SentryReporter(self.sentry_dsn)  # raises on malformed DSN
 
     def apply_defaults(self):
         """Defaults + deprecation shims (config_parse.go:118-185)."""
@@ -285,6 +306,7 @@ def read_config(path: str, environ=None) -> Config:
     cfg, unknown = _load_semi_strict(text, Config)
     _apply_env_overrides(cfg, environ)
     cfg.apply_defaults()
+    cfg.validate()
     if unknown:
         log.warning("config contains unknown keys: %s", sorted(unknown))
     return cfg

@@ -158,6 +158,11 @@ class Server:
         self.import_server = None   # gRPC Forward.SendMetrics ingest
 
         self._stop = threading.Event()
+        self._sentry = None
+        self._profiler = None
+        self._thread_profiles: List = []
+        self._profiles_lock = threading.Lock()
+        self._guard = lambda fn: fn  # replaced in start()
         self._threads: List[threading.Thread] = []
         self._native_readers: List = []
         self._native_pumps: List[threading.Thread] = []
@@ -257,9 +262,45 @@ class Server:
         """Bring up listeners, span workers and the flush ticker
         (server.go:555-666)."""
         cfg = self.config
+        # crash surface: report-then-rethrow on every veneur thread
+        # (ConsumePanic, sentry.go:17-52) + a process-wide excepthook
+        from veneur_tpu import crash
+
+        if cfg.sentry_dsn:
+            self._sentry = crash.SentryReporter(cfg.sentry_dsn)
+        crash.install_excepthook(self._sentry)
+        self._guard = lambda fn: crash.guarded(fn, self._sentry)
+        if cfg.enable_profiling:
+            import cProfile
+
+            # cProfile instruments only its own thread, so each guarded
+            # veneur thread runs its own profiler; shutdown merges every
+            # profile that finished by then (threads still running at
+            # dump time are not included)
+            self._profiler = cProfile.Profile()
+            self._profiler.enable()
+            base_guard = self._guard
+
+            def profiled_guard(fn):
+                wrapped = base_guard(fn)
+
+                def run(*args, **kwargs):
+                    prof = cProfile.Profile()
+                    prof.enable()
+                    try:
+                        return wrapped(*args, **kwargs)
+                    finally:
+                        prof.disable()
+                        with self._profiles_lock:
+                            self._thread_profiles.append(prof)
+                return run
+
+            self._guard = profiled_guard
+            log.info("profiling enabled; stats written on shutdown")
         for _ in range(max(1, cfg.num_span_workers)):
             w = SpanWorker(self.span_sinks, self.span_chan, self._stop)
-            t = threading.Thread(target=w.work, name="span-worker", daemon=True)
+            t = threading.Thread(target=self._guard(w.work),
+                                 name="span-worker", daemon=True)
             t.start()
             self._span_workers.append(w)
             self._threads.append(t)
@@ -305,7 +346,8 @@ class Server:
             self._forwarder = configure_forwarding(self)
 
         self._flush_thread = threading.Thread(
-            target=self._flush_loop, name="flush-ticker", daemon=True)
+            target=self._guard(self._flush_loop), name="flush-ticker",
+            daemon=True)
         self._flush_thread.start()
         log.info("veneur server started (role=%s, interval=%.1fs)",
                  "local" if self.is_local() else "global", self.interval)
@@ -363,8 +405,9 @@ class Server:
             return False
         self._native_readers.append(reader)
         self.statsd_addrs.append((resolved.host or "0.0.0.0", reader.port))
-        t = threading.Thread(target=self._native_pump, args=(reader,),
-                             name="native-udp-pump", daemon=True)
+        t = threading.Thread(target=self._guard(self._native_pump),
+                             args=(reader,), name="native-udp-pump",
+                             daemon=True)
         t.start()
         self._native_pumps.append(t)
         log.info("native ingest on udp port %d (%d readers)", reader.port,
@@ -403,15 +446,39 @@ class Server:
         flush_once(self)
 
     def shutdown(self):
-        """Graceful stop (server.go:1120-1130)."""
+        """Graceful stop: quiesce ingest, drain one final flush so the
+        current interval's data reaches the sinks, then tear down
+        (server.go:1120-1130; the final drain is this framework's
+        equivalent of the reference's graceful-restart guarantee that at
+        most one interval is ever lost)."""
         self._stop.set()
         # pump threads must leave drain() before the reader pool is freed
         for t in self._native_pumps:
             t.join(timeout=2.0)
         for reader in self._native_readers:
             reader.stop()
+        # the ticker must finish any in-flight flush before the final
+        # drain runs, or two passes would drain the store concurrently
         if self._flush_thread is not None:
             self._flush_thread.join(timeout=5.0)
+        try:
+            self.flush()
+        except Exception:
+            log.exception("final flush failed")
+        if self._profiler is not None:
+            import pstats
+
+            self._profiler.disable()
+            path = "veneur-profile.pstats"
+            stats = pstats.Stats(self._profiler)
+            with self._profiles_lock:
+                for prof in self._thread_profiles:
+                    stats.add(prof)
+            stats.dump_stats(path)
+            log.info("profile written to %s (%d thread profiles merged)",
+                     path, len(self._thread_profiles))
+            self._profiler = None
+            self._thread_profiles = []
         if self.ops_server is not None:
             self.ops_server.stop()
         if self.import_server is not None:
