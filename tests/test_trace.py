@@ -276,6 +276,6 @@ class TestSelfTelemetryLoop:
             srv.flush()
             batch = sink.get_flush()
             names = {m.name for m in batch}
-            assert any("flush.intermetrics_total" in n for n in names), names
+            assert any("veneur.flush.post_metrics_total" in n for n in names), names
         finally:
             srv.shutdown()

@@ -1,0 +1,170 @@
+"""Cross-process trace propagation + self-telemetry breadth.
+
+The reference injects opentracing context on forward POSTs and extracts
+it on /import (``/root/reference/http/http.go:184-188``,
+``handlers_global.go:125``), so a local's flush span and the global's
+import span share one trace. It also emits a canonical self-metric set
+(``README.md:248-277``) through its own pipeline.
+"""
+
+import queue
+import time
+
+import pytest
+
+from veneur_tpu.config import Config
+from veneur_tpu.samplers import parser as p
+from veneur_tpu.server import Server
+from veneur_tpu.sinks import ChannelMetricSink
+from veneur_tpu.sinks.base import SpanSink
+
+
+class SpanCapture(SpanSink):
+    name = "span_capture"
+
+    def __init__(self):
+        self.spans = []
+
+    def start(self, trace_client=None):
+        pass
+
+    def ingest(self, span):
+        self.spans.append(span)
+
+    def flush(self):
+        pass
+
+
+def _mk_global(use_grpc):
+    cfg = Config(statsd_listen_addresses=[], interval="86400s",
+                 grpc_address="127.0.0.1:0" if use_grpc else "",
+                 http_address="" if use_grpc else "127.0.0.1:0",
+                 aggregates=["count"])
+    cap = SpanCapture()
+    g = Server(cfg, metric_sinks=[ChannelMetricSink()], span_sinks=[cap])
+    g.start()
+    return g, cap
+
+
+def _mk_local(gaddr, use_grpc):
+    cfg = Config(statsd_listen_addresses=[], interval="86400s",
+                 forward_address=gaddr, forward_use_grpc=use_grpc,
+                 aggregates=["count"])
+    cap = SpanCapture()
+    srv = Server(cfg, metric_sinks=[ChannelMetricSink()], span_sinks=[cap])
+    srv.start()
+    return srv, cap
+
+
+@pytest.mark.parametrize("use_grpc", [True, False])
+def test_forwarded_flush_spans_stitch_into_one_trace(use_grpc):
+    g, gcap = _mk_global(use_grpc)
+    try:
+        addr = (f"127.0.0.1:{g.import_server.port}" if use_grpc
+                else f"http://127.0.0.1:{g.ops_server.port}")
+        lserver, lcap = _mk_local(addr, use_grpc)
+        try:
+            lserver.store.process_metric(
+                p.parse_metric(b"stitch.h:4.5|h"))
+            lserver.flush()
+            deadline = time.time() + 10
+            while time.time() < deadline and g.store.imported < 1:
+                time.sleep(0.02)
+            assert g.store.imported >= 1
+            # wait for both sides' span workers to drain their channels
+            def span_named(cap, name):
+                deadline = time.time() + 10
+                while time.time() < deadline:
+                    for s in cap.spans:
+                        if s.name == name:
+                            return s
+                    time.sleep(0.02)
+                return None
+            flush_span = span_named(lcap, "flush")
+            import_span = span_named(gcap, "import")
+            assert flush_span is not None, "local flush span missing"
+            assert import_span is not None, "global import span missing"
+            assert import_span.trace_id == flush_span.trace_id
+            assert import_span.parent_id == flush_span.id
+        finally:
+            lserver.shutdown()
+    finally:
+        g.shutdown()
+
+
+def test_canonical_self_metrics_flow_through_pipeline():
+    """The flush span's samples re-enter via the extraction sink and are
+    flushed as veneur.* metrics on the NEXT flush."""
+    cfg = Config(statsd_listen_addresses=[], interval="86400s",
+                 aggregates=["count"])
+    sink = ChannelMetricSink()
+    server = Server(cfg, metric_sinks=[sink])
+    server.start()
+    try:
+        server.store.process_metric(p.parse_metric(b"user.metric:1|c"))
+        server.packet_errors += 3
+        server.flush()
+        sink.get_flush()
+        # let the span worker feed the extraction sink
+        deadline = time.time() + 10
+        want = {"veneur.flush.total_duration_ns.count",
+                "veneur.worker.metrics_processed_total",
+                "veneur.packet.error_total",
+                "veneur.gc.number",
+                "veneur.mem.heap_alloc_bytes",
+                "veneur.worker.metrics_flushed_total"}
+        got = {}
+        while time.time() < deadline:
+            server.flush()
+            try:
+                for m in sink.get_flush(timeout=2):
+                    got[m.name] = m
+            except queue.Empty:
+                pass
+            if want <= set(got):
+                break
+        missing = want - set(got)
+        assert not missing, f"missing self-metrics: {missing}"
+        assert got["veneur.packet.error_total"].value == 3.0
+        assert got["veneur.worker.metrics_processed_total"].value >= 1.0
+        flushed = [m for m in got.values()
+                   if m.name == "veneur.worker.metrics_flushed_total"]
+        assert flushed
+    finally:
+        server.shutdown()
+
+
+class TestOpenTracingShim:
+    def test_span_lifecycle_records_to_client(self):
+        from veneur_tpu.trace import new_channel_client
+        from veneur_tpu.trace import opentracing as ot
+
+        chan = queue.Queue()
+        tracer = ot.Tracer(client=new_channel_client(chan))
+        with tracer.start_span("op.outer") as sp:
+            sp.set_tag("k", "v")
+        recorded = chan.get(timeout=2)
+        assert recorded.name == "op.outer"
+
+    def test_inject_extract_roundtrip_http(self):
+        from veneur_tpu.trace import opentracing as ot
+
+        tracer = ot.Tracer()
+        span = tracer.start_span("parent")
+        carrier = {}
+        tracer.inject(span.context, ot.FORMAT_HTTP_HEADERS, carrier)
+        ctx = tracer.extract(ot.FORMAT_HTTP_HEADERS,
+                             {k.upper(): v for k, v in carrier.items()})
+        assert ctx.trace_id == span.context.trace_id
+        assert ctx.span_id == span.context.span_id
+        child = tracer.start_span("child", child_of=ctx)
+        assert child.context.trace_id == span.context.trace_id
+
+    def test_extract_garbage_returns_none(self):
+        from veneur_tpu.trace import opentracing as ot
+
+        tracer = ot.Tracer()
+        assert tracer.extract(ot.FORMAT_TEXT_MAP, {"traceid": "zzz"}) is None
+        assert tracer.extract(ot.FORMAT_TEXT_MAP, {}) is None
+        with pytest.raises(ValueError):
+            tracer.extract("binary", {})

@@ -827,6 +827,10 @@ class MetricsSummary:
     local_sets: int = 0
     local_timers: int = 0
     local_status_checks: int = 0
+    # per-interval ingest tallies, snapshotted under the store lock at
+    # flush so concurrent increments are never lost
+    processed: int = 0
+    imported: int = 0
 
 
 @dataclass
@@ -1178,6 +1182,8 @@ class MetricStore:
                 self._flush_scalars(self.global_gauges, MetricType.GAUGE,
                                     final, now)
 
+            ms.processed = self.processed
+            ms.imported = self.imported
             self.processed = 0
             self.imported = 0
             # every interner was reset, so the native table's memoized

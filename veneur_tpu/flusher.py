@@ -64,18 +64,32 @@ def _flush_once(server: "Server", span):
         forward=is_local and server.forward_fn is not None)
     flush_elapsed = time.perf_counter() - t0
     log.debug("store flush took %.1f ms (%s)", flush_elapsed * 1e3, ms)
-    # flush self-metrics ride on the flush span (flusher.go:134-187's
-    # flush_total_duration_ns / flushed-metric tallies)
+    # the canonical self-metric set (README.md:248-277) rides on the
+    # flush span and re-enters the pipeline through the extraction sink
     span.add(
-        ssf_samples.timing("flush.total_duration_ns", flush_elapsed,
+        ssf_samples.timing("veneur.flush.total_duration_ns", flush_elapsed,
                            {"part": "store"}),
-        ssf_samples.count("flush.intermetrics_total",
-                          float(len(final_metrics)), None))
+        ssf_samples.count("veneur.flush.post_metrics_total",
+                          float(len(final_metrics)), None),
+        *_worker_samples(server, ms),
+        *_runtime_samples())
 
-    # local → global forwarding happens off the flush path (flusher.go:66-75)
+    # local → global forwarding happens off the flush path
+    # (flusher.go:66-75); the flush span rides along so the global's
+    # import span joins this trace (http/http.go:184-188)
     if is_local and server.forward_fn is not None and len(forwardable):
-        threading.Thread(target=server.forward_fn, args=(forwardable,),
-                         daemon=True).start()
+        import inspect
+
+        try:
+            span_aware = "parent_span" in inspect.signature(
+                server.forward_fn).parameters
+        except (TypeError, ValueError):
+            span_aware = False
+        if span_aware:
+            fwd = lambda: server.forward_fn(forwardable, parent_span=span)
+        else:
+            fwd = lambda: server.forward_fn(forwardable)
+        threading.Thread(target=fwd, daemon=True).start()
 
     if not final_metrics:
         span_flusher.join(timeout=10.0)
@@ -99,6 +113,58 @@ def _flush_once(server: "Server", span):
             log.exception("plugin %s flush failed", plugin.name)
 
     span_flusher.join(timeout=10.0)
+
+
+def _worker_samples(server, ms):
+    """Ingest/worker tallies (veneur.worker.* / veneur.packet.* from the
+    canonical list, README.md:256-276). Counters are since-last-flush
+    deltas, like the reference's per-interval worker counters."""
+    from veneur_tpu.trace import samples as ssf_samples
+
+    errs = server.packet_errors - server._last_packet_errors
+    drops = server.packet_drops - server._last_packet_drops
+    server._last_packet_errors = server.packet_errors
+    server._last_packet_drops = server.packet_drops
+    out = [
+        ssf_samples.count("veneur.worker.metrics_processed_total",
+                          float(ms.processed), None),
+        ssf_samples.count("veneur.worker.metrics_imported_total",
+                          float(ms.imported), None),
+        ssf_samples.count("veneur.packet.error_total", float(errs),
+                          {"packet_type": "statsd"}),
+        ssf_samples.count("veneur.packet.drop_total", float(drops),
+                          {"packet_type": "statsd"}),
+    ]
+    for mtype in ("counters", "gauges", "histograms", "sets", "timers"):
+        out.append(ssf_samples.count(
+            "veneur.worker.metrics_flushed_total", float(getattr(ms, mtype)),
+            {"metric_type": mtype.rstrip("s")}))
+    return out
+
+
+def _runtime_samples():
+    """The Go-runtime gauges' Python analogues (veneur.gc.*,
+    veneur.mem.*, README.md:267-269). Telemetry must never abort a
+    flush, so everything here is best-effort."""
+    import gc
+    import sys
+
+    from veneur_tpu.trace import samples as ssf_samples
+
+    out = [ssf_samples.gauge(
+        "veneur.gc.number",
+        float(sum(s["collections"] for s in gc.get_stats())), None)]
+    try:
+        import resource
+
+        maxrss = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+        # Linux reports KB, macOS bytes; Windows has no resource module
+        rss_bytes = maxrss if sys.platform == "darwin" else maxrss * 1024
+        out.append(ssf_samples.gauge("veneur.mem.heap_alloc_bytes",
+                                     float(rss_bytes), None))
+    except ImportError:  # pragma: no cover - non-POSIX
+        pass
+    return out
 
 
 def _flush_sink(sink, metrics):

@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import logging
 import threading
+import time
 from concurrent import futures
 from typing import Callable, Optional
 
@@ -50,12 +51,18 @@ class GRPCForwarder:
         self.forwarded = 0
         self.errors = 0
 
-    def forward(self, state):
+    def forward(self, state, parent_span=None):
         mlist = metric_list_from_state(state, self.compression)
         if not mlist.metrics:
             return
+        metadata = None
+        if parent_span is not None:
+            # same propagation as the HTTP path, as gRPC metadata
+            metadata = tuple(
+                (k.lower(), v)
+                for k, v in parent_span.context_as_parent().items())
         try:
-            self._send(mlist, timeout=self.timeout)
+            self._send(mlist, timeout=self.timeout, metadata=metadata)
             with self._lock:
                 self.forwarded += len(mlist.metrics)
         except grpc.RpcError as e:
@@ -76,7 +83,9 @@ class ImportServer:
     """
 
     def __init__(self, store=None,
-                 apply: Optional[Callable] = None, workers: int = 4):
+                 apply: Optional[Callable] = None, workers: int = 4,
+                 trace_client=None):
+        self._trace_client = trace_client
         if apply is None:
             if store is None:
                 raise ValueError("need a store or an apply callable")
@@ -97,6 +106,12 @@ class ImportServer:
         self.port: Optional[int] = None
 
     def _send_metrics(self, request: forward_pb2.MetricList, context):
+        from veneur_tpu import trace as vtrace
+
+        carrier = {k: v for k, v in (context.invocation_metadata() or ())}
+        span = vtrace.from_headers(carrier, resource="veneur.import")
+        span.name = "import"
+        t0 = time.perf_counter()
         n_ok = 0
         for m in request.metrics:
             try:
@@ -108,6 +123,15 @@ class ImportServer:
                 log.debug("failed to import metric %s: %s", m.name, e)
         with self._lock:
             self.received += n_ok
+        from veneur_tpu.trace import samples as ssf_samples
+
+        span.add(ssf_samples.timing("veneur.import.response_duration_ns",
+                                    time.perf_counter() - t0,
+                                    {"part": "merge"}),
+                 ssf_samples.count("veneur.import.metrics_total", float(n_ok),
+                                   None))
+        span.finish()
+        span.client_record(self._trace_client)
         return empty_pb2.Empty()
 
     def start(self, addr: str = "[::]:0") -> int:

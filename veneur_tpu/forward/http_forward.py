@@ -56,13 +56,19 @@ class HTTPForwarder:
         self.forwarded = 0
         self.errors = 0
 
-    def forward(self, state):
+    def forward(self, state, parent_span=None):
         metrics = json_metrics_from_state(state, self.compression)
         if not metrics:
             return
         url = self.base + "/import"
+        headers = None
+        if parent_span is not None:
+            # propagate the flush span's context so the global's import
+            # span stitches into the same trace (http/http.go:184-188)
+            headers = parent_span.context_as_parent()
         try:
-            status = post_helper(url, metrics, timeout=self.timeout)
+            status = post_helper(url, metrics, timeout=self.timeout,
+                                 headers=headers)
             if 200 <= status < 300:
                 with self._lock:
                     self.forwarded += len(metrics)

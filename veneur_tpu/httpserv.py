@@ -113,19 +113,37 @@ class _Handler(BaseHTTPRequestHandler):
         except ImportError400 as e:
             self._reply(400, str(e))
             return
+        # extract the forwarder's trace context so the import span
+        # stitches into the local's flush trace (handlers_global.go:125)
+        carrier = {k.lower(): v for k, v in self.headers.items()}
         # accept, then merge off the request thread — the reference's
         # ``go s.ImportMetrics`` (http.go:54-60); a merge blocked behind a
         # long flush must not hold the forwarder's POST open
         self._reply(202, "accepted")
-        threading.Thread(target=self._merge, args=(handle, metrics),
+        threading.Thread(target=self._merge,
+                         args=(handle, metrics, carrier,
+                               self.server.veneur_trace_client),
                          daemon=True).start()
 
     @staticmethod
-    def _merge(handle, metrics):
+    def _merge(handle, metrics, carrier=None, trace_client=None):
+        from veneur_tpu import trace as vtrace
+        from veneur_tpu.trace import samples as ssf_samples
+
+        span = vtrace.from_headers(carrier or {}, resource="veneur.import")
+        span.name = "import"
         try:
-            handle(metrics)
-        except Exception:
+            n_ok = handle(metrics)
+            if not isinstance(n_ok, int):  # span-unaware import callables
+                n_ok = len(metrics)
+            span.add(ssf_samples.count("veneur.import.metrics_total",
+                                       float(n_ok), None))
+        except Exception as e:
+            span.error(e)
             log.exception("import failed")
+        finally:
+            span.finish()
+            span.client_record(trace_client)
 
 
 class OpsServer:
@@ -137,18 +155,20 @@ class OpsServer:
     """
 
     def __init__(self, addr: str = "127.0.0.1:0",
-                 import_fn: Optional[Callable[[List[dict]], None]] = None):
+                 import_fn: Optional[Callable[[List[dict]], None]] = None,
+                 trace_client=None):
         host, _, port = addr.rpartition(":")
         self._httpd = ThreadingHTTPServer((host or "127.0.0.1", int(port)),
                                           _Handler)
         self._httpd.daemon_threads = True
         self._httpd.veneur_import = import_fn
+        self._httpd.veneur_trace_client = trace_client
         self._httpd.veneur_get_routes = {}
         self._thread: Optional[threading.Thread] = None
 
     @classmethod
     def for_server(cls, server, addr: str) -> "OpsServer":
-        def import_metrics(metrics: List[dict]):
+        def import_metrics(metrics: List[dict]) -> int:
             errs = 0
             for d in metrics:
                 try:
@@ -160,8 +180,10 @@ class OpsServer:
             if errs:
                 log.warning("failed to import %d/%d metrics",
                             errs, len(metrics))
+            return len(metrics) - errs
 
-        ops = cls(addr, import_fn=import_metrics)
+        ops = cls(addr, import_fn=import_metrics,
+                  trace_client=getattr(server, "trace_client", None))
         ops.add_route("/config", lambda: (
             200, json.dumps({k: v for k, v in vars(server.config).items()
                              if "key" not in k and "secret" not in k

@@ -177,6 +177,8 @@ class Server:
         # ingest error/telemetry counters
         self.packet_errors = 0
         self.packet_drops = 0
+        self._last_packet_errors = 0
+        self._last_packet_drops = 0
         self._warned_no_forward = False
         # bound listener addresses (useful when configured with port 0)
         self.statsd_addrs: List = []
@@ -337,7 +339,8 @@ class Server:
         if cfg.grpc_address:
             from veneur_tpu.forward.grpc_forward import ImportServer
 
-            self.import_server = ImportServer(self.store)
+            self.import_server = ImportServer(
+                self.store, trace_client=self.trace_client)
             self.import_server.start(cfg.grpc_address)
         # local → global forwarding client (server.go:626-635)
         if self.forward_fn is None:

@@ -1,0 +1,142 @@
+"""OpenTracing-compatible layer over the SSF trace core.
+
+The reference ships an opentracing.Tracer implementation
+(``/root/reference/trace/opentracing.go``) so applications written
+against the OpenTracing API emit SSF spans; ``http/http.go:184-188``
+uses its inject/extract for forward-request propagation. This is the
+Python equivalent: the classic ``Tracer`` / ``Span`` / ``SpanContext``
+trio with TextMap/HTTP-headers inject-extract, backed by
+``veneur_tpu.trace.Trace``. Only the surface veneur itself exercises is
+implemented — not the full semantic-conventions catalogue.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, Optional
+
+from veneur_tpu import trace as vtrace
+
+FORMAT_TEXT_MAP = "text_map"
+FORMAT_HTTP_HEADERS = "http_headers"
+
+
+class SpanContext:
+    """Propagation-relevant identity of a span (opentracing.go:58-76)."""
+
+    def __init__(self, trace_id: int, span_id: int, resource: str = ""):
+        self.trace_id = trace_id
+        self.span_id = span_id
+        self.resource = resource
+
+    def baggage(self) -> Dict[str, str]:
+        return {"traceid": str(self.trace_id),
+                "parentid": str(self.span_id),
+                vtrace.RESOURCE_KEY: self.resource}
+
+
+class Span:
+    """An OpenTracing span wrapping a Trace (opentracing.go:78-170)."""
+
+    def __init__(self, tracer: "Tracer", trace: "vtrace.Trace"):
+        self._tracer = tracer
+        self._trace = trace
+        self._tags: Dict[str, str] = {}
+        self._finished = False
+
+    @property
+    def context(self) -> SpanContext:
+        return SpanContext(self._trace.trace_id, self._trace.span_id,
+                           self._trace.resource)
+
+    def set_operation_name(self, name: str) -> "Span":
+        self._trace.name = name
+        return self
+
+    def set_tag(self, key: str, value) -> "Span":
+        self._tags[key] = str(value)
+        return self
+
+    def log_kv(self, kv: Dict[str, str]) -> "Span":
+        for k, v in kv.items():
+            self.set_tag(f"log.{k}", v)
+        return self
+
+    def finish(self, finish_time: Optional[float] = None):
+        if self._finished:  # explicit finish inside a with-block
+            return
+        self._finished = True
+        self._trace.finish()
+        if finish_time is not None:
+            self._trace.end = finish_time
+        self._trace.client_record(self._tracer.client,
+                                  tags=self._tags or None)
+
+    def __enter__(self) -> "Span":
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        if exc is not None:
+            self._trace.error(exc)
+        self.finish()
+
+
+class Tracer:
+    """start_span / inject / extract (opentracing.go:172-280)."""
+
+    def __init__(self, client=None):
+        self.client = client
+
+    def start_span(self, operation_name: str,
+                   child_of: Optional[SpanContext] = None,
+                   start_time: Optional[float] = None) -> Span:
+        if child_of is not None:
+            ctx = (child_of.context if isinstance(child_of, Span)
+                   else child_of)
+            import random
+
+            t = vtrace.Trace(resource=ctx.resource or operation_name)
+            t.trace_id = ctx.trace_id
+            t.parent_id = ctx.span_id
+            t.span_id = random.getrandbits(63)
+        else:
+            t = vtrace.Trace.start_trace(operation_name)
+        t.name = operation_name
+        if start_time is not None:
+            t.start = start_time
+        else:
+            t.start = time.time()
+        return Span(self, t)
+
+    def inject(self, span_context: SpanContext, format: str,
+               carrier: Dict[str, str]):
+        if format not in (FORMAT_TEXT_MAP, FORMAT_HTTP_HEADERS):
+            raise ValueError(f"unsupported carrier format {format!r}")
+        carrier.update(span_context.baggage())
+
+    def extract(self, format: str,
+                carrier: Dict[str, str]) -> Optional[SpanContext]:
+        if format not in (FORMAT_TEXT_MAP, FORMAT_HTTP_HEADERS):
+            raise ValueError(f"unsupported carrier format {format!r}")
+        lowered = {k.lower(): v for k, v in carrier.items()}
+        try:
+            trace_id = int(lowered.get("traceid", "0"))
+            span_id = int(lowered.get("parentid", "0"))
+        except ValueError:
+            return None
+        if not trace_id:
+            return None
+        return SpanContext(trace_id, span_id,
+                           lowered.get(vtrace.RESOURCE_KEY, ""))
+
+
+_global_tracer = Tracer()
+
+
+def set_global_tracer(tracer: Tracer):
+    global _global_tracer
+    _global_tracer = tracer
+
+
+def global_tracer() -> Tracer:
+    return _global_tracer
