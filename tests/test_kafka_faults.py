@@ -1,0 +1,271 @@
+"""Kafka wire producer against a fake broker, plus fault injection.
+
+Mirrors the reference's transport-failure tests
+(``/root/reference/proxysrv/server_test.go:73-97`` — unreachable
+destinations, timeouts) and proves the bundled producer end to end the
+way the reference proves its sarama wiring with mock producers.
+"""
+
+import queue
+import socket
+import struct
+import threading
+import time
+import zlib
+
+import pytest
+
+from veneur_tpu.sinks.kafka_wire import WireProducer, _Reader
+
+
+class FakeBroker:
+    """Just enough Kafka: Metadata v0 + Produce v0, with injectable
+    produce error codes. Records every produced message value."""
+
+    def __init__(self, partitions: int = 2, produce_error: int = 0):
+        self.partitions = partitions
+        self.produce_error = produce_error
+        self.messages = []   # (topic, partition, value bytes)
+        self._srv = socket.socket()
+        self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._srv.bind(("127.0.0.1", 0))
+        self._srv.listen(8)
+        self.port = self._srv.getsockname()[1]
+        self._stop = False
+        threading.Thread(target=self._accept_loop, daemon=True).start()
+
+    def _accept_loop(self):
+        while not self._stop:
+            try:
+                conn, _ = self._srv.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._serve, args=(conn,),
+                             daemon=True).start()
+
+    def _recv_exact(self, conn, n):
+        data = b""
+        while len(data) < n:
+            chunk = conn.recv(n - len(data))
+            if not chunk:
+                raise ConnectionError
+            data += chunk
+        return data
+
+    def _serve(self, conn):
+        try:
+            while True:
+                (size,) = struct.unpack(">i", self._recv_exact(conn, 4))
+                r = _Reader(self._recv_exact(conn, size))
+                api = r.i16()
+                r.i16()  # api version
+                corr = r.i32()
+                r.string()  # client id
+                if api == 3:
+                    resp = self._metadata(r)
+                elif api == 0:
+                    resp = self._produce(r)
+                    if resp is None:
+                        continue  # acks=0: no response
+                else:
+                    break
+                payload = struct.pack(">i", corr) + resp
+                conn.sendall(struct.pack(">i", len(payload)) + payload)
+        except (ConnectionError, OSError, struct.error):
+            pass
+        finally:
+            conn.close()
+
+    def _metadata(self, r):
+        r.i32()  # topic count
+        topic = r.string()
+        out = struct.pack(">i", 1)  # one broker: us
+        out += struct.pack(">i", 1)  # node id
+        host = b"127.0.0.1"
+        out += struct.pack(">h", len(host)) + host
+        out += struct.pack(">i", self.port)
+        out += struct.pack(">i", 1)  # one topic
+        out += struct.pack(">h", 0)  # topic error
+        tb = topic.encode()
+        out += struct.pack(">h", len(tb)) + tb
+        out += struct.pack(">i", self.partitions)
+        for pid in range(self.partitions):
+            out += struct.pack(">h", 0)       # partition error
+            out += struct.pack(">i", pid)
+            out += struct.pack(">i", 1)       # leader: us
+            out += struct.pack(">i", 0)       # replicas: empty
+            out += struct.pack(">i", 0)       # isr: empty
+        return out
+
+    def _produce(self, r):
+        acks = r.i16()
+        r.i32()  # timeout
+        r.i32()  # topic count
+        topic = r.string()
+        r.i32()  # partition count
+        pid = r.i32()
+        mset = r.take(r.i32())
+        mr = _Reader(mset)
+        mr.i64()  # offset
+        mr.i32()  # message size
+        crc = mr.i32() & 0xFFFFFFFF
+        body_start = mr.pos
+        mr.i16()  # magic + attributes
+        klen = mr.i32()
+        if klen > 0:
+            mr.take(klen)
+        value = mr.take(mr.i32())
+        assert crc == (zlib.crc32(mset[body_start:]) & 0xFFFFFFFF)
+        if self.produce_error == 0:
+            self.messages.append((topic, pid, value))
+        if acks == 0:
+            return None
+        tb = topic.encode()
+        return (struct.pack(">i", 1)
+                + struct.pack(">h", len(tb)) + tb
+                + struct.pack(">i", 1)
+                + struct.pack(">i", pid)
+                + struct.pack(">h", self.produce_error)
+                + struct.pack(">q", len(self.messages)))
+
+    def close(self):
+        self._stop = True
+        self._srv.close()
+
+
+@pytest.fixture
+def broker():
+    b = FakeBroker()
+    yield b
+    b.close()
+
+
+class TestWireProducer:
+    def test_produce_roundtrip(self, broker):
+        p = WireProducer(f"127.0.0.1:{broker.port}", acks=1)
+        for i in range(20):
+            p.produce("metrics", f"payload{i}".encode(), key=f"k{i}")
+        p.close()
+        assert len(broker.messages) == 20
+        assert {v for _, _, v in broker.messages} == {
+            f"payload{i}".encode() for i in range(20)}
+        # the hash partitioner spreads keys over both partitions
+        assert {pid for _, pid, _ in broker.messages} == {0, 1}
+
+    def test_acks_none_fire_and_forget(self, broker):
+        p = WireProducer(f"127.0.0.1:{broker.port}", acks=0)
+        p.produce("m", b"x")
+        deadline = time.time() + 5
+        while time.time() < deadline and not broker.messages:
+            time.sleep(0.01)
+        assert broker.messages
+        p.close()
+
+    def test_broker_error_code_raises_after_retries(self, broker):
+        broker.produce_error = 6  # NOT_LEADER_FOR_PARTITION
+        p = WireProducer(f"127.0.0.1:{broker.port}", acks=1, retry_max=1)
+        with pytest.raises(RuntimeError, match="error code 6"):
+            p.produce("m", b"x")
+        assert p.errors == 1
+        p.close()
+
+    def test_unreachable_broker_raises_not_hangs(self):
+        sock = socket.socket()
+        sock.bind(("127.0.0.1", 0))
+        port = sock.getsockname()[1]
+        sock.close()  # nothing listening here
+        p = WireProducer(f"127.0.0.1:{port}", acks=1, retry_max=0,
+                         timeout_ms=500)
+        t0 = time.time()
+        with pytest.raises(OSError):
+            p.produce("m", b"x")
+        assert time.time() - t0 < 5
+
+    def test_kafka_sink_uses_wire_producer(self, broker):
+        import json
+
+        from veneur_tpu.sinks.kafka import KafkaMetricSink
+        from veneur_tpu.samplers.intermetric import InterMetric, MetricType
+
+        sink = KafkaMetricSink(f"127.0.0.1:{broker.port}", "veneur.metrics")
+        sink.start(None)
+        sink.flush([InterMetric(name="kafka.e2e", timestamp=7, value=4.5,
+                                tags=["a:b"], type=MetricType.GAUGE)])
+        deadline = time.time() + 5
+        while time.time() < deadline and not broker.messages:
+            time.sleep(0.01)
+        assert broker.messages
+        doc = json.loads(broker.messages[0][2])
+        assert doc["name"] == "kafka.e2e"
+
+
+class TestForwardFaults:
+    """Unreachable forward destinations (proxysrv/server_test.go:73-97)."""
+
+    def test_http_forwarder_unreachable_counts_error(self):
+        from veneur_tpu.forward.http_forward import HTTPForwarder
+        from veneur_tpu.core.store import ForwardableState
+
+        sock = socket.socket()
+        sock.bind(("127.0.0.1", 0))
+        port = sock.getsockname()[1]
+        sock.close()
+        fwd = HTTPForwarder(f"http://127.0.0.1:{port}", timeout=1.0)
+        state = ForwardableState()
+        state.counters.append(("c", [], 1))
+        fwd.forward(state)  # must not raise
+        assert fwd.errors == 1
+        assert fwd.forwarded == 0
+
+    def test_grpc_forwarder_unreachable_counts_error(self):
+        from veneur_tpu.forward.grpc_forward import GRPCForwarder
+        from veneur_tpu.core.store import ForwardableState
+
+        sock = socket.socket()
+        sock.bind(("127.0.0.1", 0))
+        port = sock.getsockname()[1]
+        sock.close()
+        fwd = GRPCForwarder(f"127.0.0.1:{port}", timeout=1.0)
+        state = ForwardableState()
+        state.counters.append(("c", [], 1))
+        fwd.forward(state)
+        assert fwd.errors == 1
+        fwd.close()
+
+    def test_slow_sink_does_not_block_other_sinks(self):
+        from veneur_tpu.config import Config
+        from veneur_tpu.samplers import parser as p
+        from veneur_tpu.server import Server
+        from veneur_tpu.sinks import ChannelMetricSink
+        from veneur_tpu.sinks.base import MetricSink
+
+        class StuckSink(MetricSink):
+            name = "stuck"
+
+            def start(self, trace_client=None):
+                pass
+
+            def flush(self, metrics):
+                time.sleep(60)
+
+            def flush_other_samples(self, samples):
+                pass
+
+        fast = ChannelMetricSink()
+        cfg = Config(statsd_listen_addresses=[], interval="86400s",
+                     aggregates=["count"])
+        server = Server(cfg, metric_sinks=[fast, StuckSink()])
+        server.start()
+        try:
+            server.store.process_metric(p.parse_metric(b"ok.c:1|c"))
+            done = []
+            t = threading.Thread(
+                target=lambda: (server.flush(), done.append(1)),
+                daemon=True)
+            t.start()
+            # the fast sink must receive the batch promptly even though
+            # the stuck sink sleeps for a minute
+            by = {m.name for m in fast.get_flush(timeout=20)}
+            assert "ok.c" in by
+        finally:
+            server._stop.set()

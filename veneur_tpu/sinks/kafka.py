@@ -16,9 +16,10 @@ Behavioral port of ``/root/reference/sinks/kafka/kafka.go``:
 
 The producer itself is injectable — the reference's tests swap in a
 sarama mock (kafka_test.go); here any object with
-``produce(topic, value)`` works. The default producer requires the
-optional ``kafka`` package (not bundled); construction fails with a
-clear error when absent.
+``produce(topic, value)`` works. The default producer prefers the
+optional ``kafka`` client package and falls back to the bundled
+stdlib wire-protocol producer (``sinks/kafka_wire.py``) when it is
+absent, so the sink works out of the box.
 """
 
 from __future__ import annotations
@@ -63,17 +64,30 @@ class ProducerConfig:
 
 
 def new_producer(brokers: str, config: ProducerConfig) -> Producer:
-    """Build a real Kafka producer (kafka.go:155-172). Requires the
-    optional ``kafka`` client package."""
+    """Build a real Kafka producer (kafka.go:155-172): the optional
+    ``kafka`` client package when installed, else the bundled stdlib
+    wire-protocol producer (sinks/kafka_wire.py)."""
     broker_list = [b for b in brokers.split(",") if b]
     if not broker_list:
         raise ValueError("No brokers in broker list")
     try:
         from kafka import KafkaProducer  # optional, not bundled
-    except ImportError as e:
-        raise RuntimeError(
-            "kafka sink requires the 'kafka' package; install it or inject "
-            "a producer") from e
+    except ImportError:
+        from veneur_tpu.sinks.kafka_wire import WireProducer
+
+        if config.buffer_bytes or config.buffer_messages or \
+                config.buffer_frequency:
+            log.warning("the bundled wire producer sends synchronously; "
+                        "buffer_bytes/buffer_messages/buffer_frequency "
+                        "are ignored (install the kafka package for "
+                        "batched sends)")
+        acks = {"all": -1, "none": 0, "local": 1}[config.normalized_acks()]
+        # default the port like the kafka client does
+        normalized = ",".join(b if ":" in b else f"{b}:9092"
+                              for b in broker_list)
+        return WireProducer(
+            normalized, acks=acks, retry_max=config.retries,
+            partitioner=config.partitioner or "hash")
     acks = {"all": "all", "none": 0, "local": 1}[config.normalized_acks()]
     kwargs = dict(
         bootstrap_servers=broker_list, acks=acks,
