@@ -1,0 +1,224 @@
+"""SlabDigestBank: the capacity-planned large-cardinality digest bank.
+
+Oracles: the dense single-plane ops path (veneur_tpu.ops.tdigest) on the
+same samples — per-row results must match across slab boundaries, storage
+dtypes, and roles, mirroring the per-sampler merge semantics of the
+reference (samplers_test.go:49-560, histo_test.go:11-25)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from veneur_tpu.core.slab import SlabDigestBank
+from veneur_tpu.ops import tdigest as td_ops
+
+C = 100.0
+QS = [0.25, 0.5, 0.9, 0.99]
+
+
+def _exact_check(pcts, rows, vals, stride=7, tol=0.05):
+    """Rank-error oracle: the RANK of each reported quantile value among
+    the row's exact samples stays within tol of q. (Value-space checks
+    are the wrong oracle at tail jumps: the reference's uniform
+    centroid interpolation — merging_digest.go:297-327, no singleton
+    special case — can legitimately land anywhere inside the gap next to
+    an outlier; its own accuracy tests are rank-based, histo_test.go:11-25.)
+    """
+    for row in range(0, int(rows.max()) + 1, stride):
+        mine = np.sort(vals[rows == row])
+        n = len(mine)
+        if n < 32:
+            continue
+        for j, q in enumerate(QS):
+            lo = np.searchsorted(mine, pcts[row, j], "left") / n
+            hi = np.searchsorted(mine, pcts[row, j], "right") / n
+            err = 0.0 if lo <= q <= hi else min(abs(lo - q), abs(hi - q))
+            assert err < tol, (
+                f"row {row} q{q}: value {pcts[row, j]} has rank "
+                f"[{lo:.3f},{hi:.3f}], want {q}")
+
+
+class TestLocalRole:
+    def test_multi_slab_matches_dense_path(self):
+        """3 slabs of 64 rows == one dense 192-row digest batch."""
+        S, N = 192, 20000
+        rng = np.random.default_rng(0)
+        rows = rng.integers(0, S, N).astype(np.int32)
+        vals = rng.gamma(2.0, 30.0, N).astype(np.float32)
+        wts = np.ones(N, np.float32)
+
+        bank = SlabDigestBank(S, C, slab_rows=64)
+        bank.ingest(rows, vals, wts)
+        out = bank.flush(QS)
+
+        k = td_ops.size_bound(C)
+        temp = td_ops.init_temp(S, k, C)
+        temp = td_ops.ingest_chunk(temp, jnp.asarray(rows),
+                                   jnp.asarray(vals), jnp.asarray(wts), C)
+        digest = td_ops.init((S,), C, k)
+        drained, pcts = td_ops.drain_and_quantile(
+            digest, temp, jnp.full((S,), jnp.inf), jnp.full((S,), -jnp.inf),
+            jnp.asarray(QS, jnp.float32), C)
+
+        np.testing.assert_allclose(out["percentiles"], np.asarray(pcts),
+                                   rtol=1e-5, atol=1e-4)
+        np.testing.assert_allclose(out["count"],
+                                   np.bincount(rows, weights=wts,
+                                               minlength=S), rtol=1e-6)
+        np.testing.assert_allclose(out["min"],
+                                   [vals[rows == r].min() for r in range(S)],
+                                   rtol=1e-6)
+        _exact_check(out["percentiles"], rows, vals)
+
+    def test_ingest_slab_local_rows(self):
+        """Pre-partitioned per-slab ingest equals global-row ingest."""
+        S, N = 128, 8000
+        rng = np.random.default_rng(1)
+        rows = rng.integers(0, S, N).astype(np.int32)
+        vals = rng.normal(50, 12, N).astype(np.float32)
+        wts = np.ones(N, np.float32)
+
+        a = SlabDigestBank(S, C, slab_rows=64)
+        a.ingest(rows, vals, wts)
+        b = SlabDigestBank(S, C, slab_rows=64)
+        for i in range(b.num_slabs):
+            sel = (rows >= i * 64) & (rows < (i + 1) * 64)
+            b.ingest_slab(i, rows[sel] - i * 64, vals[sel], wts[sel])
+        oa, ob = a.flush(QS), b.flush(QS)
+        np.testing.assert_allclose(oa["percentiles"], ob["percentiles"],
+                                   rtol=1e-5, atol=1e-4)
+        np.testing.assert_array_equal(oa["count"], ob["count"])
+
+    def test_flush_resets_state(self):
+        S = 64
+        rng = np.random.default_rng(2)
+        bank = SlabDigestBank(S, C, slab_rows=64)
+        rows = rng.integers(0, S, 4000).astype(np.int32)
+        vals = rng.normal(0, 1, 4000).astype(np.float32)
+        bank.ingest(rows, vals, np.ones(4000, np.float32))
+        first = bank.flush(QS)
+        assert first["count"].sum() > 0
+        second = bank.flush(QS)
+        assert second["count"].sum() == 0
+        assert np.isnan(second["percentiles"]).all()
+
+    def test_bf16_storage_within_tolerance(self):
+        """bf16 resident digests: same flush results within 2^-8 relative
+        (storage rounding), still inside the digest error envelope."""
+        S, N = 96, 30000
+        rng = np.random.default_rng(3)
+        rows = rng.integers(0, S, N).astype(np.int32)
+        vals = rng.gamma(3.0, 20.0, N).astype(np.float32)
+        wts = np.ones(N, np.float32)
+
+        f32 = SlabDigestBank(S, C, slab_rows=32, digest_dtype=jnp.float32)
+        b16 = SlabDigestBank(S, C, slab_rows=32, digest_dtype=jnp.bfloat16)
+        for bank in (f32, b16):
+            bank.ingest(rows, vals, wts)
+        of, ob = f32.flush(QS), b16.flush(QS)
+        # counts come from the f32 scalar stats: exact in BOTH banks
+        np.testing.assert_array_equal(of["count"], ob["count"])
+        span = of["max"] - of["min"]
+        assert (np.abs(of["percentiles"] - ob["percentiles"])
+                / np.maximum(span[:, None], 1e-6)).max() < 0.01
+        _exact_check(ob["percentiles"], rows, vals, stride=5)
+
+    def test_multi_interval_bf16(self):
+        """bf16 rounding must not accumulate across drains within an
+        interval: 8 successive chunks, then flush."""
+        S = 32
+        rng = np.random.default_rng(4)
+        bank = SlabDigestBank(S, C, slab_rows=32, digest_dtype=jnp.bfloat16)
+        allr, allv = [], []
+        for _ in range(8):
+            rows = rng.integers(0, S, 5000).astype(np.int32)
+            vals = rng.normal(100, 25, 5000).astype(np.float32)
+            bank.ingest(rows, vals, np.ones(5000, np.float32))
+            allr.append(rows)
+            allv.append(vals)
+        out = bank.flush(QS)
+        _exact_check(out["percentiles"], np.concatenate(allr),
+                     np.concatenate(allv), stride=3)
+
+
+class TestMergeRole:
+    def _forwarded(self, rng, S, k):
+        """A host's forwarded digest batch: [S, k] centroids + extrema."""
+        rows = rng.integers(0, S, 20000).astype(np.int32)
+        vals = rng.gamma(2.0, 40.0, 20000).astype(np.float32)
+        temp = td_ops.init_temp(S, k, C)
+        temp = td_ops.ingest_chunk(temp, jnp.asarray(rows),
+                                   jnp.asarray(vals),
+                                   jnp.ones((20000,), jnp.float32), C)
+        d = td_ops.drain_temp(td_ops.init((S,), C, k), temp, C)
+        return d, rows, vals
+
+    def test_merge_matches_ops_merge(self):
+        """Slab-wise merge of two hosts == td_ops.merge on the dense path."""
+        S = 128
+        k = td_ops.size_bound(C)
+        rng = np.random.default_rng(5)
+        d1, r1, v1 = self._forwarded(rng, S, k)
+        d2, r2, v2 = self._forwarded(rng, S, k)
+
+        bank = SlabDigestBank(S, C, slab_rows=64, mode="merge")
+        for d in (d1, d2):
+            for i in range(bank.num_slabs):
+                sl = slice(i * 64, (i + 1) * 64)
+                bank.merge_digests(i, np.asarray(d.mean[sl]),
+                                   np.asarray(d.weight[sl]),
+                                   np.asarray(d.min[sl]),
+                                   np.asarray(d.max[sl]))
+        out = bank.flush(QS)
+
+        # oracle: merge into an empty dense digest, then quantile
+        merged = td_ops.merge(d1, d2, C)
+        pcts = td_ops.quantile(merged, jnp.asarray(QS, jnp.float32))
+        span = np.asarray(merged.max - merged.min)
+        diff = (np.abs(out["percentiles"] - np.asarray(pcts))
+                / np.maximum(span[:, None], 1e-6))
+        assert diff.max() < 0.02
+        np.testing.assert_allclose(out["count"],
+                                   np.asarray(merged.count()), rtol=1e-5)
+        _exact_check(out["percentiles"], np.concatenate([r1, r2]),
+                     np.concatenate([v1, v2]), stride=11)
+
+    def test_merge_mode_has_no_temp(self):
+        bank = SlabDigestBank(256, C, slab_rows=128, mode="merge")
+        assert all(t is None for t in bank.temps)
+        with pytest.raises(AssertionError):
+            bank.ingest(np.zeros(4, np.int32), np.ones(4, np.float32),
+                        np.ones(4, np.float32))
+
+
+class TestCapacityPlan:
+    def test_hbm_accounting(self):
+        k = td_ops.size_bound(C)
+        bank = SlabDigestBank(1 << 21, C, slab_rows=1 << 20,
+                              digest_dtype=jnp.bfloat16)
+        plan = bank.hbm_bytes()
+        assert plan["num_slabs"] == 2
+        assert plan["digest_bytes"] == 2 * ((1 << 20) * k * 2 * 2
+                                            + (1 << 20) * 4 * 2)
+        assert plan["temp_bytes"] == 2 * ((1 << 20) * k * 4 * 2
+                                          + (1 << 20) * 4 * 5)
+
+    def test_north_star_fits_v5e(self):
+        """The 10M bf16 local plan stays under a 16 GB v5e-1 HBM."""
+        bank = SlabDigestBank(10_000_000, C, digest_dtype=jnp.bfloat16)
+        plan = bank.hbm_bytes()
+        resident = plan["total_bytes"] + plan["slab_transient_bytes"]
+        assert resident < 15 * 2**30, f"{resident / 2**30:.1f} GB"
+
+    def test_partial_last_slab(self):
+        """num_series not a slab multiple: padded rows stay silent."""
+        S = 100
+        rng = np.random.default_rng(6)
+        bank = SlabDigestBank(S, C, slab_rows=64)
+        assert bank.num_slabs == 2
+        rows = rng.integers(0, S, 5000).astype(np.int32)
+        vals = rng.normal(10, 2, 5000).astype(np.float32)
+        bank.ingest(rows, vals, np.ones(5000, np.float32))
+        out = bank.flush(QS)
+        assert out["percentiles"].shape == (S, len(QS))
+        assert out["count"].sum() == 5000

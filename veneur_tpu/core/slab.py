@@ -1,0 +1,339 @@
+"""Capacity-planned t-digest bank for multi-million-series cardinality.
+
+The dense ``DigestGroup`` (core/store.py) keeps one resident ``[S, K]``
+plane per digest field. Two things stop that layout short of the 10M-series
+north star (BASELINE.md) on a 16 GB v5e-1:
+
+  * TPU tiling pads the trailing axis to 128 lanes, so a ``[S, 104]`` f32
+    plane costs 1.23x its logical bytes (and the old K=160 cost 1.6x);
+  * the flush program (sort + drain + quantile over the whole plane) peaks
+    at several times the resident size.
+
+This bank re-plans the capacity:
+
+  * state lives in **flat 1-D planes** per slab (``[slab*K]``), which tile
+    without lane padding — resident bytes == logical bytes;
+  * the digest planes can be stored **bfloat16** (``digest_dtype``): all
+    kernel math stays f32 (upcast per slab), only storage is rounded.
+    Weight rounding perturbs quantile positions by <= 2^-8 relative — far
+    inside the t-digest error envelope (eps=.02, histo_test.go:11-25) —
+    and exact counts ride the separate f32 scalar stats, so nothing the
+    flusher emits as a counter is ever rounded;
+  * every device program touches ONE slab (<= 1M rows): peak transient
+    memory is slab-sized, and each Pallas operand stays under Mosaic's
+    2 GiB (32-bit byte offset) limit.
+
+Capacity plan this buys on one 16 GB v5e-1 (K=104, 1M-row slabs):
+
+  | series | digest dtype | resident | role |
+  |--------|--------------|----------|------|
+  |  4M    | f32          |  6.7 GB  | local (samples -> temp -> drain) |
+  | 10M    | bf16         | 12.6 GB  | local, the north-star config     |
+  | 10M    | bf16, merge  |  4.3 GB  | global (imported digest merges)  |
+
+The 10M f32 local config needs ~16.7 GB resident and therefore two chips
+(or DP sharding via the mesh store, core/mesh_store.py) — that is the
+stated path beyond 10M as well: the series axis is embarrassingly
+shardable, so N chips multiply every row in this table by N.
+
+Reference behavior re-expressed here: Worker.Flush + Histo.Flush
+(flusher.go:134-254, samplers/samplers.go:511-636) for the local role,
+ImportMetricGRPC -> tdigest.Merge (worker.go:354-398) for the global one.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import List, NamedTuple, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from veneur_tpu.ops import tdigest as td_ops
+
+SLAB_ROWS_DEFAULT = 1 << 20
+
+
+class DigestSlab(NamedTuple):
+    """Resident state for one slab of series rows (flat planes)."""
+
+    mean: jax.Array      # [slab*K] storage dtype; +inf = empty slot
+    weight: jax.Array    # [slab*K] storage dtype; 0 = empty slot
+    dmin: jax.Array      # [slab] f32 observed minima (+inf when empty)
+    dmax: jax.Array      # [slab] f32 observed maxima (-inf when empty)
+
+
+class TempSlab(NamedTuple):
+    """Interval accumulators for one slab (local role only), flat planes."""
+
+    sum_w: jax.Array     # [slab*K] f32
+    sum_wm: jax.Array    # [slab*K] f32
+    count: jax.Array     # [slab] f32
+    vsum: jax.Array      # [slab] f32
+    vmin: jax.Array      # [slab] f32
+    vmax: jax.Array      # [slab] f32
+    recip: jax.Array     # [slab] f32
+
+
+def _init_digest_slab(slab: int, k: int, dtype) -> DigestSlab:
+    return DigestSlab(
+        mean=jnp.full((slab * k,), jnp.inf, dtype),
+        weight=jnp.zeros((slab * k,), dtype),
+        dmin=jnp.full((slab,), jnp.inf, jnp.float32),
+        dmax=jnp.full((slab,), -jnp.inf, jnp.float32),
+    )
+
+
+def _init_temp_slab(slab: int, k: int) -> TempSlab:
+    return TempSlab(
+        sum_w=jnp.zeros((slab * k,), jnp.float32),
+        sum_wm=jnp.zeros((slab * k,), jnp.float32),
+        count=jnp.zeros((slab,), jnp.float32),
+        vsum=jnp.zeros((slab,), jnp.float32),
+        vmin=jnp.full((slab,), jnp.inf, jnp.float32),
+        vmax=jnp.full((slab,), -jnp.inf, jnp.float32),
+        recip=jnp.zeros((slab,), jnp.float32),
+    )
+
+
+@partial(jax.jit, donate_argnums=(0,), static_argnums=(4, 5))
+def _ingest_slab(temp: TempSlab, rows, values, weights, slab: int,
+                 compression: float) -> TempSlab:
+    """Scatter one flat sample chunk into a slab's flat accumulators.
+
+    rows: [N] LOCAL row ids; anything >= slab is padding / out-of-slab and
+    must scatter nowhere (flat index >= slab*K with mode='drop')."""
+    k = temp.sum_w.shape[0] // slab
+    oor = rows >= slab
+    r, v, w, b = td_ops.bin_flat_samples(
+        jnp.where(oor, slab, rows), values,
+        jnp.where(oor, 0.0, weights), slab, k, compression)
+    live = w > 0
+    vz = jnp.where(live, v, 0.0)
+    flat = jnp.where(r >= slab, slab * k, r * k + b)
+    return TempSlab(
+        sum_w=temp.sum_w.at[flat].add(w, mode="drop"),
+        sum_wm=temp.sum_wm.at[flat].add(w * vz, mode="drop"),
+        count=temp.count.at[r].add(w, mode="drop"),
+        vsum=temp.vsum.at[r].add(w * vz, mode="drop"),
+        vmin=temp.vmin.at[r].min(jnp.where(live, v, jnp.inf), mode="drop"),
+        vmax=temp.vmax.at[r].max(jnp.where(live, v, -jnp.inf), mode="drop"),
+        recip=temp.recip.at[r].add(jnp.where(live, w / v, 0.0), mode="drop"),
+    )
+
+
+@partial(jax.jit, donate_argnums=(0, 1), static_argnums=(3, 4))
+def _flush_slab(digest: DigestSlab, temp: TempSlab, qs, slab: int,
+                compression: float):
+    """Drain one slab's temp into its digests and emit percentiles.
+
+    Returns (fresh empty digest+temp for the next interval, drained digest
+    planes in storage dtype, percentiles [slab, P], scalar stats)."""
+    k = digest.mean.shape[0] // slab
+    dt = digest.mean.dtype
+    d = td_ops.TDigest(
+        mean=digest.mean.reshape(slab, k).astype(jnp.float32),
+        weight=digest.weight.reshape(slab, k).astype(jnp.float32),
+        min=digest.dmin, max=digest.dmax)
+    t = td_ops.TempCentroids(
+        sum_w=temp.sum_w.reshape(slab, k), sum_wm=temp.sum_wm.reshape(slab, k),
+        count=temp.count, vsum=temp.vsum, vmin=temp.vmin, vmax=temp.vmax,
+        recip=temp.recip)
+    inf = jnp.full((slab,), jnp.inf, jnp.float32)
+    drained, pcts = td_ops.drain_and_quantile(d, t, inf, -inf, qs,
+                                              compression)
+    out_mean = drained.mean.astype(dt).reshape(-1)
+    out_weight = drained.weight.astype(dt).reshape(-1)
+    fresh_d = _init_digest_slab(slab, k, dt)
+    fresh_t = _init_temp_slab(slab, k)
+    return (fresh_d, fresh_t, out_mean, out_weight, drained.min, drained.max,
+            pcts, temp.count, temp.vsum, temp.vmin, temp.vmax, temp.recip)
+
+
+@partial(jax.jit, donate_argnums=(0,), static_argnums=(5, 6))
+def _merge_slab(digest: DigestSlab, in_mean, in_weight, in_min, in_max,
+                slab: int, compression: float) -> DigestSlab:
+    """Merge one slab of imported digests into the resident state (the
+    global-aggregator path: tdigest.Merge, worker.go:354-398).
+
+    in_mean/in_weight: [slab, M] f32, weight==0 padding; rows need not be
+    sorted. in_min/in_max: [slab] f32."""
+    k = digest.mean.shape[0] // slab
+    dt = digest.mean.dtype
+    own_m = digest.mean.reshape(slab, k).astype(jnp.float32)
+    own_w = digest.weight.reshape(slab, k).astype(jnp.float32)
+    live = in_weight > 0
+    key = jnp.where(live, in_mean, jnp.inf)
+    key, w_in = lax.sort((key, in_weight), dimension=-1, num_keys=1,
+                         is_stable=False)
+    new_m, new_w = td_ops._dispatch_compress_presorted(
+        own_m, own_w, key, w_in, compression, k)
+    return DigestSlab(
+        mean=new_m.astype(dt).reshape(-1),
+        weight=new_w.astype(dt).reshape(-1),
+        dmin=jnp.minimum(digest.dmin, in_min),
+        dmax=jnp.maximum(digest.dmax, in_max),
+    )
+
+
+@partial(jax.jit, donate_argnums=(0,), static_argnums=(2, 3))
+def _quantile_slab(digest: DigestSlab, qs, slab: int, compression: float):
+    """Flush a merge-mode slab: percentiles + counts from the resident
+    digests alone, then reset (the global role has no temp accumulators)."""
+    k = digest.mean.shape[0] // slab
+    dt = digest.mean.dtype
+    d = td_ops.TDigest(
+        mean=digest.mean.reshape(slab, k).astype(jnp.float32),
+        weight=digest.weight.reshape(slab, k).astype(jnp.float32),
+        min=digest.dmin, max=digest.dmax)
+    pcts = td_ops.quantile(d, qs)
+    counts = d.count()
+    return _init_digest_slab(slab, k, dt), pcts, counts, d.min, d.max
+
+
+class SlabDigestBank:
+    """A bank of ``num_series`` t-digests held as flat per-slab planes.
+
+    mode='local': samples stream in via :meth:`ingest` / :meth:`ingest_slab`
+    into per-slab temp accumulators; :meth:`flush` drains them (the fused
+    Pallas program per slab) and returns percentiles + scalar stats.
+
+    mode='merge': no temp planes; imported digests merge straight into the
+    resident state via :meth:`merge_digests`; :meth:`flush` emits
+    percentiles/counts and resets — the single-chip global-aggregator
+    kernel (BASELINE config #4's on-chip half).
+    """
+
+    def __init__(self, num_series: int,
+                 compression: float = td_ops.DEFAULT_COMPRESSION,
+                 slab_rows: int = SLAB_ROWS_DEFAULT,
+                 digest_dtype=jnp.float32,
+                 mode: str = "local"):
+        if mode not in ("local", "merge"):
+            raise ValueError(f"unknown mode {mode!r}")
+        self.num_series = num_series
+        self.compression = compression
+        self.k = td_ops.size_bound(compression)
+        # <= 1M rows per slab (Mosaic 2 GiB operand bound), and never a
+        # slab wider than the bank itself — small banks must not allocate
+        # or time a full default-width slab (rounded up to the kernel's
+        # 128-row block)
+        self.slab_rows = min(slab_rows, 1 << 20,
+                             max(-(-num_series // 128) * 128, 8))
+        self.num_slabs = -(-num_series // self.slab_rows)
+        self.digest_dtype = jnp.dtype(digest_dtype)
+        self.mode = mode
+        self.digests: List[DigestSlab] = [
+            _init_digest_slab(self.slab_rows, self.k, self.digest_dtype)
+            for _ in range(self.num_slabs)]
+        self.temps: List[Optional[TempSlab]] = [
+            _init_temp_slab(self.slab_rows, self.k) if mode == "local"
+            else None
+            for _ in range(self.num_slabs)]
+
+    # -- capacity plan ----------------------------------------------------
+
+    def hbm_bytes(self) -> dict:
+        """Resident-plane byte accounting (flat planes tile unpadded)."""
+        dsz = self.digest_dtype.itemsize
+        per_slab_digest = self.slab_rows * self.k * dsz * 2 \
+            + self.slab_rows * 4 * 2
+        per_slab_temp = (self.slab_rows * self.k * 4 * 2
+                         + self.slab_rows * 4 * 5) \
+            if self.mode == "local" else 0
+        total = self.num_slabs * (per_slab_digest + per_slab_temp)
+        return {
+            "digest_bytes": self.num_slabs * per_slab_digest,
+            "temp_bytes": self.num_slabs * per_slab_temp,
+            "total_bytes": total,
+            "slab_transient_bytes": self.slab_rows * self.k * 4 * 6,
+            "num_slabs": self.num_slabs,
+            "k": self.k,
+        }
+
+    # -- local role: sample ingest ---------------------------------------
+
+    def ingest_slab(self, slab_idx: int, rows, values, weights):
+        """Fold a flat chunk of samples whose rows are LOCAL to one slab."""
+        assert self.mode == "local"
+        self.temps[slab_idx] = _ingest_slab(
+            self.temps[slab_idx], jnp.asarray(rows), jnp.asarray(values),
+            jnp.asarray(weights), self.slab_rows, self.compression)
+
+    def ingest(self, rows, values, weights):
+        """Fold a flat chunk with GLOBAL row ids: each slab scatters the
+        in-range subset (out-of-range ids drop on-device, so one chunk
+        costs num_slabs scatter programs — pre-partition by slab where the
+        producer can, cf. the native reader's shard split)."""
+        assert self.mode == "local"
+        rows = jnp.asarray(rows)
+        values = jnp.asarray(values)
+        weights = jnp.asarray(weights)
+        for i in range(self.num_slabs):
+            base = i * self.slab_rows
+            local = jnp.where((rows >= base)
+                              & (rows < base + self.slab_rows),
+                              rows - base, self.slab_rows)
+            self.temps[i] = _ingest_slab(
+                self.temps[i], local, values, weights, self.slab_rows,
+                self.compression)
+
+    # -- global role: digest import --------------------------------------
+
+    def merge_digests(self, slab_idx: int, mean, weight, mins, maxs):
+        """Merge imported digests for one slab: mean/weight [slab, M] f32
+        (weight==0 padding), mins/maxs [slab] f32."""
+        self.digests[slab_idx] = _merge_slab(
+            self.digests[slab_idx], jnp.asarray(mean, jnp.float32),
+            jnp.asarray(weight, jnp.float32),
+            jnp.asarray(mins, jnp.float32), jnp.asarray(maxs, jnp.float32),
+            self.slab_rows, self.compression)
+
+    # -- flush ------------------------------------------------------------
+
+    def flush(self, percentiles: Sequence[float], fetch: bool = True,
+              want_digest: bool = False):
+        """Drain every slab; returns a dict of np arrays over all series
+        (or per-slab device arrays when fetch=False, for benchmarking).
+
+        want_digest=True additionally keeps each slab's drained digest
+        planes (for the forward/export path). At 10M series that is
+        ~4 GB of extra live output — leave it off unless the caller
+        actually forwards."""
+        qs = jnp.asarray(list(percentiles), jnp.float32)
+        outs = []
+        for i in range(self.num_slabs):
+            if self.mode == "local":
+                (self.digests[i], self.temps[i], mean, weight, dmin, dmax,
+                 pcts, count, vsum, vmin, vmax, recip) = _flush_slab(
+                    self.digests[i], self.temps[i], qs, self.slab_rows,
+                    self.compression)
+                out = {"percentiles": pcts, "count": count,
+                       "sum": vsum, "min": vmin, "max": vmax,
+                       "recip": recip}
+                if want_digest:
+                    out["digest_mean"] = mean
+                    out["digest_weight"] = weight
+                outs.append(out)
+            else:
+                (self.digests[i], pcts, counts, dmin, dmax) = _quantile_slab(
+                    self.digests[i], qs, self.slab_rows, self.compression)
+                outs.append({"percentiles": pcts, "count": counts,
+                             "min": dmin, "max": dmax})
+        if not fetch:
+            return outs
+        n = self.num_series
+        host = [jax.device_get(o) for o in outs]
+        keys = host[0].keys()
+        return {key: np.concatenate([h[key] for h in host], axis=0)[:n]
+                for key in keys if key not in ("digest_mean",
+                                               "digest_weight")}
+
+    def block_until_ready(self):
+        for d in self.digests:
+            jax.block_until_ready(d.weight)
+        for t in self.temps:
+            if t is not None:
+                jax.block_until_ready(t.sum_w)

@@ -1,0 +1,90 @@
+// ThreadSanitizer driver for the native ingest path.
+//
+// The reference leans on Go's race detector in CI for its reader
+// goroutines (SURVEY §5); this is the C++ equivalent for our
+// SO_REUSEPORT reader pool: N reader threads recvmmsg + parse into
+// mutex-guarded batches while the main thread swaps batches out and
+// polls the atomic counters, with sender threads blasting DogStatsD
+// datagrams at the shared port the whole time.
+//
+// Built single-TU (includes veneur_ingest.cpp) so every function is
+// instrumented. Run by tests/test_native_tsan.py with
+// TSAN_OPTIONS=halt_on_error=1; any data race fails the test via the
+// sanitizer's exit code.
+
+#include "veneur_ingest.cpp"
+
+#include <arpa/inet.h>
+#include <cstdio>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+void sender_loop(int port, int ndatagrams, int seed) {
+  int fd = socket(AF_INET, SOCK_DGRAM, 0);
+  if (fd < 0) return;
+  sockaddr_in addr;
+  memset(&addr, 0, sizeof(addr));
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  addr.sin_addr.s_addr = inet_addr("127.0.0.1");
+  for (int i = 0; i < ndatagrams; i++) {
+    char buf[512];
+    int n = snprintf(buf, sizeof(buf),
+                     "svc.req.time:%d|ms|@0.5|#env:prod,shard:%d\n"
+                     "svc.req.count:1|c|#env:prod\n"
+                     "svc.users:%d|s\n"
+                     "svc.gauge:%d.5|g|#host:h%d",
+                     (seed + i) % 1000, i % 8, seed + i, i, seed % 4);
+    sendto(fd, buf, n, 0, reinterpret_cast<sockaddr*>(&addr), sizeof(addr));
+    if (i % 64 == 0) usleep(100);  // let readers keep up; drops are fine too
+  }
+  close(fd);
+}
+
+}  // namespace
+
+int main() {
+  void* pool = vt_reader_start("127.0.0.1", 0, /*nreaders=*/4,
+                               /*rcvbuf=*/1 << 20, /*batch_records=*/4096,
+                               /*batch_arena=*/1 << 20, /*dgram_max=*/8192);
+  if (!pool) {
+    fprintf(stderr, "vt_reader_start failed\n");
+    return 2;
+  }
+  int port = vt_reader_port(pool);
+  int nreaders = vt_reader_count(pool);
+
+  std::vector<std::thread> senders;
+  for (int s = 0; s < 3; s++) {
+    senders.emplace_back(sender_loop, port, 4000, s * 100000);
+  }
+
+  // concurrent swap + counter polling while senders and readers run
+  uint64_t records = 0;
+  for (int iter = 0; iter < 150; iter++) {
+    for (int i = 0; i < nreaders; i++) {
+      VtBatch* b = vt_reader_swap(pool, i);
+      records += b->count;
+      (void)vt_reader_packets(pool, i);
+      (void)vt_reader_drops(pool, i);
+    }
+    usleep(2000);
+  }
+  for (auto& t : senders) t.join();
+  usleep(50000);  // drain the tail
+  for (int i = 0; i < nreaders; i++) {
+    records += vt_reader_swap(pool, i)->count;
+  }
+  vt_reader_stop(pool);
+
+  fprintf(stderr, "tsan driver parsed %llu records\n",
+          static_cast<unsigned long long>(records));
+  if (records == 0) {
+    fprintf(stderr, "no records parsed — sender or reader broken\n");
+    return 3;
+  }
+  return 0;
+}

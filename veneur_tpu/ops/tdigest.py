@@ -44,9 +44,17 @@ DEFAULT_COMPRESSION = 100.0
 
 
 def size_bound(compression: float) -> int:
-    """Max number of centroids a digest can hold (merging_digest.go:66-68),
-    rounded up to a multiple of 8 for TPU sublane alignment."""
-    raw = int(math.pi * compression / 2 + 0.5) + 1
+    """Slots a digest needs under this module's floor(k) binning, rounded up
+    to a multiple of 8 for TPU sublane alignment.
+
+    The reference's greedy scan can pack up to ceil(pi*C/2) centroids
+    (merging_digest.go:66-68); our re-derivation assigns cluster id
+    floor(k(q_mid)) with k in [0, C), so at most C+1 bins are ever live
+    (+1 more of fp headroom: the clipped asin can round k to exactly C).
+    Tighter rows mean ~35% less HBM per digest plane and a narrower
+    bitonic merge in the Pallas kernel, with bit-identical results: the
+    extra slots were provably always empty."""
+    raw = int(compression) + 2
     return (raw + 7) // 8 * 8
 
 

@@ -35,6 +35,21 @@ from jax.experimental.pallas import tpu as pltpu
 
 _ROWS = 128          # series rows per kernel block
 _KCHUNK = 16         # output bins reduced per inner step
+# Mosaic addresses kernel operands with 32-bit byte offsets, so any single
+# pallas_call operand must stay under 2 GiB. Rows beyond this bound are
+# processed in row-slabs (the padded [slab, 256] f32 plane at 1M rows is
+# 1 GiB); the slab loop unrolls into a handful of kernel launches that XLA
+# schedules back-to-back over the same HBM planes.
+_MAX_SLAB_ROWS = 1 << 20
+
+
+def _row_slabs(total: int):
+    """Yield (start, size) row spans each small enough for one kernel call."""
+    start = 0
+    while start < total:
+        size = min(_MAX_SLAB_ROWS, total - start)
+        yield start, size
+        start += size
 
 
 def _next_pow2(n: int) -> int:
@@ -140,8 +155,10 @@ def _merge_bin_reduce(ma, wa, mb, wb, compression: float, half: int,
         hit = cluster[:, None, :] == targets[None, :, :]      # [R, KC, M]
         sw_parts.append(jnp.sum(jnp.where(hit, w[:, None, :], 0.0), axis=2))
         swm_parts.append(jnp.sum(jnp.where(hit, wm[:, None, :], 0.0), axis=2))
-    sw = jnp.concatenate(sw_parts, axis=1)                    # [R, K]
-    swm = jnp.concatenate(swm_parts, axis=1)
+    # kout need not be a multiple of _KCHUNK; trim the overshoot (those
+    # bins can never be hit — cluster ids are clipped to kout-1)
+    sw = jnp.concatenate(sw_parts, axis=1)[:, :kout]          # [R, K]
+    swm = jnp.concatenate(swm_parts, axis=1)[:, :kout]
     live_o = sw > 0
     nm = jnp.where(live_o, swm / jnp.where(live_o, sw, 1.0), -jnp.inf)
     return nm, sw
@@ -218,7 +235,25 @@ def _drain_quantile_pallas(mean_a, weight_a, mean_b, weight_b, mn, mx, qs,
                            interpret: bool = False):
     """Fused drain + percentile program. mean_b/weight_b must be
     row-ascending (caller sorts the temp half); mn/mx are the final
-    per-row extrema [S]; qs is [P]."""
+    per-row extrema [S]; qs is [P]. Rows are processed in <= 1M-row slabs
+    to respect Mosaic's 32-bit operand addressing."""
+    s = mean_a.shape[0]
+    if s > _MAX_SLAB_ROWS:
+        outs = [
+            _drain_quantile_slab(
+                mean_a[st:st + sz], weight_a[st:st + sz],
+                mean_b[st:st + sz], weight_b[st:st + sz],
+                mn[st:st + sz], mx[st:st + sz], qs, compression, out_size,
+                interpret)
+            for st, sz in _row_slabs(s)]
+        return tuple(jnp.concatenate(parts, axis=0) for parts in zip(*outs))
+    return _drain_quantile_slab(mean_a, weight_a, mean_b, weight_b, mn, mx,
+                                qs, compression, out_size, interpret)
+
+
+def _drain_quantile_slab(mean_a, weight_a, mean_b, weight_b, mn, mx, qs,
+                         compression: float, out_size: int,
+                         interpret: bool = False):
     s, ka = mean_a.shape
     kb = mean_b.shape[1]
     nq = qs.shape[0]
@@ -280,6 +315,22 @@ def drain_quantile(mean_a, weight_a, mean_b_sorted, weight_b_sorted, mn, mx,
 def _compress_presorted_pallas(mean_a, weight_a, mean_b, weight_b,
                                compression: float, out_size: int,
                                interpret: bool = False):
+    s = mean_a.shape[0]
+    if s > _MAX_SLAB_ROWS:
+        outs = [
+            _compress_presorted_slab(
+                mean_a[st:st + sz], weight_a[st:st + sz],
+                mean_b[st:st + sz], weight_b[st:st + sz],
+                compression, out_size, interpret)
+            for st, sz in _row_slabs(s)]
+        return tuple(jnp.concatenate(parts, axis=0) for parts in zip(*outs))
+    return _compress_presorted_slab(mean_a, weight_a, mean_b, weight_b,
+                                    compression, out_size, interpret)
+
+
+def _compress_presorted_slab(mean_a, weight_a, mean_b, weight_b,
+                             compression: float, out_size: int,
+                             interpret: bool = False):
     s, ka = mean_a.shape
     kb = mean_b.shape[1]
     half = _next_pow2(max(ka, kb))

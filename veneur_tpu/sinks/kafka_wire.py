@@ -85,8 +85,12 @@ class WireProducer:
                  client_id: str = "veneur-tpu"):
         self.bootstrap: List[Tuple[str, int]] = []
         for b in brokers.split(","):
-            host, _, port = b.strip().rpartition(":")
-            self.bootstrap.append((host or "127.0.0.1", int(port)))
+            host, sep, port = b.strip().rpartition(":")
+            if sep and port.isdigit():
+                self.bootstrap.append((host or "127.0.0.1", int(port)))
+            else:
+                # bare hostname: default port, like the kafka clients do
+                self.bootstrap.append((b.strip() or "127.0.0.1", 9092))
         self.acks = acks
         self.timeout_ms = timeout_ms
         self.retry_max = max(0, retry_max)
@@ -188,7 +192,13 @@ class WireProducer:
         parts = self._leaders[topic]
         pids = sorted(parts)
         if key is not None and self.partitioner == "hash":
-            pid = pids[hash(key) % len(pids)]
+            # stable FNV-1a over the key (sarama's HashPartitioner):
+            # Python's builtin hash() is salted per process, which would
+            # scatter one key across partitions between restarts
+            h = 2166136261
+            for byte in key.encode("utf-8"):
+                h = ((h ^ byte) * 16777619) & 0xFFFFFFFF
+            pid = pids[h % len(pids)]
         elif self.partitioner == "random":
             pid = pids[random.randrange(len(pids))]
         else:
