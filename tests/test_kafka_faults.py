@@ -6,6 +6,7 @@ destinations, timeouts) and proves the bundled producer end to end the
 way the reference proves its sarama wiring with mock producers.
 """
 
+import math
 import queue
 import socket
 import struct
@@ -269,3 +270,40 @@ class TestForwardFaults:
             assert "ok.c" in by
         finally:
             server._stop.set()
+
+
+class TestHashPartitioner:
+    def test_sarama_parity(self):
+        """Key->partition must match sarama's HashPartitioner bit-for-bit
+        (FNV-1a 32 -> int32 truncation -> Go %, negatives negated), so a
+        mixed Go/Python fleet co-partitions."""
+        from veneur_tpu.sinks.kafka_wire import WireProducer
+
+        prod = WireProducer("127.0.0.1:9092")
+        prod._leaders["t"] = {0: ("h", 1), 1: ("h", 1), 2: ("h", 1)}
+
+        def sarama(key: str, n: int) -> int:
+            h = 2166136261
+            for byte in key.encode("utf-8"):
+                h = ((h ^ byte) * 16777619) & 0xFFFFFFFF
+            i32 = h - (1 << 32) if h >= (1 << 31) else h
+            # Go's % truncates toward zero
+            p = int(math.fmod(i32, n))
+            return -p if p < 0 else p
+
+        seen = set()
+        for key in [f"series-{i}" for i in range(200)] + ["", "a", "host:x"]:
+            want = sarama(key, 3)
+            pid, _ = prod._pick("t", key)
+            assert pid == want, key
+            seen.add(pid)
+        assert seen == {0, 1, 2}
+
+    def test_broker_parsing(self):
+        from veneur_tpu.sinks.kafka_wire import WireProducer
+
+        assert WireProducer("k1:9093").bootstrap == [("k1", 9093)]
+        assert WireProducer("k1").bootstrap == [("k1", 9092)]
+        assert WireProducer("k1:").bootstrap == [("k1", 9092)]
+        assert WireProducer("k1:9093,k2").bootstrap == [("k1", 9093),
+                                                        ("k2", 9092)]

@@ -183,12 +183,125 @@ class TestMergeRole:
         _exact_check(out["percentiles"], np.concatenate([r1, r2]),
                      np.concatenate([v1, v2]), stride=11)
 
+    def test_bf16_merge_counts_exact(self):
+        """Counts must not stall on bf16 weight rounding: a hot series
+        receives many small imported batches; the reported count is the
+        exact sum (the f32 count plane), not the rounded weight total."""
+        S = 64
+        k = td_ops.size_bound(C)
+        bank = SlabDigestBank(S, C, slab_rows=64, mode="merge",
+                              digest_dtype=jnp.bfloat16)
+        # one centroid per import, always the same mean: the resident
+        # centroid's weight grows past bf16's integer range (256) where
+        # +3.0 increments round away
+        mean = np.full((S, 1), 50.0, np.float32)
+        w = np.full((S, 1), 3.0, np.float32)
+        mins = np.full(S, 50.0, np.float32)
+        maxs = np.full(S, 50.0, np.float32)
+        n_batches = 400
+        for _ in range(n_batches):
+            bank.merge_digests(0, mean, w, mins, maxs)
+        out = bank.flush(QS)
+        np.testing.assert_array_equal(out["count"],
+                                      np.full(S, 3.0 * n_batches))
+
     def test_merge_mode_has_no_temp(self):
         bank = SlabDigestBank(256, C, slab_rows=128, mode="merge")
         assert all(t is None for t in bank.temps)
         with pytest.raises(AssertionError):
             bank.ingest(np.zeros(4, np.int32), np.ones(4, np.float32),
                         np.ones(4, np.float32))
+
+
+class TestStoreWiring:
+    """digest_storage='slab' must be behaviorally identical to the dense
+    store on the same traffic (the store-level oracle that makes the
+    capacity plan a product path, not a bench harness)."""
+
+    def _stores(self):
+        from veneur_tpu.core.store import MetricStore
+
+        dense = MetricStore(initial_capacity=64, chunk=128)
+        slab = MetricStore(initial_capacity=64, chunk=128,
+                           digest_storage="slab", slab_rows=64)
+        return dense, slab
+
+    def _drive(self, store, rng):
+        from veneur_tpu.samplers.parser import (MetricKey, UDPMetric,
+                                                LOCAL_ONLY, MIXED_SCOPE)
+
+        for i in range(150):
+            store.process_metric(UDPMetric(
+                key=MetricKey(name=f"lat{i % 20}", type="timer"),
+                value=float(rng.integers(1, 500)), tags=["route:a"],
+                sample_rate=1.0, scope=MIXED_SCOPE, digest=0))
+            store.process_metric(UDPMetric(
+                key=MetricKey(name=f"hist{i % 7}", type="histogram"),
+                value=float(rng.integers(1, 100)), tags=[],
+                sample_rate=0.5, scope=LOCAL_ONLY, digest=0))
+        store.import_digest(MetricKey(name="fleet.lat", type="histogram"),
+                            ["dc:x"], np.asarray([10.0, 20.0, 30.0]),
+                            np.asarray([1.0, 2.0, 1.0]), 10.0, 30.0)
+
+    def test_store_parity_dense_vs_slab(self):
+        from veneur_tpu.samplers.intermetric import HistogramAggregates
+
+        agg = HistogramAggregates.from_names(
+            ["min", "max", "count", "median"])
+        outs = []
+        for store in self._stores():
+            self._drive(store, np.random.default_rng(9))
+            final, fwd, ms = store.flush([0.5, 0.99], agg, is_local=False,
+                                         now=1000, forward=False)
+            outs.append(sorted((m.name, tuple(m.tags), round(m.value, 2))
+                               for m in final))
+            assert ms.timers == 20 and ms.local_histograms == 7
+        assert outs[0] == outs[1]
+
+    def test_store_slab_forwardable(self):
+        """is_local=True: digests export for forwarding from the slab
+        store exactly as from the dense one."""
+        from veneur_tpu.samplers.intermetric import HistogramAggregates
+
+        agg = HistogramAggregates.from_names(["count"])
+        fwds = []
+        for store in self._stores():
+            self._drive(store, np.random.default_rng(11))
+            _, fwd, _ = store.flush([0.5], agg, is_local=True, now=0,
+                                    forward=True)
+            fwds.append(fwd)
+        a, b = fwds
+        assert len(a.timers) == len(b.timers) == 20
+        for (n1, t1, m1, w1, lo1, hi1), (n2, t2, m2, w2, lo2, hi2) in zip(
+                sorted(a.timers), sorted(b.timers)):
+            assert n1 == n2 and t1 == t2 and lo1 == lo2 and hi1 == hi2
+            np.testing.assert_allclose(m1, m2, rtol=1e-6)
+            np.testing.assert_allclose(w1, w2, rtol=1e-6)
+
+    def test_slab_group_grows(self):
+        from veneur_tpu.core.slab import SlabDigestGroup
+        from veneur_tpu.samplers.parser import MetricKey
+
+        g = SlabDigestGroup(slab_rows=8, chunk=32)
+        for i in range(50):
+            g.sample(MetricKey(name=f"m{i}", type="histogram"), [],
+                     float(i), 1.0)
+        assert g.capacity >= 50 and len(g.digests) >= 7
+        interner, out = g.flush([0.5])
+        assert len(interner.rows) == 50
+        np.testing.assert_allclose(out["count"], np.ones(50))
+        np.testing.assert_allclose(out["median"], np.arange(50.0))
+
+    def test_config_validation(self):
+        from veneur_tpu.config import Config
+
+        Config(digest_storage="slab", digest_dtype="bfloat16").validate()
+        with pytest.raises(ValueError, match="digest_storage"):
+            Config(digest_storage="mmap").validate()
+        with pytest.raises(ValueError, match="digest_dtype"):
+            Config(digest_dtype="float8").validate()
+        with pytest.raises(ValueError, match="bfloat16 requires"):
+            Config(digest_dtype="bfloat16").validate()
 
 
 class TestCapacityPlan:

@@ -126,6 +126,17 @@ class Config:
     store_chunk: int = 16384
     # initial dense-series capacity per scope-class (grows by doubling)
     store_initial_capacity: int = 4096
+    # histogram/timer digest backing store: "dense" (one [S,K] plane per
+    # group, default) or "slab" (flat per-slab planes, the multi-million-
+    # series capacity plan of core/slab.py; grows one slab at a time)
+    digest_storage: str = "dense"
+    # resident digest dtype for the slab store: "float32" or "bfloat16"
+    # (bf16 halves HBM — the 10M-series-per-chip plan; kernel math and
+    # counts stay f32, quantile storage rounding <= 2^-8 relative)
+    digest_dtype: str = "float32"
+    # rows per slab for the slab store (clamped to 1M by Mosaic's 2 GiB
+    # operand bound; smaller slabs bound flush transients tighter)
+    slab_rows: int = 1 << 20
     # drain plain-IPv4 UDP statsd listeners with the C++ recvmmsg reader
     # pool + batch parser when the native library is available
     native_ingest: bool = True
@@ -155,6 +166,23 @@ class Config:
             from veneur_tpu.crash import SentryReporter
 
             SentryReporter(self.sentry_dsn)  # raises on malformed DSN
+        if self.digest_storage not in ("dense", "slab"):
+            raise ValueError(
+                f"digest_storage must be 'dense' or 'slab', got "
+                f"{self.digest_storage!r}")
+        if self.digest_dtype not in ("float32", "bfloat16"):
+            raise ValueError(
+                f"digest_dtype must be 'float32' or 'bfloat16', got "
+                f"{self.digest_dtype!r}")
+        if self.digest_dtype == "bfloat16" and self.digest_storage != "slab":
+            raise ValueError(
+                "digest_dtype: bfloat16 requires digest_storage: slab "
+                "(the dense store is f32-only)")
+        if self.digest_storage == "slab" and self.mesh_enabled:
+            raise ValueError(
+                "digest_storage: slab and mesh_enabled are mutually "
+                "exclusive — the mesh store is its own capacity plan "
+                "(series sharded across chips); pick one")
 
     def apply_defaults(self):
         """Defaults + deprecation shims (config_parse.go:118-185)."""

@@ -43,6 +43,7 @@ ImportMetricGRPC -> tdigest.Merge (worker.go:354-398) for the global one.
 
 from __future__ import annotations
 
+import math
 from functools import partial
 from typing import List, NamedTuple, Optional, Sequence
 
@@ -52,17 +53,26 @@ import numpy as np
 from jax import lax
 
 from veneur_tpu.ops import tdigest as td_ops
+from veneur_tpu.ops.tdigest_pallas import _next_pow2
 
 SLAB_ROWS_DEFAULT = 1 << 20
 
 
 class DigestSlab(NamedTuple):
-    """Resident state for one slab of series rows (flat planes)."""
+    """Resident state for one slab of series rows (flat planes).
+
+    count is an EXACT f32 per-series total maintained alongside the
+    (possibly bf16) centroid weights: merge-mode flushes report it
+    instead of summing rounded weights, so counts never stall on bf16
+    round-to-nearest even when a hot centroid's weight ULP exceeds an
+    imported batch's contribution. (Local mode reports temp.count, which
+    is f32 already; there this plane just rides along.)"""
 
     mean: jax.Array      # [slab*K] storage dtype; +inf = empty slot
     weight: jax.Array    # [slab*K] storage dtype; 0 = empty slot
     dmin: jax.Array      # [slab] f32 observed minima (+inf when empty)
     dmax: jax.Array      # [slab] f32 observed maxima (-inf when empty)
+    count: jax.Array     # [slab] f32 exact total weight
 
 
 class TempSlab(NamedTuple):
@@ -83,6 +93,7 @@ def _init_digest_slab(slab: int, k: int, dtype) -> DigestSlab:
         weight=jnp.zeros((slab * k,), dtype),
         dmin=jnp.full((slab,), jnp.inf, jnp.float32),
         dmax=jnp.full((slab,), -jnp.inf, jnp.float32),
+        count=jnp.zeros((slab,), jnp.float32),
     )
 
 
@@ -122,6 +133,31 @@ def _ingest_slab(temp: TempSlab, rows, values, weights, slab: int,
         vmax=temp.vmax.at[r].max(jnp.where(live, v, -jnp.inf), mode="drop"),
         recip=temp.recip.at[r].add(jnp.where(live, w / v, 0.0), mode="drop"),
     )
+
+
+@partial(jax.jit, donate_argnums=(0, 1), static_argnums=(8, 9))
+def _import_slab(temp: TempSlab, digest: DigestSlab, rows, means, weights,
+                 stat_rows, stat_mins, stat_maxs, slab: int,
+                 compression: float):
+    """Fold imported digest CENTROIDS into a slab's accumulators without
+    touching the local scalar stats (samplers.go:473-480); imported
+    per-digest extrema land on the digest's dmin/dmax planes and only
+    bound the final digest."""
+    k = temp.sum_w.shape[0] // slab
+    oor = rows >= slab
+    r, v, w, b = td_ops.bin_flat_samples(
+        jnp.where(oor, slab, rows), means,
+        jnp.where(oor, 0.0, weights), slab, k, compression)
+    live = w > 0
+    vz = jnp.where(live, v, 0.0)
+    flat = jnp.where(r >= slab, slab * k, r * k + b)
+    temp = temp._replace(
+        sum_w=temp.sum_w.at[flat].add(w, mode="drop"),
+        sum_wm=temp.sum_wm.at[flat].add(w * vz, mode="drop"))
+    digest = digest._replace(
+        dmin=digest.dmin.at[stat_rows].min(stat_mins, mode="drop"),
+        dmax=digest.dmax.at[stat_rows].max(stat_maxs, mode="drop"))
+    return temp, digest
 
 
 @partial(jax.jit, donate_argnums=(0, 1), static_argnums=(3, 4))
@@ -175,6 +211,9 @@ def _merge_slab(digest: DigestSlab, in_mean, in_weight, in_min, in_max,
         weight=new_w.astype(dt).reshape(-1),
         dmin=jnp.minimum(digest.dmin, in_min),
         dmax=jnp.maximum(digest.dmax, in_max),
+        # exact f32 running total, immune to bf16 weight rounding
+        count=digest.count + jnp.sum(jnp.where(live, in_weight, 0.0),
+                                     axis=-1),
     )
 
 
@@ -189,8 +228,8 @@ def _quantile_slab(digest: DigestSlab, qs, slab: int, compression: float):
         weight=digest.weight.reshape(slab, k).astype(jnp.float32),
         min=digest.dmin, max=digest.dmax)
     pcts = td_ops.quantile(d, qs)
-    counts = d.count()
-    return _init_digest_slab(slab, k, dt), pcts, counts, d.min, d.max
+    return (_init_digest_slab(slab, k, dt), pcts, digest.count, d.min,
+            d.max)
 
 
 class SlabDigestBank:
@@ -326,10 +365,14 @@ class SlabDigestBank:
             return outs
         n = self.num_series
         host = [jax.device_get(o) for o in outs]
-        keys = host[0].keys()
-        return {key: np.concatenate([h[key] for h in host], axis=0)[:n]
-                for key in keys if key not in ("digest_mean",
-                                               "digest_weight")}
+        result = {}
+        for key in host[0].keys():
+            cols = [h[key] for h in host]
+            if key in ("digest_mean", "digest_weight"):
+                # flat [slab*K] planes -> [S, K] rows
+                cols = [c.reshape(self.slab_rows, self.k) for c in cols]
+            result[key] = np.concatenate(cols, axis=0)[:n]
+        return result
 
     def block_until_ready(self):
         for d in self.digests:
@@ -337,3 +380,261 @@ class SlabDigestBank:
         for t in self.temps:
             if t is not None:
                 jax.block_until_ready(t.sum_w)
+
+
+class SlabDigestGroup:
+    """Drop-in ``DigestGroup`` replacement backed by slab state: the
+    store-facing adapter that makes the 10M-series capacity plan a server
+    configuration (``digest_storage: slab``) rather than a bench harness.
+
+    Same public surface as ``core.store.DigestGroup`` — interner, sample /
+    sample_many / import_centroids staging, flush -> (interner, result
+    dict) with identical keys — but state lives in flat per-slab planes
+    (optionally bf16), capacity grows slab-at-a-time instead of
+    reallocating one dense plane, and the flush fetches each slab's
+    results right after its device program so peak extra memory stays
+    slab-sized.
+
+    Staged chunks are partitioned by slab on the host and padded to
+    power-of-two lengths, so each (slab width, chunk pow2) pair compiles
+    once — at most ~log2(chunk) program variants per group.
+    """
+
+    def __init__(self, slab_rows: int = SLAB_ROWS_DEFAULT,
+                 chunk: int = 1 << 16,
+                 compression: float = td_ops.DEFAULT_COMPRESSION,
+                 digest_dtype=jnp.float32):
+        from veneur_tpu.core.store import Interner
+
+        self._interner_cls = Interner
+        self.interner = Interner()
+        self.compression = compression
+        self.k = td_ops.size_bound(compression)
+        self.chunk = chunk
+        self.slab_rows = min(slab_rows, 1 << 20)
+        self.digest_dtype = jnp.dtype(digest_dtype)
+        self.digests: List[DigestSlab] = [
+            _init_digest_slab(self.slab_rows, self.k, self.digest_dtype)]
+        self.temps: List[TempSlab] = [
+            _init_temp_slab(self.slab_rows, self.k)]
+        self._device_dirty = False
+        self._new_sample_buffers()
+        self._new_import_buffers()
+
+    # -- capacity ---------------------------------------------------------
+
+    @property
+    def capacity(self) -> int:
+        return len(self.digests) * self.slab_rows
+
+    def __len__(self):
+        return len(self.interner)
+
+    def ensure_capacity(self, max_row: int):
+        while max_row >= self.capacity:
+            self.digests.append(
+                _init_digest_slab(self.slab_rows, self.k, self.digest_dtype))
+            self.temps.append(_init_temp_slab(self.slab_rows, self.k))
+            # stale sentinels from before the grow are harmless (their
+            # weights are 0) but re-point them anyway, like DigestGroup
+            self._rows[self._fill:] = self.capacity
+            self._imp_rows[self._imp_fill:] = self.capacity
+
+    def _row(self, key, tags) -> int:
+        row = self.interner.intern(key, tags)
+        if row >= self.capacity:
+            self.ensure_capacity(row)
+        return row
+
+    # -- staging ----------------------------------------------------------
+
+    def _new_sample_buffers(self):
+        self._rows = np.full(self.chunk, self.capacity, np.int32)
+        self._vals = np.zeros(self.chunk, np.float32)
+        self._wts = np.zeros(self.chunk, np.float32)
+        self._fill = 0
+
+    def _new_import_buffers(self):
+        self._imp_rows = np.full(self.chunk, self.capacity, np.int32)
+        self._imp_means = np.zeros(self.chunk, np.float32)
+        self._imp_wts = np.zeros(self.chunk, np.float32)
+        self._imp_fill = 0
+        self._imp_stat_rows: List[int] = []
+        self._imp_stat_mins: List[float] = []
+        self._imp_stat_maxs: List[float] = []
+
+    def sample(self, key, tags, value: float, sample_rate: float):
+        row = self._row(key, tags)
+        i = self._fill
+        self._rows[i] = row
+        self._vals[i] = value
+        self._wts[i] = np.float32(1.0) / np.float32(sample_rate)
+        self._fill = i + 1
+        if self._fill == self.chunk:
+            self._drain_samples()
+
+    def sample_many(self, rows: np.ndarray, vals: np.ndarray,
+                    wts: np.ndarray):
+        n = len(rows)
+        start = 0
+        while start < n:
+            if self._fill == self.chunk:
+                self._drain_samples()
+            take = min(self.chunk - self._fill, n - start)
+            i = self._fill
+            self._rows[i:i + take] = rows[start:start + take]
+            self._vals[i:i + take] = vals[start:start + take]
+            self._wts[i:i + take] = wts[start:start + take]
+            self._fill = i + take
+            start += take
+        if self._fill == self.chunk:
+            self._drain_samples()
+
+    def import_centroids(self, key, tags, means: np.ndarray,
+                         weights: np.ndarray, dmin: float, dmax: float):
+        row = self._row(key, tags)
+        n = len(means)
+        start = 0
+        while start < n:
+            if self._imp_fill == self.chunk:
+                self._drain_imports()
+            take = min(self.chunk - self._imp_fill, n - start)
+            i = self._imp_fill
+            self._imp_rows[i:i + take] = row
+            self._imp_means[i:i + take] = means[start:start + take]
+            self._imp_wts[i:i + take] = weights[start:start + take]
+            self._imp_fill = i + take
+            start += take
+        if math.isfinite(dmin):
+            self._imp_stat_rows.append(row)
+            self._imp_stat_mins.append(dmin)
+            self._imp_stat_maxs.append(dmax)
+            if len(self._imp_stat_rows) >= self.chunk:
+                self._drain_imports()
+
+    # -- drains -----------------------------------------------------------
+
+    def _per_slab(self, rows, *arrays):
+        """Partition staged entries by slab; yields (slab_idx, local_rows,
+        arrays...) padded to power-of-two lengths (bounded jit variants)."""
+        slabs = rows // self.slab_rows
+        for i in np.unique(slabs):
+            if i < 0 or i >= len(self.digests):
+                continue  # sentinel padding rows
+            sel = slabs == i
+            m = int(sel.sum())
+            pad = _next_pow2(m)
+            local = np.full(pad, self.slab_rows, np.int32)
+            local[:m] = rows[sel] - i * self.slab_rows
+            padded = []
+            for a in arrays:
+                buf = np.zeros(pad, a.dtype)
+                buf[:m] = a[sel]
+                padded.append(buf)
+            yield int(i), local, padded
+
+    def _drain_samples(self):
+        if self._fill == 0:
+            return
+        self._device_dirty = True
+        rows, vals, wts = self._rows, self._vals, self._wts
+        self._new_sample_buffers()
+        for i, local, (v, w) in self._per_slab(rows, vals, wts):
+            self.temps[i] = _ingest_slab(
+                self.temps[i], jnp.asarray(local), jnp.asarray(v),
+                jnp.asarray(w), self.slab_rows, self.compression)
+
+    def _drain_imports(self):
+        if self._imp_fill == 0 and not self._imp_stat_rows:
+            return
+        self._device_dirty = True
+        rows, means, wts = self._imp_rows, self._imp_means, self._imp_wts
+        stat_rows = np.asarray(self._imp_stat_rows, np.int32)
+        stat_mins = np.asarray(self._imp_stat_mins, np.float32)
+        stat_maxs = np.asarray(self._imp_stat_maxs, np.float32)
+        self._new_import_buffers()
+        # centroid scatter per touched slab
+        by_slab = {i: (local, padded)
+                   for i, local, padded in self._per_slab(rows, means, wts)}
+        # extrema per touched slab
+        stats = {i: (local, padded) for i, local, padded in
+                 self._per_slab(stat_rows, stat_mins, stat_maxs)} \
+            if len(stat_rows) else {}
+        empty_f = np.zeros(2, np.float32)
+        empty_r = np.full(2, self.slab_rows, np.int32)
+        for i in sorted(set(by_slab) | set(stats)):
+            c_local, c_pad = by_slab.get(
+                i, (empty_r, [empty_f, empty_f]))
+            s_local, s_pad = stats.get(
+                i, (empty_r, [np.full(2, np.inf, np.float32),
+                              np.full(2, -np.inf, np.float32)]))
+            self.temps[i], self.digests[i] = _import_slab(
+                self.temps[i], self.digests[i],
+                jnp.asarray(c_local), jnp.asarray(c_pad[0]),
+                jnp.asarray(c_pad[1]), jnp.asarray(s_local),
+                jnp.asarray(s_pad[0]), jnp.asarray(s_pad[1]),
+                self.slab_rows, self.compression)
+
+    def _drain_staging(self):
+        self._drain_samples()
+        self._drain_imports()
+
+    # -- flush ------------------------------------------------------------
+
+    def _reset_device(self):
+        nslabs = len(self.digests)
+        self.digests = [
+            _init_digest_slab(self.slab_rows, self.k, self.digest_dtype)
+            for _ in range(nslabs)]
+        self.temps = [_init_temp_slab(self.slab_rows, self.k)
+                      for _ in range(nslabs)]
+        self._device_dirty = False
+
+    def flush(self, percentiles: List[float]):
+        """Drain + percentile every slab; identical contract to
+        DigestGroup.flush: (old interner, dict of host arrays [:n])."""
+        self._drain_staging()
+        n = len(self.interner)
+        interner, self.interner = self.interner, self._interner_cls()
+        if n == 0:
+            if self._device_dirty:
+                self._reset_device()
+            self._new_sample_buffers()
+            self._new_import_buffers()
+            return interner, {}
+        qs = jnp.asarray(list(percentiles) + [0.5], jnp.float32)
+        parts = []
+        for i in range(len(self.digests)):
+            need = min(n - i * self.slab_rows, self.slab_rows)
+            (self.digests[i], self.temps[i], mean, weight, dmin, dmax,
+             pcts, count, vsum, vmin, vmax, recip) = _flush_slab(
+                self.digests[i], self.temps[i], qs, self.slab_rows,
+                self.compression)
+            if need <= 0:
+                continue
+            k = self.k
+            # fetch this slab's interned prefix NOW so the device buffers
+            # free before the next slab's program runs
+            parts.append(jax.device_get((
+                mean.reshape(self.slab_rows, k)[:need].astype(jnp.float32),
+                weight.reshape(self.slab_rows, k)[:need].astype(jnp.float32),
+                dmin[:need], dmax[:need], pcts[:need], count[:need],
+                vsum[:need], vmin[:need], vmax[:need], recip[:need])))
+        (d_mean, d_weight, d_min, d_max, pcts, count, vsum, vmin, vmax,
+         recip) = (np.concatenate(cols, axis=0) for cols in zip(*parts))
+        self._device_dirty = False
+        self._new_sample_buffers()
+        self._new_import_buffers()
+        return interner, {
+            "digest_mean": d_mean,
+            "digest_weight": d_weight,
+            "digest_min": d_min,
+            "digest_max": d_max,
+            "percentiles": pcts[:, :-1],
+            "median": pcts[:, -1],
+            "count": count,
+            "sum": vsum,
+            "min": vmin,
+            "max": vmax,
+            "recip": recip,
+        }

@@ -863,9 +863,14 @@ class MetricStore:
                  chunk: int = DEFAULT_CHUNK,
                  compression: float = td_ops.DEFAULT_COMPRESSION,
                  hll_precision: int = hll_ops.DEFAULT_PRECISION,
-                 mesh=None):
+                 mesh=None, digest_storage: str = "dense",
+                 digest_dtype: str = "float32", slab_rows: int = 1 << 20):
         self._lock = threading.RLock()
         self.mesh = mesh
+        if mesh is not None and digest_storage == "slab":
+            raise ValueError(
+                "digest_storage='slab' cannot combine with a device mesh "
+                "(the mesh store shards series across chips instead)")
         self.counters = ScalarGroup("counter", initial_capacity)
         self.global_counters = ScalarGroup("counter", initial_capacity)
         self.gauges = ScalarGroup("gauge", initial_capacity)
@@ -883,12 +888,37 @@ class MetricStore:
                                           compression)
             self.sets = MeshSetGroup(mesh, initial_capacity, chunk,
                                      hll_precision)
+        elif digest_storage == "slab":
+            # the multi-million-series capacity plan (core/slab.py): flat
+            # per-slab planes, optional bf16 residency, slab-wise growth
+            from veneur_tpu.core.slab import SlabDigestGroup
+
+            def slab_group():
+                return SlabDigestGroup(slab_rows=slab_rows, chunk=chunk,
+                                       compression=compression,
+                                       digest_dtype=digest_dtype)
+
+            self.histograms = slab_group()
+            self.timers = slab_group()
+            self.sets = SetGroup(initial_capacity, chunk, hll_precision)
         else:
             self.histograms = DigestGroup(initial_capacity, chunk, compression)
             self.timers = DigestGroup(initial_capacity, chunk, compression)
             self.sets = SetGroup(initial_capacity, chunk, hll_precision)
-        self.local_histograms = DigestGroup(initial_capacity, chunk, compression)
-        self.local_timers = DigestGroup(initial_capacity, chunk, compression)
+        if digest_storage == "slab" and mesh is None:
+            from veneur_tpu.core.slab import SlabDigestGroup
+
+            self.local_histograms = SlabDigestGroup(
+                slab_rows=slab_rows, chunk=chunk, compression=compression,
+                digest_dtype=digest_dtype)
+            self.local_timers = SlabDigestGroup(
+                slab_rows=slab_rows, chunk=chunk, compression=compression,
+                digest_dtype=digest_dtype)
+        else:
+            self.local_histograms = DigestGroup(initial_capacity, chunk,
+                                                compression)
+            self.local_timers = DigestGroup(initial_capacity, chunk,
+                                            compression)
         self.local_sets = SetGroup(initial_capacity, chunk, hll_precision)
         self.heavy_hitters = HeavyHitterGroup(initial_capacity, chunk)
         self.hll_precision = hll_precision

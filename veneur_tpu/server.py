@@ -128,6 +128,9 @@ class Server:
             compression=config.tdigest_compression,
             hll_precision=config.hll_precision,
             mesh=mesh,
+            digest_storage=config.digest_storage,
+            digest_dtype=config.digest_dtype,
+            slab_rows=config.slab_rows,
         )
         self.event_worker = EventWorker()
         self.span_chan: "queue.Queue" = queue.Queue(config.span_channel_capacity)

@@ -89,8 +89,10 @@ class WireProducer:
             if sep and port.isdigit():
                 self.bootstrap.append((host or "127.0.0.1", int(port)))
             else:
-                # bare hostname: default port, like the kafka clients do
-                self.bootstrap.append((b.strip() or "127.0.0.1", 9092))
+                # bare hostname (or trailing colon): default port 9092,
+                # like the kafka clients do
+                bare = host if sep else b.strip()
+                self.bootstrap.append((bare or "127.0.0.1", 9092))
         self.acks = acks
         self.timeout_ms = timeout_ms
         self.retry_max = max(0, retry_max)
@@ -192,13 +194,18 @@ class WireProducer:
         parts = self._leaders[topic]
         pids = sorted(parts)
         if key is not None and self.partitioner == "hash":
-            # stable FNV-1a over the key (sarama's HashPartitioner):
-            # Python's builtin hash() is salted per process, which would
-            # scatter one key across partitions between restarts
+            # sarama's HashPartitioner, bit-for-bit: FNV-1a 32, then the
+            # hash reinterpreted as int32 with negative partitions negated
+            # — co-partitioning with Go producers/consumers depends on it.
+            # (Python's builtin hash() is salted per process and would
+            # scatter one key across partitions between restarts.)
             h = 2166136261
             for byte in key.encode("utf-8"):
                 h = ((h ^ byte) * 16777619) & 0xFFFFFFFF
-            pid = pids[h % len(pids)]
+            if h >= 1 << 31:
+                h -= 1 << 32  # int32 reinterpretation
+            p = h % len(pids) if h >= 0 else -((-h) % len(pids))
+            pid = pids[-p if p < 0 else p]
         elif self.partitioner == "random":
             pid = pids[random.randrange(len(pids))]
         else:
