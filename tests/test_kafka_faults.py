@@ -281,6 +281,7 @@ class TestHashPartitioner:
 
         prod = WireProducer("127.0.0.1:9092")
         prod._leaders["t"] = {0: ("h", 1), 1: ("h", 1), 2: ("h", 1)}
+        prod._npartitions["t"] = 3
 
         def sarama(key: str, n: int) -> int:
             h = 2166136261
@@ -298,6 +299,35 @@ class TestHashPartitioner:
             assert pid == want, key
             seen.add(pid)
         assert seen == {0, 1, 2}
+
+    def test_leaderless_partition_fails_not_reroutes(self):
+        """A key hashing to a mid-election partition must error (so
+        produce() retries after re-learning metadata), NOT silently land
+        on a different partition than the Go fleet would use."""
+        import pytest as _pytest
+
+        from veneur_tpu.sinks.kafka_wire import WireProducer
+
+        prod = WireProducer("127.0.0.1:9092")
+        prod._npartitions["t"] = 3
+        prod._leaders["t"] = {0: ("h", 1), 2: ("h", 1)}  # 1 leaderless
+        key = next(k for k in (f"k{i}" for i in range(100))
+                   if self._fnv_mod(k, 3) == 1)
+        with _pytest.raises(RuntimeError, match="no leader"):
+            prod._pick("t", key)
+        # keys for healthy partitions still resolve to the sarama slot
+        ok = next(k for k in (f"k{i}" for i in range(100))
+                  if self._fnv_mod(k, 3) == 2)
+        assert prod._pick("t", ok)[0] == 2
+
+    @staticmethod
+    def _fnv_mod(key: str, n: int) -> int:
+        h = 2166136261
+        for byte in key.encode("utf-8"):
+            h = ((h ^ byte) * 16777619) & 0xFFFFFFFF
+        if h >= 1 << 31:
+            h -= 1 << 32
+        return abs(h) % n
 
     def test_broker_parsing(self):
         from veneur_tpu.sinks.kafka_wire import WireProducer

@@ -160,3 +160,49 @@ class TestCompressKernel:
         assert np.abs(got - want).max() < 1e-4
         # strictly monotone (bin edges must not reorder)
         assert (np.diff(got) >= 0).all()
+
+
+class TestInKernelSort:
+    """sort_b: the in-VMEM descending bitonic sort of the b half. Unused
+    by the default pipelines (measured slower on v5e, where the kernel is
+    VMEM-bound — see tdigest.drain_temp) but kept as a tested capability
+    for shapes/hardware where the external lax.sort loses."""
+
+    def test_sort_b_matches_presorted(self):
+        # narrow digest (C=20 -> K=24, half=32): the full-width interpret
+        # lowering of the 28-stage sort compiles pathologically slowly on
+        # XLA CPU; the network logic is width-generic
+        S, C, K = 130, 20.0, td.size_bound(20.0)
+        rng = np.random.default_rng(0)
+        ma = jnp.asarray(np.sort(rng.normal(0, 1, (S, K)), axis=1)
+                         .astype(np.float32))
+        wa = jnp.asarray(rng.uniform(0.5, 2, (S, K)).astype(np.float32))
+        mb_raw = rng.normal(0, 1, (S, K)).astype(np.float32)
+        wb_raw = rng.uniform(0.5, 2, (S, K)).astype(np.float32)
+        dead = rng.uniform(0, 1, (S, K)) < 0.3
+        mb_raw[dead] = np.inf
+        wb_raw[dead] = 0.0
+        order = np.argsort(np.where(wb_raw > 0, mb_raw, np.inf), axis=1)
+        mb_s = jnp.asarray(np.take_along_axis(mb_raw, order, 1))
+        wb_s = jnp.asarray(np.take_along_axis(wb_raw, order, 1))
+        mb, wb = jnp.asarray(mb_raw), jnp.asarray(wb_raw)
+
+        nm1, nw1 = tp.compress_presorted(ma, wa, mb_s, wb_s, C, K,
+                                         interpret=True)
+        nm2, nw2 = tp.compress_presorted(ma, wa, mb, wb, C, K,
+                                         interpret=True, sort_b=True)
+        np.testing.assert_allclose(np.asarray(nw1), np.asarray(nw2),
+                                   rtol=1e-6, atol=1e-6)
+        live = np.asarray(nw1) > 0
+        np.testing.assert_allclose(np.asarray(nm1)[live],
+                                   np.asarray(nm2)[live], rtol=1e-5)
+
+        mn = jnp.full((S,), -5.0, jnp.float32)
+        mx = jnp.full((S,), 5.0, jnp.float32)
+        qs = jnp.asarray([0.1, 0.5, 0.9], jnp.float32)
+        o1 = tp.drain_quantile(ma, wa, mb_s, wb_s, mn, mx, qs, C, K,
+                               interpret=True)
+        o2 = tp.drain_quantile(ma, wa, mb, wb, mn, mx, qs, C, K,
+                               interpret=True, sort_b=True)
+        np.testing.assert_allclose(np.asarray(o1[2]), np.asarray(o2[2]),
+                                   rtol=1e-5, atol=1e-5)

@@ -178,6 +178,9 @@ class Config:
             raise ValueError(
                 "digest_dtype: bfloat16 requires digest_storage: slab "
                 "(the dense store is f32-only)")
+        if self.slab_rows <= 0:
+            raise ValueError(f"slab_rows must be positive, got "
+                             f"{self.slab_rows}")
         if self.digest_storage == "slab" and self.mesh_enabled:
             raise ValueError(
                 "digest_storage: slab and mesh_enabled are mutually "

@@ -160,13 +160,15 @@ def _import_slab(temp: TempSlab, digest: DigestSlab, rows, means, weights,
     return temp, digest
 
 
-@partial(jax.jit, donate_argnums=(0, 1), static_argnums=(3, 4))
+@partial(jax.jit, donate_argnums=(0, 1), static_argnums=(3, 4, 5))
 def _flush_slab(digest: DigestSlab, temp: TempSlab, qs, slab: int,
-                compression: float):
+                compression: float, want_digest: bool = True):
     """Drain one slab's temp into its digests and emit percentiles.
 
     Returns (fresh empty digest+temp for the next interval, drained digest
-    planes in storage dtype, percentiles [slab, P], scalar stats)."""
+    planes in storage dtype — or None/None when want_digest=False, which
+    saves a full-plane cast+write per flush — percentiles [slab, P],
+    scalar stats)."""
     k = digest.mean.shape[0] // slab
     dt = digest.mean.dtype
     d = td_ops.TDigest(
@@ -180,8 +182,11 @@ def _flush_slab(digest: DigestSlab, temp: TempSlab, qs, slab: int,
     inf = jnp.full((slab,), jnp.inf, jnp.float32)
     drained, pcts = td_ops.drain_and_quantile(d, t, inf, -inf, qs,
                                               compression)
-    out_mean = drained.mean.astype(dt).reshape(-1)
-    out_weight = drained.weight.astype(dt).reshape(-1)
+    if want_digest:
+        out_mean = drained.mean.astype(dt).reshape(-1)
+        out_weight = drained.weight.astype(dt).reshape(-1)
+    else:
+        out_mean = out_weight = None
     fresh_d = _init_digest_slab(slab, k, dt)
     fresh_t = _init_temp_slab(slab, k)
     return (fresh_d, fresh_t, out_mean, out_weight, drained.min, drained.max,
@@ -252,6 +257,10 @@ class SlabDigestBank:
                  mode: str = "local"):
         if mode not in ("local", "merge"):
             raise ValueError(f"unknown mode {mode!r}")
+        if slab_rows <= 0 or num_series <= 0:
+            raise ValueError(
+                f"slab_rows and num_series must be positive, got "
+                f"{slab_rows}/{num_series}")
         self.num_series = num_series
         self.compression = compression
         self.k = td_ops.size_bound(compression)
@@ -348,7 +357,7 @@ class SlabDigestBank:
                 (self.digests[i], self.temps[i], mean, weight, dmin, dmax,
                  pcts, count, vsum, vmin, vmax, recip) = _flush_slab(
                     self.digests[i], self.temps[i], qs, self.slab_rows,
-                    self.compression)
+                    self.compression, want_digest)
                 out = {"percentiles": pcts, "count": count,
                        "sum": vsum, "min": vmin, "max": vmax,
                        "recip": recip}
@@ -411,6 +420,8 @@ class SlabDigestGroup:
         self.compression = compression
         self.k = td_ops.size_bound(compression)
         self.chunk = chunk
+        if slab_rows <= 0:
+            raise ValueError(f"slab_rows must be positive, got {slab_rows}")
         self.slab_rows = min(slab_rows, 1 << 20)
         self.digest_dtype = jnp.dtype(digest_dtype)
         self.digests: List[DigestSlab] = [

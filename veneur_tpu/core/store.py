@@ -871,6 +871,17 @@ class MetricStore:
             raise ValueError(
                 "digest_storage='slab' cannot combine with a device mesh "
                 "(the mesh store shards series across chips instead)")
+
+        def _slab_group():
+            # the multi-million-series capacity plan (core/slab.py): flat
+            # per-slab planes, optional bf16 residency, slab-wise growth
+            from veneur_tpu.core.slab import SlabDigestGroup
+
+            return SlabDigestGroup(slab_rows=slab_rows, chunk=chunk,
+                                   compression=compression,
+                                   digest_dtype=digest_dtype)
+
+        self._slab_group = _slab_group
         self.counters = ScalarGroup("counter", initial_capacity)
         self.global_counters = ScalarGroup("counter", initial_capacity)
         self.gauges = ScalarGroup("gauge", initial_capacity)
@@ -889,31 +900,16 @@ class MetricStore:
             self.sets = MeshSetGroup(mesh, initial_capacity, chunk,
                                      hll_precision)
         elif digest_storage == "slab":
-            # the multi-million-series capacity plan (core/slab.py): flat
-            # per-slab planes, optional bf16 residency, slab-wise growth
-            from veneur_tpu.core.slab import SlabDigestGroup
-
-            def slab_group():
-                return SlabDigestGroup(slab_rows=slab_rows, chunk=chunk,
-                                       compression=compression,
-                                       digest_dtype=digest_dtype)
-
-            self.histograms = slab_group()
-            self.timers = slab_group()
+            self.histograms = self._slab_group()
+            self.timers = self._slab_group()
             self.sets = SetGroup(initial_capacity, chunk, hll_precision)
         else:
             self.histograms = DigestGroup(initial_capacity, chunk, compression)
             self.timers = DigestGroup(initial_capacity, chunk, compression)
             self.sets = SetGroup(initial_capacity, chunk, hll_precision)
-        if digest_storage == "slab" and mesh is None:
-            from veneur_tpu.core.slab import SlabDigestGroup
-
-            self.local_histograms = SlabDigestGroup(
-                slab_rows=slab_rows, chunk=chunk, compression=compression,
-                digest_dtype=digest_dtype)
-            self.local_timers = SlabDigestGroup(
-                slab_rows=slab_rows, chunk=chunk, compression=compression,
-                digest_dtype=digest_dtype)
+        if digest_storage == "slab":
+            self.local_histograms = self._slab_group()
+            self.local_timers = self._slab_group()
         else:
             self.local_histograms = DigestGroup(initial_capacity, chunk,
                                                 compression)

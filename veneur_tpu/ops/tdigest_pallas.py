@@ -93,6 +93,46 @@ def _bitonic_merge(key: jax.Array, w: jax.Array):
     return key, w
 
 
+def _bitonic_sort_desc(key: jax.Array, w: jax.Array):
+    """Full bitonic sort DESCENDING along axis 1 (length must be a power
+    of two). Empty slots carry key=+inf and therefore sort to the FRONT —
+    exactly the layout the merge stage expects for the b half (the
+    pre-reversed ascending list). Replaces the callers' XLA lax.sort,
+    which round-trips HBM on every one of its ~log^2 passes; here the
+    whole network runs on the block in VMEM."""
+    l = key.shape[1]
+    iota = jax.lax.broadcasted_iota(jnp.int32, key.shape, 1)
+    k = 2
+    while k <= l:
+        # bitonic direction per k-block, inverted for a descending result
+        # (at k == l the sign is uniform: one final descending pass).
+        # Encoded as a per-position key sign flip — "keep min of the
+        # signed key" — because Mosaic cannot select between i1 vectors.
+        sk_sign = jnp.where((iota & k) == 0, -1.0, 1.0)
+        j = k // 2
+        while j >= 1:
+            lead = (iota & j) == 0
+            sk = sk_sign * key
+            sk_up = _shift_left(sk, j, jnp.inf)
+            sk_dn = _shift_right(sk, j, -jnp.inf)
+            k_up = _shift_left(key, j, jnp.inf)
+            k_dn = _shift_right(key, j, -jnp.inf)
+            w_up = _shift_left(w, j, 0.0)
+            w_dn = _shift_right(w, j, 0.0)
+            swap_lead = sk > sk_up          # lead keeps the signed min
+            swap_trail = sk_dn > sk         # trail keeps the signed max
+            new_key = jnp.where(lead,
+                                jnp.where(swap_lead, k_up, key),
+                                jnp.where(swap_trail, k_dn, key))
+            new_w = jnp.where(lead,
+                              jnp.where(swap_lead, w_up, w),
+                              jnp.where(swap_trail, w_dn, w))
+            key, w = new_key, new_w
+            j //= 2
+        k *= 2
+    return key, w
+
+
 def _prefix_sum(x: jax.Array) -> jax.Array:
     """Inclusive prefix sum along axis 1 via log-step shifts."""
     d = 1
@@ -113,17 +153,20 @@ def _asin_poly(x: jax.Array) -> jax.Array:
 
 
 def _compress_kernel(ma_ref, wa_ref, mb_ref, wb_ref, om_ref, ow_ref, *,
-                     compression: float, half: int, kout: int, m: int):
+                     compression: float, half: int, kout: int, m: int,
+                     sort_b: bool):
     nm, sw = _merge_bin_reduce(ma_ref[...], wa_ref[...], mb_ref[...],
-                               wb_ref[...], compression, half, kout, m)
+                               wb_ref[...], compression, half, kout, m,
+                               sort_b)
     om_ref[...] = nm
     ow_ref[...] = sw
 
 
 def _merge_bin_reduce(ma, wa, mb, wb, compression: float, half: int,
-                      kout: int, m: int):
-    """Shared kernel body: bitonic-merge the two halves (b pre-reversed),
-    assign k-scale bins, and segment-reduce into kout output bins.
+                      kout: int, m: int, sort_b: bool = False):
+    """Shared kernel body: bitonic-merge the two halves (b pre-reversed —
+    or, with sort_b, sorted descending right here in VMEM), assign
+    k-scale bins, and segment-reduce into kout output bins.
     Returns (nm, sw) with dead bins carrying mean == -inf."""
     rows = ma.shape[0]
 
@@ -133,6 +176,11 @@ def _merge_bin_reduce(ma, wa, mb, wb, compression: float, half: int,
         return jnp.concatenate(
             [x, jnp.full((rows, width - x.shape[1]), fill, x.dtype)], axis=1)
 
+    if sort_b:
+        # unsorted b half (empties = +inf): descending in-kernel sort
+        # lands +inf pads in front — the same layout the pre-reversed
+        # path produces, at VMEM cost instead of ~log^2 HBM sort passes
+        mb, wb = _bitonic_sort_desc(mb, wb)
     key = jnp.concatenate([pad_to(ma, half, jnp.inf), mb], axis=1)
     w = jnp.concatenate([pad_to(wa, half, 0.0), wb], axis=1)
     key, w = _bitonic_merge(key, w)
@@ -218,10 +266,11 @@ def _kernel_quantiles(nm, sw, mn, mx, qs, kout: int, nq: int):
 
 def _drain_kernel(ma_ref, wa_ref, mb_ref, wb_ref, mn_ref, mx_ref, qs_ref,
                   om_ref, ow_ref, pct_ref, *, compression: float, half: int,
-                  kout: int, m: int, nq: int):
+                  kout: int, m: int, nq: int, sort_b: bool):
     """compress + quantile fused: one VMEM round for the whole flush."""
     nm, sw = _merge_bin_reduce(ma_ref[...], wa_ref[...], mb_ref[...],
-                               wb_ref[...], compression, half, kout, m)
+                               wb_ref[...], compression, half, kout, m,
+                               sort_b)
     om_ref[...] = nm
     ow_ref[...] = sw
     pct_ref[...] = _kernel_quantiles(nm, sw, mn_ref[...], mx_ref[...],
@@ -229,12 +278,14 @@ def _drain_kernel(ma_ref, wa_ref, mb_ref, wb_ref, mn_ref, mx_ref, qs_ref,
 
 
 @functools.partial(jax.jit,
-                   static_argnames=("compression", "out_size", "interpret"))
+                   static_argnames=("compression", "out_size", "interpret",
+                                    "sort_b"))
 def _drain_quantile_pallas(mean_a, weight_a, mean_b, weight_b, mn, mx, qs,
                            compression: float, out_size: int,
-                           interpret: bool = False):
+                           interpret: bool = False, sort_b: bool = False):
     """Fused drain + percentile program. mean_b/weight_b must be
-    row-ascending (caller sorts the temp half); mn/mx are the final
+    row-ascending — or arbitrary-order with sort_b=True (empties = +inf),
+    in which case the kernel sorts them in VMEM. mn/mx are the final
     per-row extrema [S]; qs is [P]. Rows are processed in <= 1M-row slabs
     to respect Mosaic's 32-bit operand addressing."""
     s = mean_a.shape[0]
@@ -244,16 +295,16 @@ def _drain_quantile_pallas(mean_a, weight_a, mean_b, weight_b, mn, mx, qs,
                 mean_a[st:st + sz], weight_a[st:st + sz],
                 mean_b[st:st + sz], weight_b[st:st + sz],
                 mn[st:st + sz], mx[st:st + sz], qs, compression, out_size,
-                interpret)
+                interpret, sort_b)
             for st, sz in _row_slabs(s)]
         return tuple(jnp.concatenate(parts, axis=0) for parts in zip(*outs))
     return _drain_quantile_slab(mean_a, weight_a, mean_b, weight_b, mn, mx,
-                                qs, compression, out_size, interpret)
+                                qs, compression, out_size, interpret, sort_b)
 
 
 def _drain_quantile_slab(mean_a, weight_a, mean_b, weight_b, mn, mx, qs,
                          compression: float, out_size: int,
-                         interpret: bool = False):
+                         interpret: bool = False, sort_b: bool = False):
     s, ka = mean_a.shape
     kb = mean_b.shape[1]
     nq = qs.shape[0]
@@ -268,13 +319,17 @@ def _drain_quantile_slab(mean_a, weight_a, mean_b, weight_b, mn, mx, qs,
         mn, mx = zf(mn, jnp.inf), zf(mx, -jnp.inf)
     sp = s + pad_rows
     kb_real = kb
-    mean_b = jnp.flip(jnp.pad(mean_b, ((0, 0), (0, half - kb)),
-                              constant_values=jnp.inf), axis=1)
-    weight_b = jnp.flip(jnp.pad(weight_b, ((0, 0), (0, half - kb))), axis=1)
+    mean_b = jnp.pad(mean_b, ((0, 0), (0, half - kb)),
+                     constant_values=jnp.inf)
+    weight_b = jnp.pad(weight_b, ((0, 0), (0, half - kb)))
+    if not sort_b:
+        # pre-reversed ascending list: +inf pads land in front
+        mean_b = jnp.flip(mean_b, axis=1)
+        weight_b = jnp.flip(weight_b, axis=1)
 
     kernel = functools.partial(_drain_kernel, compression=compression,
                                half=half, kout=out_size, m=ka + kb_real,
-                               nq=nq)
+                               nq=nq, sort_b=sort_b)
     out_mean, out_w, pcts = pl.pallas_call(
         kernel,
         grid=(sp // rows,),
@@ -300,37 +355,42 @@ def _drain_quantile_slab(mean_a, weight_a, mean_b, weight_b, mn, mx, qs,
     return out_mean, out_w, pcts
 
 
-def drain_quantile(mean_a, weight_a, mean_b_sorted, weight_b_sorted, mn, mx,
+def drain_quantile(mean_a, weight_a, mean_b, weight_b, mn, mx,
                    qs, compression: float, out_size: int,
-                   interpret: bool = False):
-    """Public fused drain+quantile; caller guarantees both halves are
-    row-ascending and mn/mx are the final extrema."""
-    return _drain_quantile_pallas(mean_a, weight_a, mean_b_sorted,
-                                  weight_b_sorted, mn, mx, qs, compression,
-                                  out_size, interpret=interpret)
+                   interpret: bool = False, sort_b: bool = False):
+    """Public fused drain+quantile; the a half must be row-ascending and
+    mn/mx the final extrema. The b half must be row-ascending too unless
+    sort_b=True (then any order, empties carrying mean=+inf, sorted on
+    the block in VMEM — cheaper than a caller-side lax.sort)."""
+    return _drain_quantile_pallas(mean_a, weight_a, mean_b,
+                                  weight_b, mn, mx, qs, compression,
+                                  out_size, interpret=interpret,
+                                  sort_b=sort_b)
 
 
 @functools.partial(jax.jit,
-                   static_argnames=("compression", "out_size", "interpret"))
+                   static_argnames=("compression", "out_size", "interpret",
+                                    "sort_b"))
 def _compress_presorted_pallas(mean_a, weight_a, mean_b, weight_b,
                                compression: float, out_size: int,
-                               interpret: bool = False):
+                               interpret: bool = False,
+                               sort_b: bool = False):
     s = mean_a.shape[0]
     if s > _MAX_SLAB_ROWS:
         outs = [
             _compress_presorted_slab(
                 mean_a[st:st + sz], weight_a[st:st + sz],
                 mean_b[st:st + sz], weight_b[st:st + sz],
-                compression, out_size, interpret)
+                compression, out_size, interpret, sort_b)
             for st, sz in _row_slabs(s)]
         return tuple(jnp.concatenate(parts, axis=0) for parts in zip(*outs))
     return _compress_presorted_slab(mean_a, weight_a, mean_b, weight_b,
-                                    compression, out_size, interpret)
+                                    compression, out_size, interpret, sort_b)
 
 
 def _compress_presorted_slab(mean_a, weight_a, mean_b, weight_b,
                              compression: float, out_size: int,
-                             interpret: bool = False):
+                             interpret: bool = False, sort_b: bool = False):
     s, ka = mean_a.shape
     kb = mean_b.shape[1]
     half = _next_pow2(max(ka, kb))
@@ -342,15 +402,19 @@ def _compress_presorted_slab(mean_a, weight_a, mean_b, weight_b,
         mean_a, weight_a = zf(mean_a, jnp.inf), zf(weight_a, 0.0)
         mean_b, weight_b = zf(mean_b, jnp.inf), zf(weight_b, 0.0)
     sp = s + pad_rows
-    # pre-reverse (and pre-pad) the descending half outside the kernel
     kb_real = kb
-    mean_b = jnp.flip(jnp.pad(mean_b, ((0, 0), (0, half - kb)),
-                              constant_values=jnp.inf), axis=1)
-    weight_b = jnp.flip(jnp.pad(weight_b, ((0, 0), (0, half - kb))), axis=1)
+    mean_b = jnp.pad(mean_b, ((0, 0), (0, half - kb)),
+                     constant_values=jnp.inf)
+    weight_b = jnp.pad(weight_b, ((0, 0), (0, half - kb)))
+    if not sort_b:
+        # pre-reverse the (already ascending) half outside the kernel
+        mean_b = jnp.flip(mean_b, axis=1)
+        weight_b = jnp.flip(weight_b, axis=1)
     kb = half
 
     kernel = functools.partial(_compress_kernel, compression=compression,
-                               half=half, kout=out_size, m=ka + kb_real)
+                               half=half, kout=out_size, m=ka + kb_real,
+                               sort_b=sort_b)
     out_mean, out_w = pl.pallas_call(
         kernel,
         grid=(sp // rows,),
@@ -384,13 +448,16 @@ def pallas_ok(mean_a: jax.Array) -> bool:
 
 def compress_presorted(mean_a, weight_a, mean_b, weight_b,
                        compression: float, out_size: int,
-                       interpret: bool = False):
-    """Fused compress of two row-ascending centroid lists; falls back to
-    the sort-based XLA compress off-TPU / for unsupported shapes."""
+                       interpret: bool = False, sort_b: bool = False):
+    """Fused compress of a row-ascending list with a second list that is
+    either row-ascending or (sort_b=True) arbitrary-order with empties at
+    mean=+inf; falls back to the sort-based XLA compress off-TPU / for
+    unsupported shapes (which sorts everything itself, so sort_b only
+    matters on the kernel path)."""
     if interpret or pallas_ok(mean_a):
         return _compress_presorted_pallas(
             mean_a, weight_a, mean_b, weight_b, compression, out_size,
-            interpret=interpret)
+            interpret=interpret, sort_b=sort_b)
     from veneur_tpu.ops import tdigest as td
 
     return td._compress(jnp.concatenate([mean_a, mean_b], axis=-1),

@@ -103,6 +103,8 @@ class WireProducer:
         self._conns: Dict[Tuple[str, int], socket.socket] = {}
         # topic -> (partition -> broker addr)
         self._leaders: Dict[str, Dict[int, Tuple[str, int]]] = {}
+        # topic -> total partition count (incl. leaderless; hash modulus)
+        self._npartitions: Dict[str, int] = {}
         self._rr = 0
         self.errors = 0
 
@@ -170,6 +172,7 @@ class WireProducer:
                 port = r.i32()
                 brokers[node] = (host, port)
             leaders: Dict[int, Tuple[str, int]] = {}
+            total = 0
             for _ in range(r.i32()):
                 r.i16()  # topic error code
                 r.string()  # topic name
@@ -181,10 +184,14 @@ class WireProducer:
                         r.i32()  # replicas
                     for _ in range(r.i32()):
                         r.i32()  # isr
+                    total += 1  # leaderless partitions still count for
+                    # the hash modulus (sarama mods by the topic's full
+                    # partition count, not the currently-leadered subset)
                     if leader in brokers:
                         leaders[pid] = brokers[leader]
             if leaders:
                 self._leaders[topic] = leaders
+                self._npartitions[topic] = total
                 return
             last_err = RuntimeError(f"no leaders for topic {topic!r}")
         raise last_err or RuntimeError("no bootstrap broker reachable")
@@ -194,18 +201,26 @@ class WireProducer:
         parts = self._leaders[topic]
         pids = sorted(parts)
         if key is not None and self.partitioner == "hash":
-            # sarama's HashPartitioner, bit-for-bit: FNV-1a 32, then the
-            # hash reinterpreted as int32 with negative partitions negated
-            # — co-partitioning with Go producers/consumers depends on it.
-            # (Python's builtin hash() is salted per process and would
-            # scatter one key across partitions between restarts.)
+            # sarama's HashPartitioner, bit-for-bit: FNV-1a 32, the hash
+            # reinterpreted as int32 with a negative result negated —
+            # which collapses to abs(int32(h)) — taken modulo the
+            # topic's TOTAL partition count (leaderless partitions
+            # included) — co-partitioning with Go producers/consumers
+            # depends on both details. (Python's builtin hash() is
+            # salted per process and would scatter one key across
+            # partitions between restarts.)
             h = 2166136261
             for byte in key.encode("utf-8"):
                 h = ((h ^ byte) * 16777619) & 0xFFFFFFFF
             if h >= 1 << 31:
                 h -= 1 << 32  # int32 reinterpretation
-            p = h % len(pids) if h >= 0 else -((-h) % len(pids))
-            pid = pids[-p if p < 0 else p]
+            pid = abs(h) % self._npartitions[topic]
+            if pid not in parts:
+                # the key's partition is mid-election: fail this attempt
+                # rather than silently re-route the key (produce() will
+                # re-learn metadata and retry)
+                raise RuntimeError(
+                    f"partition {pid} of {topic!r} has no leader")
         elif self.partitioner == "random":
             pid = pids[random.randrange(len(pids))]
         else:
