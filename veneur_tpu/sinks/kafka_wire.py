@@ -46,6 +46,12 @@ def _bytes(b: Optional[bytes]) -> bytes:
     return struct.pack(">i", len(b)) + b
 
 
+class _NoLeader(RuntimeError):
+    """A keyed message's partition currently has no leader (election in
+    flight) — retryable after a metadata refresh, without tearing down
+    connections to healthy brokers."""
+
+
 class _Reader:
     def __init__(self, data: bytes):
         self.data = data
@@ -199,7 +205,6 @@ class WireProducer:
     def _pick(self, topic: str, key: Optional[str]) -> Tuple[int,
                                                              Tuple[str, int]]:
         parts = self._leaders[topic]
-        pids = sorted(parts)
         if key is not None and self.partitioner == "hash":
             # sarama's HashPartitioner, bit-for-bit: FNV-1a 32, the hash
             # reinterpreted as int32 with a negative result negated —
@@ -218,12 +223,14 @@ class WireProducer:
             if pid not in parts:
                 # the key's partition is mid-election: fail this attempt
                 # rather than silently re-route the key (produce() will
-                # re-learn metadata and retry)
-                raise RuntimeError(
+                # re-learn metadata and retry, keeping its connections)
+                raise _NoLeader(
                     f"partition {pid} of {topic!r} has no leader")
         elif self.partitioner == "random":
+            pids = sorted(parts)
             pid = pids[random.randrange(len(pids))]
         else:
+            pids = sorted(parts)
             self._rr += 1
             pid = pids[self._rr % len(pids)]
         return pid, parts[pid]
@@ -258,6 +265,13 @@ class WireProducer:
                             raise RuntimeError(
                                 f"produce failed with error code {code}")
                     return
+                except _NoLeader as e:
+                    # expected during elections: re-learn metadata for
+                    # this topic only; healthy-broker connections and
+                    # other topics' leaders are untouched (no churn
+                    # storm while the cluster is already degraded)
+                    err = e
+                    self._leaders.pop(topic, None)
                 except Exception as e:
                     err = e
                     # leadership may have moved; reconnect + re-learn
