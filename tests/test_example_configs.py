@@ -1,0 +1,44 @@
+"""The shipped example configs must stay loadable and valid — the same
+guarantee the reference's config tests give its example.yamls
+(config_test.go:107-133)."""
+
+import os
+
+from veneur_tpu.config import read_config, read_proxy_config
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_example_yaml_loads_and_validates():
+    cfg = read_config(os.path.join(_ROOT, "example.yaml"))
+    cfg.validate()
+    cfg.apply_defaults()
+    assert cfg.statsd_listen_addresses == ["udp://127.0.0.1:8126"]
+    assert cfg.parse_interval() == 10.0
+    assert cfg.percentiles == [0.5, 0.75, 0.99]
+    assert cfg.digest_storage == "dense"
+    # a local instance is one with forward_address set; the example
+    # documents both roles but ships as a global
+    assert cfg.forward_address == ""
+
+
+def test_example_proxy_yaml_loads():
+    cfg = read_proxy_config(os.path.join(_ROOT, "example_proxy.yaml"))
+    assert cfg.http_address == "0.0.0.0:8127"
+    assert cfg.forward_timeout == "10s"
+
+
+def test_example_yaml_has_no_unknown_keys():
+    """Every key in the example must be a real Config field — a doc'd
+    key that the server ignores is exactly the failure mode the dead-key
+    audit flagged."""
+    import yaml
+
+    from veneur_tpu.config import Config
+
+    with open(os.path.join(_ROOT, "example.yaml")) as f:
+        data = yaml.safe_load(f)
+    fields = {f.name for f in
+              __import__("dataclasses").fields(Config)}
+    unknown = set(data) - fields
+    assert not unknown, unknown

@@ -343,6 +343,84 @@ class TestLightStepSink:
         assert rec["parent_span_id"] == 0
 
 
+class TestLightStepHTTPTransport:
+    """The bundled HTTP reporting transport: real POSTs to a local fake
+    collector, auth header, batch drain, and collector-down resilience."""
+
+    def _collector(self):
+        import http.server
+        import threading as _threading
+
+        received = []
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def do_POST(self):
+                body = self.rfile.read(int(self.headers["Content-Length"]))
+                received.append((self.path, dict(self.headers), body))
+                self.send_response(200)
+                self.end_headers()
+
+            def log_message(self, *a):
+                pass
+
+        httpd = http.server.HTTPServer(("127.0.0.1", 0), Handler)
+        t = _threading.Thread(target=httpd.serve_forever, daemon=True)
+        t.start()
+        return httpd, received
+
+    def test_reports_spans_with_token(self):
+        import json as _json
+        import time as _time
+
+        httpd, received = self._collector()
+        try:
+            sink = LightStepSpanSink(
+                f"http://127.0.0.1:{httpd.server_port}",
+                access_token="tok-123", num_clients=1)
+            from veneur_tpu.sinks.lightstep import HTTPReportingTracer
+
+            assert isinstance(sink.tracers[0], HTTPReportingTracer)
+            sink.tracers[0].report_interval = 0.05
+            for tid in (7, 8):
+                sink.ingest(make_span(trace_id=tid, span_id=tid))
+            deadline = _time.time() + 10
+            while _time.time() < deadline and not received:
+                _time.sleep(0.02)
+            sink.close()
+            assert received, "collector saw no report"
+            path, headers, body = received[0]
+            assert path == "/api/v2/reports"
+            assert headers["Lightstep-Access-Token"] == "tok-123"
+            report = _json.loads(body)
+            assert report["access_token"] == "tok-123"
+            assert sorted(s["trace_id"] for s in report["spans"]) == [7, 8]
+        finally:
+            httpd.shutdown()
+
+    def test_collector_down_drops_without_crash(self):
+        from veneur_tpu.sinks.lightstep import HTTPReportingTracer
+
+        tracer = HTTPReportingTracer("127.0.0.1", 1, plaintext=True,
+                                     access_token="t", max_spans=4,
+                                     report_interval=0.05)
+        import time as _time
+
+        for i in range(10):
+            tracer.report({"span_id": i})
+        deadline = _time.time() + 10
+        while _time.time() < deadline and tracer.dropped == 0:
+            _time.sleep(0.02)
+        tracer.close()
+        assert tracer.dropped > 0
+        assert tracer.reported == 0
+
+    def test_no_token_stays_buffering(self):
+        from veneur_tpu.sinks.lightstep import BufferingTracer
+
+        sink = LightStepSpanSink("http://localhost:8080")
+        assert isinstance(sink.tracers[0], BufferingTracer)
+
+
 GOLDEN_METRIC = InterMetric(
     name="a.b.c.max", timestamp=1476119058, value=100.0,
     tags=["foo:bar", "baz:quz"], type=MetricType.GAUGE)

@@ -22,7 +22,9 @@ class ChannelMetricSink(MetricSink):
     def flush(self, metrics) -> None:
         self.queue.put(list(metrics))
 
-    def get_flush(self, timeout: float = 5.0):
+    def get_flush(self, timeout: float = 30.0):
+        # generous default: the flush that feeds this sink may be paying
+        # a first-use jit compile, which can exceed 5s on a loaded host
         return self.queue.get(timeout=timeout)
 
 

@@ -9,10 +9,15 @@ id clamped to 0, ``error-code`` / ``indicator`` / component tags, error
 flag — and finished with the SSF end timestamp (lightstep.go:124-175).
 ``flush`` reports and resets the per-service counts (lightstep.go:203+).
 
-The proprietary LightStep transport is not bundled; a ``tracer_factory``
-returning objects with ``report(span_dict)`` (and optionally ``close()``)
-is injected — the production factory would wrap the LightStep gRPC
-collector protocol.
+Transport: when an access token is configured the default tracer is
+``HTTPReportingTracer`` — a bundled background reporter that POSTs
+buffered span batches as JSON to ``{collector}/api/v2/reports`` with the
+``Lightstep-Access-Token`` header, linear-backoff on failure, bounded
+buffer with oldest-first drop (the role the vendored client's reporting
+loop plays; the proprietary thrift/protobuf encoding is replaced by
+JSON, which LightStep's collectors also accept on this endpoint).
+A custom ``tracer_factory`` returning objects with ``report(span_dict)``
+(and optionally ``close()``) can still be injected.
 """
 
 from __future__ import annotations
@@ -22,6 +27,7 @@ import threading
 from typing import Callable, Dict, List, Optional
 from urllib.parse import urlparse
 
+from veneur_tpu.forward.http_forward import post_helper
 from veneur_tpu.protocol import wire
 from veneur_tpu.sinks.base import SpanSink
 
@@ -31,6 +37,7 @@ LIGHTSTEP_DEFAULT_PORT = 8080
 LIGHTSTEP_DEFAULT_INTERVAL = 300.0  # 5 minutes (lightstep.go:29)
 INDICATOR_SPAN_TAG_NAME = "indicator"
 RESOURCE_KEY = "resource"
+REPORT_PATH = "/api/v2/reports"
 
 
 class BufferingTracer:
@@ -60,6 +67,96 @@ class BufferingTracer:
         pass
 
 
+class HTTPReportingTracer(BufferingTracer):
+    """Bundled reporting transport: the BufferingTracer's bounded buffer
+    plus a daemon thread that drains it every ``report_interval``
+    seconds (or when ``max_batch`` spans accumulate) and POSTs one JSON
+    report to the collector via the shared ``post_helper``.
+
+    Failure semantics mirror the reference's client behavior: the batch
+    in flight is dropped on a failed POST (spans are telemetry, not
+    durable data), the buffer keeps absorbing new spans with
+    oldest-first drop, and retry waits back off linearly — the
+    batch-full wake is ignored while failing, so an outage under load
+    cannot turn into a tight connect loop (cf. trace/backend.go:135-180).
+    """
+
+    def __init__(self, host: str, port: int, plaintext: bool,
+                 access_token: str, max_spans: int = 1024,
+                 report_interval: float = 1.0, max_batch: int = 512,
+                 **_unused):
+        super().__init__(max_spans=max_spans)
+        scheme = "http" if plaintext else "https"
+        self.url = f"{scheme}://{host}:{port}{REPORT_PATH}"
+        self.access_token = access_token
+        self.max_batch = max_batch
+        self.report_interval = report_interval
+        self.reported = 0
+        self._wake = threading.Event()
+        self._stop = threading.Event()
+        self._failures = 0
+        self._thread = threading.Thread(target=self._run,
+                                        name="lightstep-reporter",
+                                        daemon=True)
+        self._thread.start()
+
+    def report(self, span: dict) -> None:
+        super().report(span)
+        with self._lock:
+            full = len(self.spans) >= self.max_batch
+        if full:
+            self._wake.set()
+
+    def _post(self, batch: List[dict]) -> bool:
+        try:
+            status = post_helper(
+                self.url, {"access_token": self.access_token,
+                           "spans": batch},
+                compress=False,
+                headers={"Lightstep-Access-Token": self.access_token})
+            if 200 <= status < 300:
+                return True
+            log.warning("lightstep report to %s got HTTP %d", self.url,
+                        status)
+        except Exception as e:
+            # any transport/protocol error (URLError, OSError, bad
+            # status line, ...) must never kill the reporter thread
+            log.warning("lightstep report to %s failed: %s", self.url, e)
+        return False
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            if self._failures:
+                # honor the backoff even if report() keeps setting the
+                # batch-full wake during an outage
+                self._stop.wait(self.report_interval
+                                * min(self._failures, 5))
+                self._wake.clear()
+            else:
+                self._wake.wait(timeout=self.report_interval)
+                self._wake.clear()
+            batch = self.drain()
+            if not batch:
+                continue
+            if self._post(batch):
+                with self._lock:
+                    self.reported += len(batch)
+                self._failures = 0
+            else:
+                # drop the failed batch; back off the next attempt
+                with self._lock:
+                    self.dropped += len(batch)
+                self._failures += 1
+
+    def close(self) -> None:
+        self._stop.set()
+        self._wake.set()
+        self._thread.join(timeout=5)
+        batch = self.drain()
+        if batch:
+            self._post(batch)
+
+
 class LightStepSpanSink(SpanSink):
     """Round-robin tracer-pool span sink (lightstep.go:30-210)."""
 
@@ -80,8 +177,14 @@ class LightStepSpanSink(SpanSink):
         self.access_token = access_token
         self.reconnect_period = reconnect_period or LIGHTSTEP_DEFAULT_INTERVAL
         n = num_clients if num_clients > 0 else 1  # lightstep.go:77-81
-        factory = tracer_factory or (
-            lambda **kw: BufferingTracer(max_spans=maximum_spans))
+        if tracer_factory is not None:
+            factory = tracer_factory
+        elif access_token:
+            # a configured token means "actually ship": use the bundled
+            # HTTP reporting transport
+            factory = HTTPReportingTracer
+        else:
+            factory = lambda **kw: BufferingTracer(max_spans=maximum_spans)
         self.tracers = [
             factory(host=self.host, port=self.port,
                     plaintext=self.plaintext, access_token=access_token,
