@@ -293,19 +293,27 @@ def bench_scalar_flush():
     return {"p50_ms": round(float(np.median(times)) * 1e3, 3), "series": 20000}
 
 
-def bench_hll(num_series: int = 1 << 18, updates: int = 1 << 17):
-    """Config #3: register scatter-max + batched estimate."""
+def bench_hll(num_series: int = 1 << 18, updates: int = 1 << 17,
+              precision: int = 14):
+    """Config #3: register scatter-max + batched estimate.
+
+    At the reference's precision 14 a dense [S, 2^14] int8 plane costs
+    16 KB/series — 1M series is 16 GB, past one v5e-1's HBM, so the
+    full-precision run benches 2^18 series (4 GB) and the 1M-series run
+    uses precision 12 (4 GB; standard error 1.04/sqrt(2^12) ≈ 1.6% vs
+    0.8%). 1M series AT precision 14 takes two chips or the mesh store
+    (the series axis shards; core/mesh_store.py)."""
     import jax
     import jax.numpy as jnp
     from veneur_tpu.ops import hll as hll_ops
 
-    m = hll_ops.num_registers(14)
+    m = hll_ops.num_registers(precision)
 
     @partial(jax.jit, donate_argnums=(0,))
     def step(regs, rows, hi, lo):
-        idx, rho = hll_ops.idx_rho(hi, lo, 14)
+        idx, rho = hll_ops.idx_rho(hi, lo, precision)
         regs = regs.at[rows, idx].max(rho.astype(regs.dtype), mode="drop")
-        est = hll_ops.estimate(regs.astype(jnp.int32), 14)
+        est = hll_ops.estimate(regs.astype(jnp.int32), precision)
         return regs, jnp.sum(est)
 
     rng = np.random.default_rng(1)
@@ -451,6 +459,7 @@ def main():
     configs["2c_merge_global_10m"] = guarded(
         bench_merge_global, 10 * (1 << 20))
     configs["3_hll"] = guarded(bench_hll)
+    configs["3b_hll_1m_p12"] = guarded(bench_hll, 1 << 20, 1 << 17, 12)
     configs["4_mesh_global"] = guarded(bench_mesh_subprocess)
     configs["5_heavy_hitters"] = guarded(bench_heavy_hitters)
 

@@ -173,6 +173,14 @@ class TestHTTPPipeline:
             client = HTTPForwarder(f"127.0.0.1:{gserver.ops_server.port}")
             client.forward(fwd)
             assert client.errors == 0 and client.forwarded == 4
+            # /import applies asynchronously (go ImportMetrics, http.go:54);
+            # flushing before the merge lands produces an EMPTY flush,
+            # which the flusher rightly skips — wait like the reference's
+            # tests do
+            deadline = time.time() + 20
+            while gserver.store.imported < 4 and time.time() < deadline:
+                time.sleep(0.01)
+            assert gserver.store.imported == 4
             gserver.flush()
             by_name = {m.name: m for m in sink.get_flush()}
             assert by_name["gctr"].value == 5.0

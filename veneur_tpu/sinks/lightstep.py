@@ -84,8 +84,14 @@ class HTTPReportingTracer(BufferingTracer):
     def __init__(self, host: str, port: int, plaintext: bool,
                  access_token: str, max_spans: int = 1024,
                  report_interval: float = 1.0, max_batch: int = 512,
-                 **_unused):
+                 reconnect_period: float = 0.0, **_unused):
         super().__init__(max_spans=max_spans)
+        if reconnect_period and reconnect_period != LIGHTSTEP_DEFAULT_INTERVAL:
+            # not silently dead (the repo's config policy): this
+            # transport opens a fresh connection per report, so the
+            # vendored client's periodic-reconnect knob has no effect
+            log.info("lightstep_reconnect_period has no effect on the "
+                     "bundled HTTP transport (it reconnects per report)")
         scheme = "http" if plaintext else "https"
         self.url = f"{scheme}://{host}:{port}{REPORT_PATH}"
         self.access_token = access_token
