@@ -8,10 +8,7 @@ protocol or codec change breaks compatibility with bytes already on the
 wire or on disk in a fleet."""
 
 import os
-import queue
-
-import numpy as np
-import pytest
+import time
 
 _FIX = os.path.join(os.path.dirname(os.path.abspath(__file__)), "fixtures")
 
@@ -70,7 +67,6 @@ class TestSSFSpanFixture:
         server.start()
         try:
             import socket
-            import time
 
             addr = server.ssf_addrs[0]
             s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
@@ -117,9 +113,9 @@ class TestImportBodyFixture:
             from veneur_tpu.samplers.intermetric import HistogramAggregates
 
             agg = HistogramAggregates.from_names(["count", "min", "max"])
-            deadline = __import__("time").time() + 10
-            while store.imported < 3 and __import__("time").time() < deadline:
-                __import__("time").sleep(0.02)
+            deadline = time.time() + 10
+            while store.imported < 3 and time.time() < deadline:
+                time.sleep(0.02)
             final, _, ms = store.flush([0.5], agg, is_local=False, now=0,
                                        forward=False)
             by = {m.name: m for m in final}

@@ -352,6 +352,13 @@ class NativeUDPReader:
             self._lib.vt_reader_stop(self._handle)
             self._handle = None
 
+    def leak(self) -> None:
+        """Deliberately abandon the pool WITHOUT freeing it: disarms
+        stop() and the GC finalizer. Used when a consumer thread may
+        still be touching the pool's batches at shutdown — a bounded
+        memory leak at process exit beats a use-after-free."""
+        self._handle = None
+
     def __del__(self):
         try:
             self.stop()

@@ -458,11 +458,31 @@ class Server:
         equivalent of the reference's graceful-restart guarantee that at
         most one interval is ever lost)."""
         self._stop.set()
-        # pump threads must leave drain() before the reader pool is freed
+        # pump threads must be fully dead before the reader pool is
+        # freed AND before the final flush: a pump blocked inside
+        # process_batch (e.g. a first-use device compile) can outlive a
+        # short join, write records into the store after the flush reset,
+        # and race vt_reader_stop freeing batches it still reads
+        deadline = time.time() + 30.0  # one shared bound, not per pump
+        pumps_dead = True
         for t in self._native_pumps:
-            t.join(timeout=2.0)
-        for reader in self._native_readers:
-            reader.stop()
+            t.join(timeout=max(0.0, deadline - time.time()))
+            if t.is_alive():
+                pumps_dead = False
+                log.warning("native pump %s did not exit in time", t.name)
+        if pumps_dead:
+            for reader in self._native_readers:
+                reader.stop()
+        else:
+            # a stuck pump may still be reading pool batches: leak the
+            # pool (and disarm its GC finalizer) rather than free memory
+            # a live thread uses. The final flush below is still safe —
+            # the store lock serializes it against process_batch — but
+            # records the pump lands after the reset die with the
+            # process (bounded loss, like any restart).
+            log.warning("leaving native reader pool allocated (pump alive)")
+            for reader in self._native_readers:
+                reader.leak()
         # the ticker must finish any in-flight flush before the final
         # drain runs, or two passes would drain the store concurrently
         if self._flush_thread is not None:

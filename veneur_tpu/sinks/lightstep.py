@@ -86,12 +86,6 @@ class HTTPReportingTracer(BufferingTracer):
                  report_interval: float = 1.0, max_batch: int = 512,
                  reconnect_period: float = 0.0, **_unused):
         super().__init__(max_spans=max_spans)
-        if reconnect_period and reconnect_period != LIGHTSTEP_DEFAULT_INTERVAL:
-            # not silently dead (the repo's config policy): this
-            # transport opens a fresh connection per report, so the
-            # vendored client's periodic-reconnect knob has no effect
-            log.info("lightstep_reconnect_period has no effect on the "
-                     "bundled HTTP transport (it reconnects per report)")
         scheme = "http" if plaintext else "https"
         self.url = f"{scheme}://{host}:{port}{REPORT_PATH}"
         self.access_token = access_token
@@ -181,6 +175,13 @@ class LightStepSpanSink(SpanSink):
         self.host = host.hostname or "localhost"
         self.plaintext = host.scheme == "http"
         self.access_token = access_token
+        if reconnect_period and tracer_factory is None:
+            # not silently dead (the repo's config policy): the bundled
+            # transports open a fresh connection per report, so the
+            # vendored client's periodic-reconnect knob has no effect.
+            # Logged once per sink, whatever the client count/transport.
+            log.info("lightstep_reconnect_period has no effect on the "
+                     "bundled transports (they reconnect per report)")
         self.reconnect_period = reconnect_period or LIGHTSTEP_DEFAULT_INTERVAL
         n = num_clients if num_clients > 0 else 1  # lightstep.go:77-81
         if tracer_factory is not None:
