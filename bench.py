@@ -413,7 +413,7 @@ def bench_heavy_hitters():
 
     @partial(jax.jit, donate_argnums=(0,))
     def step(s, rows, hi, lo, c):
-        s = cm.update(s, rows, hi, lo, c)
+        s = cm.update(s, rows, rows.astype(jnp.uint32), hi, lo, c)
         return s, jnp.sum(s.topk_counts)
 
     sk, chk = step(sk, rows, hi, lo, counts)

@@ -36,7 +36,8 @@ class TestCountMinKernel:
         sk = cm.init(1, depth=4, width=1 << 14, k=32)
         rows = jnp.zeros(len(stream), jnp.int32)
         hi, lo = _split(stream)
-        sk = cm.update(sk, rows, hi, lo, jnp.ones(len(stream), jnp.float32))
+        sk = cm.update(sk, rows, rows.astype(jnp.uint32), hi, lo,
+                       jnp.ones(len(stream), jnp.float32))
         qhi, qlo = _split(ids)
         est = np.asarray(cm.estimate(sk, jnp.zeros(500, jnp.int32), qhi, qlo))
         exact = collections.Counter(stream.tolist())
@@ -59,7 +60,8 @@ class TestCountMinKernel:
         # several drains, as the store produces
         for part in np.array_split(stream, 7):
             hi, lo = _split(part)
-            sk = cm.update(sk, jnp.zeros(len(part), jnp.int32), hi, lo,
+            zr = jnp.zeros(len(part), jnp.int32)
+            sk = cm.update(sk, zr, zr.astype(jnp.uint32), hi, lo,
                            jnp.ones(len(part), jnp.float32))
         got_ids = {(int(h) << 32) | int(l)
                    for h, l, c in zip(np.asarray(sk.topk_hi[0]),
@@ -85,8 +87,9 @@ class TestCountMinKernel:
         hi, lo = _split(np.tile(keys, 10))
         rows0 = jnp.zeros(80, jnp.int32)
         rows1 = jnp.ones(80, jnp.int32)
-        sk = cm.update(sk, rows0, hi, lo, jnp.ones(80, jnp.float32))
-        sk = cm.update(sk, rows1, hi, lo,
+        sk = cm.update(sk, rows0, rows0.astype(jnp.uint32), hi, lo,
+                       jnp.ones(80, jnp.float32))
+        sk = cm.update(sk, rows1, rows1.astype(jnp.uint32), hi, lo,
                        jnp.full(80, 3.0, jnp.float32))
         c0 = np.sort(np.asarray(sk.topk_counts[0]))[-8:]
         c1 = np.sort(np.asarray(sk.topk_counts[1]))[-8:]
@@ -106,7 +109,8 @@ class TestHeavyHitterStore:
             exact[users[d]] += 1
             store.process_metric(p.parse_metric(
                 f"api.by_user:{users[d]}|s|#veneurtopk,env:prod".encode()))
-        final, _, _ = store.flush([], AGG, is_local=True, now=7)
+        final, _, _ = store.flush([], AGG, is_local=True, now=7,
+                                  forward=False)
         topk = {m.tags[-1].split(":", 1)[1]: m.value for m in final
                 if m.name == "api.by_user.topk"}
         assert 0 < len(topk) <= 32
@@ -164,7 +168,8 @@ class TestHeavyHitterStore:
             for _ in range(10 - i):
                 store.process_metric(p.parse_metric(
                     f"m.k:member{i}|s|#veneurtopk".encode()))
-        final, _, _ = store.flush([], AGG, is_local=True, now=1)
+        final, _, _ = store.flush([], AGG, is_local=True, now=1,
+                                  forward=False)
         names = [m.tags[-1] for m in final if m.name == "m.k.topk"]
         assert len(names) == 10
         hexed = [t for t in names if t.startswith("key:0x")]
@@ -176,8 +181,97 @@ class TestHeavyHitterStore:
         for i in range(20):
             store.process_metric(p.parse_metric(
                 f"grow.h{i}:k|s|#veneurtopk".encode()))
-        final, _, _ = store.flush([], AGG, is_local=True, now=1)
+        final, _, _ = store.flush([], AGG, is_local=True, now=1,
+                                  forward=False)
         topk = [m for m in final if m.name.endswith(".topk")]
         assert len(topk) == 20
         for m in topk:
             assert m.value == 1.0
+
+
+class TestTopkForwarding:
+    """Fleet aggregation of heavy hitters: two locals forward their
+    sketches (count-min table + top-k candidates) through the JSON wire;
+    the global's fleet top-k counts are the SUMS of per-host counts —
+    the merge path the store docstring used to disclaim."""
+
+    def _local_with(self, counts: dict):
+        store = MetricStore(initial_capacity=16, chunk=256)
+        for member, n in counts.items():
+            for _ in range(n):
+                store.process_metric(p.parse_metric(
+                    f"api.callers:{member}|s|#veneurtopk".encode()))
+        return store
+
+    def test_fleet_topk_sums_across_hosts(self):
+        from veneur_tpu.forward.convert import (apply_json_metric,
+                                                json_metrics_from_state)
+
+        # host A and host B see overlapping key sets
+        a = self._local_with({"alice": 30, "bob": 10, "carol": 2})
+        b = self._local_with({"alice": 5, "bob": 25, "dave": 7})
+        gstore = MetricStore(initial_capacity=16, chunk=256)
+        for local in (a, b):
+            _, fwd, _ = local.flush([], AGG, is_local=True, now=0,
+                                    forward=True)
+            assert fwd.topk is not None
+            # through the real JSON wire format (serialize + parse)
+            import json as _json
+
+            payload = _json.loads(_json.dumps(
+                json_metrics_from_state(fwd)))
+            for d in payload:
+                apply_json_metric(gstore, d)
+
+        final, _, _ = gstore.flush([], AGG, is_local=False, now=1,
+                                   forward=False)
+        got = {m.tags[-1].split(":", 1)[1]: m.value
+               for m in final if m.name == "api.callers.topk"}
+        # count-min estimates are upward-biased only; at this load the
+        # tables are collision-free, so sums are exact
+        assert got["alice"] == 35.0
+        assert got["bob"] == 35.0
+        assert got["carol"] == 2.0
+        assert got["dave"] == 7.0
+
+    def test_fleet_topk_survives_different_intern_orders(self):
+        """Regression: table columns are salted with the STABLE series
+        id, not the local row index — host A interning m1 then m2 and
+        host B interning only m2 (row 0) must still sum m2's counts."""
+        from veneur_tpu.forward.convert import (apply_json_metric,
+                                                json_metrics_from_state)
+
+        a = MetricStore(initial_capacity=16, chunk=256)
+        for _ in range(3):
+            a.process_metric(p.parse_metric(b"m1:x|s|#veneurtopk"))
+        for _ in range(10):
+            a.process_metric(p.parse_metric(b"m2:bob|s|#veneurtopk"))
+        b = MetricStore(initial_capacity=16, chunk=256)
+        for _ in range(25):
+            b.process_metric(p.parse_metric(b"m2:bob|s|#veneurtopk"))
+
+        gstore = MetricStore(initial_capacity=16, chunk=256)
+        # interleave so the global also interns m2 at a different row
+        # than host A did
+        gstore.process_metric(p.parse_metric(b"zzz:pad|s|#veneurtopk"))
+        for local in (a, b):
+            _, fwd, _ = local.flush([], AGG, is_local=True, now=0,
+                                    forward=True)
+            for d in json_metrics_from_state(fwd):
+                apply_json_metric(gstore, d)
+        final, _, _ = gstore.flush([], AGG, is_local=False, now=1,
+                                   forward=False)
+        got = {(m.name, m.tags[-1]): m.value for m in final
+               if m.name.endswith(".topk")}
+        assert got[("m2.topk", "key:bob")] == 35.0
+        assert got[("m1.topk", "key:x")] == 3.0
+
+    def test_import_rejects_mismatched_shape(self):
+        gstore = MetricStore(initial_capacity=16, chunk=256)
+        with pytest.raises(ValueError, match="shape"):
+            gstore.import_topk(np.zeros((2, 128), np.float32), [])
+
+    def test_forward_disabled_keeps_topk_local(self):
+        a = self._local_with({"x": 3})
+        _, fwd, _ = a.flush([], AGG, is_local=True, now=0, forward=False)
+        assert fwd.topk is None

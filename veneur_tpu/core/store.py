@@ -674,8 +674,11 @@ class HeavyHitterGroup:
     surviving heavy hitter. Member strings are memoized host-side (the
     sketch itself only sees 64-bit hashes); the memo is bounded and
     unknown hashes emit as hex, so unbounded key cardinality cannot
-    exhaust host memory. Local-only for now: tables are psum-mergeable,
-    but cross-instance forwarding is not wired in this round.
+    exhaust host memory. Cross-instance aggregation: locals forward
+    (table, top-k candidates, members) over the JSON forward path
+    (convert.py "topk_sketch"); the global adds tables elementwise and
+    re-ranks the fleet top-k (import_sketch). The gRPC forward path does
+    not carry the sketch (metricpb stays reference-wire-compatible).
     """
 
     MEMO_LIMIT = 1 << 20
@@ -694,6 +697,12 @@ class HeavyHitterGroup:
         self._device_dirty = False
         self._members: Dict[int, str] = {}
         self._update = jax.jit(cm_ops.update, donate_argnums=(0,))
+        self._add_table = jax.jit(cm_ops.add_table, donate_argnums=(0,))
+        self._inject = jax.jit(cm_ops.inject_candidates,
+                               donate_argnums=(0,))
+        # stable per-row series ids (+1 slot for the staging sentinel);
+        # see CountMin.sids for why these must be instance-independent
+        self._sids_np = np.zeros(capacity + 1, np.uint32)
         self._new_sample_buffers()
 
     def _new_sample_buffers(self):
@@ -706,10 +715,23 @@ class HeavyHitterGroup:
     def __len__(self):
         return len(self.interner)
 
+    @staticmethod
+    def stable_sid(name: str, joined_tags: str) -> int:
+        """Instance-independent 32-bit series id: fnv1a over the series
+        identity. Every instance MUST derive the same sid for the same
+        series — count-min columns are salted with it (CountMin.sids)."""
+        h = 2166136261
+        for b in f"{name}|set|{joined_tags}".encode("utf-8"):
+            h = ((h ^ b) * 16777619) & 0xFFFFFFFF
+        return h
+
     def _row(self, key: MetricKey, tags: List[str]) -> int:
         row = self.interner.intern(key, tags)
         if row >= self.capacity:
             self.ensure_capacity(row)
+        if self._sids_np[row] == 0:  # first sight (or the 2^-32 rehash)
+            self._sids_np[row] = self.stable_sid(key.name,
+                                                 ",".join(tags))
         return row
 
     def ensure_capacity(self, max_row: int):
@@ -721,7 +743,12 @@ class HeavyHitterGroup:
             self.sketch = self.sketch._replace(
                 topk_hi=jnp.pad(self.sketch.topk_hi, pad),
                 topk_lo=jnp.pad(self.sketch.topk_lo, pad),
-                topk_counts=jnp.pad(self.sketch.topk_counts, pad))
+                topk_counts=jnp.pad(self.sketch.topk_counts, pad),
+                sids=jnp.pad(self.sketch.sids, (0, self.capacity - old)))
+            sids = np.zeros(self.capacity + 1, np.uint32)
+            sids[:old + 1] = self._sids_np
+            sids[old] = 0  # the old sentinel slot is now a real row
+            self._sids_np = sids
             self._rows[self._fill:] = self.capacity
 
     def _memoize(self, h: int, member: str):
@@ -773,38 +800,94 @@ class HeavyHitterGroup:
         self._device_dirty = True
         rows, hi, lo, wts = self._rows, self._hi, self._lo, self._wts
         self._new_sample_buffers()
-        self.sketch = self._update(self.sketch, rows, hi, lo, wts)
+        sids = self._sids_np[rows]
+        self.sketch = self._update(self.sketch, rows, sids, hi, lo, wts)
 
     def _drain_staging(self):
         self._drain_samples()
 
-    def flush(self):
-        """Returns (interner, [(row, member, count), ...]) and resets."""
+    def import_sketch(self, table: np.ndarray, series: List[tuple]):
+        """Merge a forwarded heavy-hitter sketch: the count-min table
+        adds elementwise, and each series' forwarded top-k keys become
+        candidates re-estimated against the combined table.
+
+        table: [depth, width] float32 (shape must match — both ends run
+        the same config, like hll precision). series: [(key, tags,
+        [(hi, lo), ...], [member-or-None, ...])]."""
+        if table.shape != (self.depth, self.width):
+            raise ValueError(
+                f"forwarded count-min shape {table.shape} != local "
+                f"({self.depth}, {self.width})")
+        self._drain_samples()  # candidates estimate against a settled table
+        self._device_dirty = True
+        rows, sids, his, los, slots = [], [], [], [], []
+        for key, tags, keys, members in series:
+            row = self._row(key, list(tags))
+            sid = int(self._sids_np[row])
+            for j, (hi, lo) in enumerate(keys):
+                rows.append(row)
+                sids.append(sid)
+                his.append(hi)
+                los.append(lo)
+                slots.append(j)
+                if members and j < len(members) and members[j]:
+                    self._memoize((int(hi) << 32) | int(lo), members[j])
+        self.sketch = self._add_table(self.sketch,
+                                      jnp.asarray(table, jnp.float32))
+        if rows:
+            self.sketch = self._inject(
+                self.sketch, jnp.asarray(rows, jnp.int32),
+                jnp.asarray(np.asarray(sids, np.uint32)),
+                jnp.asarray(np.asarray(his, np.uint32)),
+                jnp.asarray(np.asarray(los, np.uint32)),
+                jnp.asarray(slots, jnp.int32))
+
+    def flush(self, want_forward: bool = False):
+        """Returns (interner, [(row, member, count), ...], forwardable)
+        and resets. forwardable is None unless want_forward: then it is
+        (table ndarray, [(name, tags, [(hi, lo)...], [member...])])."""
         self._drain_samples()
         n = len(self.interner)
         interner, self.interner = self.interner, Interner()
         if n == 0 and not self._device_dirty:
             # pristine sketch: skip the device reallocation entirely
-            return interner, []
+            return interner, [], None
         out = []
+        fwd = None
         if n:
             hi, lo, ct = jax.device_get(
                 (self.sketch.topk_hi[:n], self.sketch.topk_lo[:n],
                  self.sketch.topk_counts[:n]))
+            # one pass builds both the emission rows and (when asked)
+            # the per-row forwardable candidate lists
+            by_row = {} if want_forward else None
             for row in range(n):
                 for j in range(self.k):
                     c = float(ct[row, j])
                     if c <= 0:
                         continue
-                    h = (int(hi[row, j]) << 32) | int(lo[row, j])
-                    member = self._members.get(h, f"0x{h:016x}")
-                    out.append((row, member, c))
+                    pair = (int(hi[row, j]), int(lo[row, j]))
+                    h = (pair[0] << 32) | pair[1]
+                    member = self._members.get(h)
+                    out.append((row, member or f"0x{h:016x}", c))
+                    if by_row is not None:
+                        keys, members = by_row.setdefault(row, ([], []))
+                        keys.append(pair)
+                        members.append(member)
+            if want_forward:
+                table = np.asarray(jax.device_get(self.sketch.table))
+                series = [
+                    (key.name, interner.tags[row]) + by_row[row]
+                    for key, row in interner.rows.items()
+                    if row in by_row]
+                fwd = (table, series)
         self.sketch = self._cm.init(self.capacity, self.depth, self.width,
                                     self.k)
+        self._sids_np = np.zeros(self.capacity + 1, np.uint32)
         self._device_dirty = False
         self._members.clear()
         self._new_sample_buffers()
-        return interner, out
+        return interner, out, fwd
 
 
 # ---------------------------------------------------------------------------
@@ -846,10 +929,14 @@ class ForwardableState:
     timers: List[tuple] = field(default_factory=list)
     # (name, tags, registers-uint8, precision)
     sets: List[tuple] = field(default_factory=list)
+    # heavy hitters: (table ndarray [depth, width],
+    # [(name, tags, [(hi, lo)...], [member-or-None...])]) or None
+    topk: Optional[tuple] = None
 
     def __len__(self):
         return (len(self.counters) + len(self.gauges) + len(self.histograms)
-                + len(self.timers) + len(self.sets))
+                + len(self.timers) + len(self.sets)
+                + (len(self.topk[1]) if self.topk else 0))
 
 
 _DIGEST_GROUPS = ("histograms", "timers", "local_histograms", "local_timers")
@@ -1115,6 +1202,18 @@ class MetricStore:
             self.imported += 1
             self.sets.import_registers(key, tags, registers)
 
+    def import_topk(self, table: np.ndarray, series: List[tuple]):
+        """Merge a forwarded heavy-hitter sketch (see
+        HeavyHitterGroup.import_sketch); series entries carry plain
+        (name, tags, keys, members) — MetricKeys are built here."""
+        with self._lock:
+            self.imported += 1
+            entries = [(MetricKey(name=name, type="set",
+                                  joined_tags=",".join(tags)),
+                        tags, keys, members)
+                       for name, tags, keys, members in series]
+            self.heavy_hitters.import_sketch(table, entries)
+
     # -- flush -------------------------------------------------------------
 
     def summary(self) -> MetricsSummary:
@@ -1133,9 +1232,10 @@ class MetricStore:
         )
 
     def flush(self, percentiles: List[float], aggregates: HistogramAggregates,
-              is_local: bool, now: int,
-              forward: bool = True) -> Tuple[List[InterMetric],
-                                             ForwardableState, MetricsSummary]:
+              is_local: bool, now: int, forward: bool = True,
+              forward_topk: bool = True) -> Tuple[List[InterMetric],
+                                                  ForwardableState,
+                                                  MetricsSummary]:
         """Drain everything: returns (final metrics for sinks, forwardable
         sketch state, tallies) and resets all groups.
 
@@ -1175,9 +1275,19 @@ class MetricStore:
                 self.sets, final if not is_local else None, now,
                 fwd_list=fwd.sets if (is_local and forward) else None)
 
-            # heavy hitters emit locally on every instance (tables are
-            # psum-mergeable but not forwarded in this round)
-            hh_interner, hh = self.heavy_hitters.flush()
+            # heavy hitters follow the mixed-SET rule (flusher.go:231-249):
+            # a forwarding local ships its sketch upstream and does NOT
+            # emit — the global merges tables additively, re-ranks, and
+            # emits the fleet top-k under the same names (no double
+            # counting downstream). When the transport cannot carry the
+            # sketch (gRPC: forward_topk=False), the local emits its own
+            # view instead so the data is never silently dropped.
+            want_hh_fwd = is_local and forward and forward_topk
+            hh_interner, hh, hh_fwd = self.heavy_hitters.flush(
+                want_forward=want_hh_fwd)
+            fwd.topk = hh_fwd
+            if want_hh_fwd:
+                hh = []
             for row, member, count in hh:
                 tags = hh_interner.tags[row]
                 final.append(InterMetric(

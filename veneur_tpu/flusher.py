@@ -58,10 +58,21 @@ def _flush_once(server: "Server", span):
                     "registered; global-scope state (sets, digests, global "
                     "counters/gauges) will be dropped each interval")
     percentiles = server.histogram_percentiles
+    forwarding = is_local and server.forward_fn is not None
+    # the heavy-hitter sketch rides the JSON path only; over gRPC the
+    # local emits its own top-k instead (store.flush docs) — say so once
+    topk_ok = getattr(server._forwarder, "supports_topk", True) \
+        if server._forwarder is not None else True
+    if forwarding and not topk_ok and not getattr(
+            server, "_warned_topk_grpc", False):
+        server._warned_topk_grpc = True
+        log.warning("gRPC forwarding cannot carry the heavy-hitter "
+                    "sketch (metricpb stays reference-compatible); "
+                    "topk series emit locally instead of fleet-merged")
     t0 = time.perf_counter()
     final_metrics, forwardable, ms = server.store.flush(
         percentiles, server.histogram_aggregates, is_local=is_local, now=now,
-        forward=is_local and server.forward_fn is not None)
+        forward=forwarding, forward_topk=topk_ok)
     flush_elapsed = time.perf_counter() - t0
     log.debug("store flush took %.1f ms (%s)", flush_elapsed * 1e3, ms)
     # the canonical self-metric set (README.md:248-277) rides on the

@@ -159,6 +159,24 @@ def json_metrics_from_state(state, compression: float = 100.0) -> List[Dict]:
         d = base(name, tags, "set")
         d["hll"] = base64.b64encode(encode_hll(registers, precision)).decode()
         out.append(d)
+    if state.topk is not None:
+        table, series = state.topk
+        table = np.ascontiguousarray(table, np.float32)
+        out.append({
+            "type": "topk_sketch",
+            "name": "veneur.topk",  # routing/debug label only
+            "tags": [],
+            "depth": int(table.shape[0]),
+            "width": int(table.shape[1]),
+            # the HTTP body is deflate-compressed as a whole, so the
+            # (mostly sparse) table compresses well despite base64
+            "table": base64.b64encode(table.tobytes()).decode(),
+            "series": [
+                {"name": name, "tags": list(tags),
+                 "keys": [[int(hi), int(lo)] for hi, lo in keys],
+                 "members": list(members)}
+                for name, tags, keys, members in series],
+        })
     return out
 
 
@@ -168,6 +186,15 @@ def apply_json_metric(store, d: Dict):
     from veneur_tpu.samplers.parser import MetricKey
 
     name, tags, mtype = d["name"], list(d.get("tags") or []), d["type"]
+    if mtype == "topk_sketch":
+        table = np.frombuffer(base64.b64decode(d["table"]),
+                              np.float32).reshape(d["depth"], d["width"])
+        series = [(s["name"], list(s.get("tags") or []),
+                   [(int(hi), int(lo)) for hi, lo in s["keys"]],
+                   list(s.get("members") or []))
+                  for s in d.get("series", [])]
+        store.import_topk(table, series)
+        return
     key = MetricKey(name=name, type=mtype, joined_tags=",".join(tags))
     if mtype == "counter":
         store.import_counter(key, tags, int(d["value"]))

@@ -32,6 +32,10 @@ _METHOD = "/forwardrpc.Forward/SendMetrics"
 class GRPCForwarder:
     """Per-flush gRPC forward of ForwardableState (flusher.go:424-473)."""
 
+    # metricpb stays byte-compatible with the reference; the heavy-hitter
+    # sketch (a framework extension) cannot ride this transport
+    supports_topk = False
+
     def __init__(self, addr: str, timeout: float = 10.0,
                  compression: float = 100.0):
         if addr.startswith(("http://", "grpc://")):

@@ -44,6 +44,9 @@ def post_helper(url: str, payload, timeout: float = 10.0,
 class HTTPForwarder:
     """Per-flush HTTP forward of ForwardableState (flusher.go:292-385)."""
 
+    # the JSON wire carries the heavy-hitter sketch extension
+    supports_topk = True
+
     def __init__(self, addr: str, timeout: float = 10.0,
                  compression: float = 100.0):
         self.base = addr.rstrip("/")

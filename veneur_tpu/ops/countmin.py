@@ -43,12 +43,17 @@ _ROW_SALTS = (0x9E3779B9, 0x85EBCA6B, 0xC2B2AE35, 0x27D4EB2F,
 class CountMin(NamedTuple):
     """table: [depth, width] f32 shared across series.
     topk_hi/lo: [S, K] uint32 key-id halves (0/0 = empty slot).
-    topk_counts: [S, K] f32 estimated counts (0 = empty)."""
+    topk_counts: [S, K] f32 estimated counts (0 = empty).
+    sids: [S] uint32 INSTANCE-INDEPENDENT series ids (a stable hash of
+    name+type+tags) — table columns are salted with these, NOT with the
+    local row index, so tables forwarded between instances that interned
+    the same series at different rows still align column-for-column."""
 
     table: jax.Array
     topk_hi: jax.Array
     topk_lo: jax.Array
     topk_counts: jax.Array
+    sids: jax.Array
 
     @property
     def depth(self) -> int:
@@ -67,6 +72,7 @@ def init(num_series: int = 1, depth: int = DEFAULT_DEPTH,
         topk_hi=jnp.zeros((num_series, k), jnp.uint32),
         topk_lo=jnp.zeros((num_series, k), jnp.uint32),
         topk_counts=jnp.zeros((num_series, k), jnp.float32),
+        sids=jnp.zeros((num_series,), jnp.uint32),
     )
 
 
@@ -81,31 +87,37 @@ def _mix32(x: jax.Array) -> jax.Array:
     return x
 
 
-def _row_index(rows: jax.Array, hi: jax.Array, lo: jax.Array, salt: int,
+def _col_index(sids: jax.Array, hi: jax.Array, lo: jax.Array, salt: int,
                width: int) -> jax.Array:
-    """Table column for one depth row: mixes (series row, key hash, row
-    salt) so one table serves every series and depth row independently."""
+    """Table column for one depth row: mixes (stable series id, key
+    hash, row salt) so one table serves every series and depth row
+    independently. The series component MUST be the instance-independent
+    sid, never a local row index — forwarded tables merge elementwise
+    and both ends have to hash a given (series, key) to the same column."""
     h = _mix32(hi ^ jnp.uint32(salt))
     h = _mix32(h ^ lo)
-    h = _mix32(h ^ rows.astype(jnp.uint32) * jnp.uint32(0x9E3779B1))
+    h = _mix32(h ^ sids.astype(jnp.uint32) * jnp.uint32(0x9E3779B1))
     return (h % jnp.uint32(width)).astype(jnp.int32)
 
 
-def update(sk: CountMin, rows: jax.Array, hi: jax.Array, lo: jax.Array,
-           counts: jax.Array) -> CountMin:
-    """Fold one flat batch of (series row, key hash, count) increments
-    into the table and refresh each touched series' top-k.
+def update(sk: CountMin, rows: jax.Array, sids: jax.Array, hi: jax.Array,
+           lo: jax.Array, counts: jax.Array) -> CountMin:
+    """Fold one flat batch of (series row, series sid, key hash, count)
+    increments into the table and refresh each touched series' top-k.
 
-    rows: [N] int32; padding uses counts == 0 (its updates add zero and
-    its candidates lose every top-k comparison).
+    rows: [N] int32; sids: [N] uint32 stable series ids (see CountMin);
+    padding uses counts == 0 (its updates add zero and its candidates
+    lose every top-k comparison).
     """
     depth, width = sk.depth, sk.width
     s, k = sk.topk_counts.shape
     counts = counts.astype(jnp.float32)
+    # teach the sketch its rows' stable ids (idempotent writes)
+    sk = sk._replace(sids=sk.sids.at[rows].set(sids, mode="drop"))
     table = sk.table
     idxs = []
     for d in range(depth):
-        idx = _row_index(rows, hi, lo, _ROW_SALTS[d], width)
+        idx = _col_index(sids, hi, lo, _ROW_SALTS[d], width)
         idxs.append(idx)
         table = table.at[d, idx].add(counts)
     # conservative estimate after the adds: min over depth rows
@@ -118,9 +130,9 @@ def update(sk: CountMin, rows: jax.Array, hi: jax.Array, lo: jax.Array,
     # must track later increments even when the key loses its candidate
     # slot to a ring collision this drain
     cur_ct = jnp.full(sk.topk_counts.shape, jnp.inf, jnp.float32)
-    series = jnp.arange(s, dtype=jnp.int32)[:, None]
     for d in range(depth):
-        idx = _row_index(jnp.broadcast_to(series, sk.topk_hi.shape),
+        idx = _col_index(jnp.broadcast_to(sk.sids[:, None],
+                                          sk.topk_hi.shape),
                          sk.topk_hi, sk.topk_lo, _ROW_SALTS[d], width)
         cur_ct = jnp.minimum(cur_ct, table[d, idx])
     cur_ct = jnp.where(sk.topk_counts > 0, cur_ct, 0.0)
@@ -149,8 +161,14 @@ def update(sk: CountMin, rows: jax.Array, hi: jax.Array, lo: jax.Array,
     all_hi = jnp.concatenate([sk.topk_hi, cand_hi], axis=1)
     all_lo = jnp.concatenate([sk.topk_lo, cand_lo], axis=1)
     all_ct = jnp.concatenate([cur_ct, cand_ct], axis=1)
-    # dedupe by id per series: sort by (hi, lo), keep each id's max count
-    # at its first occurrence, zero the duplicates
+    top_hi, top_lo, top_ct = _dedupe_topk(all_hi, all_lo, all_ct, k)
+    return sk._replace(table=table, topk_hi=top_hi, topk_lo=top_lo,
+                       topk_counts=top_ct)
+
+
+def _dedupe_topk(all_hi, all_lo, all_ct, k: int):
+    """Per-series candidate selection: sort by (hi, lo), keep each id's
+    max count at its first occurrence, zero the duplicates, take top k."""
     shi, slo, sct = lax.sort((all_hi, all_lo, all_ct), dimension=-1,
                              num_keys=2, is_stable=False)
     same = jnp.concatenate(
@@ -164,12 +182,62 @@ def update(sk: CountMin, rows: jax.Array, hi: jax.Array, lo: jax.Array,
     top_hi = jnp.take_along_axis(shi, top_i, axis=1)
     top_lo = jnp.take_along_axis(slo, top_i, axis=1)
     live = top_ct > 0
-    return CountMin(
-        table=table,
-        topk_hi=jnp.where(live, top_hi, 0),
-        topk_lo=jnp.where(live, top_lo, 0),
-        topk_counts=top_ct,
-    )
+    return (jnp.where(live, top_hi, 0), jnp.where(live, top_lo, 0), top_ct)
+
+
+def add_table(sk: CountMin, table: jax.Array) -> CountMin:
+    """Merge another instance's count-min table: elementwise add (the
+    sketch is additively mergeable — columns align across instances
+    because both hash with stable sids), then refresh every standing
+    top-k member's estimate against the combined table — a forwarded
+    table can raise counts for keys this instance already tracks."""
+    table = sk.table + table.astype(jnp.float32)
+    cur_ct = jnp.full(sk.topk_counts.shape, jnp.inf, jnp.float32)
+    for d in range(sk.depth):
+        idx = _col_index(jnp.broadcast_to(sk.sids[:, None],
+                                          sk.topk_hi.shape),
+                         sk.topk_hi, sk.topk_lo, _ROW_SALTS[d],
+                         sk.width)
+        cur_ct = jnp.minimum(cur_ct, table[d, idx])
+    cur_ct = jnp.where(sk.topk_counts > 0, cur_ct, 0.0)
+    return sk._replace(table=table, topk_counts=cur_ct)
+
+
+def inject_candidates(sk: CountMin, rows: jax.Array, sids: jax.Array,
+                      hi: jax.Array, lo: jax.Array,
+                      slots: jax.Array) -> CountMin:
+    """Offer forwarded top-k candidates (no count contribution — their
+    mass arrived via add_table): estimate each against the current table
+    and merge into the per-series top-k lists.
+
+    rows: [N] int32 with out-of-range = padding; sids: [N] uint32 stable
+    series ids; (hi, lo) == (0, 0) is also padding. slots: [N] int32,
+    the candidate's index within its series' forwarded list — callers
+    know it exactly (a forwarded list has at most K entries), which
+    makes the scatter collision-free without any ring hashing."""
+    s, k = sk.topk_counts.shape
+    live = (rows >= 0) & (rows < s) & ((hi != 0) | (lo != 0))
+    sk = sk._replace(sids=sk.sids.at[rows].set(sids, mode="drop"))
+    est = jnp.full(rows.shape, jnp.inf, jnp.float32)
+    for d in range(sk.depth):
+        idx = _col_index(sids, hi, lo, _ROW_SALTS[d], sk.width)
+        est = jnp.minimum(est, sk.table[d, idx])
+    est = jnp.where(live, est, 0.0)
+    ring = k
+    srows = jnp.where(live, rows, s).astype(jnp.int32)
+    slot = jnp.minimum(slots.astype(jnp.int32), ring - 1)
+    cand_hi = jnp.zeros((s, ring), jnp.uint32).at[srows, slot].set(
+        hi, mode="drop")
+    cand_lo = jnp.zeros((s, ring), jnp.uint32).at[srows, slot].set(
+        lo, mode="drop")
+    cand_ct = jnp.zeros((s, ring), jnp.float32).at[srows, slot].set(
+        est, mode="drop")
+    all_hi = jnp.concatenate([sk.topk_hi, cand_hi], axis=1)
+    all_lo = jnp.concatenate([sk.topk_lo, cand_lo], axis=1)
+    all_ct = jnp.concatenate([sk.topk_counts, cand_ct], axis=1)
+    top_hi, top_lo, top_ct = _dedupe_topk(all_hi, all_lo, all_ct, k)
+    return sk._replace(topk_hi=top_hi, topk_lo=top_lo,
+                       topk_counts=top_ct)
 
 
 def _rev_seg_max(x: jax.Array, same: jax.Array) -> jax.Array:
@@ -198,9 +266,11 @@ def _rev_seg_max(x: jax.Array, same: jax.Array) -> jax.Array:
 
 def estimate(sk: CountMin, rows: jax.Array, hi: jax.Array,
              lo: jax.Array) -> jax.Array:
-    """Point-query frequency estimates for (series, key) pairs."""
+    """Point-query frequency estimates for (series row, key) pairs;
+    rows resolve to stable sids through the sketch's sid plane."""
+    sids = sk.sids[jnp.clip(rows, 0, sk.sids.shape[0] - 1)]
     est = jnp.full(rows.shape, jnp.inf, jnp.float32)
     for d in range(sk.depth):
-        idx = _row_index(rows, hi, lo, _ROW_SALTS[d], sk.width)
+        idx = _col_index(sids, hi, lo, _ROW_SALTS[d], sk.width)
         est = jnp.minimum(est, sk.table[d, idx])
     return est
