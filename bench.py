@@ -152,6 +152,66 @@ def bench_histo_flush(num_series: int, digest_dtype: str = "float32",
             "ingest_msamples_s": round(ingest_rate, 1)}
 
 
+def bench_import_throughput(num_series: int = 20000, duration: float = 4.0):
+    """Config #2d: metrics/sec MERGED through the whole import path —
+    the second north-star metric (BASELINE.md: 'flush latency + metrics/
+    sec merged'). A real gRPC ImportServer backed by the store receives
+    pre-serialized MetricList batches of forwarded histogram digests;
+    reported as series merged per second including wire decode, host
+    staging, and the device scatter path. The Go counterpart is
+    BenchmarkImportServerSendMetrics (importsrv/server_test.go:115)."""
+    import grpc
+    from google.protobuf import empty_pb2
+
+    from veneur_tpu.core.store import ForwardableState, MetricStore
+    from veneur_tpu.forward.convert import metric_list_from_state
+    from veneur_tpu.forward.grpc_forward import _METHOD, ImportServer
+    from veneur_tpu.protocol import forward_pb2
+
+    rng = np.random.default_rng(0)
+    # one host's forwarded batch: num_series digests, 48 centroids each
+    state = ForwardableState()
+    for i in range(num_series):
+        means = np.sort(rng.gamma(2.0, 30.0, 48))
+        state.histograms.append(
+            (f"svc.latency.{i}", [f"shard:{i % 13}"], means,
+             np.ones(48), float(means[0]), float(means[-1])))
+    mlist = metric_list_from_state(state)
+
+    store = MetricStore(initial_capacity=1 << 15, chunk=1 << 15)
+    srv = ImportServer(store)
+    port = srv.start("127.0.0.1:0")
+    chan = grpc.insecure_channel(
+        f"127.0.0.1:{port}",
+        options=[("grpc.max_send_message_length", 256 << 20),
+                 ("grpc.max_receive_message_length", 256 << 20)])
+    try:
+        send = chan.unary_unary(
+            _METHOD,
+            request_serializer=forward_pb2.MetricList.SerializeToString,
+            response_deserializer=empty_pb2.Empty.FromString)
+        # warm until sends run compile-free: the staging drains change
+        # phase between the first calls, each new shape compiling a
+        # scatter variant (~20 s on TPU over the tunnel)
+        for _ in range(6):
+            t0 = time.perf_counter()
+            send(mlist, timeout=600)
+            if time.perf_counter() - t0 < 1.5:
+                break
+        sent = 0
+        t0 = time.perf_counter()
+        while time.perf_counter() - t0 < duration:
+            send(mlist, timeout=300)
+            sent += num_series
+        dt = time.perf_counter() - t0
+        return {"series_merged_per_s": int(sent / dt),
+                "batch_series": num_series,
+                "centroids_per_digest": 48}
+    finally:
+        chan.close()
+        srv.stop()
+
+
 def bench_merge_global(num_series: int, digest_dtype: str = "bfloat16",
                        iters: int = 5):
     """Config #2c: the single-chip global-aggregator kernel — merge one
@@ -465,6 +525,9 @@ def main():
         bench_histo_flush, 10 * (1 << 20), "bfloat16", 5, 4, 1 << 19)
     configs["2c_merge_global_10m"] = guarded(
         bench_merge_global, 10 * (1 << 20))
+    # the OTHER north-star metric: metrics/sec merged through the whole
+    # gRPC import path (wire decode + bulk staging + device scatter)
+    configs["2d_import_grpc"] = guarded(bench_import_throughput)
     configs["3_hll"] = guarded(bench_hll)
     configs["3b_hll_1m_p12"] = guarded(bench_hll, 1 << 20, 1 << 17, 12)
     configs["4_mesh_global"] = guarded(bench_mesh_subprocess)

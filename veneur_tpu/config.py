@@ -140,6 +140,14 @@ class Config:
     # drain plain-IPv4 UDP statsd listeners with the C++ recvmmsg reader
     # pool + batch parser when the native library is available
     native_ingest: bool = True
+    # gRPC forward writes the reference's repeated-Centroid schema IN
+    # ADDITION to the packed arrays, so a Go global — or any importer
+    # predating the packed extension — can read this local's digests.
+    # Doubles digest wire size. Needed when forwarding INTO a reference
+    # fleet, or temporarily during a rolling upgrade where locals would
+    # otherwise be upgraded before their global (upgrade globals first
+    # and this can stay off: the import side reads both schemas).
+    forward_reference_compatible: bool = False
     # shard the global-tier store over a (series, hosts) device mesh;
     # only meaningful on a global instance (forward_address unset)
     mesh_enabled: bool = False

@@ -523,6 +523,16 @@ class SlabDigestGroup:
             if len(self._imp_stat_rows) >= self.chunk:
                 self._drain_imports()
 
+    def import_centroids_bulk(self, rows: np.ndarray, means: np.ndarray,
+                              weights: np.ndarray, stat_rows,
+                              stat_mins, stat_maxs):
+        """Bulk staging append for the import path (rows pre-interned by
+        the caller); shares DigestGroup's staging protocol."""
+        from veneur_tpu.core.store import bulk_stage_import_centroids
+
+        bulk_stage_import_centroids(self, rows, means, weights, stat_rows,
+                                    stat_mins, stat_maxs)
+
     # -- drains -----------------------------------------------------------
 
     def _per_slab(self, rows, *arrays):

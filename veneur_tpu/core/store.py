@@ -245,6 +245,33 @@ def _flush_digests(digest: td_ops.TDigest, temp: td_ops.TempCentroids,
             temp.recip)
 
 
+def bulk_stage_import_centroids(group, rows: np.ndarray, means: np.ndarray,
+                                weights: np.ndarray, stat_rows,
+                                stat_mins, stat_maxs):
+    """Shared bulk-import staging protocol for digest groups (dense and
+    slab share the ``_imp_*`` buffer layout and drain rules): span copies
+    into the import buffers, then drain when either the centroid buffer
+    or the stat lists fill."""
+    n = len(rows)
+    start = 0
+    while start < n:
+        if group._imp_fill == group.chunk:
+            group._drain_imports()
+        take = min(group.chunk - group._imp_fill, n - start)
+        i = group._imp_fill
+        group._imp_rows[i:i + take] = rows[start:start + take]
+        group._imp_means[i:i + take] = means[start:start + take]
+        group._imp_wts[i:i + take] = weights[start:start + take]
+        group._imp_fill = i + take
+        start += take
+    group._imp_stat_rows.extend(stat_rows)
+    group._imp_stat_mins.extend(stat_mins)
+    group._imp_stat_maxs.extend(stat_maxs)
+    if (group._imp_fill == group.chunk
+            or len(group._imp_stat_rows) >= group.chunk):
+        group._drain_imports()
+
+
 class DigestGroup:
     """One scope-class of histograms/timers as a dense t-digest batch."""
 
@@ -390,6 +417,16 @@ class DigestGroup:
             if len(self._imp_stat_rows) >= self.chunk:
                 self._drain_imports()
 
+    def import_centroids_bulk(self, rows: np.ndarray, means: np.ndarray,
+                              weights: np.ndarray, stat_rows: List[int],
+                              stat_mins: List[float],
+                              stat_maxs: List[float]):
+        """Bulk staging append for the import path (rows pre-interned by
+        the caller): span copies into the import buffers instead of a
+        Python call per digest."""
+        bulk_stage_import_centroids(self, rows, means, weights, stat_rows,
+                                    stat_mins, stat_maxs)
+
     def _drain_samples(self):
         if self._fill == 0:
             return
@@ -405,9 +442,14 @@ class DigestGroup:
             return
         self._device_dirty = True
         ns = len(self._imp_stat_rows)
-        stat_rows = np.full(max(ns, 1), self.capacity, np.int32)
-        stat_mins = np.full(max(ns, 1), np.inf, np.float32)
-        stat_maxs = np.full(max(ns, 1), -np.inf, np.float32)
+        # pad the stat arrays to a power-of-two bucket: every distinct
+        # length would otherwise compile its own _ingest_centroids
+        # variant (~20s each on TPU) — bulk imports produce a different
+        # ns per batch phase
+        cap = 1 << max(ns - 1, 0).bit_length() if ns else 1
+        stat_rows = np.full(max(cap, 1), self.capacity, np.int32)
+        stat_mins = np.full(max(cap, 1), np.inf, np.float32)
+        stat_maxs = np.full(max(cap, 1), -np.inf, np.float32)
         if ns:
             stat_rows[:ns] = self._imp_stat_rows
             stat_mins[:ns] = self._imp_stat_mins
@@ -1195,6 +1237,49 @@ class MetricStore:
             self.imported += 1
             group = self.timers if key.type == "timer" else self.histograms
             group.import_centroids(key, tags, means, weights, dmin, dmax)
+
+    def import_digests_bulk(self, entries: List[tuple]):
+        """Merge many forwarded digests in one pass: one lock hold, one
+        flat staging append per group instead of a per-metric call chain
+        (the gRPC import server's hot path; cf. the reference's
+        per-worker chunking, importsrv/server.go:99-132).
+
+        entries: [(key, tags, means, weights, dmin, dmax)]."""
+        with self._lock:
+            self.imported += len(entries)
+            for want_timer, group in ((False, self.histograms),
+                                      (True, self.timers)):
+                sel = [e for e in entries
+                       if (e[0].type == "timer") == want_timer]
+                if not sel:
+                    continue
+                if not hasattr(group, "import_centroids_bulk"):
+                    for key, tags, means, weights, dmin, dmax in sel:
+                        group.import_centroids(key, tags, means, weights,
+                                               dmin, dmax)
+                    continue
+                total = sum(len(e[2]) for e in sel)
+                flat_rows = np.empty(total, np.int32)
+                flat_means = np.empty(total, np.float32)
+                flat_wts = np.empty(total, np.float32)
+                stat_rows: List[int] = []
+                stat_mins: List[float] = []
+                stat_maxs: List[float] = []
+                pos = 0
+                for key, tags, means, weights, dmin, dmax in sel:
+                    row = group._row(key, tags)
+                    n = len(means)
+                    flat_rows[pos:pos + n] = row
+                    flat_means[pos:pos + n] = means
+                    flat_wts[pos:pos + n] = weights
+                    pos += n
+                    if math.isfinite(dmin):
+                        stat_rows.append(row)
+                        stat_mins.append(dmin)
+                        stat_maxs.append(dmax)
+                group.import_centroids_bulk(flat_rows, flat_means,
+                                            flat_wts, stat_rows,
+                                            stat_mins, stat_maxs)
 
     def import_set(self, key: MetricKey, tags: List[str],
                    registers: np.ndarray):

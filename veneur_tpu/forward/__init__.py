@@ -41,7 +41,9 @@ def configure_forwarding(server):
     if not cfg.forward_address:
         return None
     if cfg.forward_use_grpc:
-        fwd = GRPCForwarder(cfg.forward_address)
+        fwd = GRPCForwarder(
+            cfg.forward_address,
+            reference_compat=cfg.forward_reference_compatible)
     else:
         fwd = HTTPForwarder(cfg.forward_address)
     server.forward_fn = fwd.forward

@@ -27,6 +27,9 @@ from veneur_tpu.protocol import forward_pb2
 log = logging.getLogger("veneur.forward.grpc")
 
 _METHOD = "/forwardrpc.Forward/SendMetrics"
+# forward messages scale with active-series cardinality; 256 MB covers
+# ~2.5M digests per interval per local before chunking is needed
+_MAX_MESSAGE = 256 * 1024 * 1024
 
 
 class GRPCForwarder:
@@ -37,13 +40,18 @@ class GRPCForwarder:
     supports_topk = False
 
     def __init__(self, addr: str, timeout: float = 10.0,
-                 compression: float = 100.0):
+                 compression: float = 100.0,
+                 reference_compat: bool = False):
         if addr.startswith(("http://", "grpc://")):
             addr = addr.split("://", 1)[1]
         self.addr = addr
         self.timeout = timeout
         self.compression = compression
-        self._channel = grpc.insecure_channel(addr)
+        self.reference_compat = reference_compat
+        self._channel = grpc.insecure_channel(
+            addr,
+            options=[("grpc.max_receive_message_length", _MAX_MESSAGE),
+                     ("grpc.max_send_message_length", _MAX_MESSAGE)])
         self._send = self._channel.unary_unary(
             _METHOD,
             request_serializer=forward_pb2.MetricList.SerializeToString,
@@ -56,7 +64,8 @@ class GRPCForwarder:
         self.errors = 0
 
     def forward(self, state, parent_span=None):
-        mlist = metric_list_from_state(state, self.compression)
+        mlist = metric_list_from_state(
+            state, self.compression, reference_compat=self.reference_compat)
         if not mlist.metrics:
             return
         metadata = None
@@ -90,6 +99,7 @@ class ImportServer:
                  apply: Optional[Callable] = None, workers: int = 4,
                  trace_client=None):
         self._trace_client = trace_client
+        self._store = store if apply is None else None
         if apply is None:
             if store is None:
                 raise ValueError("need a store or an apply callable")
@@ -98,8 +108,13 @@ class ImportServer:
         self.received = 0
         self.import_errors = 0
         self._lock = threading.Lock()
+        # a big local's per-interval MetricList (one digest per active
+        # series) easily passes gRPC's 4 MB default — 20k digests with
+        # ~50 centroids each is ~20 MB on the wire
         self._grpc = grpc.server(
-            futures.ThreadPoolExecutor(max_workers=workers))
+            futures.ThreadPoolExecutor(max_workers=workers),
+            options=[("grpc.max_receive_message_length", _MAX_MESSAGE),
+                     ("grpc.max_send_message_length", _MAX_MESSAGE)])
         handler = grpc.method_handlers_generic_handler(
             "forwardrpc.Forward",
             {"SendMetrics": grpc.unary_unary_rpc_method_handler(
@@ -117,14 +132,26 @@ class ImportServer:
         span.name = "import"
         t0 = time.perf_counter()
         n_ok = 0
-        for m in request.metrics:
-            try:
-                self._apply(m)
-                n_ok += 1
-            except Exception as e:  # one bad metric must not drop the batch
+        if self._store is not None:
+            # batched digest staging: one bulk store call instead of a
+            # per-metric chain — the import tier's actual throughput
+            # ceiling. Malformed metrics are validated out BEFORE
+            # anything is applied (no double-apply fallback).
+            from veneur_tpu.forward.convert import apply_metric_list
+
+            n_ok, n_err = apply_metric_list(self._store, request)
+            if n_err:
                 with self._lock:
-                    self.import_errors += 1
-                log.debug("failed to import metric %s: %s", m.name, e)
+                    self.import_errors += n_err
+        else:
+            for m in request.metrics:
+                try:
+                    self._apply(m)
+                    n_ok += 1
+                except Exception as e:  # one bad metric must not drop it all
+                    with self._lock:
+                        self.import_errors += 1
+                    log.debug("failed to import metric %s: %s", m.name, e)
         with self._lock:
             self.received += n_ok
         from veneur_tpu.trace import samples as ssf_samples
