@@ -129,6 +129,45 @@ def _digest_arrays(td) -> tuple:
             float("-inf") if empty else td.max)
 
 
+def _validated_digest(key, tags, means, weights, dmin, dmax):
+    """Normalize a digest import so the bulk store call cannot raise on
+    its data: 1-D numeric parallel arrays, float extrema."""
+    means = np.asarray(means, np.float64)
+    weights = np.asarray(weights, np.float64)
+    if means.ndim != 1 or means.shape != weights.shape:
+        raise ValueError("centroid mean/weight arrays malformed")
+    return (key, tags, means, weights, float(dmin), float(dmax))
+
+
+def _apply_ops(store, others, digests) -> tuple:
+    """Apply pre-validated import ops: per-op guard on the scalar/set
+    path (a store-level rejection — e.g. an HLL precision mismatch —
+    skips that metric, never the batch), one bulk call for digests
+    (fully data-validated; anything raising past that is systemic and
+    SHOULD be batch-fatal). Returns (n_applied, n_errors)."""
+    n_ok = 0
+    n_err = 0
+    for kind, key, tags, payload in others:
+        try:
+            if kind == "counter":
+                store.import_counter(key, tags, payload)
+            elif kind == "gauge":
+                store.import_gauge(key, tags, payload)
+            elif kind == "set":
+                store.import_set(key, tags, payload)
+            else:  # topk: payload = (table, series)
+                store.import_topk(*payload)
+            n_ok += 1
+        except Exception as e:
+            n_err += 1
+            log.debug("store rejected imported metric %s: %s",
+                      key if isinstance(key, str) else key.name, e)
+    if digests:
+        store.import_digests_bulk(digests)
+        n_ok += len(digests)
+    return n_ok, n_err
+
+
 def apply_metric_list(store, mlist: forward_pb2.MetricList) -> tuple:
     """Merge a whole imported MetricList, batching the digest path: all
     histogram/timer centroids stage as flat arrays through ONE bulk store
@@ -137,42 +176,44 @@ def apply_metric_list(store, mlist: forward_pb2.MetricList) -> tuple:
     ingest ceiling).
 
     Per-metric error isolation without double-apply: every metric is
-    VALIDATED (type enum, payload decode, parallel-array lengths) before
-    anything touches the store; malformed ones are skipped and counted,
-    exactly like the server's old per-metric loop. Returns
-    (n_applied, n_errors)."""
+    PARSED AND DECODED up front (type enum, payload decode, parallel
+    array shapes) into typed ops — decoded payloads are carried forward,
+    not re-decoded — and the apply phase guards each non-digest op, so a
+    poison metric is skipped and counted, never batch-fatal and never
+    re-applied through a retry path. Returns (n_applied, n_errors)."""
     from veneur_tpu.samplers.parser import MetricKey
 
     digests = []   # (key, tags, means, weights, dmin, dmax)
-    others = []    # pre-validated non-digest metrics
+    others = []    # (kind, key, tags, decoded-payload)
     n_err = 0
     for m in mlist.metrics:
         try:
             tname = _TYPE_PB.get(m.type)
             if tname is None:
                 raise ValueError(f"unknown metric type {m.type}")
-            if m.WhichOneof("value") == "histogram":
+            which = m.WhichOneof("value")
+            tags = list(m.tags)
+            key = MetricKey(name=m.name, type=tname,
+                            joined_tags=",".join(tags))
+            if which == "histogram":
                 means, weights, dmin, dmax = _digest_arrays(
                     m.histogram.t_digest)
-                if len(means) != len(weights):
-                    raise ValueError("centroid mean/weight length mismatch")
-                tags = list(m.tags)
-                key = MetricKey(name=m.name, type=tname,
-                                joined_tags=",".join(tags))
-                digests.append((key, tags, means, weights, dmin, dmax))
+                digests.append(_validated_digest(key, tags, means,
+                                                 weights, dmin, dmax))
+            elif which == "counter":
+                others.append(("counter", key, tags, int(m.counter.value)))
+            elif which == "gauge":
+                others.append(("gauge", key, tags, float(m.gauge.value)))
+            elif which == "set":
+                registers, _ = decode_hll(m.set.hyper_log_log)
+                others.append(("set", key, tags, registers))
             else:
-                # decode-validate now (cheap), apply after validation
-                if m.WhichOneof("value") == "set":
-                    decode_hll(m.set.hyper_log_log)
-                others.append(m)
+                raise ValueError(f"metric {m.name} has no value")
         except Exception as e:
             n_err += 1
             log.debug("skipping malformed metric %s: %s", m.name, e)
-    for m in others:
-        apply_metric(store, m)
-    if digests:
-        store.import_digests_bulk(digests)
-    return len(others) + len(digests), n_err
+    n_ok, apply_errs = _apply_ops(store, others, digests)
+    return n_ok, n_err + apply_errs
 
 
 def apply_metric(store, m: metricpb_pb2.Metric):
@@ -255,6 +296,60 @@ def json_metrics_from_state(state, compression: float = 100.0) -> List[Dict]:
                 for name, tags, keys, members in series],
         })
     return out
+
+
+def apply_json_metric_list(store, metrics: List[Dict]) -> tuple:
+    """JSON twin of apply_metric_list: fully parse/decode every entry
+    into typed ops first (decoded payloads carried forward), guard each
+    non-digest apply, and stage all digests through one bulk store call.
+    Returns (n_applied, n_errors)."""
+    from veneur_tpu.samplers.parser import MetricKey
+
+    digests = []
+    others = []
+    n_err = 0
+    for d in metrics:
+        try:
+            mtype = d["type"]
+            tags = list(d.get("tags") or [])
+            if mtype in ("histogram", "timer"):
+                td = d["digest"]
+                cents = td.get("centroids") or []
+                key = MetricKey(name=d["name"], type=mtype,
+                                joined_tags=",".join(tags))
+                digests.append(_validated_digest(
+                    key, tags,
+                    np.array([c[0] for c in cents], np.float64),
+                    np.array([c[1] for c in cents], np.float64),
+                    td.get("min", float("inf")),
+                    td.get("max", float("-inf"))))
+                continue
+            key = MetricKey(name=d["name"], type=mtype,
+                            joined_tags=",".join(tags))
+            if mtype == "counter":
+                others.append(("counter", key, tags, int(d["value"])))
+            elif mtype == "gauge":
+                others.append(("gauge", key, tags, float(d["value"])))
+            elif mtype == "set":
+                registers, _ = decode_hll(base64.b64decode(d["hll"]))
+                others.append(("set", key, tags, registers))
+            elif mtype == "topk_sketch":
+                table = np.frombuffer(
+                    base64.b64decode(d["table"]),
+                    np.float32).reshape(int(d["depth"]), int(d["width"]))
+                series = [(s["name"], list(s.get("tags") or []),
+                           [(int(hi), int(lo)) for hi, lo in s["keys"]],
+                           list(s.get("members") or []))
+                          for s in d.get("series", [])]
+                others.append(("topk", d["name"], tags, (table, series)))
+            else:
+                raise ValueError(f"unknown JSON metric type {mtype!r}")
+        except Exception as e:
+            n_err += 1
+            log.debug("skipping malformed JSON metric %r: %s",
+                      d.get("name"), e)
+    n_ok, apply_errs = _apply_ops(store, others, digests)
+    return n_ok, n_err + apply_errs
 
 
 def apply_json_metric(store, d: Dict):

@@ -24,7 +24,6 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, List, Optional
 
 from veneur_tpu import __version__
-from veneur_tpu.forward.convert import apply_json_metric
 
 log = logging.getLogger("veneur.http")
 
@@ -169,18 +168,13 @@ class OpsServer:
     @classmethod
     def for_server(cls, server, addr: str) -> "OpsServer":
         def import_metrics(metrics: List[dict]) -> int:
-            errs = 0
-            for d in metrics:
-                try:
-                    apply_json_metric(server.store, d)
-                except Exception as e:
-                    errs += 1
-                    log.debug("failed to import metric %r: %s",
-                              d.get("name"), e)
+            from veneur_tpu.forward.convert import apply_json_metric_list
+
+            n_ok, errs = apply_json_metric_list(server.store, metrics)
             if errs:
                 log.warning("failed to import %d/%d metrics",
                             errs, len(metrics))
-            return len(metrics) - errs
+            return n_ok
 
         ops = cls(addr, import_fn=import_metrics,
                   trace_client=getattr(server, "trace_client", None))

@@ -19,6 +19,11 @@ from typing import Dict, List, Optional, Sequence
 import grpc
 from google.protobuf import empty_pb2
 
+# match the forward tier's limits (grpc_forward._MAX_MESSAGE): a proxy
+# between a big local and its global must pass the same message sizes
+_GRPC_OPTIONS = [("grpc.max_receive_message_length", 256 * 1024 * 1024),
+                 ("grpc.max_send_message_length", 256 * 1024 * 1024)]
+
 from veneur_tpu.forward.convert import type_name
 from veneur_tpu.protocol import forward_pb2
 from veneur_tpu.proxy.consistent import ConsistentRing, EmptyRingError
@@ -41,7 +46,7 @@ class _ConnMap:
             entry = self._conns.get(dest)
             if entry is None:
                 addr = dest.split("://", 1)[-1]
-                channel = grpc.insecure_channel(addr)
+                channel = grpc.insecure_channel(addr, options=_GRPC_OPTIONS)
                 send = channel.unary_unary(
                     _METHOD,
                     request_serializer=(
@@ -76,7 +81,8 @@ class GRPCProxyServer:
         if destinations:
             self.set_destinations(destinations)
 
-        self._grpc = grpc.server(futures.ThreadPoolExecutor(workers))
+        self._grpc = grpc.server(futures.ThreadPoolExecutor(workers),
+                                 options=_GRPC_OPTIONS)
         handler = grpc.method_handlers_generic_handler(
             "forwardrpc.Forward",
             {"SendMetrics": grpc.unary_unary_rpc_method_handler(
