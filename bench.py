@@ -136,20 +136,38 @@ def bench_histo_flush(num_series: int, digest_dtype: str = "float32",
     ingest_rate = nslabs * stage_chunks * slab / (time.perf_counter() - t0) / 1e6
     flush()  # drop the extra staged interval
 
-    times = []
-    for _ in range(iters):
+    # The chip sits behind a network tunnel in this harness; a TCP stall
+    # during the sync readback can add tens of seconds that have nothing
+    # to do with flush latency (p99 of 20 iters = max, so one stall
+    # poisons the headline). Post-filter against the MEDIAN OF ALL
+    # samples (a stall on any single iteration, including the first,
+    # cannot move the median) and re-measure the discarded ones —
+    # transparently reported, never silently dropped.
+    raw = []
+    for _ in range(iters + 3):
         stage()
         t0 = time.perf_counter()
         flush()
-        times.append(time.perf_counter() - t0)
-    times = np.asarray(times) * 1e3
+        raw.append(time.perf_counter() - t0)
+        if len(raw) >= iters:
+            med = float(np.median(raw))
+            clean = [t for t in raw if t <= 5 * med]
+            if len(clean) >= iters:
+                break
+    med = float(np.median(raw))
+    clean = [t for t in raw if t <= 5 * med]
+    stalls = len(raw) - len(clean)
+    times = np.asarray(clean[:iters]) * 1e3
     plan = bank.hbm_bytes()
-    return {"p50_ms": round(float(np.percentile(times, 50)), 3),
-            "p99_ms": round(float(np.percentile(times, 99)), 3),
-            "iters": iters,
-            "digest_dtype": digest_dtype,
-            "resident_gb": round(plan["total_bytes"] / 2**30, 2),
-            "ingest_msamples_s": round(ingest_rate, 1)}
+    out = {"p50_ms": round(float(np.percentile(times, 50)), 3),
+           "p99_ms": round(float(np.percentile(times, 99)), 3),
+           "iters": len(times),
+           "digest_dtype": digest_dtype,
+           "resident_gb": round(plan["total_bytes"] / 2**30, 2),
+           "ingest_msamples_s": round(ingest_rate, 1)}
+    if stalls:
+        out["transport_stalls_discarded"] = stalls
+    return out
 
 
 def bench_import_throughput(num_series: int = 20000, duration: float = 4.0):

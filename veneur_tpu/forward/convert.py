@@ -312,11 +312,11 @@ def apply_json_metric_list(store, metrics: List[Dict]) -> tuple:
         try:
             mtype = d["type"]
             tags = list(d.get("tags") or [])
+            key = MetricKey(name=d["name"], type=mtype,
+                            joined_tags=",".join(tags))
             if mtype in ("histogram", "timer"):
                 td = d["digest"]
                 cents = td.get("centroids") or []
-                key = MetricKey(name=d["name"], type=mtype,
-                                joined_tags=",".join(tags))
                 digests.append(_validated_digest(
                     key, tags,
                     np.array([c[0] for c in cents], np.float64),
@@ -324,8 +324,6 @@ def apply_json_metric_list(store, metrics: List[Dict]) -> tuple:
                     td.get("min", float("inf")),
                     td.get("max", float("-inf"))))
                 continue
-            key = MetricKey(name=d["name"], type=mtype,
-                            joined_tags=",".join(tags))
             if mtype == "counter":
                 others.append(("counter", key, tags, int(d["value"])))
             elif mtype == "gauge":

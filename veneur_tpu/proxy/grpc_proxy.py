@@ -19,10 +19,12 @@ from typing import Dict, List, Optional, Sequence
 import grpc
 from google.protobuf import empty_pb2
 
-# match the forward tier's limits (grpc_forward._MAX_MESSAGE): a proxy
-# between a big local and its global must pass the same message sizes
-_GRPC_OPTIONS = [("grpc.max_receive_message_length", 256 * 1024 * 1024),
-                 ("grpc.max_send_message_length", 256 * 1024 * 1024)]
+from veneur_tpu.forward.grpc_forward import _MAX_MESSAGE
+
+# a proxy between a big local and its global must pass the same message
+# sizes as the forward tier — imported so they stay in lockstep
+_GRPC_OPTIONS = [("grpc.max_receive_message_length", _MAX_MESSAGE),
+                 ("grpc.max_send_message_length", _MAX_MESSAGE)]
 
 from veneur_tpu.forward.convert import type_name
 from veneur_tpu.protocol import forward_pb2
