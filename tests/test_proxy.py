@@ -197,6 +197,98 @@ class TestHTTPProxyPipeline:
             proxy.shutdown()
 
 
+class _SpanRecorder(BaseHTTPRequestHandler):
+    """Downstream /spans endpoint recording every POSTed batch."""
+
+    def log_message(self, *a):
+        pass
+
+    def do_POST(self):
+        body = self.rfile.read(int(self.headers.get("Content-Length") or 0))
+        if self.path == "/spans":
+            self.server.batches.append(json.loads(body))
+            self.send_response(202)
+        else:
+            self.send_response(404)
+        self.send_header("Content-Length", "0")
+        self.end_headers()
+
+
+class TestProxySpans:
+    def test_spans_fan_out_partitioned_by_trace_id(self):
+        """POST /spans on the proxy partitions Datadog trace spans by
+        trace id over the trace ring and forwards each batch to its
+        destination's /spans (proxy.go:393-434)."""
+        from veneur_tpu.forward.http_forward import post_helper
+
+        downstreams = []
+        for _ in range(2):
+            httpd = HTTPServer(("127.0.0.1", 0), _SpanRecorder)
+            httpd.batches = []
+            threading.Thread(target=httpd.serve_forever,
+                             daemon=True).start()
+            downstreams.append(httpd)
+        trace_dests = [f"http://127.0.0.1:{d.server_address[1]}"
+                       for d in downstreams]
+
+        class PerService:
+            def get_destinations_for_service(self, name):
+                if name == "veneur-trace":
+                    return trace_dests
+                return ["http://127.0.0.1:9"]  # metrics ring, unused here
+
+        proxy = Proxy(ProxyConfig(http_address="127.0.0.1:0",
+                                  consul_forward_service_name="veneur",
+                                  consul_trace_service_name="veneur-trace",
+                                  forward_timeout="5s"),
+                      discoverer=PerService())
+        proxy.start()
+        try:
+            # two spans per trace: same trace must land on one downstream
+            spans = [{"trace_id": tid, "span_id": 2 * tid + j,
+                      "parent_id": 0, "service": "svc", "name": "op",
+                      "resource": "r", "start": 1, "duration": 2,
+                      "error": 0, "type": "web", "meta": {}, "metrics": {}}
+                     for tid in range(1, 21) for j in range(2)]
+            status = post_helper(
+                f"http://127.0.0.1:{proxy.port}/spans", spans,
+                compress=False)
+            assert status == 202
+            deadline = time.time() + 5
+            while (time.time() < deadline
+                   and sum(len(b) for d in downstreams
+                           for b in d.batches) < 40):
+                time.sleep(0.02)
+            got = [[s for b in d.batches for s in b] for d in downstreams]
+            assert sum(len(g) for g in got) == 40
+            assert all(len(g) > 0 for g in got), "ring used only one dest"
+            # co-location: no trace id appears on both downstreams
+            tids = [set(s["trace_id"] for s in g) for g in got]
+            assert not (tids[0] & tids[1])
+            # the counter increments after the POST response lands; wait
+            deadline = time.time() + 5
+            while time.time() < deadline and proxy.traces_proxied < 40:
+                time.sleep(0.02)
+            assert proxy.traces_proxied == 40
+        finally:
+            proxy.shutdown()
+            for d in downstreams:
+                d.shutdown()
+
+    def test_spans_404_when_not_accepting_traces(self):
+        from veneur_tpu.forward.http_forward import post_helper
+
+        proxy = Proxy(ProxyConfig(http_address="127.0.0.1:0"),
+                      discoverer=StaticDiscoverer(["http://127.0.0.1:9"]))
+        proxy.start()
+        try:
+            status = post_helper(f"http://127.0.0.1:{proxy.port}/spans",
+                                 [], compress=False)
+            assert status == 404
+        finally:
+            proxy.shutdown()
+
+
 class TestGRPCProxyPipeline:
     def test_local_to_grpc_proxy_to_two_globals(self):
         stores = [MetricStore(initial_capacity=64, chunk=128)

@@ -211,6 +211,46 @@ class TestSSF:
         finally:
             server.shutdown()
 
+    def test_blocked_span_sink_does_not_stall_extraction(self):
+        """A hung span sink must not stall other sinks — critically the
+        metric-extraction sink, the path SSF metrics take to the store
+        (the reference bounds each sink's Ingest at 9s, worker.go:541-590;
+        here each sink drains on its own bounded lane)."""
+        import threading
+
+        release = threading.Event()
+
+        class BlockedSink(ChannelSpanSink):
+            @property
+            def name(self):
+                return "blocked"
+
+            def ingest(self, span):
+                release.wait(30.0)
+
+        blocked = BlockedSink()
+        config = Config(statsd_listen_addresses=[],
+                        ssf_listen_addresses=["udp://127.0.0.1:0"],
+                        interval="86400s")
+        sink = ChannelMetricSink()
+        server = Server(config, metric_sinks=[sink], span_sinks=[blocked])
+        server.start()
+        try:
+            # spans with metrics keep arriving while "blocked" is wedged
+            for _ in range(3):
+                send_udp(server.ssf_addrs[0],
+                         self._span().SerializeToString())
+            # extraction proceeds: the SSF counters reach the store even
+            # though the blocked sink never returns from ingest
+            assert wait_for(lambda: server.store.processed >= 3)
+            server.flush()
+            batch = sink.get_flush()
+            assert any(m.name == "ssf.count" and m.value == 6.0
+                       for m in batch)
+        finally:
+            release.set()
+            server.shutdown()
+
     def test_indicator_span_timer(self):
         server, sink = make_server(
             ssf_listen_addresses=["udp://127.0.0.1:0"],

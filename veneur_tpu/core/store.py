@@ -264,9 +264,21 @@ def bulk_stage_import_centroids(group, rows: np.ndarray, means: np.ndarray,
         group._imp_wts[i:i + take] = weights[start:start + take]
         group._imp_fill = i + take
         start += take
-    group._imp_stat_rows.extend(stat_rows)
-    group._imp_stat_mins.extend(stat_mins)
-    group._imp_stat_maxs.extend(stat_maxs)
+    # stat triples stage in chunk-bounded spans too: one oversized drain
+    # would pad the stat arrays past the bounded pow2 ladder and compile
+    # a one-off _ingest_centroids variant (~20s each on TPU)
+    ns = len(stat_rows)
+    pos = 0
+    while pos < ns:
+        room = group.chunk - len(group._imp_stat_rows)
+        if room == 0:
+            group._drain_imports()
+            continue
+        take = min(room, ns - pos)
+        group._imp_stat_rows.extend(stat_rows[pos:pos + take])
+        group._imp_stat_mins.extend(stat_mins[pos:pos + take])
+        group._imp_stat_maxs.extend(stat_maxs[pos:pos + take])
+        pos += take
     if (group._imp_fill == group.chunk
             or len(group._imp_stat_rows) >= group.chunk):
         group._drain_imports()

@@ -46,10 +46,19 @@ def _flush_once(server: "Server", span):
         except Exception:
             log.exception("sink %s flush_other_samples failed", sink.name)
 
-    # span sinks flush concurrently with the metric path (flusher.go:49)
-    span_flusher = threading.Thread(
-        target=_flush_spans, args=(server,), daemon=True)
-    span_flusher.start()
+    # span sinks flush concurrently with the metric path (flusher.go:49).
+    # A wedged span sink can hold its barrier for 9s, so with short
+    # intervals the previous flusher may still be running — never stack a
+    # second concurrent flush onto the same sinks
+    span_flusher = getattr(server, "_span_flush_thread", None)
+    if span_flusher is None or not span_flusher.is_alive():
+        span_flusher = threading.Thread(
+            target=_flush_spans, args=(server,), daemon=True)
+        server._span_flush_thread = span_flusher
+        span_flusher.start()
+    else:
+        log.warning("previous span flush still running; skipping this "
+                    "interval's span flush")
 
     is_local = server.is_local()
     if is_local and server.forward_fn is None and not server._warned_no_forward:

@@ -45,6 +45,8 @@ def configure_forwarding(server):
             cfg.forward_address,
             reference_compat=cfg.forward_reference_compatible)
     else:
-        fwd = HTTPForwarder(cfg.forward_address)
+        fwd = HTTPForwarder(
+            cfg.forward_address,
+            reference_compat=cfg.forward_reference_compatible)
     server.forward_fn = fwd.forward
     return fwd

@@ -163,8 +163,19 @@ def _apply_ops(store, others, digests) -> tuple:
             log.debug("store rejected imported metric %s: %s",
                       key if isinstance(key, str) else key.name, e)
     if digests:
-        store.import_digests_bulk(digests)
-        n_ok += len(digests)
+        try:
+            store.import_digests_bulk(digests)
+            n_ok += len(digests)
+        except Exception:
+            # the batch is fully data-validated, so anything raising here
+            # is systemic (device OOM, compile failure). The bulk apply is
+            # not transactional — a prefix may already be staged — so the
+            # whole batch counts as errors and is NOT retried (neither
+            # forwarder retries a failed send; a retry could double-count
+            # the applied prefix).
+            n_err += len(digests)
+            log.exception("bulk digest import failed; dropping %d digests",
+                          len(digests))
     return n_ok, n_err
 
 
@@ -247,9 +258,15 @@ def apply_metric(store, m: metricpb_pb2.Metric):
 # ---------------------------------------------------------------------------
 
 
-def json_metrics_from_state(state, compression: float = 100.0) -> List[Dict]:
+def json_metrics_from_state(state, compression: float = 100.0,
+                            include_topk: bool = True) -> List[Dict]:
     """ForwardableState → list of JSON-metric dicts, the structured
-    replacement for ``JSONMetric``'s gob blob (flusher.go:292-385)."""
+    replacement for ``JSONMetric``'s gob blob (flusher.go:292-385).
+
+    include_topk=False suppresses the heavy-hitter sketch extension so a
+    reference (Go) global never sees an unknown metric type (it would log
+    an import error every interval); set when forwarding into a reference
+    fleet (forward_reference_compatible)."""
     out: List[Dict] = []
 
     def base(name, tags, mtype):
@@ -277,7 +294,7 @@ def json_metrics_from_state(state, compression: float = 100.0) -> List[Dict]:
         d = base(name, tags, "set")
         d["hll"] = base64.b64encode(encode_hll(registers, precision)).decode()
         out.append(d)
-    if state.topk is not None:
+    if state.topk is not None and include_topk:
         table, series = state.topk
         table = np.ascontiguousarray(table, np.float32)
         out.append({

@@ -44,23 +44,27 @@ def post_helper(url: str, payload, timeout: float = 10.0,
 class HTTPForwarder:
     """Per-flush HTTP forward of ForwardableState (flusher.go:292-385)."""
 
-    # the JSON wire carries the heavy-hitter sketch extension
-    supports_topk = True
-
     def __init__(self, addr: str, timeout: float = 10.0,
-                 compression: float = 100.0):
+                 compression: float = 100.0,
+                 reference_compat: bool = False):
         self.base = addr.rstrip("/")
         if not self.base.startswith(("http://", "https://")):
             self.base = "http://" + self.base
         self.timeout = timeout
         self.compression = compression
+        # the JSON wire carries the heavy-hitter sketch extension, but a
+        # reference (Go) global would reject it as an unknown metric type
+        # every interval — suppress it when forwarding into a Go fleet
+        # (the flusher then has the local emit its own top-k instead)
+        self.supports_topk = not reference_compat
         # forward() runs on a fresh thread each flush; guard the counters
         self._lock = threading.Lock()
         self.forwarded = 0
         self.errors = 0
 
     def forward(self, state, parent_span=None):
-        metrics = json_metrics_from_state(state, self.compression)
+        metrics = json_metrics_from_state(
+            state, self.compression, include_topk=self.supports_topk)
         if not metrics:
             return
         url = self.base + "/import"

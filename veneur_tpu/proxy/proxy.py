@@ -10,8 +10,10 @@ with zero destinations is fatal (proxy.go:232-243).
 
 from __future__ import annotations
 
+import json
 import logging
 import threading
+import zlib
 from collections import defaultdict
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, List, Optional
@@ -58,19 +60,38 @@ class _ProxyHandler(BaseHTTPRequestHandler):
 
     def do_POST(self):
         body = self._drain_body()
-        if self.path != "/import":
+        if self.path == "/import":
+            try:
+                metrics = unmarshal_metrics_from_http(self.headers, body)
+            except ImportError400 as e:
+                self._reply(400, str(e))
+                return
+            # accept, then fan out off the request thread
+            # (handlers_global.go:28-43: "go p.ProxyMetrics")
+            self._reply(202, "accepted")
+            threading.Thread(target=self.server.veneur_proxy.proxy_metrics,
+                             args=(metrics,), daemon=True).start()
+        elif self.path == "/spans":
+            # Datadog trace spans fan out over their own ring
+            # (handlers_global.go:45-56 → ProxyTraces, proxy.go:393-434)
+            proxy = self.server.veneur_proxy
+            if not proxy.accepting_traces:
+                self._reply(404, "not accepting traces")
+                return
+            try:
+                if (self.headers.get("Content-Encoding") or "") == "deflate":
+                    body = zlib.decompress(body)
+                traces = json.loads(body)
+                if not isinstance(traces, list):
+                    raise ValueError("expected a JSON array of spans")
+            except (ValueError, zlib.error) as e:
+                self._reply(400, f"bad trace body: {e}")
+                return
+            self._reply(202, "accepted")
+            threading.Thread(target=proxy.proxy_traces, args=(traces,),
+                             daemon=True).start()
+        else:
             self._reply(404, "not found")
-            return
-        try:
-            metrics = unmarshal_metrics_from_http(self.headers, body)
-        except ImportError400 as e:
-            self._reply(400, str(e))
-            return
-        # accept, then fan out off the request thread
-        # (handlers_global.go:28-43: "go p.ProxyMetrics")
-        self._reply(202, "accepted")
-        threading.Thread(target=self.server.veneur_proxy.proxy_metrics,
-                         args=(metrics,), daemon=True).start()
 
 
 class Proxy:
@@ -95,11 +116,30 @@ class Proxy:
                 "proxy needs consul_forward_service_name or forward_address")
 
         self.ring = ConsistentRing()
+        # trace spans ride their own ring (proxy.go:41,119-136): Consul
+        # service when configured, else the static trace_address. The
+        # trace ring needs its OWN discoverer: with a static
+        # forward_address the metrics discoverer would hand the trace
+        # ring the metrics destination instead of consulting the trace
+        # service. An injected discoverer serves both rings (tests).
+        self.trace_service_name = config.consul_trace_service_name
+        self.trace_ring = ConsistentRing()
+        self.accepting_traces = bool(self.trace_service_name
+                                     or config.trace_address)
+        if discoverer is not None:
+            self.trace_discoverer: Optional[Discoverer] = discoverer
+        elif self.trace_service_name:
+            self.trace_discoverer = ConsulDiscoverer()
+        else:
+            self.trace_discoverer = None  # static trace_address, if any
+            if config.trace_address:
+                self.trace_ring.set_members([config.trace_address])
         self._stop = threading.Event()
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._threads: List[threading.Thread] = []
         # telemetry
         self.proxied = 0
+        self.traces_proxied = 0
         self.forward_errors = 0
         self.refresh_failures = 0
         self._lock = threading.Lock()
@@ -107,24 +147,34 @@ class Proxy:
     # -- discovery ----------------------------------------------------------
 
     def refresh_destinations(self):
-        """Re-resolve membership; a failure or empty result keeps the
-        previous ring (proxy.go:337-371)."""
+        """Re-resolve membership for every configured ring
+        (proxy.go:239-267)."""
+        self._refresh_ring(self.discoverer, self.service_name, self.ring)
+        if (self.accepting_traces and self.trace_service_name
+                and self.trace_discoverer is not None):
+            self._refresh_ring(self.trace_discoverer,
+                               self.trace_service_name, self.trace_ring)
+
+    def _refresh_ring(self, discoverer: Discoverer, service_name: str,
+                      ring: ConsistentRing):
+        """Re-resolve one ring's membership; a failure or empty result
+        keeps the previous ring (proxy.go:337-371)."""
         try:
-            destinations = self.discoverer.get_destinations_for_service(
-                self.service_name)
+            destinations = discoverer.get_destinations_for_service(
+                service_name)
         except Exception as e:
             with self._lock:
                 self.refresh_failures += 1
             log.warning("destination refresh failed, keeping %d known: %s",
-                        len(self.ring), e)
+                        len(ring), e)
             return
         if not destinations:
             with self._lock:
                 self.refresh_failures += 1
             log.warning("discovery returned zero destinations, keeping %d",
-                        len(self.ring))
+                        len(ring))
             return
-        self.ring.set_members(destinations)
+        ring.set_members(destinations)
 
     def _refresh_loop(self):
         while not self._stop.wait(self.refresh_interval):
@@ -135,40 +185,59 @@ class Proxy:
     def proxy_metrics(self, metrics: List[dict]):
         """Hash each metric to its destination, batch, POST in parallel
         (proxy.go:437-505)."""
+        self._fan_out(metrics, self.ring, metric_ring_key, "/import",
+                      compress=True, counter="proxied", what="metrics")
+
+    def proxy_traces(self, traces: List[dict]):
+        """Partition Datadog trace spans by trace id over the trace ring
+        and POST each batch to ``{dest}/spans``; the /spans endpoint takes
+        an array but not deflate (proxy.go:393-434)."""
+        self._fan_out(traces, self.trace_ring,
+                      lambda t: str(int(t["trace_id"])), "/spans",
+                      compress=False, counter="traces_proxied",
+                      what="trace spans")
+
+    def _fan_out(self, items: List[dict], ring: ConsistentRing, key_fn,
+                 path: str, compress: bool, counter: str, what: str):
+        """The shared partition → parallel-POST machinery behind both
+        fan-outs."""
         by_dest: Dict[str, List[dict]] = defaultdict(list)
         dropped = 0
-        for d in metrics:
+        for d in items:
             try:
-                by_dest[self.ring.get(metric_ring_key(d))].append(d)
-            except (EmptyRingError, KeyError):
+                by_dest[ring.get(key_fn(d))].append(d)
+            except (EmptyRingError, KeyError, TypeError, ValueError):
                 dropped += 1
         if dropped:
-            log.warning("dropped %d unroutable metrics", dropped)
+            log.warning("dropped %d unroutable %s", dropped, what)
         threads = []
         for dest, batch in by_dest.items():
-            t = threading.Thread(target=self._post_batch, args=(dest, batch),
-                                 daemon=True)
+            t = threading.Thread(
+                target=self._post_batch,
+                args=(dest, batch, path, compress, counter, what),
+                daemon=True)
             t.start()
             threads.append(t)
         for t in threads:
             t.join(timeout=self.forward_timeout + 1.0)
 
-    def _post_batch(self, dest: str, batch: List[dict]):
+    def _post_batch(self, dest: str, batch: List[dict], path: str,
+                    compress: bool, counter: str, what: str):
         url = dest.rstrip("/")
         if not url.startswith(("http://", "https://")):
             url = "http://" + url
         try:
-            status = post_helper(url + "/import", batch,
+            status = post_helper(url + path, batch, compress=compress,
                                  timeout=self.forward_timeout)
             if not 200 <= status < 300:
                 raise OSError(f"destination returned HTTP {status}")
             with self._lock:
-                self.proxied += len(batch)
+                setattr(self, counter, getattr(self, counter) + len(batch))
         except Exception as e:
             with self._lock:
                 self.forward_errors += 1
-            log.warning("failed to proxy %d metrics to %s: %s",
-                        len(batch), dest, e)
+            log.warning("failed to proxy %d %s to %s: %s",
+                        len(batch), what, dest, e)
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -183,7 +252,15 @@ class Proxy:
         if len(self.ring) == 0:
             raise RuntimeError(
                 "refusing to start with zero destinations (proxy.go:232-243)")
-        if not isinstance(self.discoverer, StaticDiscoverer):
+        if (self.accepting_traces and self.trace_service_name
+                and len(self.trace_ring) == 0):
+            raise RuntimeError("refusing to start with zero trace "
+                               "destinations (proxy.go:239-243)")
+        needs_refresh = (
+            not isinstance(self.discoverer, StaticDiscoverer)
+            or (self.trace_discoverer is not None
+                and not isinstance(self.trace_discoverer, StaticDiscoverer)))
+        if needs_refresh:
             t = threading.Thread(target=self._refresh_loop,
                                  name="proxy-refresh", daemon=True)
             t.start()

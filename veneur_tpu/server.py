@@ -51,15 +51,122 @@ class EventWorker:
         return out
 
 
+class _SinkIngestor:
+    """One span sink's bounded ingest lane: a dedicated thread drains a
+    bounded queue into ``sink.ingest``.
+
+    This is the thread-pool translation of the reference's
+    goroutine-per-ingest with a 9 s timeout (worker.go:541-590): there a
+    hung sink times out and the worker moves on (leaking the goroutine);
+    here a hung sink wedges only its own lane — spans pile into its queue
+    and, once full, drop with ``ingest_timeout_total`` — while every
+    other sink (critically the metric-extraction sink, the main path to
+    the store) keeps draining.
+    """
+
+    TIMEOUT = 9.0  # worker.go:523
+
+    def __init__(self, sink: SpanSink, stop: threading.Event,
+                 capacity: int = 4096):
+        self.sink = sink
+        self.stop = stop
+        self.queue: "queue.Queue" = queue.Queue(capacity)
+        self.ingest_errors = 0
+        self.ingest_timeouts = 0
+        # offer() runs on every span-worker thread concurrently
+        self._drop_lock = threading.Lock()
+        self._flush_thread: Optional[threading.Thread] = None
+        self._thread = threading.Thread(
+            target=self._work, name=f"span-ingest-{sink.name}", daemon=True)
+        self._thread.start()
+
+    def offer(self, span) -> None:
+        try:
+            self.queue.put_nowait(span)
+        except queue.Full:
+            # the lane is wedged (or 9s+ behind): drop, as the reference
+            # does after its per-span timeout fires
+            with self._drop_lock:
+                self.ingest_timeouts += 1
+
+    def _work(self):
+        while True:
+            try:
+                span = self.queue.get(timeout=0.5)
+            except queue.Empty:
+                # exit only once stopped AND drained, so shutdown's final
+                # flush never abandons spans already accepted off the
+                # channel (the "at most one interval lost" contract)
+                if self.stop.is_set():
+                    return
+                continue
+            try:
+                self.sink.ingest(span)
+            except Exception:
+                self.ingest_errors += 1
+                log.exception("span sink %s ingest failed", self.sink.name)
+            finally:
+                self.queue.task_done()
+
+    def drain(self, timeout: float = TIMEOUT) -> bool:
+        """Wait (bounded) until every offered span has finished ingesting
+        (not merely been popped); False if the lane is still wedged."""
+        deadline = time.monotonic() + timeout
+        while self.queue.unfinished_tasks:
+            if time.monotonic() >= deadline:
+                return False
+            time.sleep(0.01)
+        return True
+
+    def flush_sink(self, timeout: float = TIMEOUT) -> None:
+        """Run ``sink.flush()`` bounded: on its own thread, joined up to
+        ``timeout``. A sink whose flush blocks forever (same dead peer
+        its ingest is wedged on) must pin only ITSELF — the next interval
+        skips just this sink while every other sink keeps flushing."""
+        if self._flush_thread is not None and self._flush_thread.is_alive():
+            log.warning("span sink %s previous flush still running; "
+                        "skipping", self.sink.name)
+            return
+
+        def run():
+            try:
+                self.sink.flush()
+            except Exception:
+                log.exception("span sink %s flush failed", self.sink.name)
+
+        t = threading.Thread(target=run,
+                             name=f"span-flush-{self.sink.name}",
+                             daemon=True)
+        self._flush_thread = t
+        t.start()
+        t.join(timeout)
+        if t.is_alive():
+            log.warning("span sink %s flush exceeded %.0fs; continuing "
+                        "without it", self.sink.name, timeout)
+
+
+def make_span_lanes(sinks: List[SpanSink],
+                    stop: threading.Event) -> List[_SinkIngestor]:
+    """One shared lane per sink — shared across every SpanWorker, so a
+    sink has exactly one ingest thread and the flush barrier covers all
+    workers' spans."""
+    return [_SinkIngestor(s, stop) for s in sinks]
+
+
 class SpanWorker:
-    """Drains the span channel into every span sink (worker.go:487-592)."""
+    """Drains the span channel into every span sink (worker.go:487-592),
+    through bounded per-sink ingest lanes (shared between workers) so a
+    hung sink cannot stall the rest (see _SinkIngestor)."""
 
     def __init__(self, sinks: List[SpanSink], span_chan: "queue.Queue",
-                 stop: threading.Event):
+                 stop: threading.Event,
+                 lanes: Optional[List[_SinkIngestor]] = None):
         self.sinks = sinks
         self.chan = span_chan
         self.stop = stop
         self.ingested = 0
+        self._lanes = lanes if lanes is not None else make_span_lanes(
+            sinks, stop)
 
     def work(self):
         while not self.stop.is_set():
@@ -68,18 +175,17 @@ class SpanWorker:
             except queue.Empty:
                 continue
             self.ingested += 1
-            for sink in self.sinks:
-                try:
-                    sink.ingest(span)
-                except Exception:
-                    log.exception("span sink %s ingest failed", sink.name)
+            for lane in self._lanes:
+                lane.offer(span)
 
     def flush(self):
-        for sink in self.sinks:
-            try:
-                sink.flush()
-            except Exception:
-                log.exception("span sink %s flush failed", sink.name)
+        for lane in self._lanes:
+            # flush-barrier: give in-flight spans a bounded chance to land
+            # before the sink flushes (a wedged lane is skipped, not waited)
+            if not lane.drain():
+                log.warning("span sink %s still wedged at flush; %d drops "
+                            "so far", lane.sink.name, lane.ingest_timeouts)
+            lane.flush_sink()
 
 
 def calculate_tick_delay(interval: float, now: float) -> float:
@@ -302,8 +408,12 @@ class Server:
 
             self._guard = profiled_guard
             log.info("profiling enabled; stats written on shutdown")
+        # shared per-sink ingest lanes: every worker feeds the same lanes,
+        # so each sink has one ingest thread and one flush barrier
+        span_lanes = make_span_lanes(self.span_sinks, self._stop)
         for _ in range(max(1, cfg.num_span_workers)):
-            w = SpanWorker(self.span_sinks, self.span_chan, self._stop)
+            w = SpanWorker(self.span_sinks, self.span_chan, self._stop,
+                           lanes=span_lanes)
             t = threading.Thread(target=self._guard(w.work),
                                  name="span-worker", daemon=True)
             t.start()

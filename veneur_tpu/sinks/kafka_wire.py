@@ -226,7 +226,10 @@ class WireProducer:
                 # re-learn metadata and retry, keeping its connections)
                 raise _NoLeader(
                     f"partition {pid} of {topic!r} has no leader")
-        elif self.partitioner == "random":
+        elif self.partitioner == "random" or self.partitioner == "hash":
+            # nil-key messages under sarama's HashPartitioner dispatch
+            # via the random partitioner (sarama partitioner.go), so a
+            # hash-partitioned producer with no key lands here too
             pids = sorted(parts)
             pid = pids[random.randrange(len(pids))]
         else:
