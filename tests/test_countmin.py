@@ -234,6 +234,49 @@ class TestTopkForwarding:
         assert got["carol"] == 2.0
         assert got["dave"] == 7.0
 
+    def test_fleet_topk_over_grpc(self):
+        """The sketch also rides gRPC, as the MetricList.topk extension
+        (skipped by a reference global), through the real transport +
+        the native import lane."""
+        from veneur_tpu.forward import GRPCForwarder, ImportServer
+
+        a = self._local_with({"alice": 30, "bob": 10})
+        b = self._local_with({"alice": 5, "bob": 25, "dave": 7})
+        gstore = MetricStore(initial_capacity=16, chunk=256)
+        srv = ImportServer(gstore)
+        port = srv.start("127.0.0.1:0")
+        try:
+            client = GRPCForwarder(f"127.0.0.1:{port}")
+            assert client.supports_topk
+            for local in (a, b):
+                _, fwd, _ = local.flush([], AGG, is_local=True, now=0,
+                                        forward=True)
+                assert fwd.topk is not None
+                client.forward(fwd)
+            assert client.errors == 0
+            final, _, _ = gstore.flush([], AGG, is_local=False, now=1,
+                                       forward=False)
+            got = {m.tags[-1].split(":", 1)[1]: m.value
+                   for m in final if m.name == "api.callers.topk"}
+            assert got["alice"] == 35.0
+            assert got["bob"] == 35.0
+            assert got["dave"] == 7.0
+        finally:
+            srv.stop()
+
+    def test_reference_compat_suppresses_topk_field(self):
+        from veneur_tpu.forward import GRPCForwarder
+        from veneur_tpu.forward.convert import metric_list_from_state
+
+        a = self._local_with({"alice": 3})
+        _, fwd, _ = a.flush([], AGG, is_local=True, now=0, forward=True)
+        assert fwd.topk is not None
+        assert metric_list_from_state(fwd).HasField("topk")
+        assert not metric_list_from_state(
+            fwd, reference_compat=True).HasField("topk")
+        compat = GRPCForwarder("127.0.0.1:1", reference_compat=True)
+        assert not compat.supports_topk
+
     def test_fleet_topk_survives_different_intern_orders(self):
         """Regression: table columns are salted with the STABLE series
         id, not the local row index — host A interning m1 then m2 and

@@ -1,0 +1,403 @@
+"""Native egress codecs + columnar flush path.
+
+Covers veneur_tpu/native/veneur_egress.cpp through native/egress.py:
+Datadog series JSON correctness vs the Python sink's finalize rules
+(sinks/datadog/datadog.go:245-330), MetricList encode/decode round-trips
+vs python-protobuf (forwardrpc/metricpb wire), the import intern table,
+and the columnar flush producing the same metrics as the legacy per-row
+path.
+"""
+
+import json
+import zlib
+
+import numpy as np
+import pytest
+
+from veneur_tpu.native import egress
+
+pytestmark = pytest.mark.skipif(not egress.available(),
+                                reason="no native toolchain")
+
+
+def arenas(strs):
+    from veneur_tpu.core.columnar import build_arenas
+
+    return build_arenas(strs)
+
+
+class TestDDSeriesJSON:
+    def _one(self, name="m.x", tags="", value=1.5, type_code=0,
+             suffix=b"", **kw):
+        kw.setdefault("timestamp", 1000)
+        kw.setdefault("interval", 10)
+        kw.setdefault("default_host", "h0")
+        bodies = egress.dd_series_bodies(
+            arenas([name]), arenas([tags]), [suffix],
+            np.array([0], np.uint32), np.array([0], np.uint8),
+            np.array([value], np.float64), np.array([type_code], np.uint8),
+            **kw)
+        assert len(bodies) == 1
+        return json.loads(zlib.decompress(bodies[0]))["series"]
+
+    def test_gauge_shape_matches_reference_ddmetric(self):
+        (m,) = self._one(name="svc.lat", tags="env:prod,route:r1")
+        assert m == {"metric": "svc.lat", "points": [[1000, 1.5]],
+                     "tags": ["env:prod", "route:r1"], "type": "gauge",
+                     "host": "h0", "interval": 10}
+
+    def test_counter_becomes_rate(self):
+        (m,) = self._one(type_code=1, value=0.3)
+        assert m["type"] == "rate" and m["points"][0][1] == 0.3
+
+    def test_magic_host_device_tags(self):
+        (m,) = self._one(tags="host:db7,device:sda,a:b")
+        assert m["host"] == "db7" and m["device_name"] == "sda"
+        assert m["tags"] == ["a:b"]
+
+    def test_empty_tags_omitted(self):
+        (m,) = self._one(tags="")
+        assert "tags" not in m and "device_name" not in m
+
+    def test_common_tags_prepended(self):
+        (m,) = self._one(tags="a:b", common_tags_json=b'"team:x","q:1"')
+        assert m["tags"] == ["team:x", "q:1", "a:b"]
+
+    def test_json_escaping(self):
+        (m,) = self._one(name='bad"na\\me\n', tags='k:v"w')
+        assert m["metric"] == 'bad"na\\me\n'
+        assert m["tags"] == ['k:v"w']
+
+    def test_suffix_appended(self):
+        (m,) = self._one(suffix=b".99percentile")
+        assert m["metric"] == "m.x.99percentile"
+
+    def test_integer_and_float_formatting(self):
+        for v, want in ((7.0, 7), (-3.0, -3), (0.125, 0.125),
+                        (123.456, 123.456), (1e-3, 0.001),
+                        (float("nan"), 0), (float("inf"), 0)):
+            (m,) = self._one(value=v)
+            got = m["points"][0][1]
+            if want:
+                assert got == pytest.approx(want, rel=1e-8), (v, got)
+            else:
+                assert got == want, (v, got)
+
+    def test_float32_values_roundtrip(self):
+        # every flush value derives from float32 planes; 9 significant
+        # digits must reproduce them exactly
+        rng = np.random.default_rng(0)
+        vals = rng.gamma(2.0, 50.0, 256).astype(np.float32)
+        bodies = egress.dd_series_bodies(
+            arenas(["m"] * 256), arenas([""] * 256), [b""],
+            np.arange(256, dtype=np.uint32), np.zeros(256, np.uint8),
+            vals.astype(np.float64), np.zeros(256, np.uint8),
+            timestamp=1, interval=10, default_host="h")
+        got = [m["points"][0][1]
+               for m in json.loads(zlib.decompress(bodies[0]))["series"]]
+        assert np.array_equal(np.asarray(got, np.float32), vals)
+
+    def test_chunking_by_max_per_body(self):
+        n = 10
+        bodies = egress.dd_series_bodies(
+            arenas(["m"] * n), arenas([""] * n), [b""],
+            np.arange(n, dtype=np.uint32), np.zeros(n, np.uint8),
+            np.ones(n), np.zeros(n, np.uint8),
+            timestamp=1, interval=10, default_host="h", max_per_body=4)
+        assert len(bodies) == 3
+        sizes = [len(json.loads(zlib.decompress(b))["series"])
+                 for b in bodies]
+        assert sizes == [4, 4, 2]
+
+    def test_uncompressed_mode(self):
+        bodies = egress.dd_series_bodies(
+            arenas(["m"]), arenas([""]), [b""],
+            np.array([0], np.uint32), np.array([0], np.uint8),
+            np.array([2.0]), np.array([0], np.uint8),
+            timestamp=1, interval=10, default_host="h", compress_level=0)
+        assert json.loads(bodies[0])["series"][0]["points"][0][1] == 2.0
+
+
+class TestMetricListCodec:
+    def _digest_planes(self, s=4, k=8, live=5):
+        rng = np.random.default_rng(1)
+        means = np.sort(rng.gamma(2, 30, (s, k)).astype(np.float32), axis=1)
+        weights = np.zeros((s, k), np.float32)
+        weights[:, :live] = rng.integers(1, 4, (s, live))
+        return means, weights, means[:, 0].copy(), means[:, live - 1].copy()
+
+    def test_encode_matches_python_protobuf(self):
+        from veneur_tpu.protocol import forward_pb2
+
+        means, weights, dmins, dmaxs = self._digest_planes()
+        chunks = egress.encode_digest_metrics(
+            arenas([f"h{i}" for i in range(4)]), arenas(["a:1,b:2"] * 4),
+            means, weights, dmins, dmaxs, pb_type=2, compression=100.0,
+            reference_compat=True)
+        ml = forward_pb2.MetricList.FromString(b"".join(chunks))
+        assert len(ml.metrics) == 4
+        m = ml.metrics[1]
+        assert m.name == "h1" and list(m.tags) == ["a:1", "b:2"]
+        td = m.histogram.t_digest
+        live = weights[1] > 0
+        assert np.allclose(td.packed_means, means[1][live])
+        assert np.allclose(td.packed_weights, weights[1][live])
+        # reference_compat also writes the repeated Centroid schema
+        assert [c.mean for c in td.main_centroids] == \
+            pytest.approx(list(means[1][live]))
+        assert td.compression == 100.0
+        assert td.min == pytest.approx(dmins[1])
+
+    def test_native_decode_of_python_protobuf(self):
+        from veneur_tpu.protocol import forward_pb2
+
+        mlist = forward_pb2.MetricList()
+        m = mlist.metrics.add(name="c", tags=["x:1"], type=0)
+        m.counter.value = -12
+        m = mlist.metrics.add(name="g", type=1)
+        m.gauge.value = 6.5
+        m = mlist.metrics.add(name="t", type=4)
+        td = m.histogram.t_digest
+        td.compression = 100.0
+        td.min, td.max = 1.0, 3.0
+        td.packed_means.extend([1.0, 3.0])
+        td.packed_weights.extend([2.0, 2.0])
+        m = mlist.metrics.add(name="ref", type=2)
+        td = m.histogram.t_digest
+        td.min, td.max = 0.0, 5.0
+        td.main_centroids.add(mean=2.5, weight=4.0)
+        m = mlist.metrics.add(name="s", type=3)
+        m.set.hyper_log_log = b"\x00\x01\x02"
+        data = mlist.SerializeToString()
+        dec = egress.decode_metric_list(data)
+        assert dec.count == 5
+        assert dec.payload[0] == egress.PAYLOAD_COUNTER
+        assert dec.ivalue[0] == -12 and dec.joined_tags(0) == "x:1"
+        assert dec.dvalue[1] == 6.5
+        o, n = int(dec.cent_off[2]), int(dec.cent_len[2])
+        assert list(dec.means[o:o + n]) == [1.0, 3.0]
+        o, n = int(dec.cent_off[3]), int(dec.cent_len[3])
+        assert list(dec.means[o:o + n]) == [2.5]
+        assert list(dec.weights[o:o + n]) == [4.0]
+        ho, hn = int(dec.hll_off[4]), int(dec.hll_len[4])
+        assert data[ho:ho + hn] == b"\x00\x01\x02"
+
+    def test_roundtrip_native_to_native(self):
+        means, weights, dmins, dmaxs = self._digest_planes(s=3)
+        chunks = egress.encode_digest_metrics(
+            arenas(["a", "b", "c"]), arenas(["", "t:1", ""]),
+            means, weights, dmins, dmaxs, pb_type=4)
+        dec = egress.decode_metric_list(b"".join(chunks))
+        assert dec.count == 3 and all(dec.type == 4)
+        assert dec.joined_tags(1) == "t:1"
+        for r in range(3):
+            o, n = int(dec.cent_off[r]), int(dec.cent_len[r])
+            live = weights[r] > 0
+            assert np.allclose(dec.means[o:o + n], means[r][live])
+
+    def test_chunked_bodies_all_parse(self):
+        from veneur_tpu.protocol import forward_pb2
+
+        means, weights, dmins, dmaxs = self._digest_planes(s=50)
+        chunks = egress.encode_digest_metrics(
+            arenas([f"m{i}" for i in range(50)]), arenas([""] * 50),
+            means, weights, dmins, dmaxs, pb_type=2, max_body_bytes=2000)
+        assert len(chunks) > 1
+        total = sum(len(forward_pb2.MetricList.FromString(c).metrics)
+                    for c in chunks)
+        assert total == 50
+
+    def test_zero_min_max_decodes_as_zero(self):
+        """proto3 omits zero-valued scalars: a digest whose true min or
+        max is 0.0 arrives with the field absent and must decode as 0.0,
+        not as 'unknown' (regression: inf extrema made the global's
+        quantile NaN)."""
+        from veneur_tpu.protocol import forward_pb2
+
+        mlist = forward_pb2.MetricList()
+        m = mlist.metrics.add(name="z", type=2)
+        td = m.histogram.t_digest
+        td.compression = 100.0
+        td.min, td.max = 0.0, 0.0  # both omitted on the wire
+        td.packed_means.extend([0.0])
+        td.packed_weights.extend([5.0])
+        dec = egress.decode_metric_list(mlist.SerializeToString())
+        assert dec.dmin[0] == 0.0 and dec.dmax[0] == 0.0
+
+    def test_empty_digest_normalizes_extrema(self):
+        means = np.zeros((1, 4), np.float32)
+        weights = np.zeros((1, 4), np.float32)
+        chunks = egress.encode_digest_metrics(
+            arenas(["e"]), arenas([""]), means, weights,
+            np.array([np.inf], np.float32), np.array([-np.inf], np.float32),
+            pb_type=2)
+        dec = egress.decode_metric_list(b"".join(chunks))
+        assert dec.cent_len[0] == 0
+        assert dec.dmin[0] == np.inf and dec.dmax[0] == -np.inf
+
+    def test_intern_table_teach_and_reset(self):
+        from veneur_tpu.protocol import forward_pb2
+
+        mlist = forward_pb2.MetricList()
+        for i in range(4):
+            m = mlist.metrics.add(name=f"n{i}", tags=[f"t:{i}"], type=0)
+            m.counter.value = i
+        dec = egress.decode_metric_list(mlist.SerializeToString())
+        tbl = egress.MListInternTable()
+        rows, miss = tbl.assign(dec)
+        assert list(miss) == [0, 1, 2, 3]
+        for i in miss:
+            i = int(i)
+            no, nl = dec.name_off[i], dec.name_len[i]
+            to, tl = dec.tags_off[i], dec.tags_len[i]
+            tbl.put(int(dec.type[i]), dec.arena[no:no + nl],
+                    dec.arena[to:to + tl], 10 + i)
+        rows, miss = tbl.assign(dec)
+        assert len(miss) == 0 and list(rows) == [10, 11, 12, 13]
+        tbl.reset()
+        _, miss = tbl.assign(dec)
+        assert len(miss) == 4
+
+
+class TestColumnarFlush:
+    """The columnar flush must produce the same metrics as the legacy
+    per-row path (to_intermetrics is the equivalence bridge)."""
+
+    def _fill(self, store):
+        from veneur_tpu.samplers import parser as P
+
+        store.process_metric(P.parse_metric(b"c.a:3|c|#env:prod"))
+        store.process_metric(P.parse_metric(b"c.a:2|c|#env:prod"))
+        store.process_metric(P.parse_metric(b"g.b:7.5|g"))
+        for v in (1.0, 2.0, 3.0, 10.0):
+            store.process_metric(P.parse_metric(f"h.c:{v}|h|#r:1".encode()))
+        store.process_metric(P.parse_metric(b"s.d:alice|s"))
+        store.process_metric(P.parse_metric(b"s.d:bob|s"))
+
+    def _flush(self, columnar):
+        from veneur_tpu.core.store import MetricStore
+        from veneur_tpu.samplers.intermetric import HistogramAggregates
+
+        store = MetricStore(initial_capacity=32, chunk=64)
+        self._fill(store)
+        agg = HistogramAggregates.from_names(
+            ["min", "max", "count", "sum", "avg", "median", "hmean"])
+        out, fwd, ms = store.flush([0.5, 0.99], agg, is_local=False,
+                                   now=500, columnar=columnar)
+        return out, fwd
+
+    def test_matches_legacy_flush(self):
+        legacy, _ = self._flush(columnar=False)
+        col, _ = self._flush(columnar=True)
+        mats = col.to_intermetrics()
+        want = {(m.name, tuple(sorted(m.tags))): m.value for m in legacy}
+        got = {(m.name, tuple(sorted(m.tags))): m.value for m in mats}
+        assert want.keys() == got.keys(), \
+            set(want) ^ set(got)
+        for k in want:
+            assert got[k] == pytest.approx(want[k], rel=1e-6,
+                                           abs=1e-9), k
+        types_want = {m.name: m.type for m in legacy}
+        types_got = {m.name: m.type for m in mats}
+        assert types_want == types_got
+
+    def test_routed_metrics_fall_back_to_extras(self):
+        from veneur_tpu.core.store import MetricStore
+        from veneur_tpu.samplers import parser as P
+        from veneur_tpu.samplers.intermetric import HistogramAggregates
+
+        store = MetricStore(initial_capacity=32, chunk=64)
+        store.process_metric(
+            P.parse_metric(b"r.a:1|c|#veneursinkonly:kafka"))
+        store.process_metric(P.parse_metric(b"r.b:1|g"))
+        agg = HistogramAggregates.from_names(["count"])
+        col, _, _ = store.flush([], agg, is_local=False, now=1,
+                                columnar=True)
+        # the routed counter group fell back to per-row extras with its
+        # routing intact; the (unrouted) gauge group stayed columnar
+        routed = [m for m in col.extras if m.name == "r.a"]
+        assert routed and routed[0].sinks == frozenset({"kafka"})
+        assert sum(len(b) for b in col.blocks) == 1
+        assert any(m.name == "r.b" for m in col.to_intermetrics())
+
+    def test_columnar_forward_state_matches_materialized(self):
+        _, fwd_legacy = self._flush_fwd(columnar=False)
+        _, fwd_col = self._flush_fwd(columnar=True)
+        assert fwd_col.histograms_columnar is not None
+        fwd_col.materialize_digests()
+        assert len(fwd_col.histograms) == len(fwd_legacy.histograms) == 1
+        (n1, t1, m1, w1, mn1, mx1) = fwd_legacy.histograms[0]
+        (n2, t2, m2, w2, mn2, mx2) = fwd_col.histograms[0]
+        assert n1 == n2 and t1 == t2
+        assert np.allclose(m1, m2) and np.allclose(w1, w2)
+        assert mn1 == pytest.approx(mn2) and mx1 == pytest.approx(mx2)
+
+    def _flush_fwd(self, columnar):
+        from veneur_tpu.core.store import MetricStore
+        from veneur_tpu.samplers.intermetric import HistogramAggregates
+
+        store = MetricStore(initial_capacity=32, chunk=64)
+        self._fill(store)
+        agg = HistogramAggregates.from_names(["count"])
+        out, fwd, _ = store.flush([], agg, is_local=True, now=500,
+                                  forward=True, columnar=columnar)
+        return out, fwd
+
+
+class TestNativeImport:
+    def test_import_columnar_equals_python_apply(self):
+        """The native import lane must merge identically to the Python
+        apply_metric_list path."""
+        from veneur_tpu.core.store import ForwardableState, MetricStore
+        from veneur_tpu.forward.convert import (apply_metric_list,
+                                                metric_list_from_state)
+        from veneur_tpu.samplers.intermetric import HistogramAggregates
+
+        rng = np.random.default_rng(2)
+        state = ForwardableState()
+        state.counters.append(("c.x", ["a:1"], 5))
+        state.gauges.append(("g.y", [], 2.5))
+        for i in range(6):
+            means = np.sort(rng.gamma(2, 30, 16))
+            state.histograms.append(
+                (f"h{i}", [f"s:{i % 2}"], means, np.ones(16),
+                 float(means[0]), float(means[-1])))
+        regs = np.zeros(1 << 14, np.uint8)
+        regs[:100] = 3
+        state.sets.append(("s.z", [], regs, 14))
+        mlist = metric_list_from_state(state)
+        data = mlist.SerializeToString()
+
+        agg = HistogramAggregates.from_names(["count"])
+        s_py = MetricStore(initial_capacity=64, chunk=256)
+        n_ok, n_err = apply_metric_list(s_py, mlist)
+        assert (n_ok, n_err) == (9, 0)
+        s_nat = MetricStore(initial_capacity=64, chunk=256)
+        dec = egress.decode_metric_list(data)
+        n_ok, n_err = s_nat.import_columnar(dec, data)
+        assert (n_ok, n_err) == (9, 0)
+        assert s_nat.imported == 9
+
+        out_py, _, _ = s_py.flush([0.5, 0.9], agg, is_local=False, now=7)
+        out_nat, _, _ = s_nat.flush([0.5, 0.9], agg, is_local=False, now=7)
+        py = {(m.name, tuple(m.tags)): m.value for m in out_py}
+        nat = {(m.name, tuple(m.tags)): m.value for m in out_nat}
+        assert py.keys() == nat.keys()
+        for k in py:
+            assert nat[k] == pytest.approx(py[k], rel=1e-5), k
+
+    def test_malformed_metric_counted_not_fatal(self):
+        from veneur_tpu.core.store import MetricStore
+        from veneur_tpu.protocol import forward_pb2
+
+        mlist = forward_pb2.MetricList()
+        m = mlist.metrics.add(name="ok", type=0)
+        m.counter.value = 1
+        mlist.metrics.add(name="novalue", type=0)  # empty oneof
+        m = mlist.metrics.add(name="badset", type=3)
+        m.set.hyper_log_log = b"XX"  # bad magic
+        data = mlist.SerializeToString()
+        store = MetricStore(initial_capacity=16, chunk=64)
+        dec = egress.decode_metric_list(data)
+        n_ok, n_err = store.import_columnar(dec, data)
+        assert n_ok == 1 and n_err == 2

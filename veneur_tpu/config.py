@@ -148,6 +148,17 @@ class Config:
     # otherwise be upgraded before their global (upgrade globals first
     # and this can stay off: the import side reads both schemas).
     forward_reference_compatible: bool = False
+    # columnar flush egress: emissions stay flat arrays from the store
+    # through native sink serialization (falls back automatically when
+    # the native egress library cannot build)
+    flush_columnar: bool = True
+    # heavy-hitter (veneurtopk) count-min sketch geometry: point-estimate
+    # overcount <= e/width of the stream's total weight with probability
+    # 1 - e^-depth; size width from the key cardinality you track
+    # (BASELINE #5's 100M-key config runs width 2^17)
+    topk_depth: int = 4
+    topk_width: int = 1 << 16
+    topk_k: int = 32
     # shard the global-tier store over a (series, hosts) device mesh;
     # only meaningful on a global instance (forward_address unset)
     mesh_enabled: bool = False

@@ -611,9 +611,13 @@ class SlabDigestGroup:
                       for _ in range(nslabs)]
         self._device_dirty = False
 
-    def flush(self, percentiles: List[float]):
+    def flush(self, percentiles: List[float], want_digests: bool = True):
         """Drain + percentile every slab; identical contract to
-        DigestGroup.flush: (old interner, dict of host arrays [:n])."""
+        DigestGroup.flush: (old interner, dict of host arrays [:n]).
+
+        want_digests=False skips fetching the [n, K] mean/weight planes
+        (only a FORWARDING flush needs them on the host — a multi-million
+        -series plane is hundreds of MB of device->host transfer)."""
         self._drain_staging()
         n = len(self.interner)
         interner, self.interner = self.interner, self._interner_cls()
@@ -636,21 +640,28 @@ class SlabDigestGroup:
             k = self.k
             # fetch this slab's interned prefix NOW so the device buffers
             # free before the next slab's program runs
-            parts.append(jax.device_get((
-                mean.reshape(self.slab_rows, k)[:need].astype(jnp.float32),
-                weight.reshape(self.slab_rows, k)[:need].astype(jnp.float32),
-                dmin[:need], dmax[:need], pcts[:need], count[:need],
-                vsum[:need], vmin[:need], vmax[:need], recip[:need])))
-        (d_mean, d_weight, d_min, d_max, pcts, count, vsum, vmin, vmax,
-         recip) = (np.concatenate(cols, axis=0) for cols in zip(*parts))
+            planes = ()
+            if want_digests:
+                planes = (
+                    mean.reshape(self.slab_rows, k)[:need]
+                        .astype(jnp.float32),
+                    weight.reshape(self.slab_rows, k)[:need]
+                          .astype(jnp.float32),
+                    dmin[:need], dmax[:need])
+            parts.append(jax.device_get(planes + (
+                pcts[:need], count[:need], vsum[:need], vmin[:need],
+                vmax[:need], recip[:need])))
+        cols = [np.concatenate(c, axis=0) for c in zip(*parts)]
         self._device_dirty = False
         self._new_sample_buffers()
         self._new_import_buffers()
-        return interner, {
-            "digest_mean": d_mean,
-            "digest_weight": d_weight,
-            "digest_min": d_min,
-            "digest_max": d_max,
+        out = {}
+        if want_digests:
+            (out["digest_mean"], out["digest_weight"], out["digest_min"],
+             out["digest_max"]) = cols[:4]
+            cols = cols[4:]
+        pcts, count, vsum, vmin, vmax, recip = cols
+        out.update({
             "percentiles": pcts[:, :-1],
             "median": pcts[:, -1],
             "count": count,
@@ -658,4 +669,5 @@ class SlabDigestGroup:
             "min": vmin,
             "max": vmax,
             "recip": recip,
-        }
+        })
+        return interner, out

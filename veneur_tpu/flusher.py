@@ -68,20 +68,29 @@ def _flush_once(server: "Server", span):
                     "counters/gauges) will be dropped each interval")
     percentiles = server.histogram_percentiles
     forwarding = is_local and server.forward_fn is not None
-    # the heavy-hitter sketch rides the JSON path only; over gRPC the
-    # local emits its own top-k instead (store.flush docs) — say so once
+    # the heavy-hitter sketch rides both transports (JSON entry /
+    # MetricList.topk extension) EXCEPT when forwarding into a reference
+    # fleet (forward_reference_compatible): then the local emits its own
+    # top-k instead — say so once
     topk_ok = getattr(server._forwarder, "supports_topk", True) \
         if server._forwarder is not None else True
     if forwarding and not topk_ok and not getattr(
             server, "_warned_topk_grpc", False):
         server._warned_topk_grpc = True
-        log.warning("gRPC forwarding cannot carry the heavy-hitter "
-                    "sketch (metricpb stays reference-compatible); "
+        log.warning("reference-compatible forwarding cannot carry the "
+                    "heavy-hitter sketch (a framework extension); "
                     "topk series emit locally instead of fleet-merged")
+    # columnar egress: flush results stay flat arrays end-to-end for
+    # native sinks; anything else materializes InterMetrics once, lazily
+    use_columnar = bool(getattr(server.config, "flush_columnar", True))
+    if use_columnar:
+        from veneur_tpu.native import egress
+
+        use_columnar = egress.available()
     t0 = time.perf_counter()
     final_metrics, forwardable, ms = server.store.flush(
         percentiles, server.histogram_aggregates, is_local=is_local, now=now,
-        forward=forwarding, forward_topk=topk_ok)
+        forward=forwarding, forward_topk=topk_ok, columnar=use_columnar)
     flush_elapsed = time.perf_counter() - t0
     log.debug("store flush took %.1f ms (%s)", flush_elapsed * 1e3, ms)
     # the canonical self-metric set (README.md:248-277) rides on the
@@ -118,19 +127,28 @@ def _flush_once(server: "Server", span):
     # one thread per metric sink (flusher.go:82-93)
     threads = []
     for sink in server.metric_sinks:
-        t = threading.Thread(target=_flush_sink, args=(sink, final_metrics),
-                             daemon=True)
+        if use_columnar and hasattr(sink, "flush_columnar"):
+            t = threading.Thread(target=_flush_sink_columnar,
+                                 args=(sink, final_metrics), daemon=True)
+        else:
+            metrics = (final_metrics.to_intermetrics() if use_columnar
+                       else final_metrics)
+            t = threading.Thread(target=_flush_sink, args=(sink, metrics),
+                                 daemon=True)
         t.start()
         threads.append(t)
     for t in threads:
         t.join(timeout=30.0)
 
     # plugins run after the sinks (flusher.go:95-109)
-    for plugin in server.plugins:
-        try:
-            plugin.flush(final_metrics)
-        except Exception:
-            log.exception("plugin %s flush failed", plugin.name)
+    if server.plugins:
+        metrics = (final_metrics.to_intermetrics() if use_columnar
+                   else final_metrics)
+        for plugin in server.plugins:
+            try:
+                plugin.flush(metrics)
+            except Exception:
+                log.exception("plugin %s flush failed", plugin.name)
 
     span_flusher.join(timeout=10.0)
 
@@ -192,6 +210,16 @@ def _flush_sink(sink, metrics):
         sink.flush(filter_acceptable(metrics, sink.name))
     except Exception:
         log.exception("sink %s flush failed", sink.name)
+
+
+def _flush_sink_columnar(sink, batch):
+    # columnar blocks are guaranteed routing-free (the store falls back
+    # to per-row emission for any veneursinkonly: group); extras carry
+    # routing and each columnar sink filters them itself
+    try:
+        sink.flush_columnar(batch)
+    except Exception:
+        log.exception("sink %s columnar flush failed", sink.name)
 
 
 def _flush_spans(server: "Server"):

@@ -42,25 +42,42 @@ def type_name(pb_type: int) -> str:
     return name
 
 
-def encode_hll(registers: np.ndarray, precision: int) -> bytes:
+def encode_hll(registers: np.ndarray, precision: int,
+               reference_compat: bool = False) -> bytes:
     """Serialize dense HLL registers for the ``SetValue.hyper_log_log``
-    bytes field. Layout: magic ``VH``, version, precision, raw registers.
-    (The reference stores the vendored axiomhq binary format here —
-    samplers.go:441-465; ours is the dense-register equivalent.)"""
+    bytes field.
+
+    Native layout: magic ``VH``, version, precision, raw registers (one
+    byte each — lossless for our register plane). reference_compat=True
+    emits the vendored axiomhq ``MarshalBinary`` dense layout instead
+    (samplers.go:441-465) so a Go global's ``UnmarshalBinary`` +
+    ``Merge`` accept it (4-bit tailcut registers: values past base+15
+    clip exactly as the reference's own inserts do)."""
     regs = np.asarray(registers, np.uint8)
     if regs.shape != (1 << precision,):
         raise ValueError(f"want {1 << precision} registers, got {regs.shape}")
+    if reference_compat:
+        from veneur_tpu.ops import axiomhq
+
+        return axiomhq.encode_dense(regs, precision)
     return _HLL_MAGIC + struct.pack("BB", _HLL_VERSION, precision) + regs.tobytes()
 
 
 def decode_hll(blob: bytes) -> tuple[np.ndarray, int]:
-    if blob[:2] != _HLL_MAGIC:
-        raise ValueError("bad HLL magic")
-    version, precision = struct.unpack_from("BB", blob, 2)
-    if version != _HLL_VERSION:
-        raise ValueError(f"unsupported HLL version {version}")
-    regs = np.frombuffer(blob, np.uint8, count=1 << precision, offset=4)
-    return regs, precision
+    """Decode a ``SetValue.hyper_log_log`` payload: our ``VH`` layout or
+    the reference's axiomhq format (dense AND sparse), auto-detected —
+    a reference local forwarding into this global just works."""
+    if blob[:2] == _HLL_MAGIC:
+        version, precision = struct.unpack_from("BB", blob, 2)
+        if version != _HLL_VERSION:
+            raise ValueError(f"unsupported HLL version {version}")
+        regs = np.frombuffer(blob, np.uint8, count=1 << precision, offset=4)
+        return regs, precision
+    from veneur_tpu.ops import axiomhq
+
+    if axiomhq.looks_like(blob):
+        return axiomhq.decode(blob)
+    raise ValueError("unrecognized HLL payload (neither VH nor axiomhq)")
 
 
 # ---------------------------------------------------------------------------
@@ -79,8 +96,20 @@ def metric_list_from_state(state, compression: float = 100.0,
     half the bytes). reference_compat=True ALSO writes the reference's
     repeated Centroid messages so a Go global can import this list —
     only needed when forwarding INTO a reference fleet (the migration
-    direction, reference local -> our global, never needs it)."""
+    direction, reference local -> our global, never needs it) — and
+    suppresses the heavy-hitter sketch extension (MetricList.topk,
+    field 14: skipped by a reference global, but kept off the compat
+    wire entirely)."""
     out = forward_pb2.MetricList()
+    if state.topk is not None and not reference_compat:
+        table, series = state.topk
+        table = np.ascontiguousarray(table, np.float32)
+        out.topk.depth, out.topk.width = table.shape
+        out.topk.table = table.tobytes()
+        for name, tags, keys, members in series:
+            s = out.topk.series.add(name=name, tags=tags)
+            s.keys.extend((int(hi) << 32) | int(lo) for hi, lo in keys)
+            s.members.extend(m or "" for m in members)
 
     for name, tags, value in state.counters:
         m = out.metrics.add(name=name, tags=tags, type=_PB_TYPE["counter"])
@@ -108,7 +137,9 @@ def metric_list_from_state(state, compression: float = 100.0,
                                           weight=float(w))
     for name, tags, registers, precision in state.sets:
         m = out.metrics.add(name=name, tags=tags, type=_PB_TYPE["set"])
-        m.set.hyper_log_log = encode_hll(registers, precision)
+        # reference_compat: axiomhq dense bytes a Go global can Merge
+        m.set.hyper_log_log = encode_hll(registers, precision,
+                                         reference_compat=reference_compat)
     return out
 
 
@@ -197,6 +228,13 @@ def apply_metric_list(store, mlist: forward_pb2.MetricList) -> tuple:
     digests = []   # (key, tags, means, weights, dmin, dmax)
     others = []    # (kind, key, tags, decoded-payload)
     n_err = 0
+    if mlist.HasField("topk"):
+        try:
+            others.append(("topk", "veneur.topk", [],
+                           decode_topk_sketch(mlist.topk)))
+        except Exception as e:
+            n_err += 1
+            log.debug("skipping malformed topk sketch: %s", e)
     for m in mlist.metrics:
         try:
             tname = _TYPE_PB.get(m.type)
@@ -251,6 +289,21 @@ def apply_metric(store, m: metricpb_pb2.Metric):
         store.import_set(key, tags, registers)
     else:
         raise ValueError(f"metric {m.name} has no value")
+
+
+def decode_topk_sketch(pb) -> tuple:
+    """forwardrpc.TopKSketch → the (table, series) tuple
+    ``store.import_topk`` takes."""
+    table = np.frombuffer(pb.table, np.float32).reshape(
+        int(pb.depth), int(pb.width))
+    series = []
+    for s in pb.series:
+        keys = [(int(k) >> 32, int(k) & 0xFFFFFFFF) for k in s.keys]
+        members = [m or None for m in s.members]
+        if len(members) < len(keys):
+            members += [None] * (len(keys) - len(members))
+        series.append((s.name, list(s.tags), keys, members))
+    return table, series
 
 
 # ---------------------------------------------------------------------------

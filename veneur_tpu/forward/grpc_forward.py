@@ -35,10 +35,6 @@ _MAX_MESSAGE = 256 * 1024 * 1024
 class GRPCForwarder:
     """Per-flush gRPC forward of ForwardableState (flusher.go:424-473)."""
 
-    # metricpb stays byte-compatible with the reference; the heavy-hitter
-    # sketch (a framework extension) cannot ride this transport
-    supports_topk = False
-
     def __init__(self, addr: str, timeout: float = 10.0,
                  compression: float = 100.0,
                  reference_compat: bool = False):
@@ -48,6 +44,11 @@ class GRPCForwarder:
         self.timeout = timeout
         self.compression = compression
         self.reference_compat = reference_compat
+        # the heavy-hitter sketch rides MetricList.topk, an extension
+        # field a reference global would skip — keep it off the wire
+        # entirely when forwarding into a reference fleet (the local
+        # then emits its own top-k, flusher.py)
+        self.supports_topk = not reference_compat
         self._channel = grpc.insecure_channel(
             addr,
             options=[("grpc.max_receive_message_length", _MAX_MESSAGE),
@@ -57,16 +58,52 @@ class GRPCForwarder:
             request_serializer=forward_pb2.MetricList.SerializeToString,
             response_deserializer=empty_pb2.Empty.FromString,
         )
+        # identity-serialized lane for natively-encoded MetricList chunks
+        # (native/veneur_egress.cpp writes the serialization directly)
+        self._send_raw = self._channel.unary_unary(
+            _METHOD,
+            request_serializer=lambda b: b,
+            response_deserializer=empty_pb2.Empty.FromString,
+        )
         # telemetry counters (flusher.go:440-470 metric names); the flusher
         # calls forward() from a fresh thread each interval, so guard them
         self._lock = threading.Lock()
         self.forwarded = 0
         self.errors = 0
 
+    # native MetricList chunks cap well under the channel's 256 MB limit
+    CHUNK_BYTES = 64 * 1024 * 1024
+
     def forward(self, state, parent_span=None):
+        from veneur_tpu.native import egress
+
+        # columnar digest planes encode natively — serialized MetricList
+        # chunks straight from the [S, K] arrays, no per-row Python
+        # (flusher.go:424-473; the chunking bounds message size the way
+        # the reference's proxy batches do)
+        raw_chunks = []
+        n_raw = 0
+        if egress.available():
+            for attr, pb_type in (("histograms_columnar", 2),
+                                  ("timers_columnar", 4)):
+                col = getattr(state, attr)
+                if col is None:
+                    continue
+                names, tags, means, weights, dmins, dmaxs = col
+                raw_chunks.extend(egress.encode_digest_metrics(
+                    names, tags, means, weights, dmins, dmaxs, pb_type,
+                    self.compression, max_body_bytes=self.CHUNK_BYTES,
+                    reference_compat=self.reference_compat))
+                n_raw += len(means)
+                setattr(state, attr, None)  # consumed
+        else:
+            state.materialize_digests()
         mlist = metric_list_from_state(
             state, self.compression, reference_compat=self.reference_compat)
-        if not mlist.metrics:
+        # a list can be topk-sketch-only (every series was columnar or
+        # heavy-hitter): HasField, not len(metrics), decides emptiness
+        has_pb = bool(mlist.metrics) or mlist.HasField("topk")
+        if not has_pb and not raw_chunks:
             return
         metadata = None
         if parent_span is not None:
@@ -74,15 +111,30 @@ class GRPCForwarder:
             metadata = tuple(
                 (k.lower(), v)
                 for k, v in parent_span.context_as_parent().items())
+        # raw chunks credit as they land: a mid-loop failure must not
+        # misreport rows the global already accepted and merged
+        raw_per_chunk = n_raw // len(raw_chunks) if raw_chunks else 0
+        sent_rows = 0
         try:
-            self._send(mlist, timeout=self.timeout, metadata=metadata)
+            if has_pb:
+                self._send(mlist, timeout=self.timeout, metadata=metadata)
+                sent_rows += len(mlist.metrics)
+            for i, chunk in enumerate(raw_chunks):
+                self._send_raw(chunk, timeout=self.timeout,
+                               metadata=metadata)
+                # last chunk carries the rounding remainder
+                sent_rows += (n_raw - raw_per_chunk * (len(raw_chunks) - 1)
+                              if i == len(raw_chunks) - 1 else raw_per_chunk)
             with self._lock:
-                self.forwarded += len(mlist.metrics)
+                self.forwarded += sent_rows
         except grpc.RpcError as e:
             with self._lock:
                 self.errors += 1
-            log.warning("failed to forward %d metrics to %s: %s",
-                        len(mlist.metrics), self.addr, e)
+                self.forwarded += sent_rows
+            log.warning("failed to forward %d metrics to %s "
+                        "(~%d sent before the failure): %s",
+                        len(mlist.metrics) + n_raw, self.addr,
+                        sent_rows, e)
 
     def close(self):
         self._channel.close()
@@ -98,6 +150,8 @@ class ImportServer:
     def __init__(self, store=None,
                  apply: Optional[Callable] = None, workers: int = 4,
                  trace_client=None):
+        from veneur_tpu.native import egress
+
         self._trace_client = trace_client
         self._store = store if apply is None else None
         if apply is None:
@@ -105,6 +159,11 @@ class ImportServer:
                 raise ValueError("need a store or an apply callable")
             apply = lambda m: apply_metric(store, m)  # noqa: E731
         self._apply = apply
+        # native lane: requests arrive as raw bytes, decode + intern +
+        # bulk-stage in C++/numpy (store.import_columnar) — the fix for
+        # the Python-protobuf-decode ceiling (~35k series/s) on the
+        # global tier's ingest
+        self._native = self._store is not None and egress.available()
         self.received = 0
         self.import_errors = 0
         self._lock = threading.Lock()
@@ -115,11 +174,13 @@ class ImportServer:
             futures.ThreadPoolExecutor(max_workers=workers),
             options=[("grpc.max_receive_message_length", _MAX_MESSAGE),
                      ("grpc.max_send_message_length", _MAX_MESSAGE)])
+        deserializer = ((lambda b: b) if self._native
+                        else forward_pb2.MetricList.FromString)
         handler = grpc.method_handlers_generic_handler(
             "forwardrpc.Forward",
             {"SendMetrics": grpc.unary_unary_rpc_method_handler(
                 self._send_metrics,
-                request_deserializer=forward_pb2.MetricList.FromString,
+                request_deserializer=deserializer,
                 response_serializer=empty_pb2.Empty.SerializeToString)})
         self._grpc.add_generic_rpc_handlers((handler,))
         self.port: Optional[int] = None
@@ -132,7 +193,19 @@ class ImportServer:
         span.name = "import"
         t0 = time.perf_counter()
         n_ok = 0
-        if self._store is not None:
+        if self._native:
+            # request is raw bytes: C++ decode + intern, numpy bulk apply
+            from veneur_tpu.native import egress
+
+            dec = egress.decode_metric_list(request)
+            try:
+                n_ok, n_err = self._store.import_columnar(dec, request)
+            finally:
+                dec.close()
+            if n_err:
+                with self._lock:
+                    self.import_errors += n_err
+        elif self._store is not None:
             # batched digest staging: one bulk store call instead of a
             # per-metric chain — the import tier's actual throughput
             # ceiling. Malformed metrics are validated out BEFORE

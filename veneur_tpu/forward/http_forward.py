@@ -21,15 +21,20 @@ log = logging.getLogger("veneur.forward.http")
 
 def post_helper(url: str, payload, timeout: float = 10.0,
                 compress: bool = True, headers: dict = None,
-                method: str = "POST") -> int:
+                method: str = "POST", precompressed: bool = False) -> int:
     """POST a JSON payload, optionally deflated (http/http.go:123-247).
     Returns the HTTP status (including non-2xx); raises only on transport
-    errors."""
-    body = json.dumps(payload).encode("utf-8")
+    errors. precompressed=True sends ``payload`` bytes as an
+    already-deflated JSON body (the native egress serializer's output)."""
     hdrs = {"Content-Type": "application/json"}
-    if compress:
-        body = zlib.compress(body)
+    if precompressed:
+        body = payload
         hdrs["Content-Encoding"] = "deflate"
+    else:
+        body = json.dumps(payload).encode("utf-8")
+        if compress:
+            body = zlib.compress(body)
+            hdrs["Content-Encoding"] = "deflate"
     if headers:
         hdrs.update(headers)
     req = urllib.request.Request(url, data=body, headers=hdrs, method=method)
@@ -63,6 +68,9 @@ class HTTPForwarder:
         self.errors = 0
 
     def forward(self, state, parent_span=None):
+        # the JSON wire is per-row; columnar digest planes (a columnar
+        # flush with gRPC-style planes) materialize to tuples first
+        state.materialize_digests()
         metrics = json_metrics_from_state(
             state, self.compression, include_topk=self.supports_topk)
         if not metrics:

@@ -237,6 +237,9 @@ class Server:
             digest_storage=config.digest_storage,
             digest_dtype=config.digest_dtype,
             slab_rows=config.slab_rows,
+            topk_depth=config.topk_depth,
+            topk_width=config.topk_width,
+            topk_k=config.topk_k,
         )
         self.event_worker = EventWorker()
         self.span_chan: "queue.Queue" = queue.Queue(config.span_channel_capacity)

@@ -13,6 +13,9 @@ class BlackholeMetricSink(MetricSink):
     def flush(self, metrics) -> None:
         pass
 
+    def flush_columnar(self, batch) -> None:
+        pass
+
     def flush_other_samples(self, samples) -> None:
         pass
 

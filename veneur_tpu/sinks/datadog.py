@@ -25,6 +25,8 @@ import threading
 from collections import deque
 from typing import Callable, Dict, List, Optional, Sequence
 
+import numpy as np
+
 from veneur_tpu.forward.http_forward import post_helper
 from veneur_tpu.protocol import constants as dogstatsd
 from veneur_tpu.protocol import wire
@@ -42,8 +44,9 @@ PostFn = Callable[..., int]
 
 
 def _default_post(url: str, payload, compress: bool = True,
-                  method: str = "POST") -> int:
-    return post_helper(url, payload, compress=compress, method=method)
+                  method: str = "POST", precompressed: bool = False) -> int:
+    return post_helper(url, payload, compress=compress, method=method,
+                       precompressed=precompressed)
 
 
 def _ok(status: int) -> bool:
@@ -58,7 +61,8 @@ class DatadogMetricSink(MetricSink):
 
     def __init__(self, interval: float, flush_max_per_body: int,
                  hostname: str, tags: Sequence[str], dd_hostname: str,
-                 api_key: str, post: Optional[PostFn] = None):
+                 api_key: str, post: Optional[PostFn] = None,
+                 compress_level: int = 1):
         self.interval = interval
         self.flush_max_per_body = max(1, flush_max_per_body)
         self.hostname = hostname
@@ -66,8 +70,13 @@ class DatadogMetricSink(MetricSink):
         self.dd_hostname = dd_hostname.rstrip("/")
         self.api_key = api_key
         self.post = post or _default_post
+        # deflate level for the native columnar serializer (level 1 runs
+        # ~2x the throughput of zlib's default 6 at a ~12% ratio cost —
+        # the single-core deflate IS the large-flush bottleneck)
+        self.compress_level = compress_level
         self.metrics_flushed = 0
         self.flush_errors = 0
+        self._common_json: Optional[bytes] = None
         # _flush_part runs on one thread per chunk; guard the counter
         self._err_lock = threading.Lock()
 
@@ -78,6 +87,66 @@ class DatadogMetricSink(MetricSink):
     @property
     def name(self) -> str:
         return "datadog"
+
+    def flush_columnar(self, batch) -> None:
+        """Columnar flush: serialize emission blocks to deflated series
+        bodies in C++ (native/veneur_egress.cpp — the vectorized twin of
+        finalize_metrics + chunked POST, datadog.go:245-330) and POST
+        them in parallel. Extras (status checks, routed metrics) take
+        the per-row path."""
+        from veneur_tpu.core.columnar import TYPE_COUNTER
+        from veneur_tpu.native import egress
+
+        bodies: List[bytes] = []
+        n_metrics = 0
+        for blk in batch.blocks:
+            values = blk.values
+            if (blk.type_codes == TYPE_COUNTER).any():
+                # counters become rates for Datadog (datadog.go:295-297)
+                values = np.where(blk.type_codes == TYPE_COUNTER,
+                                  values / self.interval, values)
+            bodies.extend(egress.dd_series_bodies(
+                blk.names, blk.tags, blk.suffixes, blk.rows,
+                blk.suffix_idx, values, blk.type_codes,
+                timestamp=batch.timestamp, interval=int(self.interval),
+                default_host=self.hostname,
+                common_tags_json=self._common_tags_json(),
+                max_per_body=self.flush_max_per_body,
+                compress_level=self.compress_level))
+            n_metrics += len(blk)
+        threads = []
+        for body in bodies:
+            t = threading.Thread(target=self._flush_body, args=(body,),
+                                 daemon=True)
+            t.start()
+            threads.append(t)
+        for t in threads:
+            t.join()
+        self.metrics_flushed += n_metrics
+        if batch.extras:
+            self.flush(batch.extras)
+
+    def _common_tags_json(self) -> bytes:
+        """The sink's fixed tags as a pre-escaped JSON fragment
+        (``"a:1","b:2"``) the native serializer prepends per metric."""
+        import json as _json
+
+        if self._common_json is None:
+            self._common_json = ",".join(
+                _json.dumps(t) for t in self.tags).encode("utf-8")
+        return self._common_json
+
+    def _flush_body(self, body: bytes) -> None:
+        try:
+            status = self.post(
+                f"{self.dd_hostname}/api/v1/series"
+                f"?api_key={self.api_key}", body, precompressed=True)
+            if not _ok(status):
+                log.warning("Datadog series flush returned HTTP %d", status)
+                self._count_error()
+        except OSError:
+            log.warning("error flushing metrics to Datadog", exc_info=True)
+            self._count_error()
 
     def flush(self, metrics: List[InterMetric]) -> None:
         dd_metrics, checks = self.finalize_metrics(metrics)
