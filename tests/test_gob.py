@@ -1,0 +1,220 @@
+"""Go ``encoding/gob`` interop: reference HTTP import bodies.
+
+Validates veneur_tpu/protocol/gob.py two ways: against hand-constructed
+streams following the gob wire spec, and — when the reference checkout
+is present — against the reference's own golden fixture
+(``fixtures/import.uncompressed``, the body its ``http_test.go`` replays),
+driven through the real HTTP import server end-to-end.
+"""
+
+import base64
+import json
+import os
+import struct
+import zlib
+
+import numpy as np
+import pytest
+
+from veneur_tpu.protocol.gob import GobError, GobStream, \
+    decode_reference_digest
+
+REF_FIXTURES = "/root/reference/fixtures"
+
+
+def u(v: int) -> bytes:
+    """gob unsigned int."""
+    if v < 128:
+        return bytes([v])
+    body = v.to_bytes((v.bit_length() + 7) // 8, "big")
+    return bytes([256 - len(body)]) + body
+
+
+def f64(v: float) -> bytes:
+    """gob float64: byte-reversed bits as an unsigned int."""
+    bits = struct.unpack("<Q", struct.pack("<d", v))[0]
+    rev = int.from_bytes(bits.to_bytes(8, "little"), "big")
+    return u(rev)
+
+
+def ty(i: int) -> bytes:
+    """gob signed int (type ids, field ids)."""
+    return u((~i << 1) | 1 if i < 0 else i << 1)
+
+
+def msg(body: bytes) -> bytes:
+    return u(len(body)) + body
+
+
+def build_digest_gob(centroids, compression, dmin, dmax) -> bytes:
+    """Assemble the exact stream MergingDigest.GobEncode produces:
+    typedefs for []Centroid (68), Centroid (66), []float64 (67), then
+    the four values."""
+    name = b"Centroid"
+    # type 68 = slice of 66
+    t_slice = msg(ty(-68) + u(2) + u(1) + u(2) + ty(68) + u(0)
+                  + u(1) + ty(66) + u(0) + u(0))
+    # type 66 = struct Centroid{Mean f64, Weight f64, Samples 67}
+    t_struct = msg(
+        ty(-66) + u(3)
+        + u(1) + u(1) + u(len(name)) + name + u(1) + ty(66) + u(0)
+        + u(1) + u(3)
+        + u(1) + u(4) + b"Mean" + u(1) + ty(4) + u(0)
+        + u(1) + u(6) + b"Weight" + u(1) + ty(4) + u(0)
+        + u(1) + u(7) + b"Samples" + u(1) + ty(67) + u(0)
+        + u(0) + u(0))
+    fname = b"[]float64"
+    t_f64s = msg(ty(-67) + u(2) + u(1) + u(1) + u(len(fname)) + fname
+                 + u(1) + ty(67) + u(0) + u(1) + ty(4) + u(0) + u(0))
+    cents = u(len(centroids))
+    for mean, weight in centroids:
+        cents += u(1) + f64(mean) + u(1) + f64(weight) + u(0)
+    v_slice = msg(ty(68) + u(0) + cents)
+    vals = b"".join(msg(ty(4) + u(0) + f64(x))
+                    for x in (compression, dmin, dmax))
+    return t_slice + t_struct + t_f64s + v_slice + vals
+
+
+class TestGobCodec:
+    def test_constructed_digest_roundtrip(self):
+        cents = [(1.5, 2.0), (40.0, 7.0), (1e6, 1.0)]
+        blob = build_digest_gob(cents, 100.0, 1.5, 1e6)
+        means, weights, comp, dmin, dmax = decode_reference_digest(blob)
+        assert list(zip(means, weights)) == cents
+        assert (comp, dmin, dmax) == (100.0, 1.5, 1e6)
+
+    def test_float_encoding_edge_values(self):
+        for v in (0.0, -0.0, 1.0, -2.5, 1e-300, 1e300, 123.456):
+            blob = build_digest_gob([(v, 1.0)], v, v, v)
+            means, _, comp, _, _ = decode_reference_digest(blob)
+            assert means[0] == v and comp == v
+
+    def test_truncated_stream_raises(self):
+        blob = build_digest_gob([(1.0, 1.0)], 100.0, 1.0, 1.0)
+        with pytest.raises(GobError):
+            decode_reference_digest(blob[:len(blob) // 2])
+
+    def test_garbage_raises(self):
+        with pytest.raises((GobError, Exception)):
+            decode_reference_digest(b"\x99\x98\x97" * 10)
+
+    def test_multibyte_uint(self):
+        s = GobStream(b"")
+        r = s.r.__class__(u(5) + u(300) + u(1 << 40))
+        assert r.read_uint() == 5
+        assert r.read_uint() == 300
+        assert r.read_uint() == 1 << 40
+
+
+@pytest.mark.skipif(not os.path.isdir(REF_FIXTURES),
+                    reason="reference checkout not present")
+class TestReferenceGolden:
+    def _fixture(self):
+        with open(os.path.join(REF_FIXTURES, "import.uncompressed")) as f:
+            return json.load(f)
+
+    def test_golden_digest_decodes(self):
+        """The reference's own serialized histogram: samples
+        1,2,7,8,100 at compression 100 (http_test.go fixtures)."""
+        d = self._fixture()[0]
+        assert d["type"] == "histogram"
+        means, weights, comp, dmin, dmax = decode_reference_digest(
+            base64.b64decode(d["value"]))
+        assert means == [1.0, 2.0, 7.0, 8.0, 100.0]
+        assert weights == [1.0] * 5
+        assert (comp, dmin, dmax) == (100.0, 1.0, 100.0)
+
+    def test_golden_body_imports_over_real_http(self):
+        """End-to-end: the reference fixture body (deflate variant —
+        exactly what a Go local POSTs) → real HTTP import server →
+        store merge → flush emits the digest's percentiles."""
+        from veneur_tpu.config import Config
+        from veneur_tpu.samplers.intermetric import HistogramAggregates
+        from veneur_tpu.server import Server
+        from veneur_tpu.sinks import ChannelMetricSink
+        import urllib.request
+
+        with open(os.path.join(REF_FIXTURES, "import.deflate"), "rb") as f:
+            body = f.read()
+        # sanity: it really is the deflated twin of the JSON fixture
+        assert json.loads(zlib.decompress(body)) == self._fixture()
+
+        sink = ChannelMetricSink()
+        server = Server(Config(statsd_listen_addresses=[],
+                               http_address="127.0.0.1:0",
+                               interval="86400s", percentiles=[0.5],
+                               aggregates=["min", "max", "count"]),
+                        metric_sinks=[sink])
+        server.start()
+        try:
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{server.ops_server.port}/import",
+                data=body,
+                headers={"Content-Type": "application/json",
+                         "Content-Encoding": "deflate"})
+            with urllib.request.urlopen(req, timeout=10) as resp:
+                assert resp.status == 202
+            deadline = 50
+            while server.store.imported < 1 and deadline:
+                import time
+
+                time.sleep(0.1)
+                deadline -= 1
+            assert server.store.imported == 1
+            server.flush()
+            by_name = {m.name: m for m in sink.get_flush()}
+            # samples 1,2,7,8,100: the reference's center-interpolated
+            # median is 7; any value in (2, 8) is within one sample of
+            # rank error, the t-digest contract at n=5
+            assert 2.0 < by_name["a.b.c.50percentile"].value <= 8.0
+        finally:
+            server.shutdown()
+
+
+class TestReferenceJsonOps:
+    """Reference-format JSONMetric entries through the appliers."""
+
+    def _entry(self, mtype, value_bytes, name="m", tagstring=""):
+        return {"name": name, "type": mtype, "tagstring": tagstring,
+                "tags": tagstring.split(",") if tagstring else None,
+                "value": base64.b64encode(value_bytes).decode()}
+
+    def test_counter_gauge_set_digest(self):
+        from veneur_tpu.core.store import MetricStore
+        from veneur_tpu.forward.convert import apply_json_metric_list
+        from veneur_tpu.ops import axiomhq
+        from veneur_tpu.samplers.intermetric import HistogramAggregates
+
+        regs = np.zeros(1 << 14, np.uint8)
+        regs[7] = 3
+        metrics = [
+            self._entry("counter", struct.pack("<q", -9), "c",
+                        "env:prod"),
+            self._entry("gauge", struct.pack("<d", 2.25), "g"),
+            self._entry("set", axiomhq.encode_dense(regs, 14), "s"),
+            self._entry("histogram",
+                        build_digest_gob([(5.0, 4.0)], 100.0, 5.0, 5.0),
+                        "h"),
+        ]
+        store = MetricStore(initial_capacity=16, chunk=64)
+        n_ok, n_err = apply_json_metric_list(store, metrics)
+        assert (n_ok, n_err) == (4, 0)
+        agg = HistogramAggregates.from_names(["count", "median"])
+        final, _, _ = store.flush([], agg, is_local=False, now=1)
+        by = {m.name: m for m in final}
+        assert by["c"].value == -9.0 and by["c"].tags == ["env:prod"]
+        assert by["g"].value == 2.25
+        # imported digests carry no LOCAL stats, so count stays sparse
+        # (samplers.go:573-576); the digest itself yields the median
+        assert "h.count" not in by
+        assert by["h.median"].value == pytest.approx(5.0)
+
+    def test_malformed_reference_entry_counted(self):
+        from veneur_tpu.core.store import MetricStore
+        from veneur_tpu.forward.convert import apply_json_metric_list
+
+        store = MetricStore(initial_capacity=16, chunk=64)
+        n_ok, n_err = apply_json_metric_list(
+            store, [self._entry("histogram", b"not gob"),
+                    self._entry("counter", struct.pack("<q", 3), "ok")])
+        assert (n_ok, n_err) == (1, 1)

@@ -1471,30 +1471,19 @@ class MetricStore:
                     flat_rows = np.empty(0, np.int32)
                     means = weights = np.empty(0, np.float64)
                 stat_mask = np.isfinite(dec.dmin[sel])
-                if hasattr(group, "import_centroids_bulk"):
-                    try:
-                        group.import_centroids_bulk(
-                            flat_rows, means, weights,
-                            list(grp_rows[stat_mask].astype(int)),
-                            list(dec.dmin[sel][stat_mask]),
-                            list(dec.dmax[sel][stat_mask]))
-                        n_ok += len(sel)
-                    except Exception:
-                        n_err += len(sel)
-                        log.exception("bulk digest import failed; "
-                                      "dropping %d digests", len(sel))
-                else:  # mesh groups take the same staging protocol
-                    try:
-                        bulk_stage_import_centroids(
-                            group, flat_rows, means, weights,
-                            list(grp_rows[stat_mask].astype(int)),
-                            list(dec.dmin[sel][stat_mask]),
-                            list(dec.dmax[sel][stat_mask]))
-                        n_ok += len(sel)
-                    except Exception:
-                        n_err += len(sel)
-                        log.exception("bulk digest import failed; "
-                                      "dropping %d digests", len(sel))
+                try:
+                    # every digest group (dense, slab, mesh) shares the
+                    # module-level staging protocol
+                    bulk_stage_import_centroids(
+                        group, flat_rows, means, weights,
+                        list(grp_rows[stat_mask].astype(int)),
+                        list(dec.dmin[sel][stat_mask]),
+                        list(dec.dmax[sel][stat_mask]))
+                    n_ok += len(sel)
+                except Exception:
+                    n_err += len(sel)
+                    log.exception("bulk digest import failed; "
+                                  "dropping %d digests", len(sel))
 
             sel = np.flatnonzero(ok & (payload == egress.PAYLOAD_SET))
             for i in sel:
@@ -1517,12 +1506,12 @@ class MetricStore:
                 try:
                     pb = forward_pb2.TopKSketch.FromString(
                         data[dec.topk_off:dec.topk_off + dec.topk_len])
-                    table, series = decode_topk_sketch(pb)
+                    cm_table, series = decode_topk_sketch(pb)
                     entries = [(MetricKey(name=name, type="set",
                                           joined_tags=",".join(tags)),
                                 tags, keys, members)
                                for name, tags, keys, members in series]
-                    self.heavy_hitters.import_sketch(table, entries)
+                    self.heavy_hitters.import_sketch(cm_table, entries)
                     n_ok += 1
                 except Exception as e:
                     n_err += 1

@@ -368,11 +368,51 @@ def json_metrics_from_state(state, compression: float = 100.0,
     return out
 
 
+def _parse_reference_json(d: Dict) -> tuple:
+    """One REFERENCE-format JSONMetric → a typed op.
+
+    A Go local's import body entries carry the sampler's internal bytes
+    in ``value`` (base64) — LE int64 for counters, LE float64 for
+    gauges, the axiomhq sketch for sets, and a gob stream for
+    histograms/timers (samplers.go Export methods; JSONMetric at
+    samplers.go:102-108 with ``tagstring`` from parser.go:47)."""
+    from veneur_tpu.protocol.gob import decode_reference_digest
+    from veneur_tpu.samplers.parser import MetricKey
+
+    mtype = d["type"]
+    tags = list(d.get("tags") or [])
+    joined = d.get("tagstring")
+    if not tags and joined:
+        tags = joined.split(",")
+    key = MetricKey(name=d["name"], type=mtype,
+                    joined_tags=joined if joined is not None
+                    else ",".join(tags))
+    blob = base64.b64decode(d["value"])
+    if mtype == "counter":
+        (v,) = struct.unpack("<q", blob)
+        return None, ("counter", key, tags, v)
+    if mtype == "gauge":
+        (v,) = struct.unpack("<d", blob)
+        return None, ("gauge", key, tags, v)
+    if mtype == "set":
+        registers, _ = decode_hll(blob)  # auto-detects axiomhq
+        return None, ("set", key, tags, registers)
+    if mtype in ("histogram", "timer"):
+        means, weights, _comp, dmin, dmax = decode_reference_digest(blob)
+        return _validated_digest(
+            key, tags, np.asarray(means, np.float64),
+            np.asarray(weights, np.float64), dmin, dmax), None
+    raise ValueError(f"unknown reference JSON metric type {mtype!r}")
+
+
 def apply_json_metric_list(store, metrics: List[Dict]) -> tuple:
     """JSON twin of apply_metric_list: fully parse/decode every entry
     into typed ops first (decoded payloads carried forward), guard each
     non-digest apply, and stage all digests through one bulk store call.
-    Returns (n_applied, n_errors)."""
+    Accepts BOTH our structured entries and the reference's gob/binary
+    ``JSONMetric`` entries (value = base64 bytes), so a Go local can
+    POST /import to this global unchanged. Returns
+    (n_applied, n_errors)."""
     from veneur_tpu.samplers.parser import MetricKey
 
     digests = []
@@ -380,6 +420,16 @@ def apply_json_metric_list(store, metrics: List[Dict]) -> tuple:
     n_err = 0
     for d in metrics:
         try:
+            if isinstance(d.get("value"), str):
+                # reference-format entry (our counters/gauges carry
+                # numbers in "value"; only reference entries put base64
+                # strings there)
+                digest_op, other_op = _parse_reference_json(d)
+                if digest_op is not None:
+                    digests.append(digest_op)
+                else:
+                    others.append(other_op)
+                continue
             mtype = d["type"]
             tags = list(d.get("tags") or [])
             key = MetricKey(name=d["name"], type=mtype,
@@ -422,9 +472,24 @@ def apply_json_metric_list(store, metrics: List[Dict]) -> tuple:
 
 def apply_json_metric(store, d: Dict):
     """Merge one imported JSON metric (handlers_global.go:60-213 +
-    Worker.ImportMetric/Combine, worker.go:313-351)."""
+    Worker.ImportMetric/Combine, worker.go:313-351). Accepts our
+    structured entries and reference-format (gob/binary) entries."""
     from veneur_tpu.samplers.parser import MetricKey
 
+    if isinstance(d.get("value"), str):
+        digest_op, other_op = _parse_reference_json(d)
+        if digest_op is not None:
+            key, tags, means, weights, dmin, dmax = digest_op
+            store.import_digest(key, tags, means, weights, dmin, dmax)
+        else:
+            kind, key, tags, payload = other_op
+            if kind == "counter":
+                store.import_counter(key, tags, payload)
+            elif kind == "gauge":
+                store.import_gauge(key, tags, payload)
+            else:
+                store.import_set(key, tags, payload)
+        return
     name, tags, mtype = d["name"], list(d.get("tags") or []), d["type"]
     if mtype == "topk_sketch":
         table = np.frombuffer(base64.b64decode(d["table"]),
