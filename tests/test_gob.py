@@ -98,6 +98,20 @@ class TestGobCodec:
         with pytest.raises((GobError, Exception)):
             decode_reference_digest(b"\x99\x98\x97" * 10)
 
+    def test_self_referential_typedef_raises_goberror(self):
+        """A crafted stream defining a type as a slice of ITSELF must
+        hit the depth cap as GobError, never RecursionError (untrusted
+        network input)."""
+        # type 66 = slice of type 66, then a deeply nested value:
+        # each nesting level is "length-1 slice" (u(1))
+        t_def = msg(ty(-66) + u(2) + u(1) + u(2) + ty(66) + u(0)
+                    + u(1) + ty(66) + u(0) + u(0))
+        nested = u(1) * 2000 + u(0)
+        v = msg(ty(66) + u(0) + nested)
+        s = GobStream(t_def + v)
+        with pytest.raises(GobError):
+            s.next_value()
+
     def test_multibyte_uint(self):
         s = GobStream(b"")
         r = s.r.__class__(u(5) + u(300) + u(1 << 40))

@@ -1,0 +1,79 @@
+"""Memory-safety fuzzing of the native MetricList decoder under ASan.
+
+The gRPC import server hands UNTRUSTED network bytes straight to
+``vt_mlist_decode`` (native/veneur_egress.cpp), and the UDP/TCP paths
+feed raw socket bytes to ``vt_parse_lines`` / ``vt_frame_scan``
+(veneur_ingest.cpp); this builds all three with AddressSanitizer+UBSan
+and replays truncations, deterministic point mutations, and structured
+garbage through decode + intern-assign + parse + frame-scan
+(native/fuzz_driver.cpp) — the ASan counterpart of the TSan harness
+over the ingest pool (test_native_tsan.py).
+"""
+
+import os
+import subprocess
+
+import pytest
+
+_NATIVE = os.path.join(os.path.dirname(__file__), "..", "veneur_tpu",
+                       "native")
+_DRIVER = os.path.join(_NATIVE, "fuzz_driver.cpp")
+_CODEC = os.path.join(_NATIVE, "veneur_egress.cpp")
+_BIN = os.path.join(_NATIVE, "fuzz_driver")
+
+
+def _build():
+    ingest = os.path.join(_NATIVE, "veneur_ingest.cpp")
+    return subprocess.run(
+        ["g++", "-O1", "-g", "-std=c++17", "-pthread",
+         "-fsanitize=address,undefined", "-fno-sanitize-recover=all",
+         _DRIVER, _CODEC, ingest, "-lz", "-o", _BIN],
+        capture_output=True, timeout=240)
+
+
+@pytest.fixture(scope="module")
+def fuzz_bin():
+    r = _build()
+    if r.returncode != 0:
+        pytest.skip("asan build unavailable: "
+                    + r.stderr.decode(errors="replace")[:300])
+    yield _BIN
+    try:
+        os.unlink(_BIN)
+    except OSError:
+        pass
+
+
+def _seed(tmp_path):
+    """A realistic MetricList covering every payload kind + the topk
+    extension, serialized by python-protobuf."""
+    import numpy as np
+
+    from veneur_tpu.core.store import ForwardableState
+    from veneur_tpu.forward.convert import metric_list_from_state
+
+    rng = np.random.default_rng(0)
+    state = ForwardableState()
+    state.counters.append(("c", ["a:1", "b:2"], -5))
+    state.gauges.append(("g", [], 2.5))
+    for i in range(20):
+        means = np.sort(rng.gamma(2, 30, 24))
+        state.histograms.append((f"h{i}", [f"s:{i}"], means,
+                                 np.ones(24), float(means[0]),
+                                 float(means[-1])))
+    regs = np.zeros(1 << 10, np.uint8)
+    regs[:50] = 3
+    state.sets.append(("s", [], regs, 10))
+    state.topk = (np.ones((2, 8), np.float32),
+                  [("t", ["x:1"], [(1, 2), (3, 4)], ["m", None])])
+    path = tmp_path / "seed.bin"
+    path.write_bytes(metric_list_from_state(state).SerializeToString())
+    return str(path)
+
+
+def test_decoder_survives_mutated_input(fuzz_bin, tmp_path):
+    r = subprocess.run([fuzz_bin, _seed(tmp_path), "4000"],
+                       capture_output=True, timeout=300)
+    assert r.returncode == 0, (
+        f"sanitizer report:\n{r.stderr.decode(errors='replace')[-2500:]}")
+    assert b"fuzz_driver: OK" in r.stdout

@@ -33,7 +33,8 @@ class CapturePost:
     def __init__(self):
         self.calls = []
 
-    def __call__(self, url, payload, compress=True, method="POST"):
+    def __call__(self, url, payload, compress=True, method="POST",
+                 precompressed=False):
         self.calls.append((url, payload, compress, method))
         return 202
 
@@ -76,6 +77,53 @@ class TestDatadogMetricSink:
         assert dd["type"] == "rate" and dd["points"][0][1] == 1.0
         assert dd["host"] == "abc123" and dd["device_name"] == "xyz"
         assert dd["tags"] == ["gloobles:toots", "x:e"]
+
+    def test_columnar_flush_matches_legacy_wire(self):
+        """The native columnar path must put the same metrics on the
+        Datadog wire as finalize_metrics does — full loop: store flush
+        (columnar) → C++ serialize+deflate → POST body."""
+        import zlib
+
+        from veneur_tpu.core.store import MetricStore
+        from veneur_tpu.native import egress
+        from veneur_tpu.samplers import parser as p
+        from veneur_tpu.samplers.intermetric import HistogramAggregates
+
+        if not egress.available():
+            pytest.skip("no native toolchain")
+        store = MetricStore(initial_capacity=32, chunk=64)
+        store.process_metric(p.parse_metric(b"web.hits:4|c|#route:r1"))
+        store.process_metric(p.parse_metric(b"web.temp:55|g|#host:db7"))
+        for v in (1.0, 9.0):
+            store.process_metric(p.parse_metric(f"web.lat:{v}|h".encode()))
+        agg = HistogramAggregates.from_names(["max", "count"])
+        col, _, _ = store.flush([], agg, is_local=False, now=700,
+                                columnar=True)
+
+        sink, post = self.make()
+        sink.flush_columnar(col)
+        series = []
+        for url, payload, *_ in post.calls:
+            assert "/api/v1/series" in url
+            series += json.loads(zlib.decompress(payload))["series"]
+        by = {m["metric"]: m for m in series}
+        assert by["web.hits"]["type"] == "rate"
+        assert by["web.hits"]["points"][0] == [700, 0.4]
+        assert by["web.hits"]["tags"] == ["gloobles:toots", "route:r1"]
+        assert by["web.temp"]["host"] == "db7"
+        assert by["web.lat.max"]["points"][0][1] == 9.0
+        assert by["web.lat.count"]["type"] == "rate"
+        assert by["web.lat.count"]["points"][0][1] == pytest.approx(0.2)
+        assert sink.metrics_flushed == len(series)
+
+        # equivalence: the legacy path on the materialized metrics
+        # produces the same (metric, value) set
+        sink2, post2 = self.make()
+        sink2.flush(col.to_intermetrics())
+        legacy = [m for _, payload, *_ in post2.calls
+                  for m in payload["series"]]
+        assert {(m["metric"], m["points"][0][1]) for m in legacy} \
+            == {(m["metric"], m["points"][0][1]) for m in series}
 
     def test_status_check_goes_to_check_run(self):
         sink, post = self.make()

@@ -1,0 +1,127 @@
+// AddressSanitizer robustness driver for the native egress codecs.
+//
+// vt_mlist_decode parses UNTRUSTED network bytes (the gRPC import
+// server's request body); vt_mintern_assign walks the decoded batch.
+// This driver hammers them with deterministic mutations of a valid
+// MetricList plus structured garbage, under ASan — the memory-safety
+// counterpart of tsan_driver.cpp for the ingest path. Exit 0 = no
+// leaks/overflows surfaced; any ASan report aborts the process.
+//
+// Built and run by tests/test_native_fuzz.py:
+//   g++ -O1 -g -std=c++17 -fsanitize=address,undefined \
+//       fuzz_driver.cpp veneur_egress.cpp -lz -o fuzz_driver
+//
+// The valid seed buffer is passed in as a file (the test writes one
+// with python-protobuf); mutations are xorshift-deterministic so a
+// failure reproduces.
+
+#include <stdint.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+#include <vector>
+
+extern "C" {
+struct VtMetricBatch;
+VtMetricBatch* vt_mlist_decode(const char* buf, size_t len);
+void vt_mbatch_free(VtMetricBatch* m);
+void* vt_mintern_new();
+void vt_mintern_free(void* t);
+uint32_t vt_mintern_assign(void* t, const VtMetricBatch* b,
+                           uint32_t* rows_out, uint32_t* miss_out);
+// ingest codecs (veneur_ingest.cpp) — same untrusted-byte surface
+struct VtBatch;
+VtBatch* vt_batch_new(uint32_t capacity, uint32_t arena_cap);
+void vt_batch_free(VtBatch* b);
+void vt_batch_reset(VtBatch* b);
+uint32_t vt_parse_lines(const char* buf, size_t len, VtBatch* b);
+uint32_t vt_frame_scan(const char* buf, size_t len, uint32_t* offs,
+                       uint32_t* lens, uint32_t max_frames,
+                       size_t* consumed, int* poisoned);
+}
+
+// the batch's count field is first; enough introspection for sizing
+struct BatchHead {
+  uint32_t count;
+};
+
+static uint64_t rng_state = 0x9E3779B97F4A7C15ULL;
+static uint64_t xorshift() {
+  rng_state ^= rng_state << 13;
+  rng_state ^= rng_state >> 7;
+  rng_state ^= rng_state << 17;
+  return rng_state;
+}
+
+static VtBatch* g_ingest_batch = nullptr;
+
+static void exercise(const char* buf, size_t len) {
+  VtMetricBatch* b = vt_mlist_decode(buf, len);
+  if (!b) return;
+  uint32_t count = reinterpret_cast<BatchHead*>(b)->count;
+  if (count > 0 && count < (1u << 24)) {
+    std::vector<uint32_t> rows(count), miss(count);
+    void* t = vt_mintern_new();
+    vt_mintern_assign(t, b, rows.data(), miss.data());
+    vt_mintern_free(t);
+  }
+  vt_mbatch_free(b);
+
+  // the same bytes through the DogStatsD line parser and the framed-SSF
+  // scanner (both consume raw socket data)
+  vt_batch_reset(g_ingest_batch);
+  vt_parse_lines(buf, len, g_ingest_batch);
+  uint32_t offs[64], lens[64];
+  size_t consumed = 0;
+  int poisoned = 0;
+  vt_frame_scan(buf, len, offs, lens, 64, &consumed, &poisoned);
+}
+
+int main(int argc, char** argv) {
+  if (argc < 2) {
+    fprintf(stderr, "usage: fuzz_driver <seed-file> [iterations]\n");
+    return 2;
+  }
+  FILE* f = fopen(argv[1], "rb");
+  if (!f) {
+    perror("seed");
+    return 2;
+  }
+  fseek(f, 0, SEEK_END);
+  long n = ftell(f);
+  fseek(f, 0, SEEK_SET);
+  std::vector<char> seed(n);
+  if (fread(seed.data(), 1, n, f) != static_cast<size_t>(n)) return 2;
+  fclose(f);
+  int iters = argc > 2 ? atoi(argv[2]) : 4000;
+  g_ingest_batch = vt_batch_new(4096, 1 << 20);
+
+  // 1. the pristine seed
+  exercise(seed.data(), seed.size());
+
+  // 2. every truncation length (catches length-field overreads)
+  for (long cut = 0; cut <= n; cut += (n > 512 ? 7 : 1))
+    exercise(seed.data(), cut);
+
+  // 3. deterministic point mutations: flip random bytes, re-parse
+  std::vector<char> mut = seed;
+  for (int i = 0; i < iters; i++) {
+    size_t at = xorshift() % mut.size();
+    char old = mut[at];
+    mut[at] = static_cast<char>(xorshift());
+    exercise(mut.data(), mut.size());
+    if (xorshift() % 4) mut[at] = old;  // mostly revert, sometimes keep
+  }
+
+  // 4. structured garbage: varint storms, giant length prefixes
+  for (int i = 0; i < 256; i++) {
+    std::vector<char> g(64 + (xorshift() % 512));
+    for (char& c : g) c = static_cast<char>(xorshift());
+    g[0] = 0x0A;  // field 1, wire type 2 — plausible MetricList start
+    g[1] = static_cast<char>(0xFF);  // huge/invalid length varints
+    exercise(g.data(), g.size());
+  }
+  printf("fuzz_driver: OK\n");
+  return 0;
+}

@@ -56,6 +56,7 @@ struct Buf {
     cap = ncap;
   }
   void put(const void* d, size_t n) {
+    if (n == 0) return;  // memcpy on a never-allocated buffer is UB
     reserve(n);
     memcpy(p + len, d, n);
     len += n;

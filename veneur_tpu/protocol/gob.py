@@ -192,7 +192,13 @@ class GobStream:
             raise GobError("empty type definition")
         self.types[type_id] = wt
 
-    def _read_value(self, type_id: int, r: _Reader):
+    # real streams nest ~3 deep ([]struct{...[]float64}); a crafted
+    # self-referential typedef must hit GobError, not RecursionError
+    MAX_DEPTH = 32
+
+    def _read_value(self, type_id: int, r: _Reader, depth: int = 0):
+        if depth > self.MAX_DEPTH:
+            raise GobError("gob value nesting too deep")
         if type_id == BOOL:
             return bool(r.read_uint())
         if type_id == INT:
@@ -207,11 +213,12 @@ class GobStream:
         if wt is None:
             raise GobError(f"value of undefined type {type_id}")
         if isinstance(wt, _SliceType):
-            return [self._read_value(wt.elem, r)
+            return [self._read_value(wt.elem, r, depth + 1)
                     for _ in range(r.read_uint())]
         # struct: (delta, value) pairs, 0-terminated; omitted fields keep
         # their zero value
-        out = {name: _zero(self, fid) for name, fid in wt.fields}
+        out = {name: _zero(self, fid, depth + 1)
+               for name, fid in wt.fields}
         field = -1
         while True:
             delta = r.read_uint()
@@ -222,7 +229,7 @@ class GobStream:
                 raise GobError(f"field {field} out of range for "
                                f"{wt.name}")
             name, fid = wt.fields[field]
-            out[name] = self._read_value(fid, r)
+            out[name] = self._read_value(fid, r, depth + 1)
 
     def next_value(self):
         """Read messages until the next VALUE (consuming type
@@ -247,7 +254,9 @@ class GobStream:
             return self._read_value(type_id, msg)
 
 
-def _zero(stream: GobStream, type_id: int):
+def _zero(stream: GobStream, type_id: int, depth: int = 0):
+    if depth > GobStream.MAX_DEPTH:
+        raise GobError("gob type nesting too deep")
     if type_id == FLOAT:
         return 0.0
     if type_id in (INT, UINT):
@@ -260,7 +269,8 @@ def _zero(stream: GobStream, type_id: int):
     if isinstance(wt, _SliceType):
         return []
     if isinstance(wt, _StructType):
-        return {name: _zero(stream, fid) for name, fid in wt.fields}
+        return {name: _zero(stream, fid, depth + 1)
+                for name, fid in wt.fields}
     return None
 
 
