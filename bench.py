@@ -489,7 +489,10 @@ def bench_egress_1m(num_series: int = 1 << 20):
             "serialize_deflate_s": round(t_serialize, 3),
             "series": num_series, "emissions": n_emissions,
             "bodies": len(bodies),
-            "deflated_mb": round(out_bytes / 1e6, 1)}
+            "deflated_mb": round(out_bytes / 1e6, 1),
+            "note": "flush_s includes ~30 MB of per-series stat fetches "
+                    "over this harness's ~10 MB/s tunnel (PCIe on a "
+                    "real TPU host)"}
 
 
 def bench_forward_1m(num_series: int = 1 << 20):

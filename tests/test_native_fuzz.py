@@ -33,6 +33,10 @@ def _build():
 
 @pytest.fixture(scope="module")
 def fuzz_bin():
+    import shutil
+
+    if shutil.which("g++") is None:
+        pytest.skip("no native toolchain")
     r = _build()
     if r.returncode != 0:
         pytest.skip("asan build unavailable: "

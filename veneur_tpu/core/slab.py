@@ -631,10 +631,12 @@ class SlabDigestGroup:
         parts = []
         for i in range(len(self.digests)):
             need = min(n - i * self.slab_rows, self.slab_rows)
+            # want_digest=False also skips the device-side cast+write of
+            # the drained planes, not just the host fetch
             (self.digests[i], self.temps[i], mean, weight, dmin, dmax,
              pcts, count, vsum, vmin, vmax, recip) = _flush_slab(
                 self.digests[i], self.temps[i], qs, self.slab_rows,
-                self.compression)
+                self.compression, want_digests)
             if need <= 0:
                 continue
             k = self.k

@@ -125,6 +125,7 @@ def _flush_once(server: "Server", span):
         return
 
     # one thread per metric sink (flusher.go:82-93)
+    t0 = time.perf_counter()
     threads = []
     for sink in server.metric_sinks:
         if use_columnar and hasattr(sink, "flush_columnar"):
@@ -139,6 +140,10 @@ def _flush_once(server: "Server", span):
         threads.append(t)
     for t in threads:
         t.join(timeout=30.0)
+    # total time across the parallel sink POSTs (README.md:264)
+    span.add(ssf_samples.timing("veneur.flush.total_duration_ns",
+                                time.perf_counter() - t0,
+                                {"part": "post"}))
 
     # plugins run after the sinks (flusher.go:95-109)
     if server.plugins:

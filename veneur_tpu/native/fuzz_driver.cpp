@@ -25,6 +25,7 @@
 extern "C" {
 struct VtMetricBatch;
 VtMetricBatch* vt_mlist_decode(const char* buf, size_t len);
+uint32_t vt_mbatch_count(const VtMetricBatch* m);
 void vt_mbatch_free(VtMetricBatch* m);
 void* vt_mintern_new();
 void vt_mintern_free(void* t);
@@ -41,11 +42,6 @@ uint32_t vt_frame_scan(const char* buf, size_t len, uint32_t* offs,
                        size_t* consumed, int* poisoned);
 }
 
-// the batch's count field is first; enough introspection for sizing
-struct BatchHead {
-  uint32_t count;
-};
-
 static uint64_t rng_state = 0x9E3779B97F4A7C15ULL;
 static uint64_t xorshift() {
   rng_state ^= rng_state << 13;
@@ -59,7 +55,7 @@ static VtBatch* g_ingest_batch = nullptr;
 static void exercise(const char* buf, size_t len) {
   VtMetricBatch* b = vt_mlist_decode(buf, len);
   if (!b) return;
-  uint32_t count = reinterpret_cast<BatchHead*>(b)->count;
+  uint32_t count = vt_mbatch_count(b);
   if (count > 0 && count < (1u << 24)) {
     std::vector<uint32_t> rows(count), miss(count);
     void* t = vt_mintern_new();
@@ -91,6 +87,10 @@ int main(int argc, char** argv) {
   fseek(f, 0, SEEK_END);
   long n = ftell(f);
   fseek(f, 0, SEEK_SET);
+  if (n <= 0) {
+    fprintf(stderr, "seed file is empty or unreadable\n");
+    return 2;
+  }
   std::vector<char> seed(n);
   if (fread(seed.data(), 1, n, f) != static_cast<size_t>(n)) return 2;
   fclose(f);

@@ -814,6 +814,12 @@ extern "C" VtMetricBatch* vt_mlist_decode(const char* buf, size_t len) {
   return out;
 }
 
+// layout-independent accessor (the fuzz driver must not depend on the
+// struct's field order)
+extern "C" uint32_t vt_mbatch_count(const VtMetricBatch* m) {
+  return m ? m->count : 0;
+}
+
 extern "C" void vt_mbatch_free(VtMetricBatch* m) {
   if (!m) return;
   VtMetricBatchImpl* impl = static_cast<VtMetricBatchImpl*>(m->impl);
