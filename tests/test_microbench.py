@@ -1,7 +1,8 @@
 """Micro-benchmarks in the test tree, mirroring the reference's Go
 bench list (BASELINE.md "Benchmark code present"): parser, SSF decode,
 scalar t-digest add/quantile, batched kernel ops, import-path merge,
-native batch parse. Like the Go benches they record numbers rather than
+native batch parse, columnar Datadog serialize+deflate, and native
+MetricList decode. Like the Go benches they record numbers rather than
 assert thresholds (CI hosts vary) — each test prints ns/op and asserts
 only that the op ran; `python -m pytest tests/test_microbench.py -s`
 shows the table. bench.py remains the system-level suite.
@@ -141,3 +142,73 @@ def test_bench_native_parse_lines():
     per = _bench("native parse_lines (64-metric buffer)", parse, n=5000)
     print(f"{'  -> per metric':40s} {per / 64 * 1e9:12.0f} ns/op")
     assert per > 0
+
+
+def test_bench_egress_serialize():
+    """Datadog series serialization through the native columnar path
+    (the Go counterpart is json.Marshal+zlib inside the datadog sink)."""
+    from veneur_tpu.core.columnar import build_arenas
+    from veneur_tpu.native import egress
+
+    if not egress.available():
+        import pytest
+
+        pytest.skip("no native toolchain")
+    n = 50_000
+    rng = np.random.default_rng(0)
+    names = build_arenas([f"svc.lat.{i % 997}" for i in range(n)])
+    tags = build_arenas([f"shard:{i % 13},env:prod" for i in range(n)])
+    rows = np.arange(n, dtype=np.uint32)
+    sfx = np.zeros(n, np.uint8)
+    vals = rng.gamma(2, 50, n)
+    types = np.zeros(n, np.uint8)
+
+    def run():
+        egress.dd_series_bodies(names, tags, [b".max"], rows, sfx, vals,
+                                types, 1, 10, "h", compress_level=1)
+
+    per = _bench("dd serialize+deflate (50k metrics)", run, n=5)
+    print(f"{'':40s} {n / per / 1e6:12.2f} M metrics/s")
+    assert per > 0
+
+
+def test_bench_mlist_decode():
+    """MetricList wire decode, native vs python-protobuf (the import
+    server's hot parse; cf. BenchmarkImportServerSendMetrics)."""
+    from veneur_tpu.core.store import ForwardableState
+    from veneur_tpu.forward.convert import metric_list_from_state
+    from veneur_tpu.native import egress
+    from veneur_tpu.protocol import forward_pb2
+
+    if not egress.available():
+        import pytest
+
+        pytest.skip("no native toolchain")
+    rng = np.random.default_rng(0)
+    state = ForwardableState()
+    for i in range(2000):
+        means = np.sort(rng.gamma(2, 30, 48))
+        state.histograms.append((f"h{i}", [f"s:{i % 7}"], means,
+                                 np.ones(48), float(means[0]),
+                                 float(means[-1])))
+    data = metric_list_from_state(state).SerializeToString()
+
+    def native():
+        egress.decode_metric_list(data).close()
+
+    def python():
+        # FromString alone is lazy C parsing; the real Python-path cost
+        # is extracting each metric's fields/arrays (what
+        # apply_metric_list had to do before the native lane)
+        ml = forward_pb2.MetricList.FromString(data)
+        for m in ml.metrics:
+            m.name
+            list(m.tags)
+            td = m.histogram.t_digest
+            np.asarray(td.packed_means)
+            np.asarray(td.packed_weights)
+
+    p_nat = _bench("mlist decode 2k digests (native)", native, n=20)
+    p_py = _bench("mlist decode+extract (python pb)", python, n=20)
+    print(f"{'native speedup':40s} {p_py / p_nat:12.1f} x")
+    assert p_nat > 0
