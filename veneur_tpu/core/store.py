@@ -28,6 +28,14 @@ last-write scalars whose per-interval cost is one vectorized pass; the
 FLOP/bandwidth-heavy mergeable-sketch math (t-digest compress, HLL
 estimate) is what rides the TPU.
 
+The EGRESS stays columnar too (``flush(columnar=True)``, the server
+default): results leave as flat arrays + interner string arenas
+(``core/columnar.py``) that native sinks serialize directly
+(``native/veneur_egress.cpp``) and the gRPC forwarder encodes from the
+``[S, K]`` digest planes — never ~15 Python objects per series. The
+import side mirrors it: natively decoded MetricLists bulk-stage through
+``import_columnar``.
+
 Scope semantics (which group a sample lands in, and which groups a local vs
 global instance flushes or forwards) follow ``worker.go:96-157`` and
 ``flusher.go:189-254`` exactly; see ``MetricStore.process_metric`` and
