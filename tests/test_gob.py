@@ -185,6 +185,107 @@ class TestReferenceGolden:
             server.shutdown()
 
 
+class TestGobEncoder:
+    def test_roundtrip(self):
+        from veneur_tpu.protocol.gob import encode_reference_digest
+
+        cents = [(0.0, 2.0), (1.5, 1.0), (1e6, 3.0)]
+        blob = encode_reference_digest([c[0] for c in cents],
+                                       [c[1] for c in cents],
+                                       100.0, 0.0, 1e6)
+        means, weights, comp, lo, hi = decode_reference_digest(blob)
+        assert list(zip(means, weights)) == cents
+        assert (comp, lo, hi) == (100.0, 0.0, 1e6)
+
+    @pytest.mark.skipif(not os.path.isdir(REF_FIXTURES),
+                        reason="reference checkout not present")
+    def test_byte_identical_to_go_encoder(self):
+        """Encoding the golden fixture's centroids reproduces the Go
+        encoder's bytes EXACTLY — proof a Go global's GobDecode accepts
+        our output (it is its own)."""
+        from veneur_tpu.protocol.gob import encode_reference_digest
+
+        with open(os.path.join(REF_FIXTURES, "import.uncompressed")) as f:
+            golden = base64.b64decode(json.load(f)[0]["value"])
+        mine = encode_reference_digest([1.0, 2.0, 7.0, 8.0, 100.0],
+                                       [1.0] * 5, 100.0, 1.0, 100.0)
+        assert mine == golden
+
+    def test_compat_forward_loop(self):
+        """A local's reference-format HTTP body merges into a global
+        through the REFERENCE parsing path identically to the structured
+        format (stand-in for a real Go global, whose formats these
+        are)."""
+        from veneur_tpu.core.store import MetricStore
+        from veneur_tpu.forward.convert import (
+            apply_json_metric_list, json_metrics_from_state,
+            reference_json_metrics_from_state)
+        from veneur_tpu.samplers import parser as p
+        from veneur_tpu.samplers.intermetric import HistogramAggregates
+
+        def local_state():
+            store = MetricStore(initial_capacity=32, chunk=128)
+            store.process_metric(
+                p.parse_metric(b"gc:5|c|#veneurglobalonly,env:prod"))
+            store.process_metric(
+                p.parse_metric(b"gg:2.5|g|#veneurglobalonly"))
+            for v in range(50):
+                store.process_metric(p.parse_metric(f"lat:{v}|ms".encode()))
+            for member in ("a", "b", "c"):
+                store.process_metric(
+                    p.parse_metric(f"users:{member}|s".encode()))
+            agg = HistogramAggregates.from_names(["count"])
+            _, fwd, _ = store.flush([], agg, is_local=True, now=0,
+                                    forward=True)
+            return fwd
+
+        agg = HistogramAggregates.from_names(["count", "median"])
+        results = {}
+        for label, payload in (
+                ("reference",
+                 reference_json_metrics_from_state(local_state())),
+                ("structured",
+                 json_metrics_from_state(local_state(),
+                                         include_topk=False))):
+            body = json.loads(json.dumps(payload))  # through the wire
+            g = MetricStore(initial_capacity=32, chunk=128)
+            n_ok, n_err = apply_json_metric_list(g, body)
+            assert n_err == 0, label
+            final, _, _ = g.flush([0.5], agg, is_local=False, now=1)
+            results[label] = {(m.name, tuple(sorted(m.tags))): m.value
+                              for m in final}
+        assert results["reference"].keys() == results["structured"].keys()
+        for k, v in results["structured"].items():
+            # the axiomhq 4-bit tailcut can clip extreme registers; at
+            # this load registers are identical, estimates equal
+            assert results["reference"][k] == pytest.approx(v, rel=1e-6)
+
+    def test_http_forwarder_emits_reference_format_under_compat(self):
+        from veneur_tpu.core.store import ForwardableState
+        from veneur_tpu.forward.http_forward import HTTPForwarder
+
+        sent = []
+        fwd = HTTPForwarder("127.0.0.1:1", reference_compat=True)
+        state = ForwardableState()
+        state.counters.append(("c", ["a:1"], 3))
+        import veneur_tpu.forward.http_forward as hf
+
+        orig = hf.post_helper
+        hf.post_helper = lambda url, payload, **kw: (sent.append(payload),
+                                                     202)[1]
+        try:
+            fwd.forward(state)
+        finally:
+            hf.post_helper = orig
+        (payload,) = sent
+        (m,) = payload
+        assert isinstance(m["value"], str)  # base64 bytes, not a number
+        assert m["tagstring"] == "a:1"
+        import struct as _s
+
+        assert _s.unpack("<q", base64.b64decode(m["value"]))[0] == 3
+
+
 class TestReferenceJsonOps:
     """Reference-format JSONMetric entries through the appliers."""
 

@@ -4,9 +4,12 @@ The reference ships two transports (SURVEY §2.2): HTTP ``POST /import``
 with deflate-compressed JSON-wrapped gob sketches (``flusher.go:292-385``,
 ``http.go:41-143``) and gRPC ``Forward.SendMetrics`` with protobuf sketch
 state (``flusher.go:424-473``, ``importsrv/server.go:101-132``). Both are
-rebuilt here over the same ``metricpb``-compatible schema; the gob payload
-is replaced by structured JSON (we are not wire-compatible with Go gob by
-design — the sketch state itself is protobuf/JSON, SURVEY §5 "checkpoint").
+rebuilt here and BOTH are wire-compatible with a reference fleet in both
+directions: the import side auto-detects reference payloads (gob digests
+via ``protocol/gob.py``, axiomhq sets), and ``forward_reference_compatible``
+makes this local emit the reference's own formats (see WIRE.md). The
+native forward format is structured JSON / packed-protobuf — faster to
+decode and the default within a fleet of this framework.
 """
 
 from veneur_tpu.forward.convert import (

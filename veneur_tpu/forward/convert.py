@@ -311,6 +311,45 @@ def decode_topk_sketch(pb) -> tuple:
 # ---------------------------------------------------------------------------
 
 
+def reference_json_metrics_from_state(state,
+                                      compression: float = 100.0
+                                      ) -> List[Dict]:
+    """ForwardableState → REFERENCE-format ``JSONMetric`` entries: the
+    exact body a Go local would POST (samplers.go Export methods) —
+    LE int64 counters, LE float64 gauges, axiomhq sets, gob t-digest
+    streams (byte-identical to Go's encoder) — so this local can forward
+    over HTTP into a reference (Go) global. The heavy-hitter sketch
+    (a framework extension) never rides this format. Like
+    ``json_metrics_from_state``, the caller materializes columnar digest
+    planes first."""
+    from veneur_tpu.ops import axiomhq
+    from veneur_tpu.protocol.gob import encode_reference_digest
+
+    out: List[Dict] = []
+
+    def entry(name, tags, mtype, blob: bytes) -> Dict:
+        return {"name": name, "type": mtype,
+                "tagstring": ",".join(tags), "tags": list(tags),
+                "value": base64.b64encode(blob).decode()}
+
+    for name, tags, value in state.counters:
+        out.append(entry(name, tags, "counter",
+                         struct.pack("<q", int(value))))
+    for name, tags, value in state.gauges:
+        out.append(entry(name, tags, "gauge",
+                         struct.pack("<d", float(value))))
+    for kind, mtype in (("histograms", "histogram"), ("timers", "timer")):
+        for name, tags, means, weights, dmin, dmax in getattr(state, kind):
+            n = len(means)
+            out.append(entry(name, tags, mtype, encode_reference_digest(
+                means, weights, compression,
+                float(dmin) if n else 0.0, float(dmax) if n else 0.0)))
+    for name, tags, registers, precision in state.sets:
+        out.append(entry(name, tags, "set",
+                         axiomhq.encode_dense(registers, precision)))
+    return out
+
+
 def json_metrics_from_state(state, compression: float = 100.0,
                             include_topk: bool = True) -> List[Dict]:
     """ForwardableState → list of JSON-metric dicts, the structured

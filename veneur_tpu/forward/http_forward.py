@@ -14,7 +14,8 @@ import urllib.error
 import urllib.request
 import zlib
 
-from veneur_tpu.forward.convert import json_metrics_from_state
+from veneur_tpu.forward.convert import (json_metrics_from_state,
+                                        reference_json_metrics_from_state)
 
 log = logging.getLogger("veneur.forward.http")
 
@@ -57,10 +58,11 @@ class HTTPForwarder:
             self.base = "http://" + self.base
         self.timeout = timeout
         self.compression = compression
-        # the JSON wire carries the heavy-hitter sketch extension, but a
-        # reference (Go) global would reject it as an unknown metric type
-        # every interval — suppress it when forwarding into a Go fleet
-        # (the flusher then has the local emit its own top-k instead)
+        # forwarding into a reference (Go) fleet: emit the reference's
+        # own JSONMetric format (gob digests, axiomhq sets, LE scalars)
+        # and drop the heavy-hitter sketch extension (the flusher then
+        # has the local emit its own top-k instead)
+        self.reference_compat = reference_compat
         self.supports_topk = not reference_compat
         # forward() runs on a fresh thread each flush; guard the counters
         self._lock = threading.Lock()
@@ -71,8 +73,12 @@ class HTTPForwarder:
         # the JSON wire is per-row; columnar digest planes (a columnar
         # flush with gRPC-style planes) materialize to tuples first
         state.materialize_digests()
-        metrics = json_metrics_from_state(
-            state, self.compression, include_topk=self.supports_topk)
+        if self.reference_compat:
+            metrics = reference_json_metrics_from_state(state,
+                                                        self.compression)
+        else:
+            metrics = json_metrics_from_state(
+                state, self.compression, include_topk=self.supports_topk)
         if not metrics:
             return
         url = self.base + "/import"

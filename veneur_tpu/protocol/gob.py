@@ -274,6 +274,79 @@ def _zero(stream: GobStream, type_id: int, depth: int = 0):
     return None
 
 
+def _enc_uint(v: int) -> bytes:
+    if v < 128:
+        return bytes([v])
+    body = v.to_bytes((v.bit_length() + 7) // 8, "big")
+    return bytes([256 - len(body)]) + body
+
+
+def _enc_int(i: int) -> bytes:
+    return _enc_uint((~i << 1) | 1 if i < 0 else i << 1)
+
+
+def _enc_float(v: float) -> bytes:
+    bits = struct.unpack("<Q", struct.pack("<d", v))[0]
+    return _enc_uint(int.from_bytes(bits.to_bytes(8, "little"), "big"))
+
+
+def _enc_msg(body: bytes) -> bytes:
+    return _enc_uint(len(body)) + body
+
+
+# The type-definition prologue MergingDigest.GobEncode's stream carries,
+# byte-identical to the Go encoder's output (ids 68 = []Centroid,
+# 66 = Centroid{Mean, Weight, Samples}, 67 = []float64, defined in that
+# order; verified against the reference's fixtures/import.uncompressed).
+_DIGEST_PROLOGUE = (
+    _enc_msg(_enc_int(-68) + _enc_uint(2)
+             + _enc_uint(1) + _enc_uint(2) + _enc_int(68) + _enc_uint(0)
+             + _enc_uint(1) + _enc_int(66) + _enc_uint(0) + _enc_uint(0))
+    + _enc_msg(_enc_int(-66) + _enc_uint(3)
+               + _enc_uint(1) + _enc_uint(1) + _enc_uint(8) + b"Centroid"
+               + _enc_uint(1) + _enc_int(66) + _enc_uint(0)
+               + _enc_uint(1) + _enc_uint(3)
+               + _enc_uint(1) + _enc_uint(4) + b"Mean"
+               + _enc_uint(1) + _enc_int(FLOAT) + _enc_uint(0)
+               + _enc_uint(1) + _enc_uint(6) + b"Weight"
+               + _enc_uint(1) + _enc_int(FLOAT) + _enc_uint(0)
+               + _enc_uint(1) + _enc_uint(7) + b"Samples"
+               + _enc_uint(1) + _enc_int(67) + _enc_uint(0)
+               + _enc_uint(0) + _enc_uint(0))
+    + _enc_msg(_enc_int(-67) + _enc_uint(2)
+               + _enc_uint(1) + _enc_uint(1) + _enc_uint(9) + b"[]float64"
+               + _enc_uint(1) + _enc_int(67) + _enc_uint(0)
+               + _enc_uint(1) + _enc_int(FLOAT) + _enc_uint(0)
+               + _enc_uint(0)))
+
+
+def encode_reference_digest(means, weights, compression: float,
+                            dmin: float, dmax: float) -> bytes:
+    """The inverse of ``decode_reference_digest``: produce the exact gob
+    stream ``MergingDigest.GobDecode`` reads (merging_digest.go:396-426)
+    — Encode([]Centroid), Encode(compression), Encode(min), Encode(max).
+    Output is byte-identical to the Go encoder's for the same centroids
+    (asserted against the reference's golden fixture in tests)."""
+    cents = bytearray(_enc_uint(len(means)))
+    for mean, weight in zip(means, weights):
+        # gob omits zero-valued struct fields (field deltas skip them);
+        # Samples stays empty (the reference's streams never populate it)
+        mean, weight = float(mean), float(weight)
+        delta = 1
+        if mean != 0.0:
+            cents += _enc_uint(1) + _enc_float(mean)
+        else:
+            delta = 2
+        if weight != 0.0:
+            cents += _enc_uint(delta) + _enc_float(weight)
+        cents += _enc_uint(0)
+    out = bytearray(_DIGEST_PROLOGUE)
+    out += _enc_msg(_enc_int(68) + _enc_uint(0) + bytes(cents))
+    for x in (compression, dmin, dmax):
+        out += _enc_msg(_enc_int(FLOAT) + _enc_uint(0) + _enc_float(x))
+    return bytes(out)
+
+
 def decode_reference_digest(blob: bytes):
     """The reference's ``MergingDigest.GobEncode`` stream → (means,
     weights, compression, dmin, dmax) (merging_digest.go:375-394:
