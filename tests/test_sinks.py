@@ -210,10 +210,15 @@ class TestDatadogSpanSink:
 class RecordingSfxClient:
     def __init__(self):
         self.batches = []
+        self.raw_bodies = []
         self.events = []
 
     def submit(self, datapoints):
         self.batches.append(datapoints)
+        return 200
+
+    def submit_raw(self, body):
+        self.raw_bodies.append(body)
         return 200
 
     def submit_event(self, event):
@@ -243,6 +248,82 @@ class TestSignalFxSink:
         assert by_name["a.b.c"]["dimensions"]["glooblestoots"] == "yes"
         # status checks emit as gauges (signalfx.go:203-207)
         assert by_name["st"]["_sfx_type"] == "gauge"
+
+    def test_columnar_flush_matches_legacy_points(self):
+        """The native columnar path must submit the same datapoints as
+        the per-row _dimensions path — full loop: store flush (columnar)
+        -> C++ serialize -> /v2/datapoint body."""
+        import json as _json
+
+        from veneur_tpu.core.store import MetricStore
+        from veneur_tpu.native import egress
+        from veneur_tpu.samplers import parser as p
+        from veneur_tpu.samplers.intermetric import HistogramAggregates
+
+        if not egress.available():
+            pytest.skip("no native toolchain")
+        store = MetricStore(initial_capacity=32, chunk=64)
+        store.process_metric(p.parse_metric(b"web.hits:4|c|#route:r1"))
+        store.process_metric(
+            p.parse_metric(b"web.temp:55|g|#host:db7,drop:me,keep:x"))
+        for v in (1.0, 9.0):
+            store.process_metric(p.parse_metric(f"web.lat:{v}|h".encode()))
+        agg = HistogramAggregates.from_names(["max", "count"])
+        col, _, _ = store.flush([], agg, is_local=False, now=700,
+                                columnar=True)
+
+        client = RecordingSfxClient()
+        sink = SignalFxSink("host", "signalbox", {"team": "core"},
+                            client=client, excluded_tags=["drop"])
+        sink.flush_columnar(col)
+        points = [dict(pt, _sfx_type=kind)
+                  for body in client.raw_bodies
+                  for kind, pts in _json.loads(body).items()
+                  for pt in pts]
+        got = {p["metric"]: p for p in points}
+        assert got["web.hits"]["_sfx_type"] == "counter"
+        assert got["web.hits"]["value"] == 4
+        assert got["web.hits"]["timestamp"] == 700000
+        assert got["web.hits"]["dimensions"] == {
+            "route": "r1", "host": "signalbox", "team": "core"}
+        # host: tag overrides the hostname dim; excluded key dropped
+        assert got["web.temp"]["dimensions"] == {
+            "host": "db7", "keep": "x", "team": "core"}
+        assert got["web.lat.max"]["value"] == 9.0
+        assert got["web.lat.count"]["_sfx_type"] == "counter"
+
+        # equivalence vs the legacy path on the materialized metrics
+        legacy = RecordingSfxClient()
+        sink2 = SignalFxSink("host", "signalbox", {"team": "core"},
+                             client=legacy, excluded_tags=["drop"])
+        sink2.flush(col.to_intermetrics())
+        want = {}
+        for pts in legacy.batches:
+            for pt in pts:
+                want[pt["metric"]] = pt
+        assert want.keys() == got.keys()
+        for k in want:
+            assert got[k]["dimensions"] == want[k]["dimensions"], k
+            assert got[k]["value"] == pytest.approx(want[k]["value"])
+            assert got[k]["timestamp"] == want[k]["timestamp"]  # both ms
+
+    def test_columnar_vary_by_falls_back(self):
+        from veneur_tpu.core.columnar import ColumnarFlush
+        from veneur_tpu.native import egress
+        from veneur_tpu.samplers.intermetric import InterMetric, MetricType
+
+        if not egress.available():
+            pytest.skip("no native toolchain")
+        default, special = RecordingSfxClient(), RecordingSfxClient()
+        sink = SignalFxSink("host", "h", client=default, vary_by="team",
+                            per_tag_clients={"ops": special})
+        batch = ColumnarFlush(timestamp=1, extras=[
+            InterMetric(name="m1", timestamp=1, value=1,
+                        tags=["team:ops"], type=MetricType.GAUGE)])
+        sink.flush_columnar(batch)
+        assert not default.raw_bodies  # fell back to the per-row path
+        (pts,) = special.batches
+        assert pts[0]["metric"] == "m1"
 
     def test_vary_by_fans_out_to_per_tag_client(self):
         default, special = RecordingSfxClient(), RecordingSfxClient()

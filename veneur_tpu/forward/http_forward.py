@@ -22,13 +22,17 @@ log = logging.getLogger("veneur.forward.http")
 
 def post_helper(url: str, payload, timeout: float = 10.0,
                 compress: bool = True, headers: dict = None,
-                method: str = "POST", precompressed: bool = False) -> int:
+                method: str = "POST", precompressed: bool = False,
+                raw_body: bytes = None) -> int:
     """POST a JSON payload, optionally deflated (http/http.go:123-247).
     Returns the HTTP status (including non-2xx); raises only on transport
     errors. precompressed=True sends ``payload`` bytes as an
-    already-deflated JSON body (the native egress serializer's output)."""
+    already-deflated JSON body; raw_body sends pre-serialized
+    UNCOMPRESSED JSON bytes (both are the native serializers' outputs)."""
     hdrs = {"Content-Type": "application/json"}
-    if precompressed:
+    if raw_body is not None:
+        body = raw_body
+    elif precompressed:
         body = payload
         hdrs["Content-Encoding"] = "deflate"
     else:

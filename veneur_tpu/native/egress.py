@@ -152,6 +152,20 @@ def _bind(lib):
     ]
     lib.vt_bodies_free.argtypes = [ctypes.POINTER(_VtBodies)]
 
+    lib.vt_sfx_datapoints_json.restype = ctypes.POINTER(_VtBodies)
+    lib.vt_sfx_datapoints_json.argtypes = [
+        ctypes.c_char_p, u32p, u32p,            # names
+        ctypes.c_char_p, u32p, u32p,            # tags
+        ctypes.c_uint32,                        # nrows
+        ctypes.c_char_p, u32p, u32p, ctypes.c_uint32,  # suffixes
+        u32p, u8p, f64p, u8p, ctypes.c_uint64,  # emissions
+        ctypes.c_int64,                         # timestamp ms
+        ctypes.c_char_p, ctypes.c_char_p,       # hostname tag, hostname
+        ctypes.c_char_p,                        # common dims json
+        ctypes.c_char_p, u32p, u32p, ctypes.c_uint32,  # common keys
+        ctypes.c_char_p, u32p, u32p, ctypes.c_uint32,  # excluded keys
+    ]
+
     lib.vt_mlist_decode.restype = ctypes.POINTER(_VtMetricBatch)
     lib.vt_mlist_decode.argtypes = [ctypes.c_char_p, ctypes.c_size_t]
     lib.vt_mbatch_free.argtypes = [ctypes.POINTER(_VtMetricBatch)]
@@ -256,6 +270,70 @@ def dd_series_bodies(names: Tuple[bytes, np.ndarray, np.ndarray],
         _p(em_type, u8),
         n, timestamp, interval, default_host.encode("utf-8"),
         common_tags_json, max_per_body, compress_level)
+    return _take_bodies(lib, bp)
+
+
+def _key_list(keys: List[bytes]):
+    """(blob, off-array, len-array, count) for a small key set."""
+    blob = b"".join(keys)
+    n = max(len(keys), 1)
+    offs = np.zeros(n, np.uint32)
+    lens = np.zeros(n, np.uint32)
+    pos = 0
+    for i, k in enumerate(keys):
+        offs[i] = pos
+        lens[i] = len(k)
+        pos += len(k)
+    return blob, offs, lens, len(keys)
+
+
+def sfx_datapoint_bodies(names: Tuple[bytes, np.ndarray, np.ndarray],
+                         tags: Tuple[bytes, np.ndarray, np.ndarray],
+                         suffixes: List[bytes],
+                         em_rows: np.ndarray, em_suffix: np.ndarray,
+                         em_values: np.ndarray, em_type: np.ndarray,
+                         timestamp_ms: int, hostname_tag: str,
+                         hostname: str,
+                         common_dims_json: bytes = b"",
+                         common_keys: Optional[List[bytes]] = None,
+                         excluded_keys: Optional[List[bytes]] = None
+                         ) -> List[bytes]:
+    """Serialize one columnar emission block into a SignalFx
+    ``/v2/datapoint`` body (``{"gauge": [...], "counter": [...]}``,
+    uncompressed). Dimension semantics mirror SignalFxSink._dimensions;
+    common_dims_json is the pre-escaped ``"k":"v",...`` fragment whose
+    keys are listed in common_keys (tag dims with those keys are
+    dropped — common dimensions override)."""
+    lib = _load()
+    if lib is None:
+        raise RuntimeError(f"native egress unavailable: {_build_error}")
+    if len(suffixes) > 255:
+        raise ValueError("more than 255 emission suffixes")
+    suffix_blob, s_off, s_len, _ = _key_list(suffixes)
+    em_rows = _u32a(em_rows)
+    em_suffix = np.ascontiguousarray(em_suffix, np.uint8)
+    em_values = np.ascontiguousarray(em_values, np.float64)
+    em_type = np.ascontiguousarray(em_type, np.uint8)
+    n = len(em_rows)
+    assert len(em_suffix) == n and len(em_values) == n and len(em_type) == n
+    name_arena, name_off, name_len = names
+    tags_arena, tags_off, tags_len = tags
+    name_off, name_len = _u32a(name_off), _u32a(name_len)
+    tags_off, tags_len = _u32a(tags_off), _u32a(tags_len)
+    ck_blob, ck_off, ck_len, ck_n = _key_list(common_keys or [])
+    ex_blob, ex_off, ex_len, ex_n = _key_list(excluded_keys or [])
+    u32, u8, f64 = ctypes.c_uint32, ctypes.c_uint8, ctypes.c_double
+    bp = lib.vt_sfx_datapoints_json(
+        name_arena, _p(name_off, u32), _p(name_len, u32),
+        tags_arena, _p(tags_off, u32), _p(tags_len, u32),
+        len(name_off),
+        suffix_blob, _p(s_off, u32), _p(s_len, u32), len(suffixes),
+        _p(em_rows, u32), _p(em_suffix, u8), _p(em_values, f64),
+        _p(em_type, u8), n, timestamp_ms,
+        hostname_tag.encode("utf-8"), hostname.encode("utf-8"),
+        common_dims_json,
+        ck_blob, _p(ck_off, u32), _p(ck_len, u32), ck_n,
+        ex_blob, _p(ex_off, u32), _p(ex_len, u32), ex_n)
     return _take_bodies(lib, bp)
 
 

@@ -428,6 +428,176 @@ extern "C" VtBodies* vt_dd_series_json(
 }
 
 // ---------------------------------------------------------------------------
+// 1b. SignalFx datapoint JSON from columns
+// ---------------------------------------------------------------------------
+//
+// Body shape: {"gauge":[{...}],"counter":[{...}]} (v2/datapoint), each
+// point {"metric","value","timestamp" (ms),"dimensions":{k:v,...}}.
+// Dimension semantics mirror the Python sink's _dimensions(): tag
+// "k:v" pairs with LAST duplicate winning, the hostname dim unless a
+// tag/common dim overrides it, common dimensions overriding tag dims,
+// excluded keys (and "veneursinkonly") dropped. The vary-by client
+// fanout is NOT handled here — the caller falls back to the per-row
+// path when that is configured.
+
+namespace {
+
+struct KeyList {  // small (few entries): linear scan is fine
+  const char* blob;
+  const uint32_t* off;
+  const uint32_t* len;
+  uint32_t n;
+
+  bool contains(const char* k, uint32_t kn) const {
+    for (uint32_t i = 0; i < n; i++)
+      if (len[i] == kn && memcmp(blob + off[i], k, kn) == 0) return true;
+    return false;
+  }
+};
+
+}  // namespace
+
+extern "C" VtBodies* vt_sfx_datapoints_json(
+    const char* name_arena, const uint32_t* name_off, const uint32_t* name_len,
+    const char* tags_arena, const uint32_t* tags_off, const uint32_t* tags_len,
+    uint32_t nrows, const char* suffix_blob, const uint32_t* suffix_off,
+    const uint32_t* suffix_len, uint32_t nsuffix, const uint32_t* em_rows,
+    const uint8_t* em_suffix, const double* em_values, const uint8_t* em_type,
+    uint64_t nem, int64_t timestamp_ms, const char* hostname_tag,
+    const char* hostname, const char* common_dims_json,
+    const char* common_keys_blob, const uint32_t* common_keys_off,
+    const uint32_t* common_keys_len, uint32_t n_common_keys,
+    const char* excl_blob, const uint32_t* excl_off, const uint32_t* excl_len,
+    uint32_t n_excl) {
+  (void)nsuffix;
+  KeyList common{common_keys_blob, common_keys_off, common_keys_len,
+                 n_common_keys};
+  KeyList excl{excl_blob, excl_off, excl_len, n_excl};
+  uint32_t ht_len = static_cast<uint32_t>(strlen(hostname_tag));
+  uint32_t common_len = static_cast<uint32_t>(strlen(common_dims_json));
+
+  // per-row dimensions fragment: `"k":"v","k2":"v2"` (no braces)
+  Buf frag;
+  std::vector<uint64_t> dim_o(nrows);
+  std::vector<uint32_t> dim_l(nrows);
+  std::vector<std::pair<uint32_t, uint32_t>> kv;  // (off,len) spans in tags
+  for (uint32_t r = 0; r < nrows; r++) {
+    const char* tags = tags_arena + tags_off[r];
+    uint32_t tlen = tags_len[r];
+    kv.clear();
+    uint32_t i = 0;
+    while (i < tlen) {
+      uint32_t j = i;
+      while (j < tlen && tags[j] != ',') j++;
+      if (j > i) kv.emplace_back(i, j - i);
+      i = j + 1;
+    }
+    uint64_t f0 = frag.len;
+    bool any = false;
+    bool host_overridden = false;
+    // LAST duplicate wins: walk in reverse, skip keys already emitted
+    // (tracked as spans into this row's emitted region)
+    std::vector<std::pair<uint32_t, uint32_t>> seen;  // key spans in tags
+    for (size_t t = kv.size(); t-- > 0;) {
+      const char* tag = tags + kv[t].first;
+      uint32_t n = kv[t].second;
+      uint32_t kn = 0;
+      while (kn < n && tag[kn] != ':') kn++;
+      bool has_sep = kn < n;
+      const char* val = has_sep ? tag + kn + 1 : tag + n;
+      uint32_t vn = has_sep ? n - kn - 1 : 0;
+      bool dup = false;
+      for (auto& s : seen)
+        if (s.second == kn && memcmp(tags + s.first, tag, kn) == 0) {
+          dup = true;
+          break;
+        }
+      if (dup) continue;
+      seen.emplace_back(kv[t].first, kn);
+      if (kn == ht_len && memcmp(tag, hostname_tag, kn) == 0)
+        host_overridden = true;
+      if ((kn == 14 && memcmp(tag, "veneursinkonly", 14) == 0)
+          || excl.contains(tag, kn) || common.contains(tag, kn))
+        continue;
+      if (any) frag.put_ch(',');
+      frag.put_ch('"');
+      put_json_str_body(frag, tag, kn);
+      frag.put(&"\":\""[0], 3);
+      put_json_str_body(frag, val, vn);
+      frag.put_ch('"');
+      any = true;
+    }
+    if (!host_overridden && ht_len && !excl.contains(hostname_tag, ht_len)
+        && !common.contains(hostname_tag, ht_len)) {
+      if (any) frag.put_ch(',');
+      frag.put_ch('"');
+      put_json_str_body(frag, hostname_tag, ht_len);
+      frag.put(&"\":\""[0], 3);
+      put_json_str_body(frag, hostname,
+                        static_cast<uint32_t>(strlen(hostname)));
+      frag.put_ch('"');
+      any = true;
+    }
+    if (common_len) {
+      if (any) frag.put_ch(',');
+      frag.put(common_dims_json, common_len);
+    }
+    dim_o[r] = f0;
+    dim_l[r] = static_cast<uint32_t>(frag.len - f0);
+  }
+
+  char ts_str[24];
+  int ts_n = snprintf(ts_str, sizeof ts_str, "%lld",
+                      static_cast<long long>(timestamp_ms));
+
+  // two passes: gauges then counters, one body
+  VtBodiesImpl* impl = new VtBodiesImpl();
+  BodyWriter w;
+  w.begin(0);  // the SignalFx client posts uncompressed
+  Buf& b = w.sink();
+#define PUT_LIT(buf, lit) (buf).put(lit, sizeof(lit) - 1)
+  PUT_LIT(b, "{");
+  const char* section_names[2] = {"\"gauge\":[", "\"counter\":["};
+  bool wrote_section = false;
+  for (int want_counter = 0; want_counter < 2; want_counter++) {
+    bool opened = false;
+    uint64_t in_section = 0;
+    for (uint64_t e = 0; e < nem; e++) {
+      if ((em_type[e] != 0) != (want_counter != 0)) continue;
+      if (!opened) {
+        if (wrote_section) b.put_ch(',');
+        b.put_str(section_names[want_counter]);
+        opened = true;
+        wrote_section = true;
+      }
+      uint32_t r = em_rows[e];
+      uint8_t s = em_suffix[e];
+      b.reserve(96 + name_len[r] + suffix_len[s] + dim_l[r]);
+      if (in_section++) b.put_ch(',');
+      PUT_LIT(b, "{\"metric\":\"");
+      put_json_str_body(b, name_arena + name_off[r], name_len[r]);
+      if (suffix_len[s]) b.put(suffix_blob + suffix_off[s], suffix_len[s]);
+      PUT_LIT(b, "\",\"value\":");
+      if (want_counter)  // counters submit as integers
+        put_i64(b, static_cast<int64_t>(em_values[e]));
+      else
+        put_double(b, em_values[e]);
+      PUT_LIT(b, ",\"timestamp\":");
+      b.put(ts_str, ts_n);
+      PUT_LIT(b, ",\"dimensions\":{");
+      b.put(frag.p + dim_o[r], dim_l[r]);
+      PUT_LIT(b, "}}");
+    }
+    if (opened) b.put_ch(']');
+  }
+  PUT_LIT(b, "}");
+#undef PUT_LIT
+  w.end(impl);
+  free(frag.p);
+  return bodies_finish(impl);
+}
+
+// ---------------------------------------------------------------------------
 // protobuf primitives
 // ---------------------------------------------------------------------------
 
