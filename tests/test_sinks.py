@@ -591,6 +591,57 @@ class TestCSVPlugins:
             lines = f.readlines()
         assert len(lines) == 2
 
+    def test_columnar_tsv_matches_legacy_rows(self):
+        """The native TSV path writes the same rows the per-row encoder
+        does (full loop: columnar store flush -> C++ TSV -> gzip
+        member)."""
+        from veneur_tpu.core.store import MetricStore
+        from veneur_tpu.native import egress
+        from veneur_tpu.plugins.csv_encode import encode_columnar_csv
+        from veneur_tpu.samplers import parser as p
+        from veneur_tpu.samplers.intermetric import HistogramAggregates
+
+        if not egress.available():
+            pytest.skip("no native toolchain")
+        store = MetricStore(initial_capacity=32, chunk=64)
+        store.process_metric(p.parse_metric(b"web.hits:4|c|#route:r1"))
+        # rate 7/10 = 0.7, and 1/3-style repeating rates stress the
+        # full-precision never-exponential value formatting
+        store.process_metric(p.parse_metric(b"web.odd:1|c"))
+        store.process_metric(p.parse_metric(b"web.big:2e16|g"))
+        store.process_metric(p.parse_metric(b"web.temp:55.25|g"))
+        for v in (1.0 / 3.0, 9.0):
+            store.process_metric(p.parse_metric(f"web.lat:{v}|h".encode()))
+        agg = HistogramAggregates.from_names(["max", "count"])
+        col, _, _ = store.flush([], agg, is_local=False, now=1476119058,
+                                columnar=True)
+        native_rows = sorted(
+            gzip.decompress(encode_columnar_csv(
+                col, "h", 10, partition_date=1476119058))
+            .decode().strip().split("\n"))
+        legacy_rows = sorted(
+            gzip.decompress(encode_intermetrics_csv(
+                col.to_intermetrics(), "h", 10,
+                partition_date=1476119058))
+            .decode().strip().split("\n"))
+        assert native_rows == legacy_rows
+        assert any(r.startswith("web.hits\t{route:r1}\trate") and
+                   "\t0.4\t" in r for r in native_rows)
+
+    def test_localfile_columnar_appends(self, tmp_path):
+        from veneur_tpu.core.columnar import ColumnarFlush
+        from veneur_tpu.native import egress
+
+        if not egress.available():
+            pytest.skip("no native toolchain")
+        path = tmp_path / "flush.tsv.gz"
+        plugin = LocalFilePlugin(str(path), "h", 10)
+        batch = ColumnarFlush(timestamp=0, extras=[GOLDEN_METRIC])
+        plugin.flush_columnar(batch)
+        with gzip.open(path, "rt") as f:
+            (line,) = f.readlines()
+        assert line.startswith("a.b.c.max\t")
+
     def test_s3_requires_client(self):
         with pytest.raises(S3ClientUninitializedError):
             S3Plugin("h").flush([GOLDEN_METRIC])

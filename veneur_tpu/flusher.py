@@ -146,14 +146,15 @@ def _flush_once(server: "Server", span):
                                 {"part": "post"}))
 
     # plugins run after the sinks (flusher.go:95-109)
-    if server.plugins:
-        metrics = (final_metrics.to_intermetrics() if use_columnar
-                   else final_metrics)
-        for plugin in server.plugins:
-            try:
-                plugin.flush(metrics)
-            except Exception:
-                log.exception("plugin %s flush failed", plugin.name)
+    for plugin in server.plugins:
+        try:
+            if use_columnar and hasattr(plugin, "flush_columnar"):
+                plugin.flush_columnar(final_metrics)
+            else:
+                plugin.flush(final_metrics.to_intermetrics()
+                             if use_columnar else final_metrics)
+        except Exception:
+            log.exception("plugin %s flush failed", plugin.name)
 
     span_flusher.join(timeout=10.0)
 

@@ -166,6 +166,17 @@ def _bind(lib):
         ctypes.c_char_p, u32p, u32p, ctypes.c_uint32,  # excluded keys
     ]
 
+    lib.vt_tsv_rows.restype = ctypes.POINTER(_VtBodies)
+    lib.vt_tsv_rows.argtypes = [
+        ctypes.c_char_p, u32p, u32p,            # names
+        ctypes.c_char_p, u32p, u32p,            # tags
+        ctypes.c_uint32,                        # nrows
+        ctypes.c_char_p, u32p, u32p, ctypes.c_uint32,  # suffixes
+        u32p, u8p, f64p, u8p, ctypes.c_uint64,  # emissions
+        ctypes.c_char_p, ctypes.c_char_p,       # hostname, interval str
+        ctypes.c_char_p, ctypes.c_char_p,       # timestamp, partition
+    ]
+
     lib.vt_mlist_decode.restype = ctypes.POINTER(_VtMetricBatch)
     lib.vt_mlist_decode.argtypes = [ctypes.c_char_p, ctypes.c_size_t]
     lib.vt_mbatch_free.argtypes = [ctypes.POINTER(_VtMetricBatch)]
@@ -335,6 +346,47 @@ def sfx_datapoint_bodies(names: Tuple[bytes, np.ndarray, np.ndarray],
         ck_blob, _p(ck_off, u32), _p(ck_len, u32), ck_n,
         ex_blob, _p(ex_off, u32), _p(ex_len, u32), ex_n)
     return _take_bodies(lib, bp)
+
+
+def tsv_rows(names: Tuple[bytes, np.ndarray, np.ndarray],
+             tags: Tuple[bytes, np.ndarray, np.ndarray],
+             suffixes: List[bytes],
+             em_rows: np.ndarray, em_suffix: np.ndarray,
+             em_values: np.ndarray, em_type: np.ndarray,
+             hostname: str, interval: int, timestamp_str: str,
+             partition_str: str) -> bytes:
+    """Serialize one columnar emission block into the archival TSV rows
+    the s3/localfile plugins write (plugins/csv_encode.py column order;
+    reference csv.go:17-92). Counter values must arrive already divided
+    by the interval (em_type picks the rate/gauge column only)."""
+    lib = _load()
+    if lib is None:
+        raise RuntimeError(f"native egress unavailable: {_build_error}")
+    if len(suffixes) > 255:
+        raise ValueError("more than 255 emission suffixes")
+    suffix_blob, s_off, s_len, _ = _key_list(suffixes)
+    em_rows = _u32a(em_rows)
+    em_suffix = np.ascontiguousarray(em_suffix, np.uint8)
+    em_values = np.ascontiguousarray(em_values, np.float64)
+    em_type = np.ascontiguousarray(em_type, np.uint8)
+    n = len(em_rows)
+    assert len(em_suffix) == n and len(em_values) == n and len(em_type) == n
+    name_arena, name_off, name_len = names
+    tags_arena, tags_off, tags_len = tags
+    name_off, name_len = _u32a(name_off), _u32a(name_len)
+    tags_off, tags_len = _u32a(tags_off), _u32a(tags_len)
+    u32, u8, f64 = ctypes.c_uint32, ctypes.c_uint8, ctypes.c_double
+    bp = lib.vt_tsv_rows(
+        name_arena, _p(name_off, u32), _p(name_len, u32),
+        tags_arena, _p(tags_off, u32), _p(tags_len, u32),
+        len(name_off),
+        suffix_blob, _p(s_off, u32), _p(s_len, u32), len(suffixes),
+        _p(em_rows, u32), _p(em_suffix, u8), _p(em_values, f64),
+        _p(em_type, u8), n,
+        hostname.encode("utf-8"), str(int(interval)).encode(),
+        timestamp_str.encode(), partition_str.encode())
+    (body,) = _take_bodies(lib, bp)
+    return body
 
 
 # ---------------------------------------------------------------------------

@@ -598,6 +598,148 @@ extern "C" VtBodies* vt_sfx_datapoints_json(
 }
 
 // ---------------------------------------------------------------------------
+// 1c. archival TSV rows from columns (plugins/s3 + localfile)
+// ---------------------------------------------------------------------------
+//
+// Column order and semantics mirror the reference's csv.go:17-92 (via
+// plugins/csv_encode.py): Name, {tags}, rate|gauge (counters divided by
+// the interval on the Python side), hostname, interval, timestamp
+// string, value, partition string. Fields containing a tab, newline,
+// quote, or CR are quoted with "" doubling, like csv.Writer.
+
+namespace {
+
+// full-precision, never-exponential value formatting matching the
+// Python encoder's _format_value (Go FormatFloat(v,'f',-1,64) parity):
+// shortest round-trip decimal, NaN/+Inf/-Inf spellings, plain notation
+void put_tsv_value(Buf& b, double v) {
+  if (std::isnan(v)) {
+    b.put("NaN", 3);
+    return;
+  }
+  if (std::isinf(v)) {
+    b.put(v > 0 ? "+Inf" : "-Inf", 4);
+    return;
+  }
+  double r = nearbyint(v);
+  if (r == v && fabs(v) < 1e16) {
+    put_i64(b, static_cast<int64_t>(r));
+    return;
+  }
+  char tmp[40];
+  int n = 0;
+  for (int prec = 15; prec <= 17; prec++) {  // shortest that round-trips
+    n = snprintf(tmp, sizeof tmp, "%.*g", prec, v);
+    if (strtod(tmp, nullptr) == v) break;
+  }
+  if (!memchr(tmp, 'e', n)) {
+    b.put(tmp, n);
+    return;
+  }
+  // %g went scientific: re-render plain and trim, like the Python
+  // fallback format(v, ".17f").rstrip("0").rstrip(".")
+  char big[512];
+  n = snprintf(big, sizeof big, "%.17f", v);
+  while (n > 0 && big[n - 1] == '0') n--;
+  if (n > 0 && big[n - 1] == '.') n--;
+  b.put(big, n);
+}
+
+void put_tsv_field(Buf& b, const char* s, uint32_t n) {
+  bool needs_quote = false;
+  for (uint32_t i = 0; i < n; i++) {
+    char c = s[i];
+    if (c == '\t' || c == '\n' || c == '\r' || c == '"') {
+      needs_quote = true;
+      break;
+    }
+  }
+  if (!needs_quote) {
+    b.put(s, n);
+    return;
+  }
+  b.put_ch('"');
+  for (uint32_t i = 0; i < n; i++) {
+    if (s[i] == '"') b.put_ch('"');
+    b.put_ch(s[i]);
+  }
+  b.put_ch('"');
+}
+
+}  // namespace
+
+extern "C" VtBodies* vt_tsv_rows(
+    const char* name_arena, const uint32_t* name_off, const uint32_t* name_len,
+    const char* tags_arena, const uint32_t* tags_off, const uint32_t* tags_len,
+    uint32_t nrows, const char* suffix_blob, const uint32_t* suffix_off,
+    const uint32_t* suffix_len, uint32_t nsuffix, const uint32_t* em_rows,
+    const uint8_t* em_suffix, const double* em_values, const uint8_t* em_type,
+    uint64_t nem, const char* hostname, const char* interval_str,
+    const char* timestamp_str, const char* partition_str) {
+  (void)nsuffix;
+  // shared trailing fragment: \t hostname \t interval \t timestamp \t
+  // (dynamic: hostnames can approach the 253-char FQDN bound)
+  Buf tailb;
+  tailb.put_ch('\t');
+  tailb.put_str(hostname);
+  tailb.put_ch('\t');
+  tailb.put_str(interval_str);
+  tailb.put_ch('\t');
+  tailb.put_str(timestamp_str);
+  tailb.put_ch('\t');
+  const char* tail = tailb.p;
+  int tail_n = static_cast<int>(tailb.len);
+  uint32_t part_n = static_cast<uint32_t>(strlen(partition_str));
+  VtBodiesImpl* impl = new VtBodiesImpl();
+  BodyWriter w;
+  w.begin(0);
+  Buf& b = w.sink();
+  for (uint64_t e = 0; e < nem; e++) {
+    uint32_t r = em_rows[e];
+    uint8_t s = em_suffix[e];
+    b.reserve(96 + name_len[r] + suffix_len[s] + tags_len[r] + tail_n
+              + part_n);
+    // Name (+suffix): the parsers reject tabs/quotes in names, but
+    // imported names are untrusted — quote when needed
+    {
+      Buf tmp;  // suffix concat for quoting; fast path avoids the copy
+      const char* np = name_arena + name_off[r];
+      if (suffix_len[s] == 0) {
+        put_tsv_field(b, np, name_len[r]);
+      } else {
+        tmp.put(np, name_len[r]);
+        tmp.put(suffix_blob + suffix_off[s], suffix_len[s]);
+        put_tsv_field(b, tmp.p, static_cast<uint32_t>(tmp.len));
+        free(tmp.p);
+      }
+    }
+    b.put_ch('\t');
+    // {tags}
+    {
+      Buf tmp;
+      tmp.put_ch('{');
+      tmp.put(tags_arena + tags_off[r], tags_len[r]);
+      tmp.put_ch('}');
+      put_tsv_field(b, tmp.p, static_cast<uint32_t>(tmp.len));
+      free(tmp.p);
+    }
+    b.put_ch('\t');
+    if (em_type[e])
+      b.put("rate", 4);
+    else
+      b.put("gauge", 5);
+    b.put(tail, tail_n);
+    put_tsv_value(b, em_values[e]);
+    b.put_ch('\t');
+    b.put(partition_str, part_n);
+    b.put_ch('\n');
+  }
+  w.end(impl);
+  free(tailb.p);
+  return bodies_finish(impl);
+}
+
+// ---------------------------------------------------------------------------
 // protobuf primitives
 // ---------------------------------------------------------------------------
 

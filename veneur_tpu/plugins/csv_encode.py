@@ -66,6 +66,47 @@ def encode_intermetric_row(m: InterMetric, hostname: str, interval: int,
     ]
 
 
+def encode_columnar_csv(batch, hostname: str, interval: int,
+                        partition_date: Optional[float] = None) -> bytes:
+    """Gzipped TSV of a ColumnarFlush: blocks serialize natively
+    (native/veneur_egress.cpp vt_tsv_rows — no per-row objects), extras
+    take the per-row encoder. Same bytes as encode_intermetrics_csv on
+    the materialized batch."""
+    import numpy as np
+
+    from veneur_tpu.native import egress
+
+    if partition_date is None:
+        partition_date = time.time()
+    ts_str = time.strftime(REDSHIFT_DATE_FORMAT,
+                           time.gmtime(batch.timestamp))
+    part_str = time.strftime(PARTITION_DATE_FORMAT,
+                             time.gmtime(partition_date))
+    buf = io.BytesIO()
+    with gzip.GzipFile(fileobj=buf, mode="wb") as gz:
+        for blk in batch.blocks:
+            values = blk.values
+            if (blk.type_codes == 1).any():
+                values = np.where(blk.type_codes == 1,
+                                  values / interval, values)
+            gz.write(egress.tsv_rows(
+                blk.names, blk.tags, blk.suffixes, blk.rows,
+                blk.suffix_idx, values, blk.type_codes, hostname,
+                interval, ts_str, part_str))
+        if batch.extras:
+            text = io.TextIOWrapper(gz, encoding="utf-8", newline="")
+            w = csv.writer(text, delimiter="\t", lineterminator="\n")
+            for m in batch.extras:
+                try:
+                    w.writerow(encode_intermetric_row(
+                        m, hostname, interval, partition_date))
+                except ValueError:
+                    continue
+            text.flush()
+            text.detach()
+    return buf.getvalue()
+
+
 def encode_intermetrics_csv(metrics: List[InterMetric], hostname: str,
                             interval: int, delimiter: str = "\t",
                             include_headers: bool = False,

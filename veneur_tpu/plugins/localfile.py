@@ -11,7 +11,8 @@ import logging
 from typing import List
 
 from veneur_tpu.plugins import Plugin
-from veneur_tpu.plugins.csv_encode import encode_intermetrics_csv
+from veneur_tpu.plugins.csv_encode import (encode_columnar_csv,
+                                           encode_intermetrics_csv)
 from veneur_tpu.samplers.intermetric import InterMetric
 
 log = logging.getLogger("veneur.plugins.localfile")
@@ -28,7 +29,16 @@ class LocalFilePlugin(Plugin):
         return "localfile"
 
     def flush(self, metrics: List[InterMetric]) -> None:
-        blob = encode_intermetrics_csv(metrics, self.hostname, self.interval)
+        self._append(encode_intermetrics_csv(metrics, self.hostname,
+                                             self.interval))
+
+    def flush_columnar(self, batch) -> None:
+        """Columnar archive: TSV rows serialize natively from the flush
+        columns instead of per-row InterMetrics."""
+        self._append(encode_columnar_csv(batch, self.hostname,
+                                         self.interval))
+
+    def _append(self, blob: bytes) -> None:
         try:
             with open(self.file_path, "ab") as f:
                 f.write(blob)

@@ -55,3 +55,17 @@ class S3Plugin(Plugin):
                             Key=s3_path(self.hostname),
                             Body=blob)
         log.debug("Completed flush to s3: %d metrics", len(metrics))
+
+    def flush_columnar(self, batch) -> None:
+        """Columnar archive: TSV rows serialize natively from the flush
+        columns instead of per-row InterMetrics."""
+        if self.svc is None:
+            raise S3ClientUninitializedError(
+                "s3 client has not been initialized")
+        from veneur_tpu.plugins.csv_encode import encode_columnar_csv
+
+        blob = encode_columnar_csv(batch, self.hostname, self.interval)
+        self.svc.put_object(Bucket=self.bucket,
+                            Key=s3_path(self.hostname),
+                            Body=blob)
+        log.debug("Completed columnar flush to s3: %d metrics", len(batch))

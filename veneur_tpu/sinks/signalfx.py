@@ -90,8 +90,6 @@ class SignalFxSink(MetricSink):
         self.metrics_flushed = 0
         self.metrics_skipped = 0
         self.events_reported = 0
-        # columnar bodies submit on parallel threads; guard the counter
-        self._flush_lock = threading.Lock()
 
     @property
     def name(self) -> str:
@@ -148,30 +146,25 @@ class SignalFxSink(MetricSink):
                 common_dims_json=common_json,
                 common_keys=[k.encode() for k in common],
                 excluded_keys=[k.encode() for k in excluded])
-            per_body = len(blk) // max(len(bodies), 1)
-            for i, body in enumerate(bodies):
-                pts = (len(blk) - per_body * (len(bodies) - 1)
-                       if i == len(bodies) - 1 else per_body)
-                submissions.append((body, pts))
+            for body in bodies:
+                submissions.append(body)
+            # count before submitting, exactly like the legacy flush()
+            # (it appends to points_by_key and counts regardless of the
+            # POST outcome; failures are logged, not un-counted)
+            self.metrics_flushed += len(blk)
 
-        def submit_one(body: bytes, pts: int) -> None:
-            # per-body accounting: a failed POST discards only its own
-            # points, like the legacy per-client submits
+        def submit_one(body: bytes) -> None:
             try:
                 status = self.default_client.submit_raw(body)
                 if status >= 300:
                     log.warning("signalfx datapoint submit returned "
-                                "HTTP %d (%d points dropped)", status, pts)
-                    return
+                                "HTTP %d", status)
             except OSError:
                 log.warning("could not submit to signalfx", exc_info=True)
-                return
-            with self._flush_lock:
-                self.metrics_flushed += pts
 
         threads = []
-        for body, pts in submissions:
-            t = threading.Thread(target=submit_one, args=(body, pts),
+        for body in submissions:
+            t = threading.Thread(target=submit_one, args=(body,),
                                  daemon=True)
             t.start()
             threads.append(t)
