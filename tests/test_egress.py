@@ -250,13 +250,37 @@ class TestMetricListCodec:
             i = int(i)
             no, nl = dec.name_off[i], dec.name_len[i]
             to, tl = dec.tags_off[i], dec.tags_len[i]
-            tbl.put(int(dec.type[i]), dec.arena[no:no + nl],
-                    dec.arena[to:to + tl], 10 + i)
+            tbl.put(int(dec.type[i]), int(dec.payload[i]),
+                    dec.arena[no:no + nl], dec.arena[to:to + tl], 10 + i)
         rows, miss = tbl.assign(dec)
         assert len(miss) == 0 and list(rows) == [10, 11, 12, 13]
         tbl.reset()
         _, miss = tbl.assign(dec)
         assert len(miss) == 4
+
+    def test_intern_table_payload_kind_in_key(self):
+        # same (type, name, tags) but a DIFFERENT value-oneof must MISS:
+        # row indices are per-group, and the applying group is chosen by
+        # the payload at apply time (ADVICE round-3, medium)
+        from veneur_tpu.protocol import forward_pb2
+
+        mlist = forward_pb2.MetricList()
+        m = mlist.metrics.add(name="n", tags=["t:1"], type=0)
+        m.counter.value = 7
+        dec = egress.decode_metric_list(mlist.SerializeToString())
+        tbl = egress.MListInternTable()
+        _, miss = tbl.assign(dec)
+        tbl.put(int(dec.type[0]), int(dec.payload[0]),
+                b"n", b"t:1", 5)
+        rows, miss = tbl.assign(dec)
+        assert len(miss) == 0 and rows[0] == 5
+        # adversarial re-send: identical key fields, gauge oneof instead
+        evil = forward_pb2.MetricList()
+        m2 = evil.metrics.add(name="n", tags=["t:1"], type=0)
+        m2.gauge.value = 1.0
+        dec2 = egress.decode_metric_list(evil.SerializeToString())
+        rows2, miss2 = tbl.assign(dec2)
+        assert list(miss2) == [0]
 
 
 class TestColumnarFlush:

@@ -159,6 +159,108 @@ class TestGRPCPipeline:
         assert client.errors == 1
 
 
+class TestPackedDigestForward:
+    """Device-compacted digest forwarding (PackedDigestPlanes, tdigest
+    fields 16/17): the 1M+-series path that replaces the raw [S,K] f32
+    plane fetch. Reference behavior matched: flusher.go:292-473 forwards
+    every digest each interval; the global merges them
+    (importsrv/server.go:101-132)."""
+
+    def _flush_packed(self, columnar=True):
+        store = local_store_with_data()
+        final, fwd, _ = store.flush([0.5], AGG, is_local=True,
+                                    now=int(time.time()),
+                                    columnar=columnar,
+                                    digest_format="packed")
+        return final, fwd
+
+    def test_packed_planes_shape(self):
+        from veneur_tpu.core.store import PackedDigestPlanes
+
+        _, fwd = self._flush_packed()
+        col = fwd.timers_columnar
+        assert col is not None and isinstance(col[2], PackedDigestPlanes)
+        p = col[2]
+        assert p.nrows == 1
+        assert int(p.counts.sum()) == len(p.means_q) == len(p.weights_bf)
+        # 50 distinct values, compression 100: all live, far under K
+        assert 0 < int(p.counts.sum()) <= 104
+        # quantized means dequantize inside the observed range
+        means = p.means_f64()
+        assert means.min() >= p.dmin[0] - 1e-9
+        assert means.max() <= p.dmax[0] + 1e-9
+        # bf16 weights preserve small integer counts exactly
+        assert p.weights_f32().sum() == pytest.approx(50.0)
+
+    def test_packed_materialize_matches_dense(self):
+        _, fwd_dense = flush_local(local_store_with_data())
+        _, fwd_packed = self._flush_packed()
+        fwd_packed.materialize_digests()
+        (n1, t1, m1, w1, mn1, mx1) = fwd_dense.timers[0]
+        (n2, t2, m2, w2, mn2, mx2) = fwd_packed.timers[0]
+        assert n1 == n2 and list(t1) == list(t2)
+        assert mn1 == pytest.approx(mn2) and mx1 == pytest.approx(mx2)
+        assert len(m1) == len(m2)
+        # quantization error bounded by range/65535; bf16 weights by 2^-9
+        span = mx1 - mn1
+        assert np.abs(np.asarray(m1) - np.asarray(m2)).max() <= \
+            span / 65535.0 + 1e-9
+        assert np.abs(np.asarray(w1) - np.asarray(w2)).max() <= \
+            np.asarray(w1).max() / 256.0
+
+    def test_packed_grpc_e2e_merges(self):
+        gstore = MetricStore(initial_capacity=32, chunk=128)
+        srv = ImportServer(gstore)
+        port = srv.start("127.0.0.1:0")
+        try:
+            client = GRPCForwarder(f"127.0.0.1:{port}")
+            assert client.wants_packed_digests
+            for _ in range(2):
+                _, fwd = self._flush_packed()
+                client.forward(fwd)
+            assert client.errors == 0
+            final, _, _ = gstore.flush([0.5], AGG, is_local=False,
+                                       now=int(time.time()))
+            by_name = {m.name: m for m in final}
+            assert by_name["gctr"].value == 10.0
+            assert by_name["lat.50percentile"].value == pytest.approx(
+                24.5, rel=0.15)
+            assert by_name["users"].value == pytest.approx(3, abs=0.1)
+        finally:
+            srv.stop()
+
+    def test_packed_reference_compat_wire(self):
+        # a reference global sees dequantized repeated-Centroid messages,
+        # never the unknown quantized fields
+        from veneur_tpu.native import egress
+
+        if not egress.available():
+            pytest.skip("native egress unavailable")
+        gstore = MetricStore(initial_capacity=32, chunk=128)
+        seen = []
+        srv = ImportServer(apply=seen.append)
+        port = srv.start("127.0.0.1:0")
+        try:
+            client = GRPCForwarder(f"127.0.0.1:{port}",
+                                   reference_compat=True)
+            # reference-compat forwarders keep the dense path; force the
+            # packed planes through anyway to exercise the C++ compat
+            # dequantizer
+            assert not client.wants_packed_digests
+            _, fwd = self._flush_packed()
+            client.forward(fwd)
+            assert client.errors == 0
+            digests = [m for m in seen
+                       if m.WhichOneof("value") == "histogram"]
+            assert digests
+            td = digests[0].histogram.t_digest
+            assert td.main_centroids and not td.quantized_means
+            w = sum(c.weight for c in td.main_centroids)
+            assert w == pytest.approx(50.0)
+        finally:
+            srv.stop()
+
+
 class TestHTTPPipeline:
     def test_e2e_via_ops_server(self):
         cfg = Config(statsd_listen_addresses=[], interval="86400s",
@@ -255,6 +357,20 @@ class TestOpsServer:
         assert self.post(server, b"[]")[0] == 400  # empty batch
         assert self.post(server, b"x", {"Content-Encoding": "deflate"})[0] == 400
         assert self.post(server, b"[]", {"Content-Encoding": "gzip"})[0] == 400
+
+    def test_import_decompression_bomb_rejected(self, ops, monkeypatch):
+        # a small deflate body must not inflate past the configured cap
+        # (unauthenticated endpoint; cf. ADVICE round-3)
+        from veneur_tpu import httpserv
+
+        server, seen = ops
+        monkeypatch.setattr(httpserv, "MAX_INFLATED_BYTES", 1 << 16)
+        bomb = zlib.compress(b'["' + b"a" * (1 << 20) + b'"]')
+        assert len(bomb) < (1 << 13)
+        status, body = self.post(server, bomb,
+                                 {"Content-Encoding": "deflate"})
+        assert status == 400 and "limit" in body
+        assert not seen
 
 
 class TestServerWiring:

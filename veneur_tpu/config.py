@@ -148,6 +148,13 @@ class Config:
     # otherwise be upgraded before their global (upgrade globals first
     # and this can stay off: the import side reads both schemas).
     forward_reference_compatible: bool = False
+    # gRPC forward ships digests as device-compacted quantized arrays
+    # (tdigest fields 16/17, 4 bytes/centroid — the mode that fits the
+    # flush interval at 1M+ series). Disable during a rolling upgrade
+    # whose globals predate the quantized extension (they would skip
+    # the unknown fields and import empty digests); reference-compat
+    # forwarding ignores this and always writes the dense schema.
+    forward_packed_digests: bool = True
     # columnar flush egress: emissions stay flat arrays from the store
     # through native sink serialization (falls back automatically when
     # the native egress library cannot build)

@@ -87,10 +87,18 @@ def _flush_once(server: "Server", span):
         from veneur_tpu.native import egress
 
         use_columnar = egress.available()
+    # device-compacted digest forwarding (PackedDigestPlanes) whenever
+    # the forwarder can take it: the raw [S,K] f32 plane fetch is what
+    # blew the interval at 1M+ forwarded series
+    digest_format = "packed" if (
+        forwarding and use_columnar
+        and getattr(server._forwarder, "wants_packed_digests", False)) \
+        else "dense"
     t0 = time.perf_counter()
     final_metrics, forwardable, ms = server.store.flush(
         percentiles, server.histogram_aggregates, is_local=is_local, now=now,
-        forward=forwarding, forward_topk=topk_ok, columnar=use_columnar)
+        forward=forwarding, forward_topk=topk_ok, columnar=use_columnar,
+        digest_format=digest_format)
     flush_elapsed = time.perf_counter() - t0
     log.debug("store flush took %.1f ms (%s)", flush_elapsed * 1e3, ms)
     # the canonical self-metric set (README.md:248-277) rides on the

@@ -47,6 +47,12 @@ def configure_forwarding(server):
         fwd = GRPCForwarder(
             cfg.forward_address,
             reference_compat=cfg.forward_reference_compatible)
+        # rolling-upgrade escape hatch: a pre-round-4 global skips the
+        # quantized wire fields (tdigest 16/17) and would import empty
+        # digests — let operators keep the dense f64 wire until every
+        # global understands packed (WIRE.md)
+        if not cfg.forward_packed_digests:
+            fwd.wants_packed_digests = False
     else:
         fwd = HTTPForwarder(
             cfg.forward_address,

@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import base64
 import logging
+import math
 import struct
 from typing import Dict, List
 
@@ -145,9 +146,20 @@ def metric_list_from_state(state, compression: float = 100.0,
 
 def _digest_arrays(td) -> tuple:
     """Extract (means, weights, min, max) from a wire t-digest,
-    preferring the packed parallel arrays (one memcpy) over the repeated
+    preferring the quantized extension (fields 16/17, 4 bytes/centroid),
+    then the packed parallel arrays (one memcpy), then the repeated
     Centroid messages a reference sender produces."""
-    if td.packed_means:
+    if td.quantized_means and len(td.quantized_means) == \
+            len(td.quantized_weights):
+        q = np.frombuffer(td.quantized_means, dtype="<u2")
+        wb = np.frombuffer(td.quantized_weights, dtype="<u2")
+        span = (td.max - td.min) / 65535.0
+        if not math.isfinite(span):
+            span = 0.0
+        means = td.min + q.astype(np.float64) * span
+        weights = (wb.astype(np.uint32) << 16).view(np.float32) \
+            .astype(np.float64)
+    elif td.packed_means:
         means = np.asarray(td.packed_means, np.float64)
         weights = np.asarray(td.packed_weights, np.float64)
     else:

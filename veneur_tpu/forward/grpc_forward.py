@@ -49,6 +49,12 @@ class GRPCForwarder:
         # entirely when forwarding into a reference fleet (the local
         # then emits its own top-k, flusher.py)
         self.supports_topk = not reference_compat
+        # ask the store for device-compacted digest planes (tdigest
+        # fields 16/17): live centroids only, 4 bytes each, instead of
+        # the raw [S,K] f32 plane fetch. Reference-compat forwarding
+        # keeps the dense f32 path so the f64 centroids a Go global
+        # imports carry full float32 precision.
+        self.wants_packed_digests = not reference_compat
         self._channel = grpc.insecure_channel(
             addr,
             options=[("grpc.max_receive_message_length", _MAX_MESSAGE),
@@ -81,6 +87,8 @@ class GRPCForwarder:
         # chunks straight from the [S, K] arrays, no per-row Python
         # (flusher.go:424-473; the chunking bounds message size the way
         # the reference's proxy batches do)
+        from veneur_tpu.core.store import PackedDigestPlanes
+
         raw_chunks = []
         n_raw = 0
         if egress.available():
@@ -89,12 +97,23 @@ class GRPCForwarder:
                 col = getattr(state, attr)
                 if col is None:
                     continue
-                names, tags, means, weights, dmins, dmaxs = col
-                raw_chunks.extend(egress.encode_digest_metrics(
-                    names, tags, means, weights, dmins, dmaxs, pb_type,
-                    self.compression, max_body_bytes=self.CHUNK_BYTES,
-                    reference_compat=self.reference_compat))
-                n_raw += len(means)
+                if isinstance(col[2], PackedDigestPlanes):
+                    # device-compacted planes: quantized arrays go on the
+                    # wire verbatim (or dequantize in C++ for a reference
+                    # global) — the 1M+-series forward path
+                    names, tags, planes = col
+                    raw_chunks.extend(egress.encode_digest_metrics_packed(
+                        names, tags, planes, pb_type, self.compression,
+                        max_body_bytes=self.CHUNK_BYTES,
+                        reference_compat=self.reference_compat))
+                    n_raw += planes.nrows
+                else:
+                    names, tags, means, weights, dmins, dmaxs = col
+                    raw_chunks.extend(egress.encode_digest_metrics(
+                        names, tags, means, weights, dmins, dmaxs, pb_type,
+                        self.compression, max_body_bytes=self.CHUNK_BYTES,
+                        reference_compat=self.reference_compat))
+                    n_raw += len(means)
                 setattr(state, attr, None)  # consumed
         else:
             state.materialize_digests()

@@ -29,9 +29,32 @@ log = logging.getLogger("veneur.http")
 
 BUILD_DATE = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
 
+# Inflate bound for deflate-encoded request bodies: a small crafted body
+# must not expand to gigabytes and OOM the process (the /import and
+# /spans endpoints are unauthenticated).
+MAX_INFLATED_BYTES = 256 * 1024 * 1024
+
 
 class ImportError400(ValueError):
     pass
+
+
+def bounded_inflate(body: bytes, limit: Optional[int] = None) -> bytes:
+    """zlib-decompress with an output-size cap; raises ImportError400 on
+    malformed input or when the inflated size exceeds ``limit``."""
+    if limit is None:
+        limit = MAX_INFLATED_BYTES
+    d = zlib.decompressobj()
+    try:
+        out = d.decompress(body, limit)
+    except zlib.error as e:
+        raise ImportError400(f"invalid deflate body: {e}")
+    if d.unconsumed_tail:
+        raise ImportError400(
+            f"deflate body inflates past the {limit}-byte limit")
+    if not d.eof:
+        raise ImportError400("invalid deflate body: truncated stream")
+    return out
 
 
 def unmarshal_metrics_from_http(headers, body: bytes) -> List[dict]:
@@ -40,10 +63,7 @@ def unmarshal_metrics_from_http(headers, body: bytes) -> List[dict]:
         raise ImportError400("empty request body")
     encoding = (headers.get("Content-Encoding") or "").lower()
     if encoding == "deflate":
-        try:
-            body = zlib.decompress(body)
-        except zlib.error as e:
-            raise ImportError400(f"invalid deflate body: {e}")
+        body = bounded_inflate(body)
     elif encoding not in ("", "identity"):
         raise ImportError400(f"unknown Content-Encoding {encoding!r}")
     try:

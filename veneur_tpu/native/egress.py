@@ -185,8 +185,8 @@ def _bind(lib):
     lib.vt_mintern_free.argtypes = [ctypes.c_void_p]
     lib.vt_mintern_reset.argtypes = [ctypes.c_void_p]
     lib.vt_mintern_put.argtypes = [
-        ctypes.c_void_p, ctypes.c_uint8, ctypes.c_char_p, ctypes.c_uint32,
-        ctypes.c_char_p, ctypes.c_uint32, ctypes.c_uint32]
+        ctypes.c_void_p, ctypes.c_uint8, ctypes.c_uint8, ctypes.c_char_p,
+        ctypes.c_uint32, ctypes.c_char_p, ctypes.c_uint32, ctypes.c_uint32]
     lib.vt_mintern_assign.restype = ctypes.c_uint32
     lib.vt_mintern_assign.argtypes = [
         ctypes.c_void_p, ctypes.POINTER(_VtMetricBatch), u32p, u32p]
@@ -196,6 +196,17 @@ def _bind(lib):
         ctypes.c_char_p, u32p, u32p,            # names
         ctypes.c_char_p, u32p, u32p,            # tags
         f32p, f32p, ctypes.c_uint32,            # means, weights, K
+        f32p, f32p,                             # dmins, dmaxs
+        ctypes.c_uint32, ctypes.c_uint8,        # nrows, pb type
+        ctypes.c_double, ctypes.c_uint64, ctypes.c_int,
+    ]
+
+    u16p = ctypes.POINTER(ctypes.c_uint16)
+    lib.vt_mlist_encode_digests_packed.restype = ctypes.POINTER(_VtBodies)
+    lib.vt_mlist_encode_digests_packed.argtypes = [
+        ctypes.c_char_p, u32p, u32p,            # names
+        ctypes.c_char_p, u32p, u32p,            # tags
+        u16p, u16p, u16p,                       # counts, means_q, weights_bf
         f32p, f32p,                             # dmins, dmaxs
         ctypes.c_uint32, ctypes.c_uint8,        # nrows, pb type
         ctypes.c_double, ctypes.c_uint64, ctypes.c_int,
@@ -455,6 +466,7 @@ class DecodedMetricList:
         b.arena_len = len(self.arena)
         u8, u32 = ctypes.c_uint8, ctypes.c_uint32
         b.type = self.type.ctypes.data_as(ctypes.POINTER(u8))
+        b.payload = self.payload.ctypes.data_as(ctypes.POINTER(u8))
         b.name_off = self.name_off.ctypes.data_as(ctypes.POINTER(u32))
         b.name_len = self.name_len.ctypes.data_as(ctypes.POINTER(u32))
         b.tags_off = self.tags_off.ctypes.data_as(ctypes.POINTER(u32))
@@ -484,8 +496,12 @@ def decode_metric_list(data: bytes) -> DecodedMetricList:
 
 
 class MListInternTable:
-    """(metricpb type, name, joined tags) -> store row, memoized in C++.
-    Misses come back for Python to resolve and teach with put()."""
+    """(metricpb type, payload kind, name, joined tags) -> store row,
+    memoized in C++. Misses come back for Python to resolve and teach
+    with put(). The payload kind is part of the key because row indices
+    are only meaningful within one group and the applying group is chosen
+    by the value-oneof: a repeated (type, name, tags) with a different
+    oneof must MISS, not reuse a foreign group's row (ADVICE round-3)."""
 
     def __init__(self):
         lib = _load()
@@ -505,9 +521,10 @@ class MListInternTable:
             miss.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)))
         return rows, miss[:nmiss]
 
-    def put(self, pb_type: int, name: bytes, tags: bytes, row: int):
-        self._lib.vt_mintern_put(self._handle, pb_type, name, len(name),
-                                 tags, len(tags), row)
+    def put(self, pb_type: int, payload: int, name: bytes, tags: bytes,
+            row: int):
+        self._lib.vt_mintern_put(self._handle, pb_type, payload, name,
+                                 len(name), tags, len(tags), row)
 
     def reset(self):
         self._lib.vt_mintern_reset(self._handle)
@@ -559,6 +576,44 @@ def encode_digest_metrics(names: Tuple[bytes, np.ndarray, np.ndarray],
         name_arena, _p(name_off, u32), _p(name_len, u32),
         tags_arena, _p(tags_off, u32), _p(tags_len, u32),
         _p(means, f32), _p(weights, f32), k,
+        _p(dmins, f32), _p(dmaxs, f32),
+        nrows, pb_type, compression, max_body_bytes,
+        1 if reference_compat else 0)
+    return _take_bodies(lib, bp)
+
+
+def encode_digest_metrics_packed(names: Tuple[bytes, np.ndarray, np.ndarray],
+                                 tags: Tuple[bytes, np.ndarray, np.ndarray],
+                                 planes, pb_type: int,
+                                 compression: float = 100.0,
+                                 max_body_bytes: int = 0,
+                                 reference_compat: bool = False
+                                 ) -> List[bytes]:
+    """Device-compacted digest planes (core.store.PackedDigestPlanes) →
+    serialized MetricList chunks. Non-compat chunks carry the quantized
+    u16 arrays verbatim (tdigest fields 16/17, 4 bytes/centroid);
+    reference_compat dequantizes in C++ and emits the reference's
+    repeated-Centroid layout."""
+    lib = _load()
+    if lib is None:
+        raise RuntimeError(f"native egress unavailable: {_build_error}")
+    counts = np.ascontiguousarray(planes.counts, np.uint16)
+    means_q = np.ascontiguousarray(planes.means_q, np.uint16)
+    weights_bf = np.ascontiguousarray(planes.weights_bf, np.uint16)
+    dmins = np.ascontiguousarray(planes.dmin, np.float32)
+    dmaxs = np.ascontiguousarray(planes.dmax, np.float32)
+    nrows = len(counts)
+    assert int(counts.astype(np.int64).sum()) == len(means_q) == \
+        len(weights_bf)
+    name_arena, name_off, name_len = names
+    tags_arena, tags_off, tags_len = tags
+    name_off, name_len = _u32a(name_off), _u32a(name_len)
+    tags_off, tags_len = _u32a(tags_off), _u32a(tags_len)
+    u16, u32, f32 = ctypes.c_uint16, ctypes.c_uint32, ctypes.c_float
+    bp = lib.vt_mlist_encode_digests_packed(
+        name_arena, _p(name_off, u32), _p(name_len, u32),
+        tags_arena, _p(tags_off, u32), _p(tags_len, u32),
+        _p(counts, u16), _p(means_q, u16), _p(weights_bf, u16),
         _p(dmins, f32), _p(dmaxs, f32),
         nrows, pb_type, compression, max_body_bytes,
         1 if reference_compat else 0)

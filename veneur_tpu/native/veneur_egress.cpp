@@ -100,9 +100,11 @@ void put_i64(Buf& b, int64_t v) {
 }
 
 // Fast metric-value formatter. Integers print exact; fractional values in
-// a sane magnitude range print with 9 significant digits (every flush
-// value derives from float32 device planes, for which 9 digits is full
-// round-trip); extreme magnitudes fall back to snprintf scientific.
+// a sane magnitude range print with 9 significant digits, VERIFIED to
+// round-trip (digest-derived values come from float32 device planes where
+// 9 digits always suffice, but counter rates and gauges are host-side
+// float64 — those fall back to a 17-digit render when 9 digits lose
+// precision). Extreme magnitudes fall back to snprintf scientific.
 // snprintf+strtod per value was the serializer's bottleneck (~0.6us each).
 void put_double(Buf& b, double v) {
   if (!std::isfinite(v)) {  // JSON has no inf/nan; Datadog rejects them
@@ -138,22 +140,32 @@ void put_double(Buf& b, double v) {
       ip += 1;
       fp = 0;
     }
-    n += fmt_i64(dst + n, static_cast<int64_t>(ip));
-    if (fp) {
-      dst[n++] = '.';
-      // zero-padded fraction, then trim trailing zeros
-      char tmp[16];
-      int fn = fmt_i64(tmp, static_cast<int64_t>(fp));
-      for (int z = fn; z < frac_digits; z++) dst[n++] = '0';
-      while (fn > 0 && tmp[fn - 1] == '0') fn--;
-      memcpy(dst + n, tmp, fn);
-      n += fn;
+    // round-trip check: the emitted decimal is exactly ip + fp/scale;
+    // only commit the fast render when that reconstructs the input
+    if (static_cast<double>(ip) + static_cast<double>(fp) / scale == a) {
+      n += fmt_i64(dst + n, static_cast<int64_t>(ip));
+      if (fp) {
+        dst[n++] = '.';
+        // zero-padded fraction, then trim trailing zeros
+        char tmp[16];
+        int fn = fmt_i64(tmp, static_cast<int64_t>(fp));
+        for (int z = fn; z < frac_digits; z++) dst[n++] = '0';
+        while (fn > 0 && tmp[fn - 1] == '0') fn--;
+        memcpy(dst + n, tmp, fn);
+        n += fn;
+      }
+      b.len += n;
+      return;
     }
-    b.len += n;
+    char tmp[32];
+    int fn = snprintf(tmp, sizeof tmp, "%.17g", v);
+    b.put(tmp, fn);
     return;
   }
   char tmp[32];
   int n = snprintf(tmp, sizeof tmp, "%.9g", v);
+  if (strtod(tmp, nullptr) != v)  // rare branch: strtod check is fine
+    n = snprintf(tmp, sizeof tmp, "%.17g", v);
   b.put(tmp, n);
 }
 
@@ -908,7 +920,9 @@ struct VtMetricBatchImpl {
 void parse_tdigest(Cursor td, VtMetricBatchImpl* b) {
   const uint8_t* packed_means = nullptr;
   const uint8_t* packed_weights = nullptr;
-  uint64_t pm_n = 0, pw_n = 0;
+  const uint8_t* quant_means = nullptr;
+  const uint8_t* quant_weights = nullptr;
+  uint64_t pm_n = 0, pw_n = 0, qm_n = 0, qw_n = 0;
   // proto3 omits zero-valued scalar fields, so an absent min/max means
   // 0.0 (a perfectly valid extremum), NOT "unknown" — only an EMPTY
   // digest normalizes to (inf, -inf), matching the Python decoder
@@ -927,6 +941,16 @@ void parse_tdigest(Cursor td, VtMetricBatchImpl* b) {
       Cursor s = scan.sub();
       packed_weights = s.p;
       pw_n = (s.end - s.p) / 8;
+    } else if (field == 16 && wt == 2) {
+      // framework extension v2: u16 range-quantized means (LE)
+      Cursor s = scan.sub();
+      quant_means = s.p;
+      qm_n = (s.end - s.p) / 2;
+    } else if (field == 17 && wt == 2) {
+      // framework extension v2: u16 bfloat16 weight bit patterns (LE)
+      Cursor s = scan.sub();
+      quant_weights = s.p;
+      qw_n = (s.end - s.p) / 2;
     } else if (field == 2 && wt == 1) {
       comp = scan.f64();
     } else if (field == 3 && wt == 1) {
@@ -940,7 +964,24 @@ void parse_tdigest(Cursor td, VtMetricBatchImpl* b) {
     }
   }
   uint64_t c0 = b->means.size();
-  if (packed_means && packed_weights && pm_n == pw_n && pm_n > 0) {
+  if (quant_means && quant_weights && qm_n == qw_n && qm_n > 0) {
+    // dequantize AFTER the scan: min/max may serialize after fields
+    // 16/17, and mean = min + q/65535 * (max-min)
+    b->means.resize(c0 + qm_n);
+    b->weights.resize(c0 + qm_n);
+    double span = (mx - mn) / 65535.0;
+    if (!std::isfinite(span)) span = 0.0;
+    for (uint64_t i = 0; i < qm_n; i++) {
+      uint16_t q, wbits;
+      memcpy(&q, quant_means + i * 2, 2);
+      memcpy(&wbits, quant_weights + i * 2, 2);
+      uint32_t f32bits = static_cast<uint32_t>(wbits) << 16;
+      float w;
+      memcpy(&w, &f32bits, 4);
+      b->means[c0 + i] = mn + q * span;
+      b->weights[c0 + i] = w;
+    }
+  } else if (packed_means && packed_weights && pm_n == pw_n && pm_n > 0) {
     b->means.resize(c0 + pm_n);
     b->weights.resize(c0 + pm_n);
     memcpy(b->means.data() + c0, packed_means, pm_n * 8);
@@ -1175,23 +1216,33 @@ uint64_t fnv1a64(const void* data, size_t n, uint64_t h = 1469598103934665603ULL
   return h;
 }
 
-uint64_t mkey_hash(uint8_t type, const char* name, uint32_t name_n,
-                   const char* tags, uint32_t tags_n) {
+// The key includes the PAYLOAD kind (which value-oneof was present), not
+// just the type enum: row indices are only meaningful within one group,
+// and the group applied to is chosen by the payload at apply time — a
+// malformed/adversarial forwarder repeating (type, name, tags) with a
+// different oneof must MISS here so Python re-resolves against the right
+// group's interner instead of writing through a foreign row index
+// (ADVICE round-3, medium).
+uint64_t mkey_hash(uint8_t type, uint8_t payload, const char* name,
+                   uint32_t name_n, const char* tags, uint32_t tags_n) {
   uint64_t h = fnv1a64(&type, 1);
+  h = fnv1a64(&payload, 1, h);
   h = fnv1a64(name, name_n, h);
   uint8_t sep = 0x1f;
   h = fnv1a64(&sep, 1, h);
   return fnv1a64(tags, tags_n, h);
 }
 
-bool mkey_eq(const MTable* t, const MEntry& e, uint8_t type, const char* name,
-             uint32_t name_n, const char* tags, uint32_t tags_n) {
-  if (e.key_len != 1 + name_n + 1 + tags_n) return false;
+bool mkey_eq(const MTable* t, const MEntry& e, uint8_t type, uint8_t payload,
+             const char* name, uint32_t name_n, const char* tags,
+             uint32_t tags_n) {
+  if (e.key_len != 2 + name_n + 1 + tags_n) return false;
   const char* k = t->arena.p + e.key_off;
   if (static_cast<uint8_t>(k[0]) != type) return false;
-  if (memcmp(k + 1, name, name_n) != 0) return false;
-  if (k[1 + name_n] != 0x1f) return false;
-  return memcmp(k + 2 + name_n, tags, tags_n) == 0;
+  if (static_cast<uint8_t>(k[1]) != payload) return false;
+  if (memcmp(k + 2, name, name_n) != 0) return false;
+  if (k[2 + name_n] != 0x1f) return false;
+  return memcmp(k + 3 + name_n, tags, tags_n) == 0;
 }
 
 void mtable_grow(MTable* t) {
@@ -1221,16 +1272,17 @@ extern "C" void vt_mintern_reset(MTable* t) {
   t->count = 0;
 }
 
-extern "C" void vt_mintern_put(MTable* t, uint8_t type, const char* name,
-                               uint32_t name_n, const char* tags,
-                               uint32_t tags_n, uint32_t row) {
+extern "C" void vt_mintern_put(MTable* t, uint8_t type, uint8_t payload,
+                               const char* name, uint32_t name_n,
+                               const char* tags, uint32_t tags_n,
+                               uint32_t row) {
   if (t->count * 2 >= t->slots.size()) mtable_grow(t);
-  uint64_t h = mkey_hash(type, name, name_n, tags, tags_n);
+  uint64_t h = mkey_hash(type, payload, name, name_n, tags, tags_n);
   size_t mask = t->slots.size() - 1;
   size_t i = h & mask;
   while (t->slots[i].used) {
     if (t->slots[i].hash == h &&
-        mkey_eq(t, t->slots[i], type, name, name_n, tags, tags_n)) {
+        mkey_eq(t, t->slots[i], type, payload, name, name_n, tags, tags_n)) {
       t->slots[i].row = row;
       return;
     }
@@ -1241,9 +1293,10 @@ extern "C" void vt_mintern_put(MTable* t, uint8_t type, const char* name,
   e.hash = h;
   e.row = row;
   e.key_off = static_cast<uint32_t>(t->arena.len);
-  e.key_len = 1 + name_n + 1 + tags_n;
+  e.key_len = 2 + name_n + 1 + tags_n;
   char sep = 0x1f;
   t->arena.put(&type, 1);
+  t->arena.put(&payload, 1);
   t->arena.put(name, name_n);
   t->arena.put(&sep, 1);
   t->arena.put(tags, tags_n);
@@ -1261,12 +1314,14 @@ extern "C" uint32_t vt_mintern_assign(MTable* t, const VtMetricBatch* b,
     const char* name = b->arena + b->name_off[i];
     const char* tags = b->arena + b->tags_off[i];
     uint8_t type = b->type[i];
-    uint64_t h = mkey_hash(type, name, b->name_len[i], tags, b->tags_len[i]);
+    uint8_t payload = b->payload[i];
+    uint64_t h =
+        mkey_hash(type, payload, name, b->name_len[i], tags, b->tags_len[i]);
     size_t s = h & mask;
     uint32_t row = UINT32_MAX;
     while (t->slots[s].used) {
       if (t->slots[s].hash == h &&
-          mkey_eq(t, t->slots[s], type, name, b->name_len[i], tags,
+          mkey_eq(t, t->slots[s], type, payload, name, b->name_len[i], tags,
                   b->tags_len[i])) {
         row = t->slots[s].row;
         break;
@@ -1394,6 +1449,152 @@ extern "C" VtBodies* vt_mlist_encode_digests(
         double d = static_cast<double>(wrow[k]);
         memcpy(body.p + body.len, &d, 8);
         body.len += 8;
+      }
+    }
+  }
+  if (body.len) {
+    impl->lens.push_back(body.len);
+    impl->ptrs.push_back(body.take());
+  }
+  free(body.p);
+  return bodies_finish(impl);
+}
+
+// Packed-plane variant: input is the device-compacted layout (per-row
+// live-centroid counts + flat u16 quantized means / bfloat16 weight bit
+// patterns) produced by core/slab.py:_pack_slab — the forward path that
+// never fetches raw [S, K] f32 planes. Wire format:
+//   reference_compat=0: tdigest fields 16/17 (the quantized arrays
+//     verbatim, 4 bytes/centroid; decoded by parse_tdigest above) —
+//   reference_compat=1: dequantized repeated Centroid messages plus the
+//     packed f64 arrays, byte-layout-identical to what
+//     vt_mlist_encode_digests emits for a reference global.
+extern "C" VtBodies* vt_mlist_encode_digests_packed(
+    const char* name_arena, const uint32_t* name_off, const uint32_t* name_len,
+    const char* tags_arena, const uint32_t* tags_off, const uint32_t* tags_len,
+    const uint16_t* counts, const uint16_t* means_q, const uint16_t* weights_bf,
+    const float* dmins, const float* dmaxs, uint32_t nrows, uint8_t pb_type,
+    double compression, uint64_t max_body_bytes, int reference_compat) {
+  VtBodiesImpl* impl = new VtBodiesImpl();
+  Buf body;
+  if (max_body_bytes == 0) max_body_bytes = UINT64_MAX;
+  uint64_t c0 = 0;
+  for (uint32_t r = 0; r < nrows; r++) {
+    uint64_t nc = counts[r];
+    const uint16_t* mq = means_q + c0;
+    const uint16_t* wb = weights_bf + c0;
+    c0 += nc;
+
+    // --- sizes, inside out
+    uint64_t td_sz = 9 + 9 + 9;  // compression + min + max
+    if (nc) {
+      if (reference_compat) {
+        uint64_t packed_bytes = nc * 8;
+        td_sz += 1 + varint_size(packed_bytes) + packed_bytes;  // field 14
+        td_sz += 1 + varint_size(packed_bytes) + packed_bytes;  // field 15
+        td_sz += nc * 20;  // Centroid{mean,weight} = 18+2
+      } else {
+        uint64_t quant_bytes = nc * 2;
+        td_sz += 2 + varint_size(quant_bytes) + quant_bytes;  // field 16
+        td_sz += 2 + varint_size(quant_bytes) + quant_bytes;  // field 17
+      }
+    }
+    uint64_t hv_sz = 1 + varint_size(td_sz) + td_sz;  // HistogramValue.t_digest
+    uint64_t metric_sz = 1 + varint_size(name_len[r]) + name_len[r];
+    const char* tags = tags_arena + tags_off[r];
+    uint32_t tlen = tags_len[r];
+    {
+      uint32_t i = 0;
+      while (i < tlen) {
+        uint32_t j = i;
+        while (j < tlen && tags[j] != ',') j++;
+        uint32_t n = j - i;
+        metric_sz += 1 + varint_size(n) + n;
+        i = j + 1;
+      }
+    }
+    if (pb_type) metric_sz += 1 + varint_size(pb_type);
+    metric_sz += 1 + varint_size(hv_sz) + hv_sz;
+
+    if (body.len &&
+        body.len + metric_sz + 1 + varint_size(metric_sz) > max_body_bytes) {
+      impl->lens.push_back(body.len);
+      impl->ptrs.push_back(body.take());
+    }
+
+    // --- write
+    put_varint(body, (1 << 3) | 2);  // MetricList.metrics
+    put_varint(body, metric_sz);
+    put_varint(body, (1 << 3) | 2);  // Metric.name
+    put_varint(body, name_len[r]);
+    body.put(name_arena + name_off[r], name_len[r]);
+    {
+      uint32_t i = 0;
+      while (i < tlen) {
+        uint32_t j = i;
+        while (j < tlen && tags[j] != ',') j++;
+        uint32_t n = j - i;
+        put_varint(body, (2 << 3) | 2);  // Metric.tags
+        put_varint(body, n);
+        body.put(tags + i, n);
+        i = j + 1;
+      }
+    }
+    if (pb_type) {
+      put_varint(body, (3 << 3) | 0);  // Metric.type
+      put_varint(body, pb_type);
+    }
+    put_varint(body, (7 << 3) | 2);  // Metric.histogram
+    put_varint(body, hv_sz);
+    put_varint(body, (1 << 3) | 2);  // HistogramValue.t_digest
+    put_varint(body, td_sz);
+    double mn = static_cast<double>(dmins[r]);
+    double span = (static_cast<double>(dmaxs[r]) - mn) / 65535.0;
+    if (!std::isfinite(span)) span = 0.0;
+    if (nc && reference_compat) {
+      for (uint64_t k = 0; k < nc; k++) {  // tdigest.main_centroids
+        uint32_t f32bits = static_cast<uint32_t>(wb[k]) << 16;
+        float w;
+        memcpy(&w, &f32bits, 4);
+        put_varint(body, (1 << 3) | 2);
+        put_varint(body, 18);
+        put_f64_field(body, 1, mn + mq[k] * span);
+        put_f64_field(body, 2, static_cast<double>(w));
+      }
+    }
+    put_f64_field(body, 2, compression);
+    put_f64_field(body, 3, static_cast<double>(dmins[r]));
+    put_f64_field(body, 4, static_cast<double>(dmaxs[r]));
+    if (nc) {
+      if (reference_compat) {
+        uint64_t packed_bytes = nc * 8;
+        put_varint(body, (14 << 3) | 2);  // packed_means (f64)
+        put_varint(body, packed_bytes);
+        body.reserve(packed_bytes);
+        for (uint64_t k = 0; k < nc; k++) {
+          double d = mn + mq[k] * span;
+          memcpy(body.p + body.len, &d, 8);
+          body.len += 8;
+        }
+        put_varint(body, (15 << 3) | 2);  // packed_weights (f64)
+        put_varint(body, packed_bytes);
+        body.reserve(packed_bytes);
+        for (uint64_t k = 0; k < nc; k++) {
+          uint32_t f32bits = static_cast<uint32_t>(wb[k]) << 16;
+          float w;
+          memcpy(&w, &f32bits, 4);
+          double d = static_cast<double>(w);
+          memcpy(body.p + body.len, &d, 8);
+          body.len += 8;
+        }
+      } else {
+        uint64_t quant_bytes = nc * 2;
+        put_varint(body, (16 << 3) | 2);  // quantized_means (u16 LE)
+        put_varint(body, quant_bytes);
+        body.put(reinterpret_cast<const char*>(mq), quant_bytes);
+        put_varint(body, (17 << 3) | 2);  // quantized_weights (bf16 LE)
+        put_varint(body, quant_bytes);
+        body.put(reinterpret_cast<const char*>(wb), quant_bytes);
       }
     }
   }

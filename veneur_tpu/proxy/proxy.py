@@ -21,7 +21,8 @@ from typing import Dict, List, Optional
 from veneur_tpu.config import ProxyConfig, parse_duration
 from veneur_tpu.discovery import ConsulDiscoverer, Discoverer, StaticDiscoverer
 from veneur_tpu.forward.http_forward import post_helper
-from veneur_tpu.httpserv import ImportError400, unmarshal_metrics_from_http
+from veneur_tpu.httpserv import (ImportError400, bounded_inflate,
+                                 unmarshal_metrics_from_http)
 from veneur_tpu.proxy.consistent import ConsistentRing, EmptyRingError
 
 log = logging.getLogger("veneur.proxy")
@@ -80,7 +81,7 @@ class _ProxyHandler(BaseHTTPRequestHandler):
                 return
             try:
                 if (self.headers.get("Content-Encoding") or "") == "deflate":
-                    body = zlib.decompress(body)
+                    body = bounded_inflate(body)
                 traces = json.loads(body)
                 if not isinstance(traces, list):
                     raise ValueError("expected a JSON array of spans")
