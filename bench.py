@@ -187,14 +187,41 @@ def bench_import_throughput(num_series: int = 20000, duration: float = 4.0):
     from veneur_tpu.protocol import forward_pb2
 
     rng = np.random.default_rng(0)
-    # one host's forwarded batch: num_series digests, 48 centroids each
+    K = 48
+    # one host's forwarded batch: num_series digests, K centroids each
+    means2d = np.sort(rng.gamma(2.0, 30.0, (num_series, K)), axis=1)
     state = ForwardableState()
     for i in range(num_series):
-        means = np.sort(rng.gamma(2.0, 30.0, 48))
         state.histograms.append(
-            (f"svc.latency.{i}", [f"shard:{i % 13}"], means,
-             np.ones(48), float(means[0]), float(means[-1])))
-    mlist = metric_list_from_state(state)
+            (f"svc.latency.{i}", [f"shard:{i % 13}"], means2d[i],
+             np.ones(K), float(means2d[i, 0]), float(means2d[i, -1])))
+    # legacy wire: packed f64 arrays (what a pre-round-4 local sends)
+    legacy_payload = metric_list_from_state(state).SerializeToString()
+    # round-4 wire: quantized u16 centroids (what a local sends now),
+    # built exactly as the packed flush would
+    from veneur_tpu.core import columnar as cbv
+    from veneur_tpu.core.store import PackedDigestPlanes
+    from veneur_tpu.native import egress as eg
+
+    quant_payload = None
+    if eg.available():
+        dmin = means2d[:, 0].astype(np.float32)
+        dmax = means2d[:, -1].astype(np.float32)
+        span = (dmax - dmin).astype(np.float64)
+        q = np.clip(np.round((means2d - dmin[:, None])
+                             / np.where(span[:, None] > 0, span[:, None], 1)
+                             * 65535), 0, 65535).astype(np.uint16)
+        wbf = (np.ones((num_series, K), np.float32).view(np.uint32)
+               >> 16).astype(np.uint16)
+        planes = PackedDigestPlanes(
+            np.full(num_series, K, np.uint16), q.reshape(-1),
+            wbf.reshape(-1), dmin, dmax)
+        names = cbv.build_arenas(
+            [f"svc.latency.{i}" for i in range(num_series)])
+        tags = cbv.build_arenas(
+            [f"shard:{i % 13}" for i in range(num_series)])
+        quant_payload = b"".join(eg.encode_digest_metrics_packed(
+            names, tags, planes, 2))
 
     # 2^17 staging chunks: a 20k x 48-centroid batch drains in 8 device
     # dispatches instead of 30 — dispatch latency, not decode, is the
@@ -202,57 +229,83 @@ def bench_import_throughput(num_series: int = 20000, duration: float = 4.0):
     store = MetricStore(initial_capacity=1 << 15, chunk=1 << 17)
     srv = ImportServer(store)
     port = srv.start("127.0.0.1:0")
-    chan = grpc.insecure_channel(
-        f"127.0.0.1:{port}",
-        options=[("grpc.max_send_message_length", 256 << 20),
-                 ("grpc.max_receive_message_length", 256 << 20)])
-    try:
-        # serialize once, send raw bytes: a real forwarding local
-        # serializes each interval's list exactly once (natively), so
-        # per-send python-protobuf serialization would only measure the
-        # bench client
-        payload = mlist.SerializeToString()
-        send_ser = chan.unary_unary(
+    payload = quant_payload if quant_payload is not None else legacy_payload
+
+    def sender_loop(deadline, counter, lock):
+        # each sender is one forwarding host with its own channel
+        chan = grpc.insecure_channel(
+            f"127.0.0.1:{port}",
+            options=[("grpc.max_send_message_length", 256 << 20),
+                     ("grpc.max_receive_message_length", 256 << 20)])
+        send = chan.unary_unary(
             _METHOD,
             request_serializer=lambda b: b,
             response_deserializer=empty_pb2.Empty.FromString)
-        send = lambda m, timeout: send_ser(payload, timeout=timeout)  # noqa: E731
+        try:
+            while time.perf_counter() < deadline:
+                send(payload, timeout=300)
+                with lock:
+                    counter[0] += num_series
+        finally:
+            chan.close()
+
+    try:
+        import threading
+
+        chan = grpc.insecure_channel(
+            f"127.0.0.1:{port}",
+            options=[("grpc.max_send_message_length", 256 << 20),
+                     ("grpc.max_receive_message_length", 256 << 20)])
+        warm_send = chan.unary_unary(
+            _METHOD,
+            request_serializer=lambda b: b,
+            response_deserializer=empty_pb2.Empty.FromString)
         # warm until sends run compile-free: the staging drains change
         # phase between the first calls, each new shape compiling a
         # scatter variant (~20 s on TPU over the tunnel)
         for _ in range(6):
             t0 = time.perf_counter()
-            send(mlist, timeout=600)
+            warm_send(payload, timeout=600)
             if time.perf_counter() - t0 < 1.5:
                 break
-        sent = 0
+        chan.close()
+        # two concurrent forwarding hosts: decode runs GIL-free in C++,
+        # so a second stream overlaps transport with store staging
+        counter, lock = [0], threading.Lock()
+        deadline = time.perf_counter() + duration
         t0 = time.perf_counter()
-        while time.perf_counter() - t0 < duration:
-            send(mlist, timeout=300)
-            sent += num_series
+        senders = [threading.Thread(target=sender_loop,
+                                    args=(deadline, counter, lock))
+                   for _ in range(2)]
+        for t in senders:
+            t.start()
+        for t in senders:
+            t.join()
         dt = time.perf_counter() - t0
+        sent = counter[0]
         # the store path alone (native decode + intern + bulk stage,
         # no gRPC transport): what each importer thread sustains — a
         # multi-core global runs one stream per core
-        from veneur_tpu.native import egress as eg
-
+        rates = {}
         if eg.available():
-            times = []
-            for _ in range(8):
-                t1 = time.perf_counter()
-                dec = eg.decode_metric_list(payload)
-                store.import_columnar(dec, payload)
-                dec.close()
-                times.append(time.perf_counter() - t1)
-            store_rate = int(num_series / float(np.median(times)))
-        else:
-            store_rate = None
+            for name, pl in (("quant", quant_payload),
+                             ("legacy", legacy_payload)):
+                times = []
+                for _ in range(8):
+                    t1 = time.perf_counter()
+                    dec = eg.decode_metric_list(pl)
+                    store.import_columnar(dec, pl)
+                    dec.close()
+                    times.append(time.perf_counter() - t1)
+                rates[name] = int(num_series / float(np.median(times)))
         return {"series_merged_per_s": int(sent / dt),
-                "store_path_series_per_s": store_rate,
+                "store_path_series_per_s": rates.get("quant"),
+                "store_path_legacy_wire_per_s": rates.get("legacy"),
+                "wire_bytes_per_series": round(len(payload) / num_series),
+                "senders": 2,
                 "batch_series": num_series,
-                "centroids_per_digest": 48}
+                "centroids_per_digest": K}
     finally:
-        chan.close()
         srv.stop()
 
 
@@ -446,14 +499,18 @@ def bench_egress_1m(num_series: int = 1 << 20):
     wts = np.ones(num_series, np.float32)
 
     def stage():
+        # re-fetch the group: store.flush swaps in a fresh generation
+        gg = store.histograms
         for r in range(2):
-            g.sample_many(rows, rng.gamma(2.0, 50.0, num_series)
-                          .astype(np.float32), wts)
-        g._drain_staging()
+            gg.sample_many(rows, rng.gamma(2.0, 50.0, num_series)
+                           .astype(np.float32), wts)
+        gg._drain_staging()
 
     def reintern():
+        gg = store.histograms
+        gg.ensure_capacity(num_series - 1)
         for i in range(num_series):
-            g.interner.intern(
+            gg.interner.intern(
                 MetricKey(name=f"svc.lat.{i}", type="histogram",
                           joined_tags=f"shard:{i % 13},env:prod"),
                 [f"shard:{i % 13}", "env:prod"])
@@ -464,7 +521,6 @@ def bench_egress_1m(num_series: int = 1 << 20):
     store.flush([], agg, is_local=False, now=0, forward=False,
                 columnar=True)
     reintern()
-    g.ensure_capacity(num_series - 1)
     stage()
 
     t0 = time.perf_counter()
@@ -569,13 +625,20 @@ def bench_forward_1m(num_series: int = 1 << 20):
                                    digest_format="packed")
         client.forward(fwd)
         def reintern_and_stage():
-            g.ensure_capacity(num_series - 1)
+            # re-fetch the group: store.flush swaps in a fresh generation
+            gg = local.histograms
+            gg.ensure_capacity(num_series - 1)
             for i in range(num_series):
-                g.interner.intern(
+                gg.interner.intern(
                     MetricKey(name=f"svc.lat.{i}", type="histogram",
                               joined_tags=f"shard:{i % 13}"),
                     [f"shard:{i % 13}"])
-            stage()
+            for _ in range(4):  # ~4 live centroids per series on the wire
+                gg.sample_many(rows,
+                               rng.gamma(2.0, 50.0, num_series)
+                               .astype(np.float32),
+                               np.ones(num_series, np.float32))
+            gg._drain_staging()
 
         # three timed intervals; report medians (tunnel dispatch latency
         # swings single-interval numbers 3x run to run)

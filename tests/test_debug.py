@@ -1,0 +1,132 @@
+"""Live debug endpoints (/debug/threads, /debug/profile, /debug/vars) —
+the running-process introspection the reference gets from net/http/pprof
+(http.go:43-48, proxy.go:383-388)."""
+
+import json
+import threading
+import time
+import urllib.request
+
+import pytest
+
+
+def get(port, path):
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=10) as r:
+        return r.status, r.read().decode(), r.headers.get("Content-Type")
+
+
+class TestDebugPrimitives:
+    def test_dump_threads_sees_other_threads(self):
+        from veneur_tpu import debug
+
+        evt = threading.Event()
+
+        def parked():
+            evt.wait(10)
+
+        t = threading.Thread(target=parked, name="parked-thread",
+                             daemon=True)
+        t.start()
+        try:
+            time.sleep(0.05)
+            dump = debug.dump_threads()
+            assert "parked-thread" in dump
+            assert "evt.wait" in dump or "parked" in dump
+        finally:
+            evt.set()
+            t.join()
+
+    def test_sample_profile_catches_busy_thread(self):
+        from veneur_tpu import debug
+
+        stop = threading.Event()
+
+        def spin():
+            while not stop.is_set():
+                sum(range(2000))
+
+        t = threading.Thread(target=spin, name="spinner", daemon=True)
+        t.start()
+        try:
+            out = debug.sample_profile(0.4, hz=100)
+        finally:
+            stop.set()
+            t.join()
+        assert "spin" in out
+        # collapsed-stack lines end with a sample count
+        data_lines = [ln for ln in out.splitlines()
+                      if ln and not ln.startswith("#")]
+        assert data_lines and data_lines[0].rsplit(" ", 1)[1].isdigit()
+
+    def test_profile_seconds_clamped(self):
+        from veneur_tpu import debug
+
+        t0 = time.perf_counter()
+        debug.sample_profile(0.0)  # clamps to 0.1, not 0 or negative
+        assert time.perf_counter() - t0 < 2.0
+
+
+class TestServerDebugRoutes:
+    @pytest.fixture()
+    def server(self):
+        from veneur_tpu.config import Config
+        from veneur_tpu.server import Server
+        from veneur_tpu.sinks import ChannelMetricSink
+
+        cfg = Config(statsd_listen_addresses=["udp://127.0.0.1:0"],
+                     interval="86400s", http_address="127.0.0.1:0",
+                     store_initial_capacity=32, store_chunk=128)
+        srv = Server(cfg, metric_sinks=[ChannelMetricSink()])
+        srv.start()
+        yield srv
+        srv.shutdown()
+
+    def test_debug_threads(self, server):
+        status, body, ctype = get(server.ops_server.port, "/debug/threads")
+        assert status == 200 and "thread" in body
+
+    def test_debug_vars_reports_store_depths(self, server):
+        from veneur_tpu.samplers import parser as p
+
+        server.store.process_metric(p.parse_metric(b"dv:1|c"))
+        status, body, ctype = get(server.ops_server.port, "/debug/vars")
+        assert status == 200 and ctype == "application/json"
+        data = json.loads(body)
+        assert data["store"]["processed_this_interval"] == 1
+        assert "counters" in data["store"]["groups"]
+        assert data["threads"] >= 2
+
+    def test_debug_profile_query_param(self, server):
+        t0 = time.perf_counter()
+        status, body, _ = get(server.ops_server.port,
+                              "/debug/profile?seconds=0.2")
+        assert status == 200
+        assert "sampling rounds" in body
+        assert time.perf_counter() - t0 < 5.0
+
+    def test_debug_profile_bad_param_is_400(self, server):
+        import urllib.error
+
+        with pytest.raises(urllib.error.HTTPError) as e:
+            get(server.ops_server.port, "/debug/profile?seconds=nope")
+        assert e.value.code == 400
+
+
+class TestProxyDebugRoutes:
+    def test_proxy_mounts_debug(self):
+        from veneur_tpu.config import ProxyConfig
+        from veneur_tpu.proxy.proxy import Proxy
+
+        cfg = ProxyConfig(http_address="127.0.0.1:0",
+                          forward_address="http://127.0.0.1:1")
+        proxy = Proxy(cfg)
+        proxy.start()
+        try:
+            status, body, _ = get(proxy.port, "/debug/threads")
+            assert status == 200 and "thread" in body
+            status, body, _ = get(proxy.port, "/debug/vars")
+            data = json.loads(body)
+            assert "ring" in data
+        finally:
+            proxy.shutdown()

@@ -163,10 +163,16 @@ class TestMeshGlobalServerE2E:
                     lserver.store.process_metric(
                         p.parse_metric(b"fleet.req:7|c|#veneurglobalonly"))
                     # mirror the forwardable state into the oracle store
+                    # through the SAME wire format the real local uses
+                    # (packed/quantized digests since round 4), so the
+                    # mesh-vs-single-chip comparison sees identical
+                    # imported centroids
                     from veneur_tpu.forward import (apply_metric,
                                                     metric_list_from_state)
                     _, ofwd, _ = lserver.store.flush(
-                        QS, AGG, is_local=True, now=int(time.time()))
+                        QS, AGG, is_local=True, now=int(time.time()),
+                        columnar=True, digest_format="packed")
+                    ofwd.materialize_digests()
                     for m in metric_list_from_state(ofwd).metrics:
                         apply_metric(ostore, m)
                     # re-ingest so the real flush + forward still happens

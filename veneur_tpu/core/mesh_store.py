@@ -224,23 +224,16 @@ class MeshDigestGroup(DigestGroup):
         self.temp = self._ingest_p(self.temp, rows, vals, wts)
 
     def _drain_imports(self):
-        if self._imp_fill == 0 and not self._imp_stat_rows:
+        if self._imp_fill == 0 and self._imp_stat_fill == 0:
             return
         self._device_dirty = True
-        # fixed-size stat scatter so import drains never retrace
-        ns = len(self._imp_stat_rows)
-        stat_rows = np.full(self.chunk, self.capacity, np.int32)
-        stat_mins = np.full(self.chunk, np.inf, np.float32)
-        stat_maxs = np.full(self.chunk, -np.inf, np.float32)
-        if ns:
-            stat_rows[:ns] = self._imp_stat_rows
-            stat_mins[:ns] = self._imp_stat_mins
-            stat_maxs[:ns] = self._imp_stat_maxs
+        # fixed-size stat scatter so import drains never retrace; the
+        # staged buffers are chunk-sized and sentinel-padded already
+        stat_rows = self._imp_stat_rows
+        stat_mins = self._imp_stat_mins
+        stat_maxs = self._imp_stat_maxs
         imp = (self._imp_rows, self._imp_means, self._imp_wts)
         self._new_import_buffers()
-        self._imp_stat_rows = []
-        self._imp_stat_mins = []
-        self._imp_stat_maxs = []
         self.temp, self.dmin, self.dmax = self._import_p(
             self.temp, self.dmin, self.dmax, *imp,
             stat_rows, stat_mins, stat_maxs)
@@ -248,6 +241,17 @@ class MeshDigestGroup(DigestGroup):
     def _run_flush(self, qs):
         return self._flush_p(self.digest, self.temp, self.dmin, self.dmax,
                              jnp.asarray(qs, jnp.float32))
+
+    def fresh(self) -> "MeshDigestGroup":
+        """Empty same-config twin (swap-on-flush generation swap);
+        carries the compiled sharded programs so the swap never
+        retraces."""
+        g = MeshDigestGroup(self.mesh, self.capacity, self.chunk,
+                            self.compression)
+        g._ingest_p = self._ingest_p
+        g._import_p = self._import_p
+        g._flush_p = self._flush_p
+        return g
 
 
 class MeshSetGroup(SetGroup):
@@ -299,3 +303,14 @@ class MeshSetGroup(SetGroup):
 
     def _estimates(self):
         return self._estimate_p(self.registers)
+
+    def fresh(self) -> "MeshSetGroup":
+        """Empty same-config twin (swap-on-flush generation swap);
+        carries the compiled sharded programs so the swap never
+        retraces."""
+        g = MeshSetGroup(self.mesh, self.capacity, self.chunk,
+                         self.precision)
+        g._hash_p = self._hash_p
+        g._reg_merge_p = self._reg_merge_p
+        g._estimate_p = self._estimate_p
+        return g

@@ -160,15 +160,18 @@ def _import_slab(temp: TempSlab, digest: DigestSlab, rows, means, weights,
     return temp, digest
 
 
-@partial(jax.jit, donate_argnums=(0, 1), static_argnums=(3, 4, 5))
+@partial(jax.jit, donate_argnums=(0, 1), static_argnums=(3, 4, 5, 6))
 def _flush_slab(digest: DigestSlab, temp: TempSlab, qs, slab: int,
-                compression: float, want_digest: bool = True):
+                compression: float, want_digest: bool = True,
+                want_fresh: bool = True):
     """Drain one slab's temp into its digests and emit percentiles.
 
-    Returns (fresh empty digest+temp for the next interval, drained digest
-    planes in storage dtype — or None/None when want_digest=False, which
-    saves a full-plane cast+write per flush — percentiles [slab, P],
-    scalar stats)."""
+    Returns (fresh empty digest+temp for the next interval — or None/None
+    when want_fresh=False: a RETIRED generation's slabs are never reused,
+    so skipping the zero-fill lets the donated planes free outright —
+    drained digest planes in storage dtype — or None/None when
+    want_digest=False, which saves a full-plane cast+write per flush —
+    percentiles [slab, P], scalar stats)."""
     k = digest.mean.shape[0] // slab
     dt = digest.mean.dtype
     d = td_ops.TDigest(
@@ -187,8 +190,11 @@ def _flush_slab(digest: DigestSlab, temp: TempSlab, qs, slab: int,
         out_weight = drained.weight.astype(dt).reshape(-1)
     else:
         out_mean = out_weight = None
-    fresh_d = _init_digest_slab(slab, k, dt)
-    fresh_t = _init_temp_slab(slab, k)
+    if want_fresh:
+        fresh_d = _init_digest_slab(slab, k, dt)
+        fresh_t = _init_temp_slab(slab, k)
+    else:
+        fresh_d = fresh_t = None
     return (fresh_d, fresh_t, out_mean, out_weight, drained.min, drained.max,
             pcts, temp.count, temp.vsum, temp.vmin, temp.vmax, temp.recip)
 
@@ -464,6 +470,8 @@ class SlabDigestGroup:
     once — at most ~log2(chunk) program variants per group.
     """
 
+    _retired = False  # see core.store.DigestGroup._retired
+
     def __init__(self, slab_rows: int = SLAB_ROWS_DEFAULT,
                  chunk: int = 1 << 16,
                  compression: float = td_ops.DEFAULT_COMPRESSION,
@@ -496,6 +504,16 @@ class SlabDigestGroup:
     def __len__(self):
         return len(self.interner)
 
+    def fresh(self) -> "SlabDigestGroup":
+        """Empty same-config twin (swap-on-flush generation swap).
+        Starts with ONE slab and re-grows slab-at-a-time as rows intern:
+        fresh slabs are zero-fill appends (no copies), and lazy growth
+        keeps the flush window's HBM peak at resident + touched-slabs
+        instead of a full 2x (the retired generation's slabs free one by
+        one as the off-lock flush donates them into its programs)."""
+        return SlabDigestGroup(self.slab_rows, self.chunk,
+                               self.compression, self.digest_dtype)
+
     def ensure_capacity(self, max_row: int):
         while max_row >= self.capacity:
             self.digests.append(
@@ -505,6 +523,7 @@ class SlabDigestGroup:
             # weights are 0) but re-point them anyway, like DigestGroup
             self._rows[self._fill:] = self.capacity
             self._imp_rows[self._imp_fill:] = self.capacity
+            self._imp_stat_rows[self._imp_stat_fill:] = self.capacity
 
     def _row(self, key, tags) -> int:
         row = self.interner.intern(key, tags)
@@ -525,9 +544,11 @@ class SlabDigestGroup:
         self._imp_means = np.zeros(self.chunk, np.float32)
         self._imp_wts = np.zeros(self.chunk, np.float32)
         self._imp_fill = 0
-        self._imp_stat_rows: List[int] = []
-        self._imp_stat_mins: List[float] = []
-        self._imp_stat_maxs: List[float] = []
+        # numpy stat staging, matching DigestGroup._new_import_buffers
+        self._imp_stat_rows = np.full(self.chunk, self.capacity, np.int32)
+        self._imp_stat_mins = np.full(self.chunk, np.inf, np.float32)
+        self._imp_stat_maxs = np.full(self.chunk, -np.inf, np.float32)
+        self._imp_stat_fill = 0
 
     def sample(self, key, tags, value: float, sample_rate: float):
         row = self._row(key, tags)
@@ -572,10 +593,12 @@ class SlabDigestGroup:
             self._imp_fill = i + take
             start += take
         if math.isfinite(dmin):
-            self._imp_stat_rows.append(row)
-            self._imp_stat_mins.append(dmin)
-            self._imp_stat_maxs.append(dmax)
-            if len(self._imp_stat_rows) >= self.chunk:
+            i = self._imp_stat_fill
+            self._imp_stat_rows[i] = row
+            self._imp_stat_mins[i] = dmin
+            self._imp_stat_maxs[i] = dmax
+            self._imp_stat_fill = i + 1
+            if self._imp_stat_fill == self.chunk:
                 self._drain_imports()
 
     def import_centroids_bulk(self, rows: np.ndarray, means: np.ndarray,
@@ -621,13 +644,14 @@ class SlabDigestGroup:
                 jnp.asarray(w), self.slab_rows, self.compression)
 
     def _drain_imports(self):
-        if self._imp_fill == 0 and not self._imp_stat_rows:
+        if self._imp_fill == 0 and self._imp_stat_fill == 0:
             return
         self._device_dirty = True
         rows, means, wts = self._imp_rows, self._imp_means, self._imp_wts
-        stat_rows = np.asarray(self._imp_stat_rows, np.int32)
-        stat_mins = np.asarray(self._imp_stat_mins, np.float32)
-        stat_maxs = np.asarray(self._imp_stat_maxs, np.float32)
+        ns = self._imp_stat_fill
+        stat_rows = self._imp_stat_rows[:ns]
+        stat_mins = self._imp_stat_mins[:ns]
+        stat_maxs = self._imp_stat_maxs[:ns]
         self._new_import_buffers()
         # centroid scatter per touched slab
         by_slab = {i: (local, padded)
@@ -682,7 +706,11 @@ class SlabDigestGroup:
         n = len(self.interner)
         interner, self.interner = self.interner, self._interner_cls()
         if n == 0:
-            if self._device_dirty:
+            if self._retired:
+                self.digests = []
+                self.temps = []
+                self._device_dirty = False
+            elif self._device_dirty:
                 self._reset_device()
             self._new_sample_buffers()
             self._new_import_buffers()
@@ -694,11 +722,14 @@ class SlabDigestGroup:
         for i in range(len(self.digests)):
             need = min(n - i * self.slab_rows, self.slab_rows)
             # want_digest=False also skips the device-side cast+write of
-            # the drained planes, not just the host fetch
+            # the drained planes, not just the host fetch; a retired
+            # generation additionally skips allocating fresh slabs (its
+            # donated planes free outright, slab by slab)
             (self.digests[i], self.temps[i], mean, weight, dmin, dmax,
              pcts, count, vsum, vmin, vmax, recip) = _flush_slab(
                 self.digests[i], self.temps[i], qs, self.slab_rows,
-                self.compression, bool(want_digests))
+                self.compression, bool(want_digests),
+                not self._retired)
             if need <= 0:
                 continue
             k = self.k
@@ -725,8 +756,12 @@ class SlabDigestGroup:
                 vmax[:need], recip[:need])))
         cols = [np.concatenate(c, axis=0) for c in zip(*parts)]
         self._device_dirty = False
-        self._new_sample_buffers()
-        self._new_import_buffers()
+        if self._retired:
+            self.digests = []
+            self.temps = []
+        else:
+            self._new_sample_buffers()
+            self._new_import_buffers()
         out = {}
         if packed:
             out["digest_min"], out["digest_max"] = cols[:2]

@@ -222,6 +222,10 @@ class ScalarGroup:
             hostnames, self.hostnames = self.hostnames, []
         return interner, values, messages, hostnames
 
+    def fresh(self) -> "ScalarGroup":
+        """Empty same-config twin (swap-on-flush generation swap)."""
+        return ScalarGroup(self.kind, self.capacity)
+
 
 # ---------------------------------------------------------------------------
 # Device-side digest groups (histograms and timers)
@@ -285,22 +289,29 @@ def bulk_stage_import_centroids(group, rows: np.ndarray, means: np.ndarray,
     ns = len(stat_rows)
     pos = 0
     while pos < ns:
-        room = group.chunk - len(group._imp_stat_rows)
-        if room == 0:
+        if group._imp_stat_fill == group.chunk:
             group._drain_imports()
-            continue
-        take = min(room, ns - pos)
-        group._imp_stat_rows.extend(stat_rows[pos:pos + take])
-        group._imp_stat_mins.extend(stat_mins[pos:pos + take])
-        group._imp_stat_maxs.extend(stat_maxs[pos:pos + take])
+        take = min(group.chunk - group._imp_stat_fill, ns - pos)
+        i = group._imp_stat_fill
+        group._imp_stat_rows[i:i + take] = stat_rows[pos:pos + take]
+        group._imp_stat_mins[i:i + take] = stat_mins[pos:pos + take]
+        group._imp_stat_maxs[i:i + take] = stat_maxs[pos:pos + take]
+        group._imp_stat_fill = i + take
         pos += take
     if (group._imp_fill == group.chunk
-            or len(group._imp_stat_rows) >= group.chunk):
+            or group._imp_stat_fill == group.chunk):
         group._drain_imports()
 
 
 class DigestGroup:
     """One scope-class of histograms/timers as a dense t-digest batch."""
+
+    # set by MetricStore._swap_generation: a retired group's flush drops
+    # its device state instead of reallocating it (the group is never
+    # used again), keeping the swap-on-flush HBM peak at the old
+    # in-place-reset level instead of 3 planes (retired + fresh twin +
+    # pointless post-flush reinit)
+    _retired = False
 
     def __init__(self, capacity: int = DEFAULT_INITIAL_CAPACITY,
                  chunk: int = DEFAULT_CHUNK,
@@ -323,9 +334,6 @@ class DigestGroup:
     def _init_staging(self):
         self._new_sample_buffers()
         self._new_import_buffers()
-        self._imp_stat_rows: List[int] = []
-        self._imp_stat_mins: List[float] = []
-        self._imp_stat_maxs: List[float] = []
 
     def _new_sample_buffers(self):
         # Fresh buffers per drain: jnp.asarray zero-copies aligned numpy
@@ -341,6 +349,15 @@ class DigestGroup:
         self._imp_means = np.zeros(self.chunk, np.float32)
         self._imp_wts = np.zeros(self.chunk, np.float32)
         self._imp_fill = 0
+        # stat triples as preallocated numpy, not Python lists: a 20k-
+        # digest import message would otherwise pay ~20k list appends +
+        # a list->array conversion per drain (the global-import hot
+        # path). Sentinel padding (out-of-range row, +inf/-inf extrema)
+        # doubles as the pow2 drain padding.
+        self._imp_stat_rows = np.full(self.chunk, self.capacity, np.int32)
+        self._imp_stat_mins = np.full(self.chunk, np.inf, np.float32)
+        self._imp_stat_maxs = np.full(self.chunk, -np.inf, np.float32)
+        self._imp_stat_fill = 0
 
     def __len__(self):
         return len(self.interner)
@@ -377,11 +394,18 @@ class DigestGroup:
         # re-point staging padding at the new out-of-range row id
         self._rows[self._fill:] = self.capacity
         self._imp_rows[self._imp_fill:] = self.capacity
+        self._imp_stat_rows[self._imp_stat_fill:] = self.capacity
 
     def ensure_capacity(self, max_row: int):
         """Grow so max_row is addressable (bulk paths bypass _row)."""
         while max_row >= self.capacity:
             self._grow()
+
+    def fresh(self) -> "DigestGroup":
+        """Empty same-config twin (swap-on-flush generation swap).
+        Carries the grown capacity so a steady-state cardinality never
+        re-grows interval over interval."""
+        return DigestGroup(self.capacity, self.chunk, self.compression)
 
     def sample_many(self, rows: np.ndarray, vals: np.ndarray,
                     wts: np.ndarray):
@@ -435,19 +459,20 @@ class DigestGroup:
             self._imp_fill = i + take
             start += take
         if math.isfinite(dmin):
-            self._imp_stat_rows.append(row)
-            self._imp_stat_mins.append(dmin)
-            self._imp_stat_maxs.append(dmax)
+            i = self._imp_stat_fill
+            self._imp_stat_rows[i] = row
+            self._imp_stat_mins[i] = dmin
+            self._imp_stat_maxs[i] = dmax
+            self._imp_stat_fill = i + 1
             # zero-centroid imports never advance _imp_fill, so the stat
-            # lists need their own drain bound (the mesh drain scatters
+            # buffers need their own drain bound (the mesh drain scatters
             # them through fixed chunk-sized buffers)
-            if len(self._imp_stat_rows) >= self.chunk:
+            if self._imp_stat_fill == self.chunk:
                 self._drain_imports()
 
     def import_centroids_bulk(self, rows: np.ndarray, means: np.ndarray,
-                              weights: np.ndarray, stat_rows: List[int],
-                              stat_mins: List[float],
-                              stat_maxs: List[float]):
+                              weights: np.ndarray, stat_rows,
+                              stat_mins, stat_maxs):
         """Bulk staging append for the import path (rows pre-interned by
         the caller): span copies into the import buffers instead of a
         Python call per digest."""
@@ -465,28 +490,23 @@ class DigestGroup:
                                     self.compression)
 
     def _drain_imports(self):
-        if self._imp_fill == 0 and not self._imp_stat_rows:
+        if self._imp_fill == 0 and self._imp_stat_fill == 0:
             return
         self._device_dirty = True
-        ns = len(self._imp_stat_rows)
+        ns = self._imp_stat_fill
         # pad the stat arrays to a power-of-two bucket: every distinct
         # length would otherwise compile its own _ingest_centroids
         # variant (~20s each on TPU) — bulk imports produce a different
-        # ns per batch phase
-        cap = 1 << max(ns - 1, 0).bit_length() if ns else 1
-        stat_rows = np.full(max(cap, 1), self.capacity, np.int32)
-        stat_mins = np.full(max(cap, 1), np.inf, np.float32)
-        stat_maxs = np.full(max(cap, 1), -np.inf, np.float32)
-        if ns:
-            stat_rows[:ns] = self._imp_stat_rows
-            stat_mins[:ns] = self._imp_stat_mins
-            stat_maxs[:ns] = self._imp_stat_maxs
+        # ns per batch phase. The staged buffers are pre-filled with
+        # identity sentinels (row=capacity, +inf/-inf), so a pow2 prefix
+        # slice IS the padded array.
+        cap = max(1 << max(ns - 1, 0).bit_length(), 1)
+        stat_rows = self._imp_stat_rows[:cap]
+        stat_mins = self._imp_stat_mins[:cap]
+        stat_maxs = self._imp_stat_maxs[:cap]
         imp_rows, imp_means, imp_wts = (self._imp_rows, self._imp_means,
                                         self._imp_wts)
         self._new_import_buffers()
-        self._imp_stat_rows = []
-        self._imp_stat_mins = []
-        self._imp_stat_maxs = []
         self.temp, self.dmin, self.dmax = _ingest_centroids(
             self.temp, self.dmin, self.dmax,
             jnp.asarray(imp_rows), jnp.asarray(imp_means),
@@ -518,7 +538,9 @@ class DigestGroup:
         n = len(self.interner)
         interner, self.interner = self.interner, Interner()
         if n == 0:
-            if self._device_dirty:
+            if self._retired:
+                self._drop_device()
+            elif self._device_dirty:
                 # bulk paths can stage data without interning; never let
                 # it leak into the next interval's rows
                 self._init_device()
@@ -564,9 +586,18 @@ class DigestGroup:
             "max": vmax,
             "recip": recip,
         })
-        self._init_device()
-        self._init_staging()
+        if self._retired:
+            self._drop_device()
+        else:
+            self._init_device()
+            self._init_staging()
         return interner, out
+
+    def _drop_device(self):
+        """Free a retired generation's device state at the earliest
+        point (it is never read again)."""
+        self.digest = self.temp = self.dmin = self.dmax = None
+        self._device_dirty = False
 
 
 # ---------------------------------------------------------------------------
@@ -604,6 +635,8 @@ class SetGroup:
     14 a series costs 16 KiB of HBM, which is what bounds single-chip set
     cardinality — shard the series axis across a mesh to scale (SURVEY §5).
     """
+
+    _retired = False  # see DigestGroup._retired
 
     def __init__(self, capacity: int = DEFAULT_INITIAL_CAPACITY,
                  chunk: int = DEFAULT_CHUNK,
@@ -650,6 +683,10 @@ class SetGroup:
         """Grow so max_row is addressable (bulk paths bypass _row)."""
         while max_row >= self.capacity:
             self._grow()
+
+    def fresh(self) -> "SetGroup":
+        """Empty same-config twin (swap-on-flush generation swap)."""
+        return SetGroup(self.capacity, self.chunk, self.precision)
 
     def sample_many(self, rows: np.ndarray, hashes: np.ndarray):
         """Bulk staging append of pre-hashed members (uint64) from the
@@ -743,7 +780,10 @@ class SetGroup:
         n = len(self.interner)
         interner, self.interner = self.interner, Interner()
         if n == 0:
-            if self._device_dirty:
+            if self._retired:
+                self.registers = None
+                self._device_dirty = False
+            elif self._device_dirty:
                 self._reset_registers()
                 self._init_staging()
             return interner, None, None
@@ -751,8 +791,14 @@ class SetGroup:
                      if want_estimates else None)
         registers = (np.asarray(self.registers[:n], np.uint8)
                      if want_registers else None)
-        self._reset_registers()
-        self._init_staging()
+        if self._retired:
+            # retired generation: free the [S, 2^p] plane now instead of
+            # allocating a third one (16 KiB/series at p=14)
+            self.registers = None
+            self._device_dirty = False
+        else:
+            self._reset_registers()
+            self._init_staging()
         return interner, estimates, registers
 
     def _estimates(self):
@@ -788,6 +834,7 @@ class HeavyHitterGroup:
     """
 
     MEMO_LIMIT = 1 << 20
+    _retired = False  # see DigestGroup._retired
 
     def __init__(self, capacity: int = DEFAULT_INITIAL_CAPACITY,
                  chunk: int = DEFAULT_CHUNK, depth: int = 4,
@@ -810,6 +857,17 @@ class HeavyHitterGroup:
         # see CountMin.sids for why these must be instance-independent
         self._sids_np = np.zeros(capacity + 1, np.uint32)
         self._new_sample_buffers()
+
+    def fresh(self) -> "HeavyHitterGroup":
+        """Empty same-config twin (swap-on-flush generation swap);
+        reuses the instance-bound jitted programs so the swap never
+        retraces."""
+        g = HeavyHitterGroup(self.capacity, self.chunk, self.depth,
+                             self.width, self.k)
+        g._update = self._update
+        g._add_table = self._add_table
+        g._inject = self._inject
+        return g
 
     def _new_sample_buffers(self):
         self._rows = np.full(self.chunk, self.capacity, np.int32)
@@ -987,12 +1045,15 @@ class HeavyHitterGroup:
                     for key, row in interner.rows.items()
                     if row in by_row]
                 fwd = (table, series)
-        self.sketch = self._cm.init(self.capacity, self.depth, self.width,
-                                    self.k)
-        self._sids_np = np.zeros(self.capacity + 1, np.uint32)
+        if self._retired:
+            self.sketch = None  # free the table now, never reused
+        else:
+            self.sketch = self._cm.init(self.capacity, self.depth,
+                                        self.width, self.k)
+            self._sids_np = np.zeros(self.capacity + 1, np.uint32)
+            self._new_sample_buffers()
         self._device_dirty = False
         self._members.clear()
-        self._new_sample_buffers()
         return interner, out, fwd
 
 
@@ -1060,6 +1121,24 @@ class PackedDigestPlanes(NamedTuple):
         scale = np.repeat(span, counts)
         return base + self.means_q.astype(np.float64) * scale
 
+    def row_slices(self):
+        """Host-side dequantization for per-row consumers: returns
+        (starts, ends, means f64 [L], weights f64 [L]) so row r's
+        centroids are ``means[starts[r]:ends[r]]`` — the ONE place the
+        quantization contract is decoded in Python."""
+        counts = self.counts.astype(np.int64)
+        ends = np.cumsum(counts)
+        return (ends - counts, ends, self.means_f64(),
+                self.weights_f32().astype(np.float64))
+
+
+def _packed_planes_from_result(r: dict) -> PackedDigestPlanes:
+    """Assemble PackedDigestPlanes from a group's packed flush result."""
+    return PackedDigestPlanes(
+        r["packed_counts"], r["packed_means"], r["packed_weights"],
+        np.asarray(r["digest_min"], np.float32),
+        np.asarray(r["digest_max"], np.float32))
+
 
 @dataclass
 class ForwardableState:
@@ -1115,11 +1194,7 @@ class ForwardableState:
             out = getattr(self, attr)
             if isinstance(col[2], PackedDigestPlanes):
                 (nb, no, nl), (tb, to, tl), p = col
-                counts = p.counts.astype(np.int64)
-                ends = np.cumsum(counts)
-                starts = ends - counts
-                means_f = p.means_f64()
-                weights_f = p.weights_f32().astype(np.float64)
+                starts, ends, means_f, weights_f = p.row_slices()
                 for r in range(p.nrows):
                     name = nb[no[r]:no[r] + nl[r]].decode(
                         "utf-8", "replace")
@@ -1149,6 +1224,28 @@ _DIGEST_GROUPS = ("histograms", "timers", "local_histograms", "local_timers")
 _SET_GROUPS = ("sets", "local_sets")
 
 
+class _Generation:
+    """The retired group set a flush drains off-lock (swap-on-flush)."""
+
+    __slots__ = ("counters", "global_counters", "gauges", "global_gauges",
+                 "local_status_checks", "histograms", "timers",
+                 "local_histograms", "local_timers", "sets", "local_sets",
+                 "heavy_hitters", "processed", "imported")
+
+
+def _summarize(g) -> "MetricsSummary":
+    """Group-count summary for any group container (the live store or a
+    retired generation) — one mapping, two callers."""
+    return MetricsSummary(
+        counters=len(g.counters), gauges=len(g.gauges),
+        histograms=len(g.histograms), sets=len(g.sets),
+        timers=len(g.timers), global_counters=len(g.global_counters),
+        global_gauges=len(g.global_gauges),
+        local_histograms=len(g.local_histograms),
+        local_sets=len(g.local_sets), local_timers=len(g.local_timers),
+        local_status_checks=len(g.local_status_checks))
+
+
 class MetricStore:
     """All eleven scope-classes plus dispatch, flush and import logic."""
 
@@ -1161,6 +1258,9 @@ class MetricStore:
                  topk_depth: int = 4, topk_width: int = 1 << 16,
                  topk_k: int = 32):
         self._lock = threading.RLock()
+        # serializes whole flush() calls (the store lock itself is held
+        # only for the generation swap — see flush())
+        self._flush_gate = threading.Lock()
         self.mesh = mesh
         if mesh is not None and digest_storage == "slab":
             raise ValueError(
@@ -1562,9 +1662,9 @@ class MetricStore:
                     # module-level staging protocol
                     bulk_stage_import_centroids(
                         group, flat_rows, means, weights,
-                        list(grp_rows[stat_mask].astype(int)),
-                        list(dec.dmin[sel][stat_mask]),
-                        list(dec.dmax[sel][stat_mask]))
+                        grp_rows[stat_mask].astype(np.int32),
+                        dec.dmin[sel][stat_mask].astype(np.float32),
+                        dec.dmax[sel][stat_mask].astype(np.float32))
                     n_ok += len(sel)
                 except Exception:
                     n_err += len(sel)
@@ -1621,19 +1721,7 @@ class MetricStore:
     # -- flush -------------------------------------------------------------
 
     def summary(self) -> MetricsSummary:
-        return MetricsSummary(
-            counters=len(self.counters),
-            gauges=len(self.gauges),
-            histograms=len(self.histograms),
-            sets=len(self.sets),
-            timers=len(self.timers),
-            global_counters=len(self.global_counters),
-            global_gauges=len(self.global_gauges),
-            local_histograms=len(self.local_histograms),
-            local_sets=len(self.local_sets),
-            local_timers=len(self.local_timers),
-            local_status_checks=len(self.local_status_checks),
-        )
+        return _summarize(self)
 
     def flush(self, percentiles: List[float], aggregates: HistogramAggregates,
               is_local: bool, now: int, forward: bool = True,
@@ -1658,110 +1746,151 @@ class MetricStore:
         instead of fetching raw f32 [S,K] planes — the mode that fits
         the flush interval at 1M+ forwarded series. Only meaningful
         with columnar=True on a forwarding local.
+
+        SWAP-ON-FLUSH: the store lock is held only for the generation
+        swap (every group object replaced by an empty same-config twin
+        via ``fresh()``); the multi-second device programs and fetches
+        then run on the retired generation OFF-LOCK, so ingest
+        (process_batch / imports) never stalls behind a flush. This is
+        the reference's design point — a brief mutex swap of
+        WorkerMetrics, then flush off-lock (worker.go:402-429,
+        flusher.go:134-184) — which the round-3 build inverted.
+        ``_flush_gate`` serializes overlapping flush() calls so retired
+        generations drain in order.
         """
-        with self._lock:
-            ms = self.summary()
-            col: Optional["ColumnarFlush"] = None
-            if columnar:
-                from veneur_tpu.core.columnar import ColumnarFlush
+        with self._flush_gate:
+            with self._lock:
+                gen = self._swap_generation()
+            return self._flush_generation(
+                gen, percentiles, aggregates, is_local, now, forward,
+                forward_topk, columnar, digest_format)
 
-                col = ColumnarFlush(timestamp=now)
-                final = col.extras  # oddballs land in the legacy list
+    # every group swapped per flush, in flush order
+    _GEN_GROUPS = ("counters", "global_counters", "gauges", "global_gauges",
+                   "local_status_checks", "histograms", "timers",
+                   "local_histograms", "local_timers", "sets", "local_sets",
+                   "heavy_hitters")
+
+    def _swap_generation(self) -> "_Generation":
+        """Retire every group behind an empty twin; caller holds _lock.
+        Also snapshots the interval tallies and invalidates the native
+        intern memos (rows restart in the fresh interners)."""
+        gen = _Generation()
+        for attr in self._GEN_GROUPS:
+            old = getattr(self, attr)
+            old._retired = True  # its flush frees state, not reinits it
+            setattr(gen, attr, old)
+            setattr(self, attr, old.fresh())
+        gen.processed = self.processed
+        gen.imported = self.imported
+        self.processed = 0
+        self.imported = 0
+        self._kind_groups = None  # holds refs to the retired groups
+        if self._native_table is not None:
+            self._native_table.reset()
+        if self._mlist_table is not None:
+            self._mlist_table.reset()
+        return gen
+
+    def _flush_generation(self, g: "_Generation", percentiles, aggregates,
+                          is_local, now, forward, forward_topk, columnar,
+                          digest_format):
+        """Drain a retired generation into emissions + forwardable state.
+        Runs off-lock: ``g``'s groups are exclusively owned here."""
+        ms = _summarize(g)
+        ms.processed = g.processed
+        ms.imported = g.imported
+        col: Optional["ColumnarFlush"] = None
+        if columnar:
+            from veneur_tpu.core.columnar import ColumnarFlush
+
+            col = ColumnarFlush(timestamp=now)
+            final = col.extras  # oddballs land in the legacy list
+        else:
+            final = []
+        fwd = ForwardableState()
+
+        # counters & gauges (mixed scope) always flush locally
+        self._flush_scalars(g.counters, MetricType.COUNTER, final, now, col)
+        self._flush_scalars(g.gauges, MetricType.GAUGE, final, now, col)
+
+        # mixed histograms/timers: no percentiles on a local instance
+        mixed_pcts = [] if is_local else list(percentiles)
+        fwd_digests = is_local and forward
+        self._flush_digest_group(
+            g.histograms, mixed_pcts, aggregates, final, now,
+            fwd_list=fwd.histograms if fwd_digests else None,
+            col=col, fwd_state=fwd if fwd_digests else None,
+            fwd_attr="histograms_columnar", digest_format=digest_format)
+        self._flush_digest_group(
+            g.timers, mixed_pcts, aggregates, final, now,
+            fwd_list=fwd.timers if fwd_digests else None,
+            col=col, fwd_state=fwd if fwd_digests else None,
+            fwd_attr="timers_columnar", digest_format=digest_format)
+
+        # local-only histograms/timers: full flush with percentiles
+        self._flush_digest_group(g.local_histograms, list(percentiles),
+                                 aggregates, final, now, fwd_list=None,
+                                 col=col)
+        self._flush_digest_group(g.local_timers, list(percentiles),
+                                 aggregates, final, now, fwd_list=None,
+                                 col=col)
+
+        # local sets always flush; mixed sets flush only on a global
+        # instance (they are forwarded from locals)
+        self._flush_set_group(g.local_sets, final, now, fwd_list=None,
+                              col=col)
+        self._flush_set_group(
+            g.sets, final if not is_local else None, now,
+            fwd_list=fwd.sets if (is_local and forward) else None,
+            col=col if not is_local else None)
+
+        # heavy hitters follow the mixed-SET rule (flusher.go:231-249):
+        # a forwarding local ships its sketch upstream and does NOT
+        # emit — the global merges tables additively, re-ranks, and
+        # emits the fleet top-k under the same names (no double
+        # counting downstream). When the transport cannot carry the
+        # sketch (gRPC: forward_topk=False), the local emits its own
+        # view instead so the data is never silently dropped.
+        want_hh_fwd = is_local and forward and forward_topk
+        hh_interner, hh, hh_fwd = g.heavy_hitters.flush(
+            want_forward=want_hh_fwd)
+        fwd.topk = hh_fwd
+        if want_hh_fwd:
+            hh = []
+        for row, member, count in hh:
+            tags = hh_interner.tags[row]
+            final.append(InterMetric(
+                name=f"{hh_interner.names[row]}.topk", timestamp=now,
+                value=count, tags=list(tags) + [f"key:{member}"],
+                type=MetricType.COUNTER, sinks=route_info(tags)))
+
+        # status checks are always local
+        self._flush_status(g.local_status_checks, final, now)
+
+        # global counters/gauges: forwarded by locals, flushed by globals
+        if is_local:
+            if forward:
+                interner, values, _, _ = \
+                    g.global_counters.snapshot_and_reset()
+                for key, row in interner.rows.items():
+                    fwd.counters.append((key.name, interner.tags[row],
+                                         int(values[row])))
+                interner, values, _, _ = \
+                    g.global_gauges.snapshot_and_reset()
+                for key, row in interner.rows.items():
+                    fwd.gauges.append((key.name, interner.tags[row],
+                                       float(values[row])))
             else:
-                final = []
-            fwd = ForwardableState()
+                g.global_counters.snapshot_and_reset()
+                g.global_gauges.snapshot_and_reset()
+        else:
+            self._flush_scalars(g.global_counters, MetricType.COUNTER,
+                                final, now)
+            self._flush_scalars(g.global_gauges, MetricType.GAUGE,
+                                final, now)
 
-            # counters & gauges (mixed scope) always flush locally
-            self._flush_scalars(self.counters, MetricType.COUNTER, final,
-                                now, col)
-            self._flush_scalars(self.gauges, MetricType.GAUGE, final, now,
-                                col)
-
-            # mixed histograms/timers: no percentiles on a local instance
-            mixed_pcts = [] if is_local else list(percentiles)
-            fwd_digests = is_local and forward
-            self._flush_digest_group(
-                self.histograms, mixed_pcts, aggregates, final, now,
-                fwd_list=fwd.histograms if fwd_digests else None,
-                col=col, fwd_state=fwd if fwd_digests else None,
-                fwd_attr="histograms_columnar", digest_format=digest_format)
-            self._flush_digest_group(
-                self.timers, mixed_pcts, aggregates, final, now,
-                fwd_list=fwd.timers if fwd_digests else None,
-                col=col, fwd_state=fwd if fwd_digests else None,
-                fwd_attr="timers_columnar", digest_format=digest_format)
-
-            # local-only histograms/timers: full flush with percentiles
-            self._flush_digest_group(self.local_histograms, list(percentiles),
-                                     aggregates, final, now, fwd_list=None,
-                                     col=col)
-            self._flush_digest_group(self.local_timers, list(percentiles),
-                                     aggregates, final, now, fwd_list=None,
-                                     col=col)
-
-            # local sets always flush; mixed sets flush only on a global
-            # instance (they are forwarded from locals)
-            self._flush_set_group(self.local_sets, final, now, fwd_list=None,
-                                  col=col)
-            self._flush_set_group(
-                self.sets, final if not is_local else None, now,
-                fwd_list=fwd.sets if (is_local and forward) else None,
-                col=col if not is_local else None)
-
-            # heavy hitters follow the mixed-SET rule (flusher.go:231-249):
-            # a forwarding local ships its sketch upstream and does NOT
-            # emit — the global merges tables additively, re-ranks, and
-            # emits the fleet top-k under the same names (no double
-            # counting downstream). When the transport cannot carry the
-            # sketch (gRPC: forward_topk=False), the local emits its own
-            # view instead so the data is never silently dropped.
-            want_hh_fwd = is_local and forward and forward_topk
-            hh_interner, hh, hh_fwd = self.heavy_hitters.flush(
-                want_forward=want_hh_fwd)
-            fwd.topk = hh_fwd
-            if want_hh_fwd:
-                hh = []
-            for row, member, count in hh:
-                tags = hh_interner.tags[row]
-                final.append(InterMetric(
-                    name=f"{hh_interner.names[row]}.topk", timestamp=now,
-                    value=count, tags=list(tags) + [f"key:{member}"],
-                    type=MetricType.COUNTER, sinks=route_info(tags)))
-
-            # status checks are always local
-            self._flush_status(final, now)
-
-            # global counters/gauges: forwarded by locals, flushed by globals
-            if is_local:
-                if forward:
-                    interner, values, _, _ = self.global_counters.snapshot_and_reset()
-                    for key, row in interner.rows.items():
-                        fwd.counters.append((key.name, interner.tags[row],
-                                             int(values[row])))
-                    interner, values, _, _ = self.global_gauges.snapshot_and_reset()
-                    for key, row in interner.rows.items():
-                        fwd.gauges.append((key.name, interner.tags[row],
-                                           float(values[row])))
-                else:
-                    self.global_counters.snapshot_and_reset()
-                    self.global_gauges.snapshot_and_reset()
-            else:
-                self._flush_scalars(self.global_counters, MetricType.COUNTER,
-                                    final, now)
-                self._flush_scalars(self.global_gauges, MetricType.GAUGE,
-                                    final, now)
-
-            ms.processed = self.processed
-            ms.imported = self.imported
-            self.processed = 0
-            self.imported = 0
-            # every interner was reset, so the native tables' memoized
-            # rows are stale
-            if self._native_table is not None:
-                self._native_table.reset()
-            if self._mlist_table is not None:
-                self._mlist_table.reset()
-            return (col if col is not None else final), fwd, ms
+        return (col if col is not None else final), fwd, ms
 
     def _flush_scalars(self, group: ScalarGroup, mtype: MetricType,
                        out: List[InterMetric], now: int, col=None):
@@ -1783,9 +1912,9 @@ class MetricStore:
                 name=key.name, timestamp=now, value=float(values[row]),
                 tags=tags, type=mtype, sinks=route_info(tags)))
 
-    def _flush_status(self, out: List[InterMetric], now: int):
-        interner, values, messages, hostnames = \
-            self.local_status_checks.snapshot_and_reset()
+    def _flush_status(self, group: ScalarGroup, out: List[InterMetric],
+                      now: int):
+        interner, values, messages, hostnames = group.snapshot_and_reset()
         for key, row in interner.rows.items():
             tags = interner.tags[row]
             out.append(InterMetric(
@@ -1817,12 +1946,8 @@ class MetricStore:
                                               percentiles))
                 if fwd_state is not None:
                     if packed:
-                        setattr(fwd_state, fwd_attr, (
-                            names, tags, PackedDigestPlanes(
-                                r["packed_counts"], r["packed_means"],
-                                r["packed_weights"],
-                                np.asarray(r["digest_min"], np.float32),
-                                np.asarray(r["digest_max"], np.float32))))
+                        setattr(fwd_state, fwd_attr,
+                                (names, tags, _packed_planes_from_result(r)))
                     else:
                         setattr(fwd_state, fwd_attr, (
                             names, tags,
@@ -1834,15 +1959,8 @@ class MetricStore:
             # sink-routed rows present (rare): per-row path keeps routing
         if packed and fwd_list is not None:
             # dequantize once for the per-row fallback
-            pk = PackedDigestPlanes(
-                r["packed_counts"], r["packed_means"], r["packed_weights"],
-                np.asarray(r["digest_min"], np.float32),
-                np.asarray(r["digest_max"], np.float32))
-            pk_counts = pk.counts.astype(np.int64)
-            pk_ends = np.cumsum(pk_counts)
-            pk_starts = pk_ends - pk_counts
-            pk_means = pk.means_f64()
-            pk_weights = pk.weights_f32().astype(np.float64)
+            pk = _packed_planes_from_result(r)
+            pk_starts, pk_ends, pk_means, pk_weights = pk.row_slices()
         for key, row in interner.rows.items():
             tags = interner.tags[row]
             sinks = route_info(tags)

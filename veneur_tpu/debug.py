@@ -1,0 +1,180 @@
+"""Live debug endpoints: inspect a RUNNING server, not a shutdown dump.
+
+The reference mounts net/http/pprof on every mux
+(``/root/reference/http.go:43-48``, ``proxy.go:383-388``) and exposes
+mutex/block profile rates (``server.go:217-230``); a wedged instance can
+be profiled in place. The Python equivalents here:
+
+    GET /debug/threads              all-thread stack dump (goroutine dump)
+    GET /debug/profile?seconds=N    statistical profiler over ALL threads
+                                    (samples sys._current_frames; cProfile
+                                    only sees the calling thread, which is
+                                    useless for a server wedged elsewhere);
+                                    output is collapsed-stack lines, flame-
+                                    graph-ready, hottest stack first
+    GET /debug/vars                 JSON of store/lane/queue depths and
+                                    ingest counters (expvar's role)
+
+Mounted on both the server's OpsServer and the proxy's mux.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import threading
+import time
+import traceback
+from collections import Counter
+from typing import Dict, Tuple
+
+MAX_PROFILE_SECONDS = 60.0
+PROFILE_HZ = 200.0
+
+# one profile at a time: overlapping samplers would double the overhead
+# and interleave their results
+_profile_lock = threading.Lock()
+
+
+def dump_threads() -> str:
+    """Every live thread's stack, newest frame last (the SIGQUIT /
+    /debug/pprof/goroutine?debug=2 equivalent)."""
+    names = {t.ident: t for t in threading.enumerate()}
+    out = []
+    for ident, frame in sys._current_frames().items():
+        t = names.get(ident)
+        name = t.name if t else "?"
+        daemon = " daemon" if t is not None and t.daemon else ""
+        out.append(f"--- thread {ident} [{name}]{daemon} ---")
+        out.append("".join(traceback.format_stack(frame)).rstrip())
+        out.append("")
+    return "\n".join(out)
+
+
+def sample_profile(seconds: float, hz: float = PROFILE_HZ) -> str:
+    """Statistical whole-process profile: poll every thread's stack at
+    ``hz`` for ``seconds``, aggregate identical stacks. Lines are
+    ``frames;joined;by;semicolon <count>`` (collapsed-stack format)."""
+    seconds = max(0.1, min(float(seconds), MAX_PROFILE_SECONDS))
+    interval = 1.0 / hz
+    stacks: Counter = Counter()
+    me = threading.get_ident()
+    samples = 0
+    if not _profile_lock.acquire(timeout=1.0):
+        return "another profile is already running\n"
+    try:
+        deadline = time.perf_counter() + seconds
+        while time.perf_counter() < deadline:
+            for ident, frame in sys._current_frames().items():
+                if ident == me:
+                    continue
+                parts = []
+                f = frame
+                while f is not None:
+                    code = f.f_code
+                    parts.append(f"{code.co_filename.rsplit('/', 1)[-1]}"
+                                 f":{code.co_name}:{f.f_lineno}")
+                    f = f.f_back
+                stacks[";".join(reversed(parts))] += 1
+            samples += 1
+            time.sleep(interval)
+    finally:
+        _profile_lock.release()
+    head = (f"# {samples} sampling rounds over {seconds:.1f}s "
+            f"at {hz:.0f} Hz; one line per distinct stack\n")
+    body = "\n".join(f"{stack} {n}"
+                     for stack, n in stacks.most_common())
+    return head + body + "\n"
+
+
+def _group_depths(store) -> Dict[str, Dict[str, int]]:
+    out = {}
+    for attr in getattr(store, "_GEN_GROUPS", ()):
+        g = getattr(store, attr, None)
+        if g is None:
+            continue
+        d = {"series": len(g)}
+        for staged, key in (("_fill", "staged_samples"),
+                            ("_imp_fill", "staged_imports"),
+                            ("_imp_stat_fill", "staged_import_stats")):
+            v = getattr(g, staged, None)
+            if isinstance(v, int):
+                d[key] = v
+        cap = getattr(g, "capacity", None)
+        if isinstance(cap, int):
+            d["capacity"] = cap
+        out[attr] = d
+    return out
+
+
+def collect_vars(server) -> dict:
+    """Store/lane/queue depth snapshot (expvar's role). Every field is
+    best-effort: a debug endpoint must never take down the server."""
+    out: dict = {"time": time.time(),
+                 "threads": len(threading.enumerate())}
+    try:
+        store = getattr(server, "store", None)
+        if store is not None:
+            out["store"] = {
+                "processed_this_interval": store.processed,
+                "imported_this_interval": store.imported,
+                "groups": _group_depths(store),
+            }
+    except Exception as e:  # pragma: no cover - diagnostic only
+        out["store_error"] = repr(e)
+    for counter in ("packet_errors", "packet_drops"):
+        v = getattr(server, counter, None)
+        if v is not None:
+            out[counter] = v
+    try:
+        workers = getattr(server, "_span_workers", None) or ()
+        lanes = []
+        for w in workers:
+            q = getattr(w, "queue", None) or getattr(w, "_queue", None)
+            lanes.append({"depth": q.qsize() if q is not None else None})
+        if lanes:
+            out["span_lanes"] = lanes
+        ew = getattr(server, "event_worker", None)
+        q = getattr(ew, "queue", None) or getattr(ew, "_queue", None)
+        if q is not None:
+            out["event_queue_depth"] = q.qsize()
+    except Exception as e:  # pragma: no cover - diagnostic only
+        out["lanes_error"] = repr(e)
+    imp = getattr(server, "import_server", None)
+    if imp is not None:
+        out["grpc_import"] = {"received": imp.received,
+                              "errors": imp.import_errors}
+    return out
+
+
+def mount(add_route, server=None, extra_vars=None):
+    """Register the /debug/* routes on a mux via its add_route(path, fn).
+
+    Handlers receive the parsed query dict. ``extra_vars`` is an optional
+    callable returning a dict merged into /debug/vars (the proxy passes
+    its ring stats)."""
+
+    def threads(query) -> Tuple[int, str, str]:
+        return 200, dump_threads(), "text/plain"
+
+    def profile(query) -> Tuple[int, str, str]:
+        try:
+            seconds = float(query.get("seconds", "5"))
+        except ValueError:
+            return 400, "seconds must be a number", "text/plain"
+        return 200, sample_profile(seconds), "text/plain"
+
+    def dvars(query) -> Tuple[int, str, str]:
+        data = collect_vars(server) if server is not None else {
+            "time": time.time(),
+            "threads": len(threading.enumerate())}
+        if extra_vars is not None:
+            try:
+                data.update(extra_vars())
+            except Exception as e:  # pragma: no cover
+                data["extra_vars_error"] = repr(e)
+        return 200, json.dumps(data, default=str), "application/json"
+
+    add_route("/debug/threads", threads)
+    add_route("/debug/profile", profile)
+    add_route("/debug/vars", dvars)

@@ -603,8 +603,13 @@ def encode_digest_metrics_packed(names: Tuple[bytes, np.ndarray, np.ndarray],
     dmins = np.ascontiguousarray(planes.dmin, np.float32)
     dmaxs = np.ascontiguousarray(planes.dmax, np.float32)
     nrows = len(counts)
-    assert int(counts.astype(np.int64).sum()) == len(means_q) == \
-        len(weights_bf)
+    total = int(counts.astype(np.int64).sum())
+    if not (total == len(means_q) == len(weights_bf)):
+        # wire-boundary invariant: the C++ walker advances by counts and
+        # would read out of bounds (must survive python -O)
+        raise ValueError(
+            f"packed planes inconsistent: sum(counts)={total}, "
+            f"means={len(means_q)}, weights={len(weights_bf)}")
     name_arena, name_off, name_len = names
     tags_arena, tags_off, tags_len = tags
     name_off, name_len = _u32a(name_off), _u32a(name_len)

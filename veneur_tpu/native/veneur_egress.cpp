@@ -1344,6 +1344,68 @@ extern "C" uint32_t vt_mintern_assign(MTable* t, const VtMetricBatch* b,
 // (protobuf messages concatenate, so the Python side can append scalar/set
 // metrics serialized by protobuf to any one body).
 
+namespace {
+
+// shared Metric framing for the two digest encoders: the size pass and
+// the write pass MUST stay byte-exact with each other, so both live here
+
+uint64_t metric_header_size(uint32_t name_n, const char* tags, uint32_t tlen,
+                            uint8_t pb_type) {
+  uint64_t sz = 1 + varint_size(name_n) + name_n;
+  uint32_t i = 0;
+  while (i < tlen) {  // tags: split joined on ','
+    uint32_t j = i;
+    while (j < tlen && tags[j] != ',') j++;
+    uint32_t n = j - i;
+    sz += 1 + varint_size(n) + n;
+    i = j + 1;
+  }
+  if (pb_type) sz += 1 + varint_size(pb_type);
+  return sz;
+}
+
+// chunk-split check + MetricList.metrics record open
+void open_metric_record(Buf& body, VtBodiesImpl* impl, uint64_t metric_sz,
+                        uint64_t max_body_bytes) {
+  if (body.len &&
+      body.len + metric_sz + 1 + varint_size(metric_sz) > max_body_bytes) {
+    impl->lens.push_back(body.len);
+    impl->ptrs.push_back(body.take());
+  }
+  put_varint(body, (1 << 3) | 2);  // MetricList.metrics
+  put_varint(body, metric_sz);
+}
+
+// Metric.name + Metric.tags + Metric.type, then the t_digest envelope
+void write_digest_metric_header(Buf& body, const char* name, uint32_t name_n,
+                                const char* tags, uint32_t tlen,
+                                uint8_t pb_type, uint64_t td_sz) {
+  put_varint(body, (1 << 3) | 2);  // Metric.name
+  put_varint(body, name_n);
+  body.put(name, name_n);
+  uint32_t i = 0;
+  while (i < tlen) {
+    uint32_t j = i;
+    while (j < tlen && tags[j] != ',') j++;
+    uint32_t n = j - i;
+    put_varint(body, (2 << 3) | 2);  // Metric.tags
+    put_varint(body, n);
+    body.put(tags + i, n);
+    i = j + 1;
+  }
+  if (pb_type) {
+    put_varint(body, (3 << 3) | 0);  // Metric.type
+    put_varint(body, pb_type);
+  }
+  uint64_t hv_sz = 1 + varint_size(td_sz) + td_sz;
+  put_varint(body, (7 << 3) | 2);  // Metric.histogram
+  put_varint(body, hv_sz);
+  put_varint(body, (1 << 3) | 2);  // HistogramValue.t_digest
+  put_varint(body, td_sz);
+}
+
+}  // namespace
+
 extern "C" VtBodies* vt_mlist_encode_digests(
     const char* name_arena, const uint32_t* name_off, const uint32_t* name_len,
     const char* tags_arena, const uint32_t* tags_off, const uint32_t* tags_len,
@@ -1373,55 +1435,15 @@ extern "C" VtBodies* vt_mlist_encode_digests(
       if (reference_compat) td_sz += nc * 20;  // Centroid{mean,weight} = 18+2
     }
     uint64_t hv_sz = 1 + varint_size(td_sz) + td_sz;  // HistogramValue.t_digest
-    uint64_t metric_sz = 1 + varint_size(name_len[r]) + name_len[r];
-    // tags: split joined on ','
     const char* tags = tags_arena + tags_off[r];
     uint32_t tlen = tags_len[r];
-    {
-      uint32_t i = 0;
-      while (i < tlen) {
-        uint32_t j = i;
-        while (j < tlen && tags[j] != ',') j++;
-        uint32_t n = j - i;
-        metric_sz += 1 + varint_size(n) + n;
-        i = j + 1;
-      }
-    }
-    if (pb_type) metric_sz += 1 + varint_size(pb_type);
-    metric_sz += 1 + varint_size(hv_sz) + hv_sz;
-
-    if (body.len &&
-        body.len + metric_sz + 1 + varint_size(metric_sz) > max_body_bytes) {
-      impl->lens.push_back(body.len);
-      impl->ptrs.push_back(body.take());
-    }
+    uint64_t metric_sz = metric_header_size(name_len[r], tags, tlen, pb_type)
+                         + 1 + varint_size(hv_sz) + hv_sz;
 
     // --- write
-    put_varint(body, (1 << 3) | 2);  // MetricList.metrics
-    put_varint(body, metric_sz);
-    put_varint(body, (1 << 3) | 2);  // Metric.name
-    put_varint(body, name_len[r]);
-    body.put(name_arena + name_off[r], name_len[r]);
-    {
-      uint32_t i = 0;
-      while (i < tlen) {
-        uint32_t j = i;
-        while (j < tlen && tags[j] != ',') j++;
-        uint32_t n = j - i;
-        put_varint(body, (2 << 3) | 2);  // Metric.tags
-        put_varint(body, n);
-        body.put(tags + i, n);
-        i = j + 1;
-      }
-    }
-    if (pb_type) {
-      put_varint(body, (3 << 3) | 0);  // Metric.type
-      put_varint(body, pb_type);
-    }
-    put_varint(body, (7 << 3) | 2);  // Metric.histogram
-    put_varint(body, hv_sz);
-    put_varint(body, (1 << 3) | 2);  // HistogramValue.t_digest
-    put_varint(body, td_sz);
+    open_metric_record(body, impl, metric_sz, max_body_bytes);
+    write_digest_metric_header(body, name_arena + name_off[r], name_len[r],
+                               tags, tlen, pb_type, td_sz);
     if (nc && reference_compat) {
       for (uint32_t k : live) {  // tdigest.main_centroids (reference schema)
         put_varint(body, (1 << 3) | 2);
@@ -1500,54 +1522,15 @@ extern "C" VtBodies* vt_mlist_encode_digests_packed(
       }
     }
     uint64_t hv_sz = 1 + varint_size(td_sz) + td_sz;  // HistogramValue.t_digest
-    uint64_t metric_sz = 1 + varint_size(name_len[r]) + name_len[r];
     const char* tags = tags_arena + tags_off[r];
     uint32_t tlen = tags_len[r];
-    {
-      uint32_t i = 0;
-      while (i < tlen) {
-        uint32_t j = i;
-        while (j < tlen && tags[j] != ',') j++;
-        uint32_t n = j - i;
-        metric_sz += 1 + varint_size(n) + n;
-        i = j + 1;
-      }
-    }
-    if (pb_type) metric_sz += 1 + varint_size(pb_type);
-    metric_sz += 1 + varint_size(hv_sz) + hv_sz;
-
-    if (body.len &&
-        body.len + metric_sz + 1 + varint_size(metric_sz) > max_body_bytes) {
-      impl->lens.push_back(body.len);
-      impl->ptrs.push_back(body.take());
-    }
+    uint64_t metric_sz = metric_header_size(name_len[r], tags, tlen, pb_type)
+                         + 1 + varint_size(hv_sz) + hv_sz;
 
     // --- write
-    put_varint(body, (1 << 3) | 2);  // MetricList.metrics
-    put_varint(body, metric_sz);
-    put_varint(body, (1 << 3) | 2);  // Metric.name
-    put_varint(body, name_len[r]);
-    body.put(name_arena + name_off[r], name_len[r]);
-    {
-      uint32_t i = 0;
-      while (i < tlen) {
-        uint32_t j = i;
-        while (j < tlen && tags[j] != ',') j++;
-        uint32_t n = j - i;
-        put_varint(body, (2 << 3) | 2);  // Metric.tags
-        put_varint(body, n);
-        body.put(tags + i, n);
-        i = j + 1;
-      }
-    }
-    if (pb_type) {
-      put_varint(body, (3 << 3) | 0);  // Metric.type
-      put_varint(body, pb_type);
-    }
-    put_varint(body, (7 << 3) | 2);  // Metric.histogram
-    put_varint(body, hv_sz);
-    put_varint(body, (1 << 3) | 2);  // HistogramValue.t_digest
-    put_varint(body, td_sz);
+    open_metric_record(body, impl, metric_sz, max_body_bytes);
+    write_digest_metric_header(body, name_arena + name_off[r], name_len[r],
+                               tags, tlen, pb_type, td_sz);
     double mn = static_cast<double>(dmins[r]);
     double span = (static_cast<double>(dmaxs[r]) - mn) / 65535.0;
     if (!std::isfinite(span)) span = 0.0;
