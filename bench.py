@@ -38,6 +38,7 @@ Prints exactly one JSON line on stdout.
 import ctypes
 import json
 import os
+import re
 import subprocess
 import sys
 import time
@@ -1041,10 +1042,38 @@ def run_isolated(fn_name: str, timeout: float = 560.0):
         return {"error": str(e)[:160]}
 
 
+def run_tpu_smoke(timeout: float = 560.0) -> dict:
+    """Run the @pytest.mark.tpu hardware subset in the bench environment
+    (VENEUR_TPU_TESTS=1 → real accelerator) and report pass/fail — each
+    round's artifact then shows hardware-verified correctness, not only
+    CPU-verified (VERDICT round-3 weak #5)."""
+    env = dict(os.environ)
+    env["VENEUR_TPU_TESTS"] = "1"
+    env.pop("JAX_PLATFORMS", None)
+    try:
+        r = subprocess.run(
+            [sys.executable, "-m", "pytest", "tests/test_tpu_smoke.py",
+             "-q", "--no-header"],
+            capture_output=True, timeout=timeout, text=True, cwd=_HERE,
+            env=env)
+        tail = [ln for ln in r.stdout.strip().splitlines() if ln][-1]
+        m = re.search(r"(\d+) passed", tail)
+        n_passed = int(m.group(1)) if m else 0
+        # an all-skipped run (e.g. jax fell back to CPU) exits 0 but
+        # verified NOTHING on hardware — that must read as not-ok
+        return {"ok": r.returncode == 0 and n_passed > 0,
+                "result": tail.strip("= ")}
+    except Exception as e:  # pragma: no cover
+        return {"ok": False, "result": f"smoke run failed: {e}"[:160]}
+
+
 def _run_all(result):
     base_us, base_src = measure_scalar_baseline_us()
     result["baseline_us_per_series"] = round(base_us, 2)
     result["baseline_source"] = base_src
+    # hardware-verified correctness first: the kernels the benches time
+    # must be RIGHT on this chip before any number matters
+    result["tpu_smoke"] = run_tpu_smoke()
 
     def guarded(fn, *args):
         # the headline line must print even if one config dies
@@ -1103,6 +1132,60 @@ def _run_all(result):
         "bench_heavy_hitters_100m")
 
 
+def _headline(result) -> dict:
+    """Compact summary that must survive the driver's 2000-byte tail cap
+    (BENCH_r03.json lost its headline to truncation — VERDICT round-3
+    weak #7): metric/value/vs_baseline, the north-star configs' key
+    numbers, and the hardware-smoke verdict. Full configs live in
+    BENCH_DETAIL.json."""
+    c = result.get("configs", {})
+
+    def pick(cfg, *keys):
+        d = c.get(cfg) or {}
+        return {k: d[k] for k in keys if k in d} or \
+            ({"error": d["error"][:60]} if "error" in d else {})
+
+    head = {
+        "metric": result.get("metric"),
+        "value": result.get("value"),
+        "unit": result.get("unit"),
+        "vs_baseline": result.get("vs_baseline"),
+        "tpu_smoke": result.get("tpu_smoke"),
+        "summary": {
+            "2_histo": pick("2_histo_4m", "p50_ms", "p99_ms", "series"),
+            "2b_10m_bf16": pick("2b_histo_10m_bf16", "p50_ms", "p99_ms"),
+            "2c_merge_10m": pick("2c_merge_global_10m", "merge_p50_ms",
+                                 "flush_p50_ms"),
+            "2d_import": pick("2d_import_grpc", "series_merged_per_s",
+                              "store_path_series_per_s"),
+            "2e_forward_1m": pick("2e_forward_1m", "total_s",
+                                  "est_total_s_on_pcie_host",
+                                  "within_interval_on_pcie_host",
+                                  "merged_ok"),
+            "5b_topk_100m": pick("5b_heavy_hitters_100m",
+                                 "updates_per_s", "recall_at_64"),
+            "6_egress_1m": pick("6_egress_1m", "total_s"),
+        },
+        "detail_file": "BENCH_DETAIL.json",
+    }
+    if "truncated_by_signal" in result:
+        head["truncated_by_signal"] = result["truncated_by_signal"]
+    return head
+
+
+def _emit(result):
+    """Full detail to BENCH_DETAIL.json + stderr; the compact headline
+    is the LAST stdout line so a tail-capped capture always parses."""
+    detail = json.dumps(result)
+    try:
+        with open(os.path.join(_HERE, "BENCH_DETAIL.json"), "w") as f:
+            f.write(detail + "\n")
+    except OSError as e:  # pragma: no cover
+        print(f"could not write BENCH_DETAIL.json: {e}", file=sys.stderr)
+    print(detail, file=sys.stderr, flush=True)
+    print(json.dumps(_headline(result)), flush=True)
+
+
 def main():
     import signal
     import threading
@@ -1123,7 +1206,7 @@ def main():
 
     def emit_and_exit(signum, frame):  # pragma: no cover - timeout path
         result.setdefault("truncated_by_signal", signum)
-        print(json.dumps(result), flush=True)
+        _emit(result)
         os._exit(0)
 
     signal.signal(signal.SIGTERM, emit_and_exit)
@@ -1133,7 +1216,7 @@ def main():
     worker.start()
     while worker.is_alive():
         worker.join(0.2)
-    print(json.dumps(result))
+    _emit(result)
 
 
 if __name__ == "__main__":

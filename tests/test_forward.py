@@ -358,6 +358,43 @@ class TestOpsServer:
         assert self.post(server, b"x", {"Content-Encoding": "deflate"})[0] == 400
         assert self.post(server, b"[]", {"Content-Encoding": "gzip"})[0] == 400
 
+    def test_import_backpressure_sheds_with_429(self):
+        # bounded merge queue: POSTs past capacity shed with 429 and a
+        # counted drop instead of spawning unbounded threads
+        # (reference analogue: bounded worker channels, http.go:54-142)
+        import threading
+
+        gate = threading.Event()
+
+        def blocked_import(metrics):
+            gate.wait(30)
+            return len(metrics)
+
+        server = OpsServer("127.0.0.1:0", import_fn=blocked_import,
+                           import_workers=1, import_queue=2)
+        server.start()
+        try:
+            body = json.dumps([{"name": "bp", "type": "counter",
+                                "tags": [], "value": 1}]).encode()
+            statuses = [self.post(server, body)[0] for _ in range(8)]
+            # 1 in-worker + 2 queued accepted; the rest shed
+            assert statuses.count(202) <= 4
+            assert statuses.count(429) >= 4
+            assert server.import_pool.shed >= 4
+            assert server.import_pool.qsize() <= 2
+            n_threads_during = threading.active_count()
+            gate.set()
+            deadline = time.time() + 10
+            while (server.import_pool.merged_batches
+                   < statuses.count(202) and time.time() < deadline):
+                time.sleep(0.01)
+            assert server.import_pool.merged_batches == statuses.count(202)
+            # bounded: no thread-per-POST pileup
+            assert n_threads_during < 20
+        finally:
+            gate.set()
+            server.stop()
+
     def test_import_decompression_bomb_rejected(self, ops, monkeypatch):
         # a small deflate body must not inflate past the configured cap
         # (unauthenticated endpoint; cf. ADVICE round-3)

@@ -281,3 +281,74 @@ class TestFlushTicker:
             assert batch[0].name == "tick"
         finally:
             server.shutdown()
+
+
+class TestSighupReload:
+    """Graceful in-process reload (the reference's HUP path,
+    server.go:1048-1076): hot-swap sinks/interval/percentiles, keep
+    sockets, store state, and frozen geometry."""
+
+    def test_reload_swaps_tunables_and_keeps_sockets(self):
+        server, sink = make_server(percentiles=[0.5], tags=["env:a"])
+        try:
+            from veneur_tpu.samplers import parser as p
+
+            old_addrs = list(server.statsd_addrs)
+            old_store = server.store
+            server.store.process_metric(p.parse_metric(b"pre:1|c"))
+
+            new_cfg = Config(
+                statsd_listen_addresses=["udp://127.0.0.1:0"],
+                interval="7s", percentiles=[0.9], tags=["env:b"],
+                aggregates=["count"], store_initial_capacity=32,
+                store_chunk=128,
+                # frozen key change must be rejected, not applied
+                digest_storage="slab")
+            server.reload(new_cfg)
+
+            assert server.interval == 7.0
+            assert server.histogram_percentiles == [0.9]
+            assert server.tags == ["env:b"]
+            # sockets and store survive; frozen geometry kept
+            assert server.statsd_addrs == old_addrs
+            assert server.store is old_store
+            assert server.config.digest_storage == "dense"
+            # injected sinks survive the reload
+            assert sink in server.metric_sinks
+            # pre-reload data still flushes
+            server.flush()
+            names = {m.name for m in sink.get_flush()}
+            assert "pre" in names
+            # ingest keeps working on the same socket
+            s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+            s.sendto(b"post:1|c", server.statsd_addrs[0])
+            deadline = time.time() + 5
+            while server.store.processed < 1 and time.time() < deadline:
+                time.sleep(0.01)
+            assert server.store.processed >= 1
+        finally:
+            server.shutdown()
+
+    def test_reload_rebuilds_forwarder(self):
+        server, _ = make_server(forward_address="127.0.0.1:1",
+                                forward_use_grpc=True)
+        try:
+            first = server._forwarder
+            assert first is not None
+            cfg = Config(statsd_listen_addresses=["udp://127.0.0.1:0"],
+                         interval="86400s", store_initial_capacity=32,
+                         store_chunk=128,
+                         forward_address="127.0.0.1:2",
+                         forward_use_grpc=True)
+            server.reload(cfg)
+            assert server._forwarder is not None
+            assert server._forwarder is not first
+            assert server.forward_fn is not None
+            # role change is refused
+            cfg2 = Config(statsd_listen_addresses=["udp://127.0.0.1:0"],
+                          interval="86400s", store_initial_capacity=32,
+                          store_chunk=128)
+            server.reload(cfg2)
+            assert server.config.forward_address  # still local
+        finally:
+            server.shutdown()

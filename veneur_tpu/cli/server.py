@@ -33,9 +33,6 @@ def main(argv=None) -> int:
         format="%(asctime)s %(levelname)s %(name)s %(message)s")
 
     server = Server(config)
-    server.start()
-    log.info("Starting server on %s (statsd) / %s (ssf)",
-             server.statsd_addrs, server.ssf_addrs)
 
     done = threading.Event()
 
@@ -43,8 +40,40 @@ def main(argv=None) -> int:
         log.info("Received signal %d, shutting down", signum)
         done.set()
 
+    def handle_hup(signum, frame):
+        # graceful in-process reload (reference HUP path,
+        # server.go:1048-1076): re-read the file, hot-swap what can be
+        # swapped, keep sockets and store state. Runs on a thread so the
+        # signal handler never blocks in sink construction.
+        def do_reload():
+            try:
+                new_cfg = read_config(args.config)
+            except Exception as e:
+                log.error("SIGHUP reload: config re-read failed, keeping "
+                          "the running config: %s", e)
+                return
+            try:
+                server.reload(new_cfg)
+            except Exception:
+                log.exception("SIGHUP reload failed; continuing with the "
+                              "previous configuration")
+
+        log.info("Received SIGHUP, reloading configuration from %s",
+                 args.config)
+        threading.Thread(target=do_reload, name="config-reload",
+                         daemon=True).start()
+
+    # register handlers BEFORE the (slow: jax init + first compiles)
+    # server start, so a signal during startup hits the handler rather
+    # than the default action killing the half-started process
     signal.signal(signal.SIGTERM, handle_signal)
     signal.signal(signal.SIGINT, handle_signal)
+    if hasattr(signal, "SIGHUP"):
+        signal.signal(signal.SIGHUP, handle_hup)
+
+    server.start()
+    log.info("Starting server on %s (statsd) / %s (ssf)",
+             server.statsd_addrs, server.ssf_addrs)
 
     # HTTPServe/gRPCServe when configured, else block forever
     # (cmd/veneur/main.go:66-88)

@@ -144,6 +144,12 @@ def collect_vars(server) -> dict:
     if imp is not None:
         out["grpc_import"] = {"received": imp.received,
                               "errors": imp.import_errors}
+    ops = getattr(server, "ops_server", None)
+    pool = getattr(ops, "import_pool", None)
+    if pool is not None:
+        out["http_import"] = {"queue_depth": pool.qsize(),
+                              "merged_batches": pool.merged_batches,
+                              "shed_batches": pool.shed}
     return out
 
 

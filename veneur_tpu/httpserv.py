@@ -17,8 +17,10 @@ from __future__ import annotations
 
 import json
 import logging
+import queue
 import threading
 import time
+import urllib.parse
 import zlib
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, List, Optional
@@ -100,20 +102,22 @@ class _Handler(BaseHTTPRequestHandler):
 
     def do_GET(self):
         self._drain_body()
-        if self.path == "/healthcheck":
+        path, _, qs = self.path.partition("?")
+        if path == "/healthcheck":
             self._reply(200, "ok")
-        elif self.path == "/version":
+        elif path == "/version":
             self._reply(200, __version__)
-        elif self.path == "/builddate":
+        elif path == "/builddate":
             self._reply(200, BUILD_DATE)
         else:
-            extra = self.server.veneur_get_routes.get(self.path)
+            extra = self.server.veneur_get_routes.get(path)
             if extra is not None:
+                query = dict(urllib.parse.parse_qsl(qs))
                 try:
-                    status, body, ctype = extra()
+                    status, body, ctype = extra(query)
                     self._reply(status, body, ctype)
                 except Exception as e:
-                    log.exception("handler for %s failed", self.path)
+                    log.exception("handler for %s failed", path)
                     self._reply(500, str(e))
             else:
                 self._reply(404, "not found")
@@ -123,8 +127,8 @@ class _Handler(BaseHTTPRequestHandler):
         if self.path != "/import":
             self._reply(404, "not found")
             return
-        handle = self.server.veneur_import
-        if handle is None:
+        pool = self.server.veneur_import_pool
+        if pool is None:
             self._reply(404, "import not enabled on this instance")
             return
         try:
@@ -135,34 +139,104 @@ class _Handler(BaseHTTPRequestHandler):
         # extract the forwarder's trace context so the import span
         # stitches into the local's flush trace (handlers_global.go:125)
         carrier = {k.lower(): v for k, v in self.headers.items()}
-        # accept, then merge off the request thread — the reference's
-        # ``go s.ImportMetrics`` (http.go:54-60); a merge blocked behind a
-        # long flush must not hold the forwarder's POST open
-        self._reply(202, "accepted")
-        threading.Thread(target=self._merge,
-                         args=(handle, metrics, carrier,
-                               self.server.veneur_trace_client),
-                         daemon=True).start()
+        # merge off the request thread (the reference's
+        # ``go s.ImportMetrics``, http.go:54-60) — but through a BOUNDED
+        # worker pool, not an unbounded thread per POST: a 64-host fleet
+        # hitting a slow interval must shed (429), not pile up threads
+        # and bodies without limit (cf. the reference's bounded worker
+        # channels, http.go:54-142)
+        if pool.submit(metrics, carrier):
+            self._reply(202, "accepted")
+        else:
+            self._reply(429, "import queue full; retry next interval")
 
-    @staticmethod
-    def _merge(handle, metrics, carrier=None, trace_client=None):
-        from veneur_tpu import trace as vtrace
-        from veneur_tpu.trace import samples as ssf_samples
+def _merge_one(handle, metrics, carrier=None, trace_client=None):
+    from veneur_tpu import trace as vtrace
+    from veneur_tpu.trace import samples as ssf_samples
 
-        span = vtrace.from_headers(carrier or {}, resource="veneur.import")
-        span.name = "import"
+    span = vtrace.from_headers(carrier or {}, resource="veneur.import")
+    span.name = "import"
+    try:
+        n_ok = handle(metrics)
+        if not isinstance(n_ok, int):  # span-unaware import callables
+            n_ok = len(metrics)
+        span.add(ssf_samples.count("veneur.import.metrics_total",
+                                   float(n_ok), None))
+    except Exception as e:
+        span.error(e)
+        log.exception("import failed")
+    finally:
+        span.finish()
+        span.client_record(trace_client)
+
+
+class ImportQueuePool:
+    """Bounded merge queue + worker pool behind ``POST /import``.
+
+    The reference chunks import bodies into bounded worker channels
+    (``/root/reference/http.go:54-142``); the analogue here is a fixed
+    worker pool draining a bounded queue. When the queue is full the
+    POST sheds with 429 instead of accumulating threads and request
+    bodies without bound (a 64-host fleet in one slow interval would
+    otherwise pile up arbitrarily). ``shed`` counts rejected batches."""
+
+    def __init__(self, handle, workers: int = 2, max_queue: int = 64,
+                 trace_client=None):
+        self._handle = handle
+        self._trace_client = trace_client
+        self._q: "queue.Queue" = queue.Queue(maxsize=max_queue)
+        self.shed = 0
+        self.merged_batches = 0
+        self._stopping = threading.Event()
+        self._lock = threading.Lock()
+        self._workers = [
+            threading.Thread(target=self._worker,
+                             name=f"import-merge-{i}", daemon=True)
+            for i in range(max(1, workers))]
+        for t in self._workers:
+            t.start()
+
+    def submit(self, metrics, carrier) -> bool:
+        """Enqueue one decoded batch; False = queue full (or the pool is
+        stopping), shed it."""
+        if self._stopping.is_set():
+            return False
         try:
-            n_ok = handle(metrics)
-            if not isinstance(n_ok, int):  # span-unaware import callables
-                n_ok = len(metrics)
-            span.add(ssf_samples.count("veneur.import.metrics_total",
-                                       float(n_ok), None))
-        except Exception as e:
-            span.error(e)
-            log.exception("import failed")
-        finally:
-            span.finish()
-            span.client_record(trace_client)
+            self._q.put_nowait((metrics, carrier))
+            return True
+        except queue.Full:
+            with self._lock:
+                self.shed += 1
+            return False
+
+    def qsize(self) -> int:
+        return self._q.qsize()
+
+    def _worker(self):
+        while True:
+            item = self._q.get()
+            if item is None:
+                return
+            if self._stopping.is_set():
+                continue  # drain without merging; exit on sentinel
+            metrics, carrier = item
+            _merge_one(self._handle, metrics, carrier, self._trace_client)
+            with self._lock:
+                self.merged_batches += 1
+
+    def stop(self):
+        # never block on a full queue (a worker wedged inside the merge
+        # handle would deadlock shutdown): flag first — workers then
+        # drain without merging — and treat an unplaceable sentinel as
+        # the bounded join's problem
+        self._stopping.set()
+        for _ in self._workers:
+            try:
+                self._q.put_nowait(None)
+            except queue.Full:
+                break  # workers draining under _stopping will free slots
+        for t in self._workers:
+            t.join(timeout=5.0)
 
 
 class OpsServer:
@@ -175,12 +249,18 @@ class OpsServer:
 
     def __init__(self, addr: str = "127.0.0.1:0",
                  import_fn: Optional[Callable[[List[dict]], None]] = None,
-                 trace_client=None):
+                 trace_client=None, import_workers: int = 2,
+                 import_queue: int = 64):
         host, _, port = addr.rpartition(":")
         self._httpd = ThreadingHTTPServer((host or "127.0.0.1", int(port)),
                                           _Handler)
         self._httpd.daemon_threads = True
-        self._httpd.veneur_import = import_fn
+        self.import_pool = (
+            ImportQueuePool(import_fn, workers=import_workers,
+                            max_queue=import_queue,
+                            trace_client=trace_client)
+            if import_fn is not None else None)
+        self._httpd.veneur_import_pool = self.import_pool
         self._httpd.veneur_trace_client = trace_client
         self._httpd.veneur_get_routes = {}
         self._thread: Optional[threading.Thread] = None
@@ -198,14 +278,18 @@ class OpsServer:
 
         ops = cls(addr, import_fn=import_metrics,
                   trace_client=getattr(server, "trace_client", None))
-        ops.add_route("/config", lambda: (
+        ops.add_route("/config", lambda query: (
             200, json.dumps({k: v for k, v in vars(server.config).items()
                              if "key" not in k and "secret" not in k
                              and "token" not in k and "dsn" not in k}),
             "application/json"))
+        from veneur_tpu import debug
+
+        debug.mount(ops.add_route, server=server)
         return ops
 
     def add_route(self, path: str, fn: Callable):
+        """fn(query: dict) -> (status, body, content_type)."""
         self._httpd.veneur_get_routes[path] = fn
 
     @property
@@ -221,3 +305,5 @@ class OpsServer:
     def stop(self):
         self._httpd.shutdown()
         self._httpd.server_close()
+        if self.import_pool is not None:
+            self.import_pool.stop()

@@ -54,8 +54,20 @@ class _ProxyHandler(BaseHTTPRequestHandler):
 
     def do_GET(self):
         self._drain_body()
-        if self.path == "/healthcheck":
+        path, _, qs = self.path.partition("?")
+        if path == "/healthcheck":
             self._reply(200, "ok")
+            return
+        extra = getattr(self.server, "veneur_get_routes", {}).get(path)
+        if extra is not None:
+            import urllib.parse
+
+            try:
+                status, body, _ = extra(dict(urllib.parse.parse_qsl(qs)))
+                self._reply(status, body)
+            except Exception as e:
+                log.exception("handler for %s failed", path)
+                self._reply(500, str(e))
         else:
             self._reply(404, "not found")
 
@@ -137,6 +149,11 @@ class Proxy:
                 self.trace_ring.set_members([config.trace_address])
         self._stop = threading.Event()
         self._httpd: Optional[ThreadingHTTPServer] = None
+        # gRPC listener (proxysrv.Server flavor), started when
+        # grpc_forward_address is configured; its ring follows the same
+        # discovery refresh as the HTTP ring (proxysrv/server.go:147-177)
+        self.grpc_server = None
+        self._last_destinations: List[str] = []
         self._threads: List[threading.Thread] = []
         # telemetry
         self.proxied = 0
@@ -176,6 +193,11 @@ class Proxy:
                         len(ring))
             return
         ring.set_members(destinations)
+        if ring is self.ring:
+            self._last_destinations = list(destinations)
+            if self.grpc_server is not None:
+                # the gRPC flavor shares the metrics ring's membership
+                self.grpc_server.set_destinations(destinations)
 
     def _refresh_loop(self):
         while not self._stop.wait(self.refresh_interval):
@@ -272,15 +294,45 @@ class Proxy:
                                           _ProxyHandler)
         self._httpd.daemon_threads = True
         self._httpd.veneur_proxy = self
+        self._httpd.veneur_get_routes = {}
+        # live debug endpoints on the proxy mux too (the reference
+        # mounts pprof on it, proxy.go:383-388)
+        from veneur_tpu import debug
+
+        def ring_vars():
+            return {"ring": {
+                "destinations": len(self.ring),
+                "trace_destinations": len(self.trace_ring),
+                "proxied": self.proxied,
+                "traces_proxied": self.traces_proxied,
+                "forward_errors": self.forward_errors,
+                "refresh_failures": self.refresh_failures,
+            }}
+
+        debug.mount(
+            lambda path, fn: self._httpd.veneur_get_routes.__setitem__(
+                path, fn),
+            extra_vars=ring_vars)
         t = threading.Thread(target=self._httpd.serve_forever,
                              name="proxy-http", daemon=True)
         t.start()
         self._threads.append(t)
+        if self.config.grpc_forward_address:
+            # gRPC flavor on its own listener, same membership + the
+            # same destForMetric key as /import (proxy/grpc_proxy.py)
+            from veneur_tpu.proxy.grpc_proxy import GRPCProxyServer
+
+            self.grpc_server = GRPCProxyServer(
+                destinations=self._last_destinations,
+                forward_timeout=self.forward_timeout)
+            self.grpc_server.start(self.config.grpc_forward_address)
         log.info("veneur-proxy listening on port %d with %d destinations",
                  self.port, len(self.ring))
 
     def shutdown(self):
         self._stop.set()
+        if self.grpc_server is not None:
+            self.grpc_server.stop()
         if self._httpd is not None:
             self._httpd.shutdown()
             self._httpd.server_close()

@@ -29,6 +29,19 @@ from veneur_tpu.sinks.signalfx import SignalFxClient, SignalFxSink
 log = logging.getLogger("veneur.sinks.factory")
 
 
+def span_sinks_configured(config: Config) -> bool:
+    """Would create_sinks build any span sinks for this config? Used by
+    the SIGHUP reload path, which cannot hot-swap span sinks (they are
+    embedded in running span-worker lanes) and must not construct
+    throwaway producers just to find out."""
+    return bool(
+        config.datadog_trace_api_address
+        or config.lightstep_collector_host
+        or config.falconer_address
+        or (config.kafka_broker and config.kafka_span_topic)
+        or config.debug_ingested_spans)
+
+
 def create_sinks(config: Config) -> Tuple[List[MetricSink], List[SpanSink],
                                           List[Plugin]]:
     metric_sinks: List[MetricSink] = []
