@@ -524,7 +524,8 @@ class DigestGroup:
         return _flush_digests(self.digest, self.temp, self.dmin, self.dmax,
                               qs, self.compression)
 
-    def flush(self, percentiles: List[float], want_digests=True):
+    def flush(self, percentiles: List[float], want_digests=True,
+              want_stats=None):
         """Run the flush program; returns (interner, host result dict) and
         resets the group.
 
@@ -533,7 +534,8 @@ class DigestGroup:
         millions of series the planes are the bulk of the transfer.
         want_digests="packed" compacts + quantizes them on device first
         (core/slab.py:_pack_slab) and fetches only the live centroids at
-        4 bytes each — see SlabDigestGroup.flush."""
+        4 bytes each — see SlabDigestGroup.flush, which also documents
+        the ``want_stats`` fetch selection."""
         self._drain_staging()
         n = len(self.interner)
         interner, self.interner = self.interner, Interner()
@@ -550,6 +552,9 @@ class DigestGroup:
             # the chip sits behind a network tunnel)
             return interner, {}
         packed = want_digests == "packed"
+        from veneur_tpu.core.slab import _fill_stat_results, _select_stats
+
+        sel = _select_stats(want_stats)
         qs = jnp.asarray(list(percentiles) + [0.5], jnp.float32)
         digest, pcts, count, vsum, vmin, vmax, recip = self._run_flush(qs)
         # one batched transfer instead of eleven round trips
@@ -567,8 +572,10 @@ class DigestGroup:
         elif want_digests:
             planes = (digest.mean[:n], digest.weight[:n], digest.min[:n],
                       digest.max[:n])
-        fetched = jax.device_get(planes + (
-            pcts[:n], count[:n], vsum[:n], vmin[:n], vmax[:n], recip[:n]))
+        stats = {"pcts": pcts, "count": count, "sum": vsum, "min": vmin,
+                 "max": vmax, "recip": recip}
+        fetched = jax.device_get(
+            planes + tuple(stats[nm][:n] for nm in sel))
         if packed:
             out["digest_min"], out["digest_max"] = fetched[:2]
             fetched = fetched[2:]
@@ -576,16 +583,7 @@ class DigestGroup:
             (out["digest_mean"], out["digest_weight"], out["digest_min"],
              out["digest_max"]) = fetched[:4]
             fetched = fetched[4:]
-        pcts, count, vsum, vmin, vmax, recip = fetched
-        out.update({
-            "percentiles": pcts[:, :-1],
-            "median": pcts[:, -1],
-            "count": count,
-            "sum": vsum,
-            "min": vmin,
-            "max": vmax,
-            "recip": recip,
-        })
+        _fill_stat_results(sel, fetched, n, percentiles, out)
         if self._retired:
             self._drop_device()
         else:
@@ -1933,9 +1931,28 @@ class MetricStore:
         want = forwarding
         if forwarding and digest_format == "packed":
             want = "packed"
-        interner, r = group.flush(percentiles, want_digests=want)
-        packed = ("packed_counts" in r) if r else False
         agg = aggregates.value
+        # fetch only the per-row stat arrays this aggregate config reads
+        # (each is 4 MB/1M rows of device->host transfer); the zero-fill
+        # for unfetched ones is never emitted because the same mask
+        # gates the emissions below and in columnar.digest_block
+        want_stats = set()
+        if agg & (Aggregate.COUNT | Aggregate.AVERAGE
+                  | Aggregate.HARMONIC_MEAN):
+            want_stats.add("count")
+        if agg & Aggregate.MIN:
+            want_stats.add("min")
+        if agg & Aggregate.MAX:
+            want_stats.add("max")
+        if agg & (Aggregate.SUM | Aggregate.AVERAGE):
+            want_stats.add("sum")
+        if agg & Aggregate.HARMONIC_MEAN:
+            want_stats.add("recip")
+        if (agg & Aggregate.MEDIAN) or percentiles:
+            want_stats.add("pcts")
+        interner, r = group.flush(percentiles, want_digests=want,
+                                  want_stats=want_stats)
+        packed = ("packed_counts" in r) if r else False
         if col is not None and len(interner):
             from veneur_tpu.core import columnar as cb
 

@@ -216,7 +216,9 @@ class ImportServer:
             # request is raw bytes: C++ decode + intern, numpy bulk apply
             from veneur_tpu.native import egress
 
-            dec = egress.decode_metric_list(request)
+            # zero-copy views: import_columnar only gathers/stages from
+            # them and they die with close() below
+            dec = egress.decode_metric_list(request, copy=False)
             try:
                 n_ok, n_err = self._store.import_columnar(dec, request)
             finally:
