@@ -159,6 +159,11 @@ class Config:
     # through native sink serialization (falls back automatically when
     # the native egress library cannot build)
     flush_columnar: bool = True
+    # POST /import backpressure (the reference's bounded worker
+    # channels, http.go:54-142): merge worker threads and the bounded
+    # batch queue behind them — past capacity, requests shed with 429
+    http_import_workers: int = 2
+    http_import_queue: int = 64
     # heavy-hitter (veneurtopk) count-min sketch geometry: point-estimate
     # overcount <= e/width of the stream's total weight with probability
     # 1 - e^-depth; size width from the key cardinality you track

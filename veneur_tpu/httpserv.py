@@ -184,7 +184,10 @@ class ImportQueuePool:
                  trace_client=None):
         self._handle = handle
         self._trace_client = trace_client
-        self._q: "queue.Queue" = queue.Queue(maxsize=max_queue)
+        # queue.Queue(maxsize<=0) means UNBOUNDED — the opposite of this
+        # pool's purpose; clamp a zero/negative config to the smallest
+        # real bound
+        self._q: "queue.Queue" = queue.Queue(maxsize=max(1, max_queue))
         self.shed = 0
         self.merged_batches = 0
         self._stopping = threading.Event()
@@ -276,8 +279,11 @@ class OpsServer:
                             errs, len(metrics))
             return n_ok
 
+        cfg = getattr(server, "config", None)
         ops = cls(addr, import_fn=import_metrics,
-                  trace_client=getattr(server, "trace_client", None))
+                  trace_client=getattr(server, "trace_client", None),
+                  import_workers=getattr(cfg, "http_import_workers", 2),
+                  import_queue=getattr(cfg, "http_import_queue", 64))
         ops.add_route("/config", lambda query: (
             200, json.dumps({k: v for k, v in vars(server.config).items()
                              if "key" not in k and "secret" not in k

@@ -134,7 +134,14 @@ def _hash_key(value: str) -> int:
 
 
 class KafkaMetricSink(MetricSink):
-    """One JSON InterMetric per message (kafka.go:60-221)."""
+    """One JSON InterMetric per message (kafka.go:60-221).
+
+    Deliberately NOT columnar (the one egress path that keeps per-row
+    flush): the wire contract is one Kafka message per metric, so each
+    metric pays a produce round anyway — the reference has the same
+    shape (one sarama message each) and the per-message produce, not
+    JSON serialization, bounds this sink at cardinality. High-cardinality
+    egress belongs to the columnar Datadog/SignalFx/TSV paths."""
 
     def __init__(self, brokers: str, metric_topic: str,
                  check_topic: str = "", event_topic: str = "",
