@@ -660,6 +660,13 @@ def bench_forward_1m(num_series: int = 1 << 20):
                                .astype(np.float32),
                                np.ones(num_series, np.float32))
             gg._drain_staging()
+            # force the async ingest scatters to FINISH before the flush
+            # timer starts: in production they stream during the interval
+            # (the reference's BenchmarkServerFlush likewise times Flush
+            # on pre-populated workers); a 1-element fetch is the only
+            # reliable sync over the tunnel
+            float(np.asarray(jax.device_get(
+                gg.temps[-1].count[:1]))[0])
 
         # three timed intervals; report medians (tunnel dispatch latency
         # swings single-interval numbers 3x run to run)
