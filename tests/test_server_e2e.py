@@ -329,6 +329,65 @@ class TestSighupReload:
         finally:
             server.shutdown()
 
+    def test_reload_sink_lifecycle(self, monkeypatch):
+        """Config-driven sinks from a reload are start()ed; the sinks
+        they replace close on the NEXT reload (after their in-flight
+        flushes finished) and at shutdown."""
+        from veneur_tpu.sinks import factory
+
+        class FakeSink:
+            name = "fake"
+
+            def __init__(self, gen):
+                self.gen = gen
+                self.started = False
+                self.closed = False
+
+            def start(self, trace_client=None):
+                self.started = True
+
+            def close(self):
+                self.closed = True
+
+            def flush(self, metrics):
+                pass
+
+            def flush_other_samples(self, samples):
+                pass
+
+        made = []
+
+        def fake_create(config):
+            s = FakeSink(len(made))
+            made.append(s)
+            return [s], [], []
+
+        server, injected = make_server()
+        try:
+            monkeypatch.setattr(factory, "create_sinks", fake_create)
+            cfg = Config(statsd_listen_addresses=["udp://127.0.0.1:0"],
+                         interval="86400s", store_initial_capacity=32,
+                         store_chunk=128)
+            server.reload(cfg)
+            assert made[0].started
+            assert made[0] in server.metric_sinks
+            assert injected in server.metric_sinks  # injected survives
+            assert not made[0].closed
+            server.reload(cfg)
+            assert made[1].started and not made[1].closed
+            assert made[0] not in server.metric_sinks
+            # made[0] is RETIRED but not yet closed (its in-flight flush
+            # threads get until the next reload); the third reload
+            # closes it
+            assert not made[0].closed
+            server.reload(cfg)
+            assert made[0].closed
+            assert not made[1].closed  # retired now, closes later
+        finally:
+            server.shutdown()
+        # shutdown closes everything still retired
+        assert made[1].closed
+
     def test_reload_rebuilds_forwarder(self):
         server, _ = make_server(forward_address="127.0.0.1:1",
                                 forward_use_grpc=True)
