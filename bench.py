@@ -597,8 +597,10 @@ def bench_forward_1m(num_series: int = 1 << 20):
 
     stage()
 
-    gstore = MetricStore(initial_capacity=1 << 10, chunk=1 << 16,
-                          digest_storage="slab", slab_rows=1 << 19)
+    # 2^17 staging chunks on the GLOBAL: ~20% faster bulk merge at 1M
+    # rows than 2^16 (fewer device dispatches; swept on-chip)
+    gstore = MetricStore(initial_capacity=1 << 10, chunk=1 << 17,
+                         digest_storage="slab", slab_rows=1 << 19)
     srv = ImportServer(gstore)
     port = srv.start("127.0.0.1:0")
     # a 64 MB chunk's decode+merge exceeds the 10 s production default
