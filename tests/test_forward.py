@@ -159,6 +159,156 @@ class TestGRPCPipeline:
         assert client.errors == 1
 
 
+class TestNativeTransport:
+    """Framed-TCP MetricList transport (forward/native_transport.py):
+    the framework-extension fast lane past python-grpc. Same merge
+    semantics as the gRPC ImportServer, same forwarder surface."""
+
+    def _pipeline(self):
+        from veneur_tpu.forward.native_transport import (NativeForwarder,
+                                                         NativeImportServer)
+
+        gstore = MetricStore(initial_capacity=32, chunk=128)
+        srv = NativeImportServer(gstore)
+        port = srv.start("127.0.0.1:0")
+        client = NativeForwarder(f"native://127.0.0.1:{port}")
+        return gstore, srv, client
+
+    def test_e2e_matches_grpc_semantics(self):
+        gstore, srv, client = self._pipeline()
+        try:
+            assert client.wants_packed_digests
+            for _ in range(2):
+                store = local_store_with_data()
+                _, fwd, _ = store.flush([0.5], AGG, is_local=True,
+                                        now=int(time.time()),
+                                        columnar=True,
+                                        digest_format="packed")
+                client.forward(fwd)
+            assert client.errors == 0 and client.forwarded == 8
+            final, _, _ = gstore.flush([0.5], AGG, is_local=False,
+                                       now=int(time.time()))
+            by_name = {m.name: m for m in final}
+            assert by_name["gctr"].value == 10.0
+            assert by_name["lat.50percentile"].value == pytest.approx(
+                24.5, rel=0.15)
+            assert by_name["users"].value == pytest.approx(3, abs=0.1)
+        finally:
+            client.close()
+            srv.stop()
+
+    def test_connection_survives_intervals_and_reconnects(self):
+        gstore, srv, client = self._pipeline()
+        try:
+            _, fwd = flush_local(local_store_with_data())
+            client.forward(fwd)
+            first_sock = client._sock
+            assert first_sock is not None
+            _, fwd = flush_local(local_store_with_data())
+            client.forward(fwd)
+            assert client._sock is first_sock  # one conn, many intervals
+            # kill the server side; the next forward errors and drops
+            # the socket, the one after that reconnects
+            srv.stop()
+            _, fwd = flush_local(local_store_with_data(n_hist=3))
+            client.forward(fwd)
+            assert client.errors == 1 and client._sock is None
+            srv2 = NativeImportServerAt(gstore, client)
+            try:
+                _, fwd = flush_local(local_store_with_data(n_hist=3))
+                client.forward(fwd)
+                assert client.errors == 1  # recovered
+            finally:
+                srv2.stop()
+        finally:
+            client.close()
+            srv.stop()
+
+    def test_idle_connection_survives_socket_timeouts(self):
+        # the server's 1s socket timeout is a stop-flag poll, NOT an
+        # idle deadline: a connection idling longer than it (long flush
+        # intervals) must still serve the next frame
+        import socket
+        import struct
+
+        from veneur_tpu.core.store import ForwardableState
+        from veneur_tpu.forward.convert import metric_list_from_state
+        from veneur_tpu.forward.native_transport import MAGIC
+
+        gstore, srv, client = self._pipeline()
+        try:
+            s = socket.create_connection(("127.0.0.1", srv.port), 5)
+            s.sendall(MAGIC)
+            time.sleep(2.5)  # > 2 server poll periods, idle
+            st = ForwardableState()
+            st.counters.append(("idle.c", [], 1))
+            body = metric_list_from_state(st).SerializeToString()
+            s.sendall(struct.pack(">I", len(body)) + body)
+            s.settimeout(5)
+            (ack,) = struct.unpack(">I", s.recv(4))
+            assert ack == 1
+            s.close()
+        finally:
+            client.close()
+            srv.stop()
+
+    def test_bad_magic_and_oversized_frame_rejected(self):
+        import socket
+        import struct
+
+        from veneur_tpu.forward.native_transport import MAGIC
+
+        gstore, srv, client = self._pipeline()
+        try:
+            def assert_closed(s):
+                # a close with unread client bytes can surface as RST
+                try:
+                    assert s.recv(4) == b""
+                except ConnectionResetError:
+                    pass
+
+            # wrong magic: connection closes, nothing merges
+            s = socket.create_connection(("127.0.0.1", srv.port), 5)
+            s.sendall(b"NOPE" + struct.pack(">I", 4) + b"xxxx")
+            assert_closed(s)
+            s.close()
+            # oversized frame length: closes without reading the payload
+            s = socket.create_connection(("127.0.0.1", srv.port), 5)
+            s.sendall(MAGIC + struct.pack(">I", 1 << 31))
+            assert_closed(s)
+            s.close()
+            # garbage payload: NACKed, stream stays usable
+            s = socket.create_connection(("127.0.0.1", srv.port), 5)
+            s.sendall(MAGIC + struct.pack(">I", 5) + b"junk!")
+            (ack,) = struct.unpack(">I", s.recv(4))
+            # a 5-byte junk blob may decode as an empty MetricList (0 ok)
+            # or fail (ACK_ERROR); either way nothing merges and the
+            # stream stays framed
+            from veneur_tpu.forward.convert import metric_list_from_state
+            from veneur_tpu.core.store import ForwardableState
+
+            st = ForwardableState()
+            st.counters.append(("nt.c", [], 3))
+            body = metric_list_from_state(st).SerializeToString()
+            s.sendall(struct.pack(">I", len(body)) + body)
+            (ack2,) = struct.unpack(">I", s.recv(4))
+            assert ack2 == 1
+            s.close()
+            assert gstore.imported == 1
+        finally:
+            client.close()
+            srv.stop()
+
+
+def NativeImportServerAt(gstore, client):
+    """Restart a native import server on the SAME port the client dials."""
+    from veneur_tpu.forward.native_transport import NativeImportServer
+
+    srv = NativeImportServer(gstore)
+    srv.start(f"127.0.0.1:{client._port}")
+    return srv
+
+
 class TestPackedDigestForward:
     """Device-compacted digest forwarding (PackedDigestPlanes, tdigest
     fields 16/17): the 1M+-series path that replaces the raw [S,K] f32

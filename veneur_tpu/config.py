@@ -57,6 +57,11 @@ class Config:
     forward_address: str = ""
     forward_use_grpc: bool = False
     grpc_address: str = ""
+    # framed-TCP MetricList import listener (framework extension — the
+    # fast lane past python-grpc's HTTP/2 overhead; forward/
+    # native_transport.py). Locals point at it with
+    # forward_address: "native://host:port".
+    native_import_address: str = ""
     hostname: str = ""
     http_address: str = ""
     indicator_span_timer_name: str = ""

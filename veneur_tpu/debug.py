@@ -144,6 +144,10 @@ def collect_vars(server) -> dict:
     if imp is not None:
         out["grpc_import"] = {"received": imp.received,
                               "errors": imp.import_errors}
+    nimp = getattr(server, "native_import_server", None)
+    if nimp is not None:
+        out["native_import"] = {"received": nimp.received,
+                                "errors": nimp.import_errors}
     ops = getattr(server, "ops_server", None)
     pool = getattr(ops, "import_pool", None)
     if pool is not None:

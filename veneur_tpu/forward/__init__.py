@@ -43,7 +43,15 @@ def configure_forwarding(server):
     cfg = server.config
     if not cfg.forward_address:
         return None
-    if cfg.forward_use_grpc:
+    if cfg.forward_address.startswith("native://"):
+        from veneur_tpu.forward.native_transport import NativeForwarder
+
+        fwd = NativeForwarder(
+            cfg.forward_address,
+            reference_compat=cfg.forward_reference_compatible)
+        if not cfg.forward_packed_digests:
+            fwd.wants_packed_digests = False
+    elif cfg.forward_use_grpc:
         fwd = GRPCForwarder(
             cfg.forward_address,
             reference_compat=cfg.forward_reference_compatible)

@@ -32,6 +32,59 @@ _METHOD = "/forwardrpc.Forward/SendMetrics"
 _MAX_MESSAGE = 256 * 1024 * 1024
 
 
+def encode_forwardable_frames(state, compression: float,
+                              reference_compat: bool,
+                              chunk_bytes: int) -> list:
+    """ForwardableState → ``[(serialized MetricList bytes, row_count)]``,
+    transport-agnostic: columnar/packed digest planes encode natively
+    (C++), everything else through the protobuf builder. Used by the
+    gRPC forwarder and the framed-TCP native forwarder — protobuf
+    messages concatenate, so each frame is a complete MetricList."""
+    from veneur_tpu.core.store import PackedDigestPlanes
+    from veneur_tpu.native import egress
+
+    frames = []
+    if egress.available():
+        for attr, pb_type in (("histograms_columnar", 2),
+                              ("timers_columnar", 4)):
+            col = getattr(state, attr)
+            if col is None:
+                continue
+            if isinstance(col[2], PackedDigestPlanes):
+                # device-compacted planes: quantized arrays go on the
+                # wire verbatim (or dequantize in C++ for a reference
+                # global) — the 1M+-series forward path
+                names, tags, planes = col
+                chunks = egress.encode_digest_metrics_packed(
+                    names, tags, planes, pb_type, compression,
+                    max_body_bytes=chunk_bytes,
+                    reference_compat=reference_compat)
+                n_raw = planes.nrows
+            else:
+                names, tags, means, weights, dmins, dmaxs = col
+                chunks = egress.encode_digest_metrics(
+                    names, tags, means, weights, dmins, dmaxs, pb_type,
+                    compression, max_body_bytes=chunk_bytes,
+                    reference_compat=reference_compat)
+                n_raw = len(means)
+            setattr(state, attr, None)  # consumed
+            # rows credit per chunk: a mid-loop transport failure must
+            # not misreport rows the global already merged
+            per = n_raw // len(chunks) if chunks else 0
+            for i, c in enumerate(chunks):
+                frames.append((c, n_raw - per * (len(chunks) - 1)
+                               if i == len(chunks) - 1 else per))
+    else:
+        state.materialize_digests()
+    mlist = metric_list_from_state(state, compression,
+                                   reference_compat=reference_compat)
+    # a list can be topk-sketch-only (every series was columnar or
+    # heavy-hitter): HasField, not len(metrics), decides emptiness
+    if mlist.metrics or mlist.HasField("topk"):
+        frames.append((mlist.SerializeToString(), len(mlist.metrics)))
+    return frames
+
+
 class GRPCForwarder:
     """Per-flush gRPC forward of ForwardableState (flusher.go:424-473)."""
 
@@ -59,13 +112,9 @@ class GRPCForwarder:
             addr,
             options=[("grpc.max_receive_message_length", _MAX_MESSAGE),
                      ("grpc.max_send_message_length", _MAX_MESSAGE)])
-        self._send = self._channel.unary_unary(
-            _METHOD,
-            request_serializer=forward_pb2.MetricList.SerializeToString,
-            response_deserializer=empty_pb2.Empty.FromString,
-        )
-        # identity-serialized lane for natively-encoded MetricList chunks
-        # (native/veneur_egress.cpp writes the serialization directly)
+        # identity-serialized: every frame arrives pre-serialized, either
+        # natively encoded (native/veneur_egress.cpp writes the
+        # serialization directly) or SerializeToString'd by the builder
         self._send_raw = self._channel.unary_unary(
             _METHOD,
             request_serializer=lambda b: b,
@@ -81,48 +130,14 @@ class GRPCForwarder:
     CHUNK_BYTES = 64 * 1024 * 1024
 
     def forward(self, state, parent_span=None):
-        from veneur_tpu.native import egress
-
         # columnar digest planes encode natively — serialized MetricList
-        # chunks straight from the [S, K] arrays, no per-row Python
+        # chunks straight from the packed arrays, no per-row Python
         # (flusher.go:424-473; the chunking bounds message size the way
         # the reference's proxy batches do)
-        from veneur_tpu.core.store import PackedDigestPlanes
-
-        raw_chunks = []
-        n_raw = 0
-        if egress.available():
-            for attr, pb_type in (("histograms_columnar", 2),
-                                  ("timers_columnar", 4)):
-                col = getattr(state, attr)
-                if col is None:
-                    continue
-                if isinstance(col[2], PackedDigestPlanes):
-                    # device-compacted planes: quantized arrays go on the
-                    # wire verbatim (or dequantize in C++ for a reference
-                    # global) — the 1M+-series forward path
-                    names, tags, planes = col
-                    raw_chunks.extend(egress.encode_digest_metrics_packed(
-                        names, tags, planes, pb_type, self.compression,
-                        max_body_bytes=self.CHUNK_BYTES,
-                        reference_compat=self.reference_compat))
-                    n_raw += planes.nrows
-                else:
-                    names, tags, means, weights, dmins, dmaxs = col
-                    raw_chunks.extend(egress.encode_digest_metrics(
-                        names, tags, means, weights, dmins, dmaxs, pb_type,
-                        self.compression, max_body_bytes=self.CHUNK_BYTES,
-                        reference_compat=self.reference_compat))
-                    n_raw += len(means)
-                setattr(state, attr, None)  # consumed
-        else:
-            state.materialize_digests()
-        mlist = metric_list_from_state(
-            state, self.compression, reference_compat=self.reference_compat)
-        # a list can be topk-sketch-only (every series was columnar or
-        # heavy-hitter): HasField, not len(metrics), decides emptiness
-        has_pb = bool(mlist.metrics) or mlist.HasField("topk")
-        if not has_pb and not raw_chunks:
+        frames = encode_forwardable_frames(
+            state, self.compression, self.reference_compat,
+            self.CHUNK_BYTES)
+        if not frames:
             return
         metadata = None
         if parent_span is not None:
@@ -130,20 +145,13 @@ class GRPCForwarder:
             metadata = tuple(
                 (k.lower(), v)
                 for k, v in parent_span.context_as_parent().items())
-        # raw chunks credit as they land: a mid-loop failure must not
-        # misreport rows the global already accepted and merged
-        raw_per_chunk = n_raw // len(raw_chunks) if raw_chunks else 0
+        total = sum(rows for _, rows in frames)
         sent_rows = 0
         try:
-            if has_pb:
-                self._send(mlist, timeout=self.timeout, metadata=metadata)
-                sent_rows += len(mlist.metrics)
-            for i, chunk in enumerate(raw_chunks):
-                self._send_raw(chunk, timeout=self.timeout,
+            for payload, rows in frames:
+                self._send_raw(payload, timeout=self.timeout,
                                metadata=metadata)
-                # last chunk carries the rounding remainder
-                sent_rows += (n_raw - raw_per_chunk * (len(raw_chunks) - 1)
-                              if i == len(raw_chunks) - 1 else raw_per_chunk)
+                sent_rows += rows
             with self._lock:
                 self.forwarded += sent_rows
         except grpc.RpcError as e:
@@ -152,8 +160,7 @@ class GRPCForwarder:
                 self.forwarded += sent_rows
             log.warning("failed to forward %d metrics to %s "
                         "(~%d sent before the failure): %s",
-                        len(mlist.metrics) + n_raw, self.addr,
-                        sent_rows, e)
+                        total, self.addr, sent_rows, e)
 
     def close(self):
         self._channel.close()

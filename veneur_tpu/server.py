@@ -271,6 +271,7 @@ class Server:
         self._forwarder = None
         self.ops_server = None      # HTTP /healthcheck,/version,/import
         self.import_server = None   # gRPC Forward.SendMetrics ingest
+        self.native_import_server = None  # framed-TCP fast lane
 
         self._stop = threading.Event()
         self._reload_lock = threading.Lock()
@@ -463,6 +464,13 @@ class Server:
             self.import_server = ImportServer(
                 self.store, trace_client=self.trace_client)
             self.import_server.start(cfg.grpc_address)
+        # framed-TCP import ingest (framework extension fast lane)
+        if cfg.native_import_address:
+            from veneur_tpu.forward.native_transport import \
+                NativeImportServer
+
+            self.native_import_server = NativeImportServer(self.store)
+            self.native_import_server.start(cfg.native_import_address)
         # local → global forwarding client (server.go:626-635)
         if self.forward_fn is None:
             from veneur_tpu.forward import configure_forwarding
@@ -573,7 +581,8 @@ class Server:
     # (SO_REUSEPORT makes a rolling restart the path for these) and the
     # store's device geometry is allocated once
     _RELOAD_FROZEN = ("statsd_listen_addresses", "ssf_listen_addresses",
-                      "http_address", "grpc_address", "tls_certificate",
+                      "http_address", "grpc_address",
+                      "native_import_address", "tls_certificate",
                       "tls_key", "tls_authority_certificate",
                       "digest_storage", "digest_dtype", "slab_rows",
                       "tdigest_compression", "hll_precision",
@@ -731,6 +740,8 @@ class Server:
             self.ops_server.stop()
         if self.import_server is not None:
             self.import_server.stop()
+        if self.native_import_server is not None:
+            self.native_import_server.stop()
         if self._forwarder is not None and hasattr(self._forwarder, "close"):
             self._forwarder.close()
         self._close_retired_sinks()
