@@ -224,6 +224,55 @@ class TestNativeTransport:
             client.close()
             srv.stop()
 
+    def test_concurrent_senders_conserve_counts(self):
+        # N locals hammering one native listener concurrently (each on
+        # its own connection) must merge every row exactly once
+        import struct
+        import threading
+        import socket as socket_mod
+
+        from veneur_tpu.core.store import ForwardableState
+        from veneur_tpu.forward.convert import metric_list_from_state
+        from veneur_tpu.forward.native_transport import MAGIC
+
+        gstore, srv, client = self._pipeline()
+        errors = []
+
+        def sender(idx):
+            try:
+                s = socket_mod.create_connection(
+                    ("127.0.0.1", srv.port), 10)
+                s.settimeout(10)
+                s.sendall(MAGIC)
+                for j in range(20):
+                    st = ForwardableState()
+                    st.counters.append((f"cc.{idx}", [], 1))
+                    body = metric_list_from_state(st).SerializeToString()
+                    s.sendall(struct.pack(">I", len(body)) + body)
+                    (ack,) = struct.unpack(">I", s.recv(4))
+                    assert ack == 1
+                s.close()
+            except Exception as e:  # pragma: no cover - diagnostic
+                errors.append(e)
+
+        try:
+            threads = [threading.Thread(target=sender, args=(i,))
+                       for i in range(6)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=30)
+            assert not errors
+            assert gstore.imported == 6 * 20
+            final, _, _ = gstore.flush([], AGG, is_local=False,
+                                       now=int(time.time()))
+            by = {m.name: m.value for m in final}
+            for i in range(6):
+                assert by[f"cc.{i}"] == 20.0
+        finally:
+            client.close()
+            srv.stop()
+
     def test_idle_connection_survives_socket_timeouts(self):
         # the server's 1s socket timeout is a stop-flag poll, NOT an
         # idle deadline: a connection idling longer than it (long flush

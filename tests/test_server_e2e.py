@@ -302,9 +302,11 @@ class TestSighupReload:
                 interval="7s", percentiles=[0.9], tags=["env:b"],
                 aggregates=["count"], store_initial_capacity=32,
                 store_chunk=128,
-                # frozen key change must be rejected, not applied
-                digest_storage="slab")
+                # frozen key changes must be rejected, not applied
+                digest_storage="slab",
+                native_import_address="127.0.0.1:45678")
             server.reload(new_cfg)
+            assert server.config.native_import_address == ""
 
             assert server.interval == 7.0
             assert server.histogram_percentiles == [0.9]
