@@ -232,7 +232,7 @@ def bench_import_throughput(num_series: int = 20000, duration: float = 4.0):
     port = srv.start("127.0.0.1:0")
     payload = quant_payload if quant_payload is not None else legacy_payload
 
-    def sender_loop(deadline, counter, lock):
+    def sender_loop(deadline, counter, lock, messages=1 << 30):
         # each sender is one forwarding host with its own channel
         chan = grpc.insecure_channel(
             f"127.0.0.1:{port}",
@@ -243,7 +243,9 @@ def bench_import_throughput(num_series: int = 20000, duration: float = 4.0):
             request_serializer=lambda b: b,
             response_deserializer=empty_pb2.Empty.FromString)
         try:
-            while time.perf_counter() < deadline:
+            for _ in range(messages):
+                if time.perf_counter() > deadline:
+                    return
                 send(payload, timeout=300)
                 with lock:
                     counter[0] += num_series
@@ -252,6 +254,18 @@ def bench_import_throughput(num_series: int = 20000, duration: float = 4.0):
 
     try:
         import threading
+
+        from veneur_tpu.forward.native_transport import (MAGIC,
+                                                         NativeImportServer)
+        from veneur_tpu.samplers.intermetric import HistogramAggregates
+
+        def reset_store():
+            # fresh generation between lanes: an unflushed store
+            # accumulates device state across the merged intervals and
+            # whatever lane measured last would read slow (swap-on-flush
+            # makes this cheap; module-level programs survive the swap)
+            store.flush([], HistogramAggregates.from_names(["count"]),
+                        is_local=False, now=0, forward=False)
 
         chan = grpc.insecure_channel(
             f"127.0.0.1:{port}",
@@ -270,107 +284,151 @@ def bench_import_throughput(num_series: int = 20000, duration: float = 4.0):
             if time.perf_counter() - t0 < 1.5:
                 break
         chan.close()
-        # two concurrent forwarding hosts: decode runs GIL-free in C++,
-        # so a second stream overlaps transport with store staging
-        counter, lock = [0], threading.Lock()
-        deadline = time.perf_counter() + duration
-        t0 = time.perf_counter()
-        senders = [threading.Thread(target=sender_loop,
-                                    args=(deadline, counter, lock))
-                   for _ in range(2)]
-        for t in senders:
-            t.start()
-        for t in senders:
-            t.join()
-        dt = time.perf_counter() - t0
-        sent = counter[0]
 
-        # the framed-TCP fast lane (forward/native_transport.py): same
-        # decode + merge, 4-byte frames instead of HTTP/2 — measures
-        # what the transport extension buys on the same single core
-        native_rate = None
-        if eg.available():
+        import jax as _jax
+
+        def barrier():
+            # the import path dispatches device scatters asynchronously;
+            # a rate without a completion barrier measures DISPATCH
+            # throughput while backlog piles on the device queue (and
+            # the next lane pays for it). Sustained = work + barrier.
+            g = store.histograms
+            g._drain_staging()
+            count = (g.temps[-1].count if getattr(g, "temps", None)
+                     else g.temp.count)
+            float(np.asarray(_jax.device_get(count[:1]))[0])
+
+        def run_grpc_round(seconds):
+            # two concurrent forwarding hosts: decode runs GIL-free in
+            # C++, so a second stream overlaps transport with staging
+            counter, lock = [0], threading.Lock()
+            deadline = time.perf_counter() + seconds
+            t0 = time.perf_counter()
+            senders = [threading.Thread(target=sender_loop,
+                                        args=(deadline, counter, lock))
+                       for _ in range(2)]
+            for t in senders:
+                t.start()
+            for t in senders:
+                t.join()
+            t_work = time.perf_counter() - t0
+            barrier()
+            return counter[0] / t_work, counter[0] / (time.perf_counter()
+                                                      - t0)
+
+        nsrv = NativeImportServer(store)
+        nport = nsrv.start("127.0.0.1:0")
+
+        def native_sender(deadline, counter, lock):
+            import socket as _socket
             import struct as _struct
 
-            from veneur_tpu.forward.native_transport import (
-                MAGIC, NativeImportServer)
-
-            nsrv = NativeImportServer(store)
-            nport = nsrv.start("127.0.0.1:0")
-
-            def native_sender(deadline, counter, lock):
-                import socket as _socket
-
-                s = _socket.create_connection(("127.0.0.1", nport), 30)
-                s.sendall(MAGIC)
-                header = _struct.pack(">I", len(payload))
-                try:
-                    while time.perf_counter() < deadline:
-                        s.sendall(header)
-                        s.sendall(payload)
-                        got = 0
-                        while got < 4:
-                            r = s.recv(4 - got)
-                            if not r:
-                                raise OSError("server closed mid-ack")
-                            got += len(r)
-                        with lock:
-                            counter[0] += num_series
-                finally:
-                    s.close()
-
+            s = _socket.create_connection(("127.0.0.1", nport), 30)
+            s.sendall(MAGIC)
+            header = _struct.pack(">I", len(payload))
             try:
-                # warm the fresh store's native path once
-                warm = [0]
-                native_sender(time.perf_counter() + 0.1, warm,
-                              threading.Lock())
-                ncounter, nlock = [0], threading.Lock()
-                ndeadline = time.perf_counter() + duration
-                nt0 = time.perf_counter()
-                nsenders = [threading.Thread(target=native_sender,
-                                             args=(ndeadline, ncounter,
-                                                   nlock))
-                            for _ in range(2)]
-                for t in nsenders:
-                    t.start()
-                for t in nsenders:
-                    t.join()
-                native_rate = int(ncounter[0]
-                                  / (time.perf_counter() - nt0))
+                while time.perf_counter() < deadline:
+                    s.sendall(header)
+                    s.sendall(payload)
+                    got = 0
+                    while got < 4:
+                        r = s.recv(4 - got)
+                        if not r:
+                            raise OSError("server closed mid-ack")
+                        got += len(r)
+                    with lock:
+                        counter[0] += num_series
             finally:
-                nsrv.stop()
+                s.close()
 
-        # the store path alone (native decode + intern + bulk stage,
-        # no gRPC transport): what each importer thread sustains — a
-        # multi-core global runs one stream per core
-        rates = {}
-        if eg.available():
-            for name, pl in (("quant", quant_payload),
-                             ("legacy", legacy_payload)):
-                times = []
-                for _ in range(8):
-                    t1 = time.perf_counter()
-                    dec = eg.decode_metric_list(pl, copy=False)
-                    store.import_columnar(dec, pl)
-                    dec.close()
-                    times.append(time.perf_counter() - t1)
-                rates[name] = int(num_series / float(np.median(times)))
-        return {"series_merged_per_s": int(sent / dt),
-                "native_transport_series_per_s": native_rate,
-                "store_path_series_per_s": rates.get("quant"),
-                "store_path_legacy_wire_per_s": rates.get("legacy"),
+        def run_native_round(seconds):
+            counter, lock = [0], threading.Lock()
+            deadline = time.perf_counter() + seconds
+            t0 = time.perf_counter()
+            senders = [threading.Thread(target=native_sender,
+                                        args=(deadline, counter, lock))
+                       for _ in range(2)]
+            for t in senders:
+                t.start()
+            for t in senders:
+                t.join()
+            t_work = time.perf_counter() - t0
+            barrier()
+            return counter[0] / t_work, counter[0] / (time.perf_counter()
+                                                      - t0)
+
+        def run_store_round(pl, iters=4):
+            t1 = time.perf_counter()
+            for _ in range(iters):
+                dec = eg.decode_metric_list(pl, copy=False)
+                store.import_columnar(dec, pl)
+                dec.close()
+            t_work = time.perf_counter() - t1
+            barrier()
+            n = iters * num_series
+            return n / t_work, n / (time.perf_counter() - t1)
+
+        # INTERLEAVED duration-based rounds, per-lane medians of TWO
+        # rates: the PIPELINE rate (senders' wall only — transport +
+        # C++ decode + intern + staging dispatch; round-3-comparable
+        # methodology and the PCIe-host proxy, since there the
+        # 12 B/centroid staged upload is free) and the SUSTAINED rate
+        # whose clock also covers the post-round device barrier (on
+        # THIS harness that barrier measures the ~20 MB/s tunnel
+        # absorbing the upload, not the framework). The reset between
+        # lanes stops queue backlog from bleeding across them.
+        lanes = {k: ([], []) for k in ("grpc", "native", "quant",
+                                       "legacy")}
+
+        def record(key, pair):
+            lanes[key][0].append(pair[0])
+            lanes[key][1].append(pair[1])
+
+        try:
+            run_native_round(0.2)  # warm the native path
+            for _ in range(3):
+                reset_store()
+                record("grpc", run_grpc_round(duration / 2))
+                reset_store()
+                record("native", run_native_round(duration / 2))
+                reset_store()
+                if eg.available():
+                    record("quant", run_store_round(quant_payload))
+                    reset_store()
+                    record("legacy", run_store_round(legacy_payload))
+        finally:
+            nsrv.stop()
+        med = lambda xs: int(np.median(xs)) if xs else None  # noqa: E731
+        return {"series_merged_per_s": med(lanes["grpc"][0]),
+                "native_transport_series_per_s": med(lanes["native"][0]),
+                "store_path_series_per_s": med(lanes["quant"][0]),
+                "store_path_legacy_wire_per_s": med(lanes["legacy"][0]),
+                "sustained_on_tunnel_per_s": {
+                    "grpc": med(lanes["grpc"][1]),
+                    "native": med(lanes["native"][1]),
+                    "store_path": med(lanes["quant"][1])},
                 "wire_bytes_per_series": round(len(payload) / num_series),
-                "senders": 2,
+                "senders": 2, "rounds": 3,
                 "batch_series": num_series,
                 "centroids_per_digest": K,
-                "note": "ALL lanes share ONE core with their own bench "
-                        "clients; store path is the per-importer-core "
-                        "ceiling. The framed-TCP native lane removes "
-                        "python-grpc's HTTP/2 cost (~+20%% e2e) and "
-                        "approaches the store path. Path to 1M/s: N "
-                        "importer cores x ~500-650k/s store path (C++ "
-                        "decode releases the GIL; per-group staging is "
-                        "vectorized), quantized wire at 264 B/series"}
+                "note": "medians over 3 interleaved rounds. Headline "
+                        "rates are the HOST PIPELINE (transport + C++ "
+                        "decode + intern + staging dispatch) — the "
+                        "PCIe-host proxy, where the 12 B/centroid "
+                        "staged upload is free; sustained_on_tunnel "
+                        "additionally clocks the post-round device "
+                        "barrier, which on THIS harness measures its "
+                        "~20 MB/s host->device tunnel absorbing the "
+                        "upload, not the framework. All lanes share one "
+                        "core with their own bench clients. Ceilings "
+                        "for THIS 48-centroid workload: host pipeline "
+                        "per core (above), device scatter ~10-15M "
+                        "centroids/s per chip (~250k series/s); the "
+                        "fleet scales both axes — N importer cores and "
+                        "mesh-sharded chips — and real forwarded "
+                        "digests average ~1-5 live centroids (see 2e), "
+                        "10-40x lighter per series. Quantized wire at "
+                        "264 B/series"}
     finally:
         srv.stop()
 
