@@ -1,0 +1,360 @@
+"""SIGUSR2 zero-downtime upgrade choreography (cli/upgrade.py): the
+SO_REUSEPORT redesign of the reference's einhorn handoff
+(server.go:1048-1076). The replacement generations here are tiny
+``python -c`` stubs so the handshake mechanics are tested against real
+processes and inherited fds without paying jax startup per test."""
+
+import os
+import signal
+import socket
+import sys
+import threading
+import time
+
+import pytest
+
+from veneur_tpu.cli import upgrade
+
+
+_REPO = os.path.abspath(upgrade.__file__).rsplit(os.sep + "veneur_tpu", 1)[0]
+
+
+def _stub(body: str):
+    """argv for a child that runs ``body`` with veneur_tpu importable."""
+    return [sys.executable, "-c",
+            "import sys; sys.path.insert(0, %r); %s" % (_REPO, body)]
+
+
+READY_BODY = ("from veneur_tpu.cli import upgrade; "
+              "assert upgrade.notify_ready()")
+
+
+def test_notify_ready_writes_one_byte_and_clears_env(monkeypatch):
+    r, w = os.pipe()
+    monkeypatch.setenv(upgrade.READY_ENV, str(w))
+    assert upgrade.notify_ready()
+    assert os.read(r, 2) == b"1"
+    os.close(r)
+    # fd is closed and the env var consumed: a second call is a no-op
+    assert upgrade.READY_ENV not in os.environ
+    assert not upgrade.notify_ready()
+
+
+def test_notify_ready_without_env_is_noop():
+    os.environ.pop(upgrade.READY_ENV, None)
+    assert not upgrade.notify_ready()
+
+
+def test_notify_ready_survives_dead_parent(monkeypatch):
+    r, w = os.pipe()
+    os.close(r)  # parent's read end gone → EPIPE on write
+    monkeypatch.setenv(upgrade.READY_ENV, str(w))
+    assert not upgrade.notify_ready()
+    os.close(w)
+
+
+def test_spawn_replacement_ready():
+    child = upgrade.spawn_replacement(
+        _stub(READY_BODY), ready_timeout=60.0)
+    assert child is not None
+    assert child.wait(timeout=30) == 0
+
+
+def test_spawn_replacement_child_exits_early():
+    argv = [sys.executable, "-c", "import sys; sys.exit(3)"]
+    assert upgrade.spawn_replacement(argv, ready_timeout=30.0) is None
+
+
+def test_spawn_replacement_timeout_kills_child():
+    argv = [sys.executable, "-c", "import time; time.sleep(600)"]
+    t0 = time.monotonic()
+    child_seen = {}
+    real_popen = upgrade.subprocess.Popen
+
+    def spy(*a, **k):
+        p = real_popen(*a, **k)
+        child_seen["p"] = p
+        return p
+
+    assert upgrade.spawn_replacement(argv, ready_timeout=1.5,
+                                     popen=spy) is None
+    assert time.monotonic() - t0 < 30
+    # the non-ready child was killed, not leaked
+    assert child_seen["p"].poll() is not None
+
+
+def test_spawn_replacement_fd_closed_without_byte():
+    # child closes the readiness fd without writing — it can never
+    # become ready, so the parent must kill it and keep serving
+    body = ("import os, time; "
+            "os.close(int(os.environ['VENEUR_READY_FD'])); "
+            "time.sleep(600)")
+    argv = [sys.executable, "-c", body]
+    child_seen = {}
+    real_popen = upgrade.subprocess.Popen
+
+    def spy(*a, **k):
+        p = real_popen(*a, **k)
+        child_seen["p"] = p
+        return p
+
+    t0 = time.monotonic()
+    assert upgrade.spawn_replacement(argv, ready_timeout=60.0,
+                                     popen=spy) is None
+    assert time.monotonic() - t0 < 30  # did not wait for the timeout
+    assert child_seen["p"].poll() is not None
+
+
+def test_spawn_failure_returns_none():
+    def boom(*a, **k):
+        raise OSError("no such binary")
+
+    assert upgrade.spawn_replacement(["/nonexistent"], popen=boom) is None
+
+
+def test_replacement_argv_reexecs_same_interpreter():
+    argv = upgrade.replacement_argv("/etc/veneur.yaml",
+                                    "veneur_tpu.cli.server")
+    assert argv[0] == sys.executable
+    assert argv[1:] == ["-m", "veneur_tpu.cli.server",
+                        "-f", "/etc/veneur.yaml"]
+
+
+def test_usr2_coalesces_and_ignores_when_draining(monkeypatch):
+    """Overlapping SIGUSR2s run one upgrade, and a signal arriving
+    after the drain began must not spawn a second replacement (two
+    would co-serve the ports forever once the parent exits)."""
+    done = threading.Event()
+    started = threading.Event()
+    release = threading.Event()
+    spawned = []
+
+    def slow_spawn(argv, **kw):
+        spawned.append(argv)
+        started.set()
+        release.wait(10)
+        return object()
+
+    monkeypatch.setattr(upgrade, "spawn_replacement", slow_spawn)
+    h = upgrade.make_sigusr2_handler("/cfg.yaml", "veneur_tpu.cli.server",
+                                     done)
+    h(signal.SIGUSR2, None)
+    assert started.wait(5)
+    h(signal.SIGUSR2, None)  # in-flight: coalesces, no second spawn
+    release.set()
+    deadline = time.monotonic() + 5
+    while not done.is_set() and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert done.is_set()
+    time.sleep(0.2)
+    assert len(spawned) == 1
+    h(signal.SIGUSR2, None)  # already draining: ignored
+    time.sleep(0.3)
+    assert len(spawned) == 1
+
+
+def test_shutdown_during_upgrade_stops_replacement(monkeypatch):
+    """SIGTERM while the replacement is still starting means STOP the
+    service: the replacement must not outlive this generation."""
+    done = threading.Event()
+    killed = []
+
+    class FakeChild:
+        pid = 777
+
+        def kill(self):
+            killed.append(self.pid)
+
+        def wait(self, timeout=None):
+            return 0
+
+    def spawn_then_term(argv, **kw):
+        done.set()  # SIGTERM lands while spawn_replacement is blocked
+        return FakeChild()
+
+    monkeypatch.setattr(upgrade, "spawn_replacement", spawn_then_term)
+    h = upgrade.make_sigusr2_handler("/cfg.yaml", "veneur_tpu.cli.server",
+                                     done)
+    h(signal.SIGUSR2, None)
+    deadline = time.monotonic() + 5
+    while not killed and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert killed == [777]
+
+
+def test_warn_for_stream_addr_parses_grpc_formats(monkeypatch, caplog):
+    """The gRPC-style addr probe: a live listener on the port warns,
+    and odd inputs (no port, v6 wildcard on any host) never raise."""
+    import logging
+
+    from veneur_tpu import networking
+
+    first = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    first.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEPORT, 1)
+    first.bind(("127.0.0.1", 0))
+    first.listen(1)
+    port = first.getsockname()[1]
+    try:
+        monkeypatch.delenv(upgrade.READY_ENV, raising=False)
+        with caplog.at_level(logging.WARNING, logger="veneur.networking"):
+            networking.warn_for_stream_addr(f"127.0.0.1:{port}")
+        assert any("already being served" in r.getMessage()
+                   for r in caplog.records)
+    finally:
+        first.close()
+    # best-effort on everything else: no exceptions
+    networking.warn_for_stream_addr("[::]:0")
+    networking.warn_for_stream_addr("localhost")
+    networking.warn_for_stream_addr("[::]:notaport")
+
+
+def test_overlap_probe_warns_on_second_instance(monkeypatch, caplog):
+    import logging
+
+    from veneur_tpu import networking
+
+    first = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    first.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEPORT, 1)
+    first.bind(("127.0.0.1", 0))
+    port = first.getsockname()[1]
+    try:
+        monkeypatch.delenv(upgrade.READY_ENV, raising=False)
+        with caplog.at_level(logging.WARNING, logger="veneur.networking"):
+            networking.warn_if_port_already_served(
+                socket.AF_INET, socket.SOCK_DGRAM, "127.0.0.1", port)
+        assert any("already being served" in r.getMessage()
+                   for r in caplog.records)
+        # an upgrade replacement overlaps by design: no warning
+        caplog.clear()
+        monkeypatch.setenv(upgrade.READY_ENV, "7")
+        with caplog.at_level(logging.WARNING, logger="veneur.networking"):
+            networking.warn_if_port_already_served(
+                socket.AF_INET, socket.SOCK_DGRAM, "127.0.0.1", port)
+        assert not caplog.records
+    finally:
+        first.close()
+    # a free port is quiet too
+    caplog.clear()
+    monkeypatch.delenv(upgrade.READY_ENV, raising=False)
+    with caplog.at_level(logging.WARNING, logger="veneur.networking"):
+        networking.warn_if_port_already_served(
+            socket.AF_INET, socket.SOCK_DGRAM, "127.0.0.1", port)
+    assert not caplog.records
+
+
+class TestServerCLIWiring:
+    """main() wires SIGUSR2 → spawn_replacement → drain: exercised with
+    the Server and spawn injected, signals delivered for real to the
+    pytest main-thread handlers."""
+
+    def _run_main_with_fakes(self, monkeypatch, tmp_path, spawn_result):
+        from veneur_tpu.cli import server as cli_server
+
+        cfg = tmp_path / "v.yaml"
+        cfg.write_text(
+            "statsd_listen_addresses: ['udp://127.0.0.1:0']\n"
+            "interval: '86400s'\n")
+
+        events = []
+
+        class FakeServer:
+            statsd_addrs = ["127.0.0.1:0"]
+            ssf_addrs = []
+
+            def __init__(self, config):
+                events.append("init")
+
+            def start(self):
+                events.append("start")
+
+            def shutdown(self):
+                events.append("shutdown")
+
+        spawned = []
+
+        def fake_spawn(argv, **kw):
+            spawned.append(argv)
+            return spawn_result
+
+        monkeypatch.setattr(cli_server, "Server", FakeServer)
+        monkeypatch.setattr(cli_server.upgrade, "spawn_replacement",
+                            fake_spawn)
+
+        rc = {}
+
+        def run():
+            rc["rc"] = cli_server.main(["-f", str(cfg)])
+
+        # signal.signal requires the main thread: deliver SIGUSR2 from a
+        # helper thread once main() has installed its handlers and is
+        # blocked in done.wait(); run main() right here.
+        def kicker():
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline and "start" not in events:
+                time.sleep(0.01)
+            os.kill(os.getpid(), signal.SIGUSR2)
+            if spawn_result is None:
+                # failed upgrade must NOT drain; unblock with TERM
+                time.sleep(1.0)
+                os.kill(os.getpid(), signal.SIGTERM)
+
+        saved = {s: signal.getsignal(s)
+                 for s in (signal.SIGTERM, signal.SIGINT, signal.SIGHUP,
+                           signal.SIGUSR2)}
+        t = threading.Thread(target=kicker)
+        t.start()
+        try:
+            run()
+        finally:
+            t.join(timeout=15)
+            for s, h in saved.items():
+                signal.signal(s, h)
+        return rc["rc"], events, spawned
+
+    def test_usr2_spawns_and_drains(self, monkeypatch, tmp_path):
+        class FakeChild:
+            pid = 12345
+
+        rc, events, spawned = self._run_main_with_fakes(
+            monkeypatch, tmp_path, FakeChild())
+        assert rc == 0
+        assert events == ["init", "start", "shutdown"]
+        (argv,) = spawned
+        assert argv[:3] == [sys.executable, "-m", "veneur_tpu.cli.server"]
+
+    def test_failed_upgrade_keeps_serving(self, monkeypatch, tmp_path):
+        rc, events, spawned = self._run_main_with_fakes(
+            monkeypatch, tmp_path, None)
+        # drained only by the later SIGTERM, not by the failed upgrade
+        assert rc == 0
+        assert events == ["init", "start", "shutdown"]
+        assert len(spawned) == 1
+
+
+def test_reuseport_overlap_two_http_generations():
+    """Two OpsServer generations co-bind one TCP port (the property the
+    upgrade relies on), and both answer /healthcheck."""
+    import urllib.request
+
+    from veneur_tpu.httpserv import OpsServer
+
+    old = OpsServer(addr="127.0.0.1:0")
+    old.start()
+    try:
+        port = old.port
+        new = OpsServer(addr=f"127.0.0.1:{port}")
+        new.start()  # would raise EADDRINUSE without SO_REUSEPORT
+        try:
+            for _ in range(4):
+                with urllib.request.urlopen(
+                        f"http://127.0.0.1:{port}/healthcheck",
+                        timeout=5) as resp:
+                    assert resp.status == 200
+        finally:
+            new.stop()
+        # old generation still serving after the new one drains away
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/healthcheck", timeout=5) as resp:
+            assert resp.status == 200
+    finally:
+        old.stop()

@@ -10,6 +10,7 @@ import signal
 import sys
 import threading
 
+from veneur_tpu.cli import upgrade
 from veneur_tpu.config import read_proxy_config
 from veneur_tpu.proxy.proxy import Proxy
 
@@ -33,8 +34,6 @@ def main(argv=None) -> int:
         format="%(asctime)s %(levelname)s %(name)s %(message)s")
 
     proxy = Proxy(config)
-    proxy.start()
-    log.info("Starting proxy on %s", config.http_address)
 
     done = threading.Event()
 
@@ -42,8 +41,20 @@ def main(argv=None) -> int:
         log.info("Received signal %d, shutting down", signum)
         done.set()
 
+    # zero-downtime upgrade, same protocol as the server binary
+    # (reference proxies run under the same einhorn handoff); the
+    # proxy is stateless so draining is just shutdown
+    handle_usr2 = upgrade.make_sigusr2_handler(
+        args.config, "veneur_tpu.cli.proxy", done, log)
+
     signal.signal(signal.SIGTERM, handle_signal)
     signal.signal(signal.SIGINT, handle_signal)
+    if hasattr(signal, "SIGUSR2"):
+        signal.signal(signal.SIGUSR2, handle_usr2)
+
+    proxy.start()
+    log.info("Starting proxy on %s", config.http_address)
+    upgrade.notify_ready()
     done.wait()
     proxy.shutdown()
     return 0

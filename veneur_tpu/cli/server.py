@@ -10,6 +10,7 @@ import signal
 import sys
 import threading
 
+from veneur_tpu.cli import upgrade
 from veneur_tpu.config import read_config
 from veneur_tpu.server import Server
 
@@ -63,6 +64,12 @@ def main(argv=None) -> int:
         threading.Thread(target=do_reload, name="config-reload",
                          daemon=True).start()
 
+    # zero-downtime binary upgrade (the reference's einhorn/SIGUSR2
+    # handoff, server.go:1048-1076, redesigned over SO_REUSEPORT — see
+    # cli/upgrade.py): spawn a replacement, drain only once it serves
+    handle_usr2 = upgrade.make_sigusr2_handler(
+        args.config, "veneur_tpu.cli.server", done, log)
+
     # register handlers BEFORE the (slow: jax init + first compiles)
     # server start, so a signal during startup hits the handler rather
     # than the default action killing the half-started process
@@ -70,10 +77,15 @@ def main(argv=None) -> int:
     signal.signal(signal.SIGINT, handle_signal)
     if hasattr(signal, "SIGHUP"):
         signal.signal(signal.SIGHUP, handle_hup)
+    if hasattr(signal, "SIGUSR2"):
+        signal.signal(signal.SIGUSR2, handle_usr2)
 
     server.start()
     log.info("Starting server on %s (statsd) / %s (ssf)",
              server.statsd_addrs, server.ssf_addrs)
+    # if we are the replacement generation of an upgrade, release the
+    # old generation to drain now that our sockets are serving
+    upgrade.notify_ready()
 
     # HTTPServe/gRPCServe when configured, else block forever
     # (cmd/veneur/main.go:66-88)

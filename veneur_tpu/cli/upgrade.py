@@ -1,0 +1,199 @@
+"""Zero-downtime binary upgrade for the CLI binaries.
+
+The reference hands its listening sockets to a replacement process via
+einhorn + ``goji/graceful``: SIGUSR2 makes the old process stop
+accepting, einhorn re-execs the binary, and the inherited socket keeps
+the port served throughout (``/root/reference/server.go:1048-1076``,
+``cmd/veneur/main.go``). That protocol exists because a plain
+``bind()`` by the replacement would fail while the old process still
+holds the port.
+
+This build's listeners all bind with SO_REUSEPORT
+(``veneur_tpu/networking.py``, ``native/veneur_ingest.cpp``), so two
+generations can serve the same port simultaneously and no socket
+inheritance is needed — the handoff reduces to *process* choreography:
+
+  1. SIGUSR2 → spawn a fresh process with the same command line.
+  2. The replacement binds the same ports alongside the old process
+     (kernel load-balances between them) and finishes startup — which
+     for this build includes jax init and the first flush-program
+     compiles, so readiness is explicit, not timer-based.
+  3. The replacement writes one byte to an inherited pipe
+     (``VENEUR_READY_FD``) once it is serving.
+  4. The old process drains: graceful shutdown with a final flush,
+     exactly as SIGTERM — but only *after* the replacement is ready,
+     so the port is never unserved.
+
+If the replacement dies or fails to become ready in time, the old
+process kills it (if needed) and keeps serving: an upgrade can fail,
+service cannot.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import select
+import subprocess
+import sys
+import threading
+import time
+from typing import List, Optional, Sequence
+
+log = logging.getLogger("veneur.upgrade")
+
+READY_ENV = "VENEUR_READY_FD"
+
+# Startup here includes jax platform init and (on first run of a new
+# binary) uncached XLA compiles, which can take tens of seconds.
+DEFAULT_READY_TIMEOUT = 300.0
+
+
+def notify_ready() -> bool:
+    """Child side of the handshake: if this process was spawned as an
+    upgrade replacement, tell the parent we are serving. Returns True
+    if a notification was sent. Call after the server has started
+    (sockets bound, readers running)."""
+    raw = os.environ.pop(READY_ENV, None)
+    if raw is None:
+        return False
+    try:
+        fd = int(raw)
+    except ValueError:
+        log.error("ignoring malformed %s=%r", READY_ENV, raw)
+        return False
+    try:
+        os.write(fd, b"1")
+        os.close(fd)
+        return True
+    except OSError as e:
+        # Parent died between spawn and our startup: we're simply the
+        # new generation now.
+        log.warning("could not notify upgrade parent: %s", e)
+        return False
+
+
+def replacement_argv(config_path: str, module: str) -> List[str]:
+    """The command line for the replacement generation. Re-exec the
+    same interpreter + module with the same config path — the einhorn
+    analogue of re-running the upgraded binary."""
+    return [sys.executable, "-m", module, "-f", config_path]
+
+
+def spawn_replacement(argv: Sequence[str],
+                      ready_timeout: float = DEFAULT_READY_TIMEOUT,
+                      popen=subprocess.Popen,
+                      ) -> Optional["subprocess.Popen"]:
+    """Parent side: spawn ``argv`` with an inherited readiness pipe and
+    wait for the one-byte handshake.
+
+    Returns the ready child process, or None if the child exited or
+    failed to become ready within ``ready_timeout`` (in which case it
+    has been killed and reaped, and the caller should keep serving).
+    ``popen`` is injectable for tests.
+    """
+    rfd, wfd = os.pipe()
+    os.set_inheritable(wfd, True)
+    env = dict(os.environ)
+    env[READY_ENV] = str(wfd)
+    try:
+        child = popen(list(argv), env=env, pass_fds=(wfd,))
+    except Exception:
+        log.exception("upgrade: failed to spawn replacement %r", argv)
+        os.close(rfd)
+        os.close(wfd)
+        return None
+    os.close(wfd)  # child holds the only write end now
+
+    try:
+        deadline = time.monotonic() + ready_timeout
+        while True:
+            remain = deadline - time.monotonic()
+            if remain <= 0:
+                log.error("upgrade: replacement pid %d not ready after "
+                          "%.0fs; killing it and continuing to serve",
+                          child.pid, ready_timeout)
+                _reap(child)
+                return None
+            readable, _, _ = select.select([rfd], [], [], min(remain, 0.5))
+            if readable:
+                if os.read(rfd, 1):
+                    log.info("upgrade: replacement pid %d is serving",
+                             child.pid)
+                    return child
+                # EOF without a byte: the write end is gone, so the
+                # child can never signal readiness — treat as a failed
+                # upgrade whether it is still running or already dead.
+                rc = child.poll()
+                if rc is None:
+                    log.error("upgrade: replacement pid %d closed the "
+                              "readiness pipe without becoming ready; "
+                              "killing it and continuing to serve",
+                              child.pid)
+                    _reap(child)
+                else:
+                    log.error("upgrade: replacement pid %d exited with "
+                              "%d before becoming ready; continuing to "
+                              "serve", child.pid, rc)
+                return None
+            rc = child.poll()
+            if rc is not None:
+                log.error("upgrade: replacement pid %d exited with %d "
+                          "before becoming ready; continuing to serve",
+                          child.pid, rc)
+                return None
+    finally:
+        os.close(rfd)
+
+
+def make_sigusr2_handler(config_path: str, module: str,
+                         done: "threading.Event",
+                         logger: logging.Logger = log):
+    """Build the SIGUSR2 handler for a CLI binary: spawn a replacement
+    generation of ``module`` and set ``done`` (→ graceful drain) only
+    once it is serving. Overlapping SIGUSR2s coalesce, and a signal
+    arriving while this generation is already draining is ignored —
+    otherwise it would spawn a second replacement that co-serves the
+    ports forever after the first one's parent exits."""
+    upgrading = threading.Lock()
+
+    def do_upgrade():
+        if not upgrading.acquire(blocking=False):
+            logger.info("SIGUSR2: an upgrade is already in progress")
+            return
+        try:
+            if done.is_set():
+                logger.info("SIGUSR2: already draining; ignoring")
+                return
+            argv = replacement_argv(config_path, module)
+            child = spawn_replacement(argv)
+            if child is None:
+                return
+            if done.is_set():
+                # a shutdown signal arrived while the replacement was
+                # starting: the operator asked for the service to STOP,
+                # so the replacement must not outlive this generation
+                logger.warning("shutdown requested during the upgrade; "
+                               "stopping replacement pid %d", child.pid)
+                _reap(child)
+                return
+            logger.info("SIGUSR2: replacement serving; draining "
+                        "this generation")
+            done.set()
+        finally:
+            upgrading.release()
+
+    def handler(signum, frame):
+        logger.info("Received SIGUSR2, starting zero-downtime upgrade")
+        threading.Thread(target=do_upgrade, name="binary-upgrade",
+                         daemon=True).start()
+
+    return handler
+
+
+def _reap(child: "subprocess.Popen") -> None:
+    child.kill()
+    try:
+        child.wait(timeout=10)
+    except Exception:
+        log.warning("upgrade: could not reap pid %d", child.pid)

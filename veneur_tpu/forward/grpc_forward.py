@@ -268,6 +268,13 @@ class ImportServer:
 
     def start(self, addr: str = "[::]:0") -> int:
         """Bind + serve; returns the bound port (server.go:1079-1093)."""
+        # grpc-core binds with SO_REUSEPORT by default on Linux, which
+        # is what the SIGUSR2 upgrade overlap needs — but it also means
+        # an accidental second instance silently splits gRPC ingest,
+        # so run the same probe every other listener type gets
+        from veneur_tpu.networking import warn_for_stream_addr
+
+        warn_for_stream_addr(addr)
         self.port = self._grpc.add_insecure_port(addr)
         if self.port == 0:
             raise RuntimeError(f"could not bind gRPC import server to {addr}")

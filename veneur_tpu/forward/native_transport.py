@@ -82,7 +82,12 @@ class NativeImportServer:
 
     def start(self, addr: str = "127.0.0.1:0") -> int:
         host, _, port = addr.rpartition(":")
-        s = socket.create_server((host or "127.0.0.1", int(port)))
+        # reuse_port (via new_tcp_listener) so an upgrade/rolling
+        # restart can overlap two generations on the import port
+        # (cli/upgrade.py)
+        from veneur_tpu.networking import new_tcp_listener
+
+        s = new_tcp_listener(socket.AF_INET, host or "127.0.0.1", int(port))
         s.settimeout(0.5)  # accept loop polls the stop flag
         self._listener = s
         self.port = s.getsockname()[1]

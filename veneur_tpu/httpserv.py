@@ -18,6 +18,7 @@ from __future__ import annotations
 import json
 import logging
 import queue
+import socket
 import threading
 import time
 import urllib.parse
@@ -242,6 +243,24 @@ class ImportQueuePool:
             t.join(timeout=5.0)
 
 
+class ReuseportHTTPServer(ThreadingHTTPServer):
+    """ThreadingHTTPServer that binds with SO_REUSEPORT so a SIGUSR2
+    upgrade (cli/upgrade.py) or rolling restart can run two generations
+    on the same port — the role einhorn socket inheritance plays for
+    the reference (server.go:1048-1076)."""
+
+    def server_bind(self):
+        if hasattr(socket, "SO_REUSEPORT"):
+            self.socket.setsockopt(socket.SOL_SOCKET,
+                                   socket.SO_REUSEPORT, 1)
+            from veneur_tpu.networking import warn_if_port_already_served
+
+            host, port = self.server_address[:2]
+            warn_if_port_already_served(self.address_family,
+                                        socket.SOCK_STREAM, host, port)
+        super().server_bind()
+
+
 class OpsServer:
     """The /healthcheck,/version,/import endpoint bundle (http.go:21-51).
 
@@ -255,7 +274,7 @@ class OpsServer:
                  trace_client=None, import_workers: int = 2,
                  import_queue: int = 64):
         host, _, port = addr.rpartition(":")
-        self._httpd = ThreadingHTTPServer((host or "127.0.0.1", int(port)),
+        self._httpd = ReuseportHTTPServer((host or "127.0.0.1", int(port)),
                                           _Handler)
         self._httpd.daemon_threads = True
         self.import_pool = (

@@ -23,13 +23,87 @@ from veneur_tpu.protocol.addr import ResolvedAddr, resolve_addr
 log = logging.getLogger("veneur.networking")
 
 
+def warn_if_port_already_served(family: int, kind: int, host: str,
+                                port: int) -> None:
+    """SO_REUSEPORT on every listener trades the EADDRINUSE fail-fast
+    for upgrade/rolling-restart overlap, so an accidental second
+    instance would otherwise *silently* split ingest with the first.
+    Probe the port with a plain (non-reuseport) bind before our real
+    bind: if someone is already serving it, say so loudly. Deliberate
+    overlaps — an upgrade replacement (VENEUR_READY_FD in the
+    environment) — stay quiet; a manual rolling restart gets one
+    informational line."""
+    if port == 0:
+        return
+    probe = None
+    try:
+        # The probe is strictly best-effort: socket creation itself can
+        # fail (e.g. EAFNOSUPPORT for an IPv6 wildcard on a v6-disabled
+        # host) and must never break startup — the real bind reports
+        # the accurate error. REUSEADDR so server-side TIME_WAIT from
+        # an ordinary restart doesn't read as a live second instance; a
+        # real listener still conflicts. EACCES etc. stay quiet too.
+        probe = socket.socket(family, kind)
+        probe.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        probe.bind((host, port))
+    except OSError as e:
+        if e.errno == errno.EADDRINUSE:
+            from veneur_tpu.cli.upgrade import READY_ENV
+
+            if os.environ.get(READY_ENV):
+                return  # upgrade replacement: overlap is the protocol
+            log.warning(
+                "port %s:%d is already being served by another process; "
+                "binding alongside it (SO_REUSEPORT). If this is not a "
+                "deliberate rolling restart, ingest will be split "
+                "between the two instances.", host, port)
+    finally:
+        if probe is not None:
+            probe.close()
+
+
+def warn_for_stream_addr(addr_str: str) -> None:
+    """The probe above for callers holding a raw ``host:port`` /
+    ``[v6]:port`` string (the gRPC listener's address format) rather
+    than a resolved family+host+port."""
+    host, _, port_s = addr_str.rpartition(":")
+    host = host.strip("[]")
+    try:
+        port = int(port_s)
+    except ValueError:
+        return
+    if not port:
+        return
+    if ":" in host or host in ("", "::"):
+        family, wildcard = socket.AF_INET6, "::"
+    else:
+        family, wildcard = socket.AF_INET, "0.0.0.0"
+    warn_if_port_already_served(family, socket.SOCK_STREAM,
+                                host or wildcard, port)
+
+
+def new_tcp_listener(family: int, host: str, port: int,
+                     backlog: int = 128) -> socket.socket:
+    """A bound+listening TCP socket with the upgrade-overlap treatment
+    every stream listener gets: SO_REUSEPORT (where available) plus the
+    accidental-second-instance probe above."""
+    listener = socket.socket(family, socket.SOCK_STREAM)
+    listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    if hasattr(socket, "SO_REUSEPORT"):
+        listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEPORT, 1)
+        warn_if_port_already_served(family, socket.SOCK_STREAM, host, port)
+    listener.bind((host, port))
+    listener.listen(backlog)
+    return listener
+
+
 def new_udp_socket(addr: ResolvedAddr, recv_buf: int,
                    reuse_port: bool) -> socket.socket:
     """A bound UDP socket with SO_REUSEPORT + SO_RCVBUF
     (socket_linux.go:12-76)."""
     sock = socket.socket(addr.socket_family, socket.SOCK_DGRAM)
     sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-    if reuse_port:
+    if reuse_port and hasattr(socket, "SO_REUSEPORT"):
         sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEPORT, 1)
     if recv_buf:
         sock.setsockopt(socket.SOL_SOCKET, socket.SO_RCVBUF, recv_buf)
@@ -49,13 +123,19 @@ def start_statsd(addr_spec: str, num_readers: int, recv_buf: int,
     UDP: num_readers reader threads each with its own SO_REUSEPORT socket.
     TCP: an accept loop spawning per-connection line readers.
     Returns (reader threads — daemons, already started; bound addresses).
+
+    Every listener binds with SO_REUSEPORT even when a single reader
+    needs no kernel balancing: a SIGUSR2 upgrade (cli/upgrade.py) and a
+    rolling restart both briefly run two generations on the same port.
     """
     addr = resolve_addr(addr_spec)
     threads: List[threading.Thread] = []
     bound: List[tuple] = []
     if addr.family == "udp":
+        warn_if_port_already_served(addr.socket_family, socket.SOCK_DGRAM,
+                                    addr.host, addr.port)
         for i in range(num_readers):
-            sock = new_udp_socket(addr, recv_buf, reuse_port=num_readers > 1)
+            sock = new_udp_socket(addr, recv_buf, reuse_port=True)
             bound.append(sock.getsockname())
             # with an ephemeral port (":0"), later readers must share the
             # port the first one got
@@ -69,10 +149,7 @@ def start_statsd(addr_spec: str, num_readers: int, recv_buf: int,
             t.start()
             threads.append(t)
     elif addr.family == "tcp":
-        listener = socket.socket(addr.socket_family, socket.SOCK_STREAM)
-        listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-        listener.bind((addr.host, addr.port))
-        listener.listen(128)
+        listener = new_tcp_listener(addr.socket_family, addr.host, addr.port)
         bound.append(listener.getsockname())
         t = threading.Thread(
             target=_tcp_accept_loop,
@@ -190,8 +267,10 @@ def start_ssf(addr_spec: str, num_readers: int, recv_buf: int,
     threads: List[threading.Thread] = []
     bound: List = []
     if addr.family == "udp":
+        warn_if_port_already_served(addr.socket_family, socket.SOCK_DGRAM,
+                                    addr.host, addr.port)
         for i in range(num_readers):
-            sock = new_udp_socket(addr, recv_buf, reuse_port=num_readers > 1)
+            sock = new_udp_socket(addr, recv_buf, reuse_port=True)
             bound.append(sock.getsockname())
             if addr.port == 0:
                 addr = ResolvedAddr(scheme=addr.scheme, family="udp",
@@ -216,10 +295,7 @@ def start_ssf(addr_spec: str, num_readers: int, recv_buf: int,
         t.start()
         threads.append(t)
     elif addr.family == "tcp":
-        listener = socket.socket(addr.socket_family, socket.SOCK_STREAM)
-        listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-        listener.bind((addr.host, addr.port))
-        listener.listen(128)
+        listener = new_tcp_listener(addr.socket_family, addr.host, addr.port)
         bound.append(listener.getsockname())
         t = threading.Thread(
             target=_stream_accept_loop,

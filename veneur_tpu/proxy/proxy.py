@@ -21,7 +21,8 @@ from typing import Dict, List, Optional
 from veneur_tpu.config import ProxyConfig, parse_duration
 from veneur_tpu.discovery import ConsulDiscoverer, Discoverer, StaticDiscoverer
 from veneur_tpu.forward.http_forward import post_helper
-from veneur_tpu.httpserv import (ImportError400, bounded_inflate,
+from veneur_tpu.httpserv import (ImportError400, ReuseportHTTPServer,
+                                 bounded_inflate,
                                  unmarshal_metrics_from_http)
 from veneur_tpu.proxy.consistent import ConsistentRing, EmptyRingError
 
@@ -290,7 +291,7 @@ class Proxy:
             self._threads.append(t)
         host, _, port = (self.config.http_address or "0.0.0.0:8127"
                          ).rpartition(":")
-        self._httpd = ThreadingHTTPServer((host or "0.0.0.0", int(port)),
+        self._httpd = ReuseportHTTPServer((host or "0.0.0.0", int(port)),
                                           _ProxyHandler)
         self._httpd.daemon_threads = True
         self._httpd.veneur_proxy = self

@@ -18,6 +18,7 @@ from __future__ import annotations
 import logging
 import math
 import queue
+import socket
 import threading
 import time
 from typing import Callable, List, Optional
@@ -525,6 +526,13 @@ class Server:
 
         if not native.available():
             return False
+        # same accidental-second-instance probe every other
+        # SO_REUSEPORT listener gets (networking.py)
+        from veneur_tpu.networking import warn_if_port_already_served
+
+        warn_if_port_already_served(socket.AF_INET, socket.SOCK_DGRAM,
+                                    resolved.host or "0.0.0.0",
+                                    resolved.port)
         try:
             reader = native.NativeUDPReader(
                 host=resolved.host or "0.0.0.0", port=resolved.port,
