@@ -205,24 +205,44 @@ def bench_import_throughput(num_series: int = 20000, duration: float = 4.0):
     from veneur_tpu.native import egress as eg
 
     quant_payload = None
+    light_payload = None
     if eg.available():
-        dmin = means2d[:, 0].astype(np.float32)
-        dmax = means2d[:, -1].astype(np.float32)
-        span = (dmax - dmin).astype(np.float64)
-        q = np.clip(np.round((means2d - dmin[:, None])
-                             / np.where(span[:, None] > 0, span[:, None], 1)
-                             * 65535), 0, 65535).astype(np.uint16)
-        wbf = (np.ones((num_series, K), np.float32).view(np.uint32)
-               >> 16).astype(np.uint16)
-        planes = PackedDigestPlanes(
-            np.full(num_series, K, np.uint16), q.reshape(-1),
-            wbf.reshape(-1), dmin, dmax)
         names = cbv.build_arenas(
             [f"svc.latency.{i}" for i in range(num_series)])
         tags = cbv.build_arenas(
             [f"shard:{i % 13}" for i in range(num_series)])
-        quant_payload = b"".join(eg.encode_digest_metrics_packed(
-            names, tags, planes, 2))
+
+        def packed_payload(live_counts: np.ndarray) -> bytes:
+            # ragged packed wire exactly as the packed flush emits it:
+            # per-row live centroid counts, u16 range-quantized means,
+            # bf16 weight bits
+            total = int(live_counts.sum())
+            q = np.empty(total, np.uint16)
+            dmin = np.empty(num_series, np.float32)
+            dmax = np.empty(num_series, np.float32)
+            pos = 0
+            for i in range(num_series):
+                n = int(live_counts[i])
+                m = means2d[i, :n]
+                dmin[i], dmax[i] = m[0], m[-1]
+                span = m[-1] - m[0]
+                q[pos:pos + n] = np.clip(np.round(
+                    (m - m[0]) / (span if span > 0 else 1) * 65535),
+                    0, 65535).astype(np.uint16)
+                pos += n
+            wbf = (np.ones(total, np.float32).view(np.uint32)
+                   >> 16).astype(np.uint16)
+            planes = PackedDigestPlanes(
+                live_counts.astype(np.uint16), q, wbf, dmin, dmax)
+            return b"".join(eg.encode_digest_metrics_packed(
+                names, tags, planes, 2))
+
+        quant_payload = packed_payload(np.full(num_series, K, np.int64))
+        # realistic forwarded density: each 10s interval leaves most
+        # digests with a handful of live centroids (config 2e measures
+        # ~1-5 on real intervals); 1-8 here, mean ~3.9
+        light_payload = packed_payload(
+            np.clip(rng.poisson(3.0, num_series) + 1, 1, 8))
 
     # 2^17 staging chunks: a 20k x 48-centroid batch drains in 8 device
     # dispatches instead of 30 — dispatch latency, not decode, is the
@@ -319,17 +339,17 @@ def bench_import_throughput(num_series: int = 20000, duration: float = 4.0):
         nsrv = NativeImportServer(store)
         nport = nsrv.start("127.0.0.1:0")
 
-        def native_sender(deadline, counter, lock):
+        def native_sender(deadline, counter, lock, pl):
             import socket as _socket
             import struct as _struct
 
             s = _socket.create_connection(("127.0.0.1", nport), 30)
             s.sendall(MAGIC)
-            header = _struct.pack(">I", len(payload))
+            header = _struct.pack(">I", len(pl))
             try:
                 while time.perf_counter() < deadline:
                     s.sendall(header)
-                    s.sendall(payload)
+                    s.sendall(pl)
                     got = 0
                     while got < 4:
                         r = s.recv(4 - got)
@@ -341,12 +361,13 @@ def bench_import_throughput(num_series: int = 20000, duration: float = 4.0):
             finally:
                 s.close()
 
-        def run_native_round(seconds):
+        def run_native_round(seconds, pl=None):
+            pl = payload if pl is None else pl
             counter, lock = [0], threading.Lock()
             deadline = time.perf_counter() + seconds
             t0 = time.perf_counter()
             senders = [threading.Thread(target=native_sender,
-                                        args=(deadline, counter, lock))
+                                        args=(deadline, counter, lock, pl))
                        for _ in range(2)]
             for t in senders:
                 t.start()
@@ -377,8 +398,8 @@ def bench_import_throughput(num_series: int = 20000, duration: float = 4.0):
         # THIS harness that barrier measures the ~20 MB/s tunnel
         # absorbing the upload, not the framework). The reset between
         # lanes stops queue backlog from bleeding across them.
-        lanes = {k: ([], []) for k in ("grpc", "native", "quant",
-                                       "legacy")}
+        lanes = {k: ([], []) for k in ("grpc", "native", "light",
+                                       "quant", "legacy")}
 
         def record(key, pair):
             lanes[key][0].append(pair[0])
@@ -386,12 +407,20 @@ def bench_import_throughput(num_series: int = 20000, duration: float = 4.0):
 
         try:
             run_native_round(0.2)  # warm the native path
+            if light_payload is not None:
+                run_native_round(0.2, light_payload)  # + its shapes
             for _ in range(3):
                 reset_store()
                 record("grpc", run_grpc_round(duration / 2))
                 reset_store()
                 record("native", run_native_round(duration / 2))
                 reset_store()
+                if light_payload is not None:
+                    # realistic forwarded density on the fastest lane:
+                    # the per-core rate a fleet actually sees
+                    record("light",
+                           run_native_round(duration / 2, light_payload))
+                    reset_store()
                 if eg.available():
                     record("quant", run_store_round(quant_payload))
                     reset_store()
@@ -401,13 +430,18 @@ def bench_import_throughput(num_series: int = 20000, duration: float = 4.0):
         med = lambda xs: int(np.median(xs)) if xs else None  # noqa: E731
         return {"series_merged_per_s": med(lanes["grpc"][0]),
                 "native_transport_series_per_s": med(lanes["native"][0]),
+                "realistic_density_series_per_s": med(lanes["light"][0]),
                 "store_path_series_per_s": med(lanes["quant"][0]),
                 "store_path_legacy_wire_per_s": med(lanes["legacy"][0]),
                 "sustained_on_tunnel_per_s": {
                     "grpc": med(lanes["grpc"][1]),
                     "native": med(lanes["native"][1]),
+                    "realistic": med(lanes["light"][1]),
                     "store_path": med(lanes["quant"][1])},
                 "wire_bytes_per_series": round(len(payload) / num_series),
+                "wire_bytes_per_series_realistic": (
+                    round(len(light_payload) / num_series)
+                    if light_payload is not None else None),
                 "senders": 2, "rounds": 3,
                 "batch_series": num_series,
                 "centroids_per_digest": K,
@@ -425,10 +459,13 @@ def bench_import_throughput(num_series: int = 20000, duration: float = 4.0):
                         "per core (above), device scatter ~10-15M "
                         "centroids/s per chip (~250k series/s); the "
                         "fleet scales both axes — N importer cores and "
-                        "mesh-sharded chips — and real forwarded "
-                        "digests average ~1-5 live centroids (see 2e), "
-                        "10-40x lighter per series. Quantized wire at "
-                        "264 B/series"}
+                        "mesh-sharded chips. realistic_density lane "
+                        "MEASURES the fleet-realistic workload on the "
+                        "framed-TCP transport: ragged packed digests at "
+                        "1-8 live centroids (mean ~3.9, matching what "
+                        "config 2e observes on real forwarded "
+                        "intervals) instead of the dense-48 stress "
+                        "shape the other lanes carry"}
     finally:
         srv.stop()
 
