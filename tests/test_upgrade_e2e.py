@@ -1,0 +1,168 @@
+"""End-to-end SIGUSR2 upgrade against REAL server processes: gen1
+serves UDP, USR2 spawns gen2 via the production code path (re-exec +
+readiness pipe), gen1 drains and exits zero, gen2 keeps serving the
+same port. This is the automated form of the handoff the unit tests
+in test_upgrade.py cover piecewise.
+
+Each generation is a real ``python -m veneur_tpu.cli.server`` process
+(CPU jax platform), so the test pays two jax startups — the timeouts
+are sized for that, and the whole class is skipped under
+``VENEUR_SKIP_SLOW=1``.
+"""
+
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+
+import pytest
+
+pytestmark = pytest.mark.skipif(
+    os.environ.get("VENEUR_SKIP_SLOW") == "1",
+    reason="slow e2e test skipped by VENEUR_SKIP_SLOW")
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+STARTUP_TIMEOUT = 180.0
+
+
+# Unlike the rest of the suite, this test cannot bind port 0 and read
+# the result back: the replacement generation re-execs the SAME config
+# file, so the ports in it must be stable across generations. Probe a
+# free port and accept the close-to-bind race (the same tradeoff
+# test_rolling_restart makes, and the window is milliseconds).
+def _free_udp_port() -> int:
+    s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEPORT, 1)
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _free_tcp_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _wait_health(port: int, deadline: float) -> bool:
+    import urllib.request
+
+    while time.monotonic() < deadline:
+        try:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/healthcheck",
+                    timeout=2) as resp:
+                if resp.status == 200:
+                    return True
+        except OSError:
+            time.sleep(0.25)
+    return False
+
+
+def _store_processed(port: int):
+    """store.processed_this_interval from /debug/vars, or None."""
+    import json
+    import urllib.request
+
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/debug/vars", timeout=2) as resp:
+            data = json.loads(resp.read())
+        return data.get("store", {}).get("processed_this_interval")
+    except OSError:
+        return None
+
+
+def test_sigusr2_full_handoff(tmp_path):
+    udp = _free_udp_port()
+    http = _free_tcp_port()
+    cfg = tmp_path / "server.yaml"
+    cfg.write_text(
+        f"statsd_listen_addresses: ['udp://127.0.0.1:{udp}']\n"
+        f"http_address: '127.0.0.1:{http}'\n"
+        "interval: '600s'\n"  # no tick resets processed_this_interval
+        "aggregates: ['count']\n"
+        "num_readers: 1\n"
+        "store_initial_capacity: 64\n"
+        "store_chunk: 128\n")
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONPATH=_REPO + os.pathsep + os.environ.get("PYTHONPATH",
+                                                              ""))
+    env.pop("XLA_FLAGS", None)
+    log1 = open(tmp_path / "gen1.log", "wb")
+    gen1 = subprocess.Popen(
+        [sys.executable, "-m", "veneur_tpu.cli.server", "-f", str(cfg)],
+        env=env, stdout=log1, stderr=subprocess.STDOUT)
+    gen2_pid = None
+    try:
+        assert _wait_health(http, time.monotonic() + STARTUP_TIMEOUT), \
+            "gen1 never became healthy"
+
+        sender = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        sender.connect(("127.0.0.1", udp))
+        sender.send(b"upgrade.before:1|c")
+
+        gen1.send_signal(signal.SIGUSR2)
+
+        # gen1 must exit 0 once the replacement is serving
+        assert gen1.wait(timeout=STARTUP_TIMEOUT) == 0
+
+        # the replacement generation owns the port now: health answers
+        # and UDP sent post-handoff must be RECEIVED AND AGGREGATED by
+        # it (gen1 is gone, so any nonzero processed count is gen2's)
+        assert _wait_health(http, time.monotonic() + 30), \
+            "no generation serving after gen1 drained"
+        for _ in range(5):
+            sender.send(b"upgrade.after:1|c")
+        sender.close()
+        deadline = time.monotonic() + 30
+        got = None
+        while time.monotonic() < deadline:
+            got = _store_processed(http)
+            if got:
+                break
+            time.sleep(0.25)
+        assert got, ("replacement generation never aggregated the "
+                     "post-handoff datagrams")
+
+        # find the replacement (child of init now; match the module)
+        out = subprocess.run(
+            ["pgrep", "-f", f"veneur_tpu.cli.server -f {cfg}"],
+            capture_output=True, text=True)
+        pids = [int(p) for p in out.stdout.split()]
+        assert pids, "replacement process not found"
+        assert gen1.pid not in pids
+        gen2_pid = pids[0]
+    finally:
+        log1.close()
+        if gen1.poll() is None:
+            gen1.kill()
+            gen1.wait(timeout=10)
+        if gen2_pid is not None:
+            try:
+                os.kill(gen2_pid, signal.SIGTERM)
+                deadline = time.monotonic() + 30
+                while time.monotonic() < deadline:
+                    try:
+                        os.kill(gen2_pid, 0)
+                    except ProcessLookupError:
+                        break
+                    time.sleep(0.25)
+                else:
+                    os.kill(gen2_pid, signal.SIGKILL)
+            except ProcessLookupError:
+                pass
+        else:
+            # belt and braces: no orphan generations survive the test
+            subprocess.run(["pkill", "-KILL", "-f",
+                            f"veneur_tpu.cli.server -f {cfg}"],
+                           capture_output=True)
+
+    gen1_log = (tmp_path / "gen1.log").read_text()
+    assert "replacement pid" in gen1_log and "is serving" in gen1_log
+    assert "draining this generation" in gen1_log
