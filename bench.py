@@ -474,6 +474,118 @@ def bench_import_throughput(num_series: int = 20000, duration: float = 4.0):
         srv.stop()
 
 
+def bench_tls_handshakes(seconds: float = 2.5):
+    """Config #7: TLS connection-establishment rate through the
+    production TLS statsd listener (networking.py). The reference's
+    README publishes its only non-pps perf numbers here: ~700
+    connections/s with ECDH prime256v1 and ~110/s with RSA 2048, on
+    localhost with 1 CPU (README.md:346). Same shape: localhost, the
+    client hammering full handshakes on the same core as the server."""
+    import datetime
+    import ipaddress
+    import socket
+    import ssl
+    import tempfile
+    import threading
+
+    from cryptography import x509
+    from cryptography.hazmat.primitives import hashes, serialization
+    from cryptography.hazmat.primitives.asymmetric import ec, rsa
+    from cryptography.x509.oid import NameOID
+
+    from veneur_tpu.networking import make_server_tls_context, start_statsd
+
+    def self_signed(key):
+        name = x509.Name(
+            [x509.NameAttribute(NameOID.COMMON_NAME, "localhost")])
+        now = datetime.datetime.now(datetime.timezone.utc)
+        return (x509.CertificateBuilder()
+                .subject_name(name).issuer_name(name)
+                .public_key(key.public_key())
+                .serial_number(x509.random_serial_number())
+                .not_valid_before(now - datetime.timedelta(minutes=5))
+                .not_valid_after(now + datetime.timedelta(days=1))
+                .add_extension(x509.SubjectAlternativeName(
+                    [x509.DNSName("localhost"),
+                     x509.IPAddress(ipaddress.ip_address("127.0.0.1"))]),
+                    critical=False)
+                .sign(key, hashes.SHA256()))
+
+    out = {}
+    for label, key in (
+            ("ecdsa_p256", ec.generate_private_key(ec.SECP256R1())),
+            ("rsa_2048", rsa.generate_private_key(public_exponent=65537,
+                                                  key_size=2048))):
+        cert = self_signed(key)
+        stop = threading.Event()
+        cert_path = key_path = None
+        try:
+            with tempfile.NamedTemporaryFile("wb", suffix=".pem",
+                                             delete=False) as cf:
+                cert_path = cf.name
+                cf.write(cert.public_bytes(serialization.Encoding.PEM))
+            with tempfile.NamedTemporaryFile("wb", suffix=".pem",
+                                             delete=False) as kf:
+                key_path = kf.name
+                kf.write(key.private_bytes(
+                    serialization.Encoding.PEM,
+                    serialization.PrivateFormat.PKCS8,
+                    serialization.NoEncryption()))
+
+            ctx = make_server_tls_context(cert_path, key_path)
+            _, bound = start_statsd(
+                "tcp://127.0.0.1:0", num_readers=1, recv_buf=0,
+                metric_max_length=4096, handle_packet=lambda b: None,
+                stop=stop, tls_config=ctx)
+            port = bound[0][1]
+            cctx = ssl.create_default_context()
+            cctx.load_verify_locations(cert_path)
+
+            def handshake():
+                with socket.create_connection(("127.0.0.1", port),
+                                              5) as raw:
+                    with cctx.wrap_socket(raw,
+                                          server_hostname="localhost"):
+                        pass
+
+            # warm once, then count completed handshakes for `seconds`;
+            # a transient reset costs one loop turn, not the config
+            for _ in range(3):
+                handshake()
+            n = errs = 0
+            deadline = time.perf_counter() + seconds
+            t0 = time.perf_counter()
+            while time.perf_counter() < deadline:
+                try:
+                    handshake()
+                    n += 1
+                except OSError:
+                    errs += 1
+                    if errs > 50:
+                        raise
+            out[f"{label}_conn_s"] = int(n / (time.perf_counter() - t0))
+            if errs:
+                out[f"{label}_transient_errors"] = errs
+        except Exception as e:
+            # keep the other key type's result (guarded() would drop all)
+            out[f"{label}_error"] = f"{type(e).__name__}: {e}"[:120]
+        finally:
+            stop.set()
+            for p in (cert_path, key_path):
+                if p is not None:
+                    try:
+                        os.unlink(p)
+                    except OSError:
+                        pass
+    out["reference_readme_conn_s"] = {"ecdh_prime256v1": 700,
+                                      "rsa_2048": 110}
+    out["note"] = ("full handshake + close per connection against the "
+                   "production TLS statsd listener; client and server "
+                   "share one core, as in the reference's "
+                   "localhost/1-CPU claim (README.md:346)")
+    return out
+
+
 def bench_merge_global(num_series: int, digest_dtype: str = "bfloat16",
                        iters: int = 5):
     """Config #2c: the single-chip global-aggregator kernel — merge one
@@ -1337,6 +1449,7 @@ def _run_all(result):
     configs["5_heavy_hitters"] = guarded(bench_heavy_hitters)
     configs["5b_heavy_hitters_100m"] = run_isolated(
         "bench_heavy_hitters_100m")
+    configs["7_tls_handshakes"] = guarded(bench_tls_handshakes)
 
 
 def _headline(result) -> dict:
@@ -1372,6 +1485,8 @@ def _headline(result) -> dict:
             "5b_topk_100m": pick("5b_heavy_hitters_100m",
                                  "updates_per_s", "recall_at_64"),
             "6_egress_1m": pick("6_egress_1m", "total_s"),
+            "7_tls": pick("7_tls_handshakes", "ecdsa_p256_conn_s",
+                          "rsa_2048_conn_s"),
         },
         "detail_file": "BENCH_DETAIL.json",
     }
