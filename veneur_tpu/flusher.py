@@ -175,9 +175,13 @@ def _worker_samples(server, ms):
 
     errs = server.packet_errors - server._last_packet_errors
     drops = server.packet_drops - server._last_packet_drops
+    span_drops = server.spans_dropped - server._last_spans_dropped
     server._last_packet_errors = server.packet_errors
     server._last_packet_drops = server.packet_drops
+    server._last_spans_dropped = server.spans_dropped
     out = [
+        ssf_samples.count("veneur.worker.spans_dropped_total",
+                          float(span_drops), None),
         ssf_samples.count("veneur.worker.metrics_processed_total",
                           float(ms.processed), None),
         ssf_samples.count("veneur.worker.metrics_imported_total",

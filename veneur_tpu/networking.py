@@ -198,15 +198,9 @@ def _tcp_accept_loop(listener: socket.socket, max_len: int,
             continue
         except OSError:
             break
-        if tls_config is not None:
-            try:
-                conn = tls_config.wrap_socket(conn, server_side=True)
-            except ssl.SSLError as e:
-                log.warning("TLS handshake failed from %s: %s", peer, e)
-                conn.close()
-                continue
         t = threading.Thread(target=_tcp_conn_loop,
-                             args=(conn, max_len, handle_line, stop),
+                             args=(conn, max_len, handle_line, stop,
+                                   tls_config, peer),
                              daemon=True)
         t.start()
     listener.close()
@@ -214,9 +208,25 @@ def _tcp_accept_loop(listener: socket.socket, max_len: int,
 
 def _tcp_conn_loop(conn: socket.socket, max_len: int,
                    handle_line: Callable[[bytes], None],
-                   stop: threading.Event):
+                   stop: threading.Event,
+                   tls_config: Optional[ssl.SSLContext] = None,
+                   peer=None):
     """Newline-scan a TCP connection; a single line longer than max_len
-    poisons the connection (server.go:920-983)."""
+    poisons the connection (server.go:920-983).
+
+    The TLS handshake happens HERE, on the per-connection thread — in
+    the accept loop a client that connects and sends nothing would
+    wedge wrap_socket and with it every other connection (slowloris);
+    on this thread it can only wedge itself, and the timeout bounds
+    even that. socket.timeout is an OSError."""
+    if tls_config is not None:
+        try:
+            conn.settimeout(10.0)
+            conn = tls_config.wrap_socket(conn, server_side=True)
+        except (ssl.SSLError, OSError) as e:
+            log.warning("TLS handshake failed from %s: %s", peer, e)
+            conn.close()
+            return
     conn.settimeout(0.5)
     buf = bytearray()
     while not stop.is_set():

@@ -296,6 +296,9 @@ class Server:
         # ingest error/telemetry counters
         self.packet_errors = 0
         self.packet_drops = 0
+        self.spans_dropped = 0
+        self._last_spans_dropped = 0
+        self._last_span_drop_log = 0.0
         self._last_packet_errors = 0
         self._last_packet_drops = 0
         self._warned_no_forward = False
@@ -349,7 +352,16 @@ class Server:
         try:
             self.span_chan.put_nowait(span)
         except queue.Full:
-            log.warning("dropping span; span channel is full")
+            # shedding is the designed overload behavior; one warning
+            # per drop would flood the log (and the GIL) at exactly the
+            # moment the pipeline is saturated — count every drop, log
+            # at most once a second
+            self.spans_dropped += 1
+            now = time.monotonic()
+            if now - self._last_span_drop_log >= 1.0:
+                self._last_span_drop_log = now
+                log.warning("dropping spans; span channel is full "
+                            "(%d dropped since start)", self.spans_dropped)
 
     def handle_ssf_stream(self, conn):
         """Framed-SSF stream pump; a framing error poisons the stream and
