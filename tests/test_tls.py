@@ -188,3 +188,36 @@ class TestTLSListeners:
                                   with_cert="rogue")
         finally:
             server.shutdown()
+
+    def test_silent_client_does_not_block_other_handshakes(self, certs):
+        """Slowloris: a client that connects and sends NOTHING must not
+        stall other clients — the handshake runs on the per-connection
+        thread (networking._tcp_conn_loop), never in the accept loop."""
+        server, sink, addr = _server(certs, client_auth=False)
+        try:
+            silent = socket.create_connection(addr, timeout=5)
+            try:
+                # while the silent connection sits in its handshake,
+                # a legitimate client must get straight through
+                t0 = time.perf_counter()
+                _send_tls(certs, addr, b"tls.past_slowloris:1|c\n")
+                assert time.perf_counter() - t0 < 5.0
+                assert _wait_processed(server, 1) == 1
+            finally:
+                silent.close()
+        finally:
+            server.shutdown()
+
+    def test_garbage_handshake_then_reset_keeps_serving(self, certs):
+        """A client that writes junk mid-handshake (or resets) costs one
+        connection; the listener keeps accepting afterwards."""
+        server, sink, addr = _server(certs, client_auth=False)
+        try:
+            for _ in range(3):
+                raw = socket.create_connection(addr, timeout=5)
+                raw.sendall(b"\x16\x03\x01\x00\x04junk")
+                raw.close()
+            _send_tls(certs, addr, b"tls.after_garbage:1|c\n")
+            assert _wait_processed(server, 1) == 1
+        finally:
+            server.shutdown()

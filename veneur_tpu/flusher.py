@@ -173,12 +173,17 @@ def _worker_samples(server, ms):
     deltas, like the reference's per-interval worker counters."""
     from veneur_tpu.trace import samples as ssf_samples
 
-    errs = server.packet_errors - server._last_packet_errors
-    drops = server.packet_drops - server._last_packet_drops
-    span_drops = server.spans_dropped - server._last_spans_dropped
-    server._last_packet_errors = server.packet_errors
-    server._last_packet_drops = server.packet_drops
-    server._last_spans_dropped = server.spans_dropped
+    # snapshot each counter ONCE: a second read for the reset would
+    # permanently drop anything counted between the two reads
+    cur_errs = server.packet_errors
+    cur_drops = server.packet_drops
+    cur_span_drops = server.spans_dropped
+    errs = cur_errs - server._last_packet_errors
+    drops = cur_drops - server._last_packet_drops
+    span_drops = cur_span_drops - server._last_spans_dropped
+    server._last_packet_errors = cur_errs
+    server._last_packet_drops = cur_drops
+    server._last_spans_dropped = cur_span_drops
     out = [
         ssf_samples.count("veneur.worker.spans_dropped_total",
                           float(span_drops), None),

@@ -298,6 +298,7 @@ class Server:
         self.packet_drops = 0
         self.spans_dropped = 0
         self._last_spans_dropped = 0
+        self._span_drop_lock = threading.Lock()
         self._last_span_drop_log = 0.0
         self._last_packet_errors = 0
         self._last_packet_drops = 0
@@ -356,12 +357,17 @@ class Server:
             # per drop would flood the log (and the GIL) at exactly the
             # moment the pipeline is saturated — count every drop, log
             # at most once a second
-            self.spans_dropped += 1
+            with self._span_drop_lock:
+                # locked: many reader/stream threads shed here at once,
+                # and an unlocked += loses counts exactly when drops
+                # spike — the condition this counter exists to measure
+                self.spans_dropped += 1
+                dropped = self.spans_dropped
             now = time.monotonic()
             if now - self._last_span_drop_log >= 1.0:
                 self._last_span_drop_log = now
                 log.warning("dropping spans; span channel is full "
-                            "(%d dropped since start)", self.spans_dropped)
+                            "(%d dropped since start)", dropped)
 
     def handle_ssf_stream(self, conn):
         """Framed-SSF stream pump; a framing error poisons the stream and
