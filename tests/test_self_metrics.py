@@ -1,0 +1,131 @@
+"""The canonical self-metric set the reference DOCUMENTS
+(README.md:256-276): a user switching from the reference dashboards on
+these exact names, so each one is locked in here — the flusher deltas
+(_worker/_forward/_import/_sink samples) and the sink-side telemetry
+they drain."""
+
+from veneur_tpu import flusher
+from veneur_tpu.forward.http_forward import HTTPForwarder
+
+
+class _StubServer:
+    """Just enough server surface for the sample helpers."""
+
+    def __init__(self, forwarder=None, sinks=()):
+        self._forwarder = forwarder
+        self.metric_sinks = list(sinks)
+
+
+def _names(samples):
+    return [s.name for s in samples]
+
+
+class TestForwardSamples:
+    def _forwarder_with_activity(self):
+        f = HTTPForwarder("127.0.0.1:1")
+        with f._lock:
+            f.forwarded = 120
+            f.errors = 2
+            f.post_durations.append(0.05)
+            f.post_content_lengths.append(4096)
+        return f
+
+    def test_documented_names_and_deltas(self):
+        f = self._forwarder_with_activity()
+        server = _StubServer(forwarder=f)
+        samples = flusher._forward_samples(server)
+        names = _names(samples)
+        assert "veneur.forward.post_metrics_total" in names
+        assert "veneur.forward.error_total" in names
+        assert "veneur.forward.duration_ns" in names
+        assert "veneur.forward.content_length_bytes" in names
+        by_name = {s.name: s for s in samples}
+        assert by_name["veneur.forward.post_metrics_total"].value == 120
+        assert by_name["veneur.forward.error_total"].value == 2
+
+    def test_second_interval_reports_delta_not_total(self):
+        f = self._forwarder_with_activity()
+        server = _StubServer(forwarder=f)
+        flusher._forward_samples(server)
+        with f._lock:
+            f.forwarded += 30
+        by_name = {s.name: s for s in flusher._forward_samples(server)}
+        assert by_name["veneur.forward.post_metrics_total"].value == 30
+        assert by_name["veneur.forward.error_total"].value == 0
+        # per-POST lists were drained by the first interval
+        assert "veneur.forward.duration_ns" not in by_name
+
+    def test_no_forwarder_is_silent(self):
+        assert flusher._forward_samples(_StubServer()) == []
+
+
+class TestImportSamples:
+    def test_request_error_total_per_protocol(self):
+        class _Imp:
+            import_errors = 7
+
+        server = _StubServer()
+        server.import_server = _Imp()
+        samples = flusher._import_samples(server)
+        assert _names(samples) == ["veneur.import.request_error_total"]
+        assert samples[0].value == 7
+        # delta on the next interval
+        assert flusher._import_samples(server)[0].value == 0
+
+
+class TestSinkSamples:
+    def test_duration_errors_and_datadog_parts(self):
+        from veneur_tpu.sinks.datadog import DatadogMetricSink
+
+        sink = DatadogMetricSink(
+            interval=10.0, flush_max_per_body=1000, hostname="h",
+            tags=[], dd_hostname="http://dd", api_key="k",
+            post=lambda *a, **k: 202)
+        sink.flush_errors = 3
+        with sink._err_lock:
+            sink._telemetry.extend([("marshal_s", 0.01), ("post_s", 0.02),
+                                    ("content_length_bytes", 2048)])
+        server = _StubServer(sinks=[sink])
+        samples = flusher._sink_samples(server, {"datadog": 0.5})
+        names = _names(samples)
+        assert names.count("veneur.flush.duration_ns") == 3  # sink+2 parts
+        assert "veneur.flush.error_total" in names
+        assert "veneur.flush.content_length_bytes" in names
+        errors = [s for s in samples
+                  if s.name == "veneur.flush.error_total"]
+        assert errors[0].value == 3
+        # drained: a second flush reports no stale parts and a 0 delta
+        samples2 = flusher._sink_samples(server, {})
+        assert _names(samples2) == ["veneur.flush.error_total"]
+        assert samples2[0].value == 0
+
+    def test_datadog_columnar_flush_records_telemetry(self):
+        import pytest
+
+        from veneur_tpu.native import egress as eg
+        if not eg.available():
+            pytest.skip("native egress unavailable")
+
+        from veneur_tpu.core.store import MetricStore
+        from veneur_tpu.samplers import parser as p
+        from veneur_tpu.samplers.intermetric import HistogramAggregates
+        from veneur_tpu.sinks.datadog import DatadogMetricSink
+
+        store = MetricStore(initial_capacity=32, chunk=64)
+        store.process_metric(p.parse_metric(b"web.hits:4|c|#route:r1"))
+        col, _, _ = store.flush(
+            [], HistogramAggregates.from_names(["count"]),
+            is_local=False, now=700, columnar=True)
+
+        posted = []
+        sink = DatadogMetricSink(
+            interval=10.0, flush_max_per_body=1000, hostname="h",
+            tags=[], dd_hostname="http://dd", api_key="k",
+            post=lambda url, body, **kw: (posted.append(body), 202)[1])
+        sink.flush_columnar(col)
+        assert posted
+        kinds = [k for k, _ in sink.drain_flush_telemetry()]
+        assert "marshal_s" in kinds and "post_s" in kinds
+        assert "content_length_bytes" in kinds
+        # drained
+        assert sink.drain_flush_telemetry() == []

@@ -34,7 +34,7 @@ class CapturePost:
         self.calls = []
 
     def __call__(self, url, payload, compress=True, method="POST",
-                 precompressed=False):
+                 precompressed=False, out_info=None):
         self.calls.append((url, payload, compress, method))
         return 202
 

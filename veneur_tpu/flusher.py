@@ -109,6 +109,8 @@ def _flush_once(server: "Server", span):
         ssf_samples.count("veneur.flush.post_metrics_total",
                           float(len(final_metrics)), None),
         *_worker_samples(server, ms),
+        *_forward_samples(server),
+        *_import_samples(server),
         *_runtime_samples())
 
     # local → global forwarding happens off the flush path
@@ -135,23 +137,37 @@ def _flush_once(server: "Server", span):
     # one thread per metric sink (flusher.go:82-93)
     t0 = time.perf_counter()
     threads = []
+    sink_elapsed: dict = {}
+
+    def timed(fn, sink, arg):
+        def run():
+            ts = time.perf_counter()
+            try:
+                fn(sink, arg)
+            finally:
+                sink_elapsed[sink.name] = time.perf_counter() - ts
+        return run
+
     for sink in server.metric_sinks:
         if use_columnar and hasattr(sink, "flush_columnar"):
-            t = threading.Thread(target=_flush_sink_columnar,
-                                 args=(sink, final_metrics), daemon=True)
+            t = threading.Thread(
+                target=timed(_flush_sink_columnar, sink, final_metrics),
+                daemon=True)
         else:
             metrics = (final_metrics.to_intermetrics() if use_columnar
                        else final_metrics)
-            t = threading.Thread(target=_flush_sink, args=(sink, metrics),
+            t = threading.Thread(target=timed(_flush_sink, sink, metrics),
                                  daemon=True)
         t.start()
         threads.append(t)
     for t in threads:
         t.join(timeout=30.0)
-    # total time across the parallel sink POSTs (README.md:264)
+    # total time across the parallel sink POSTs (README.md:264), plus
+    # the per-sink breakdown and each sink's errors/marshal/post parts
     span.add(ssf_samples.timing("veneur.flush.total_duration_ns",
                                 time.perf_counter() - t0,
                                 {"part": "post"}))
+    span.add(*_sink_samples(server, sink_elapsed))
 
     # plugins run after the sinks (flusher.go:95-109)
     for plugin in server.plugins:
@@ -173,17 +189,12 @@ def _worker_samples(server, ms):
     deltas, like the reference's per-interval worker counters."""
     from veneur_tpu.trace import samples as ssf_samples
 
-    # snapshot each counter ONCE: a second read for the reset would
-    # permanently drop anything counted between the two reads
-    cur_errs = server.packet_errors
-    cur_drops = server.packet_drops
-    cur_span_drops = server.spans_dropped
-    errs = cur_errs - server._last_packet_errors
-    drops = cur_drops - server._last_packet_drops
-    span_drops = cur_span_drops - server._last_spans_dropped
-    server._last_packet_errors = cur_errs
-    server._last_packet_drops = cur_drops
-    server._last_spans_dropped = cur_span_drops
+    errs = _delta_since(server, "_last_packet_errors",
+                        server.packet_errors)
+    drops = _delta_since(server, "_last_packet_drops",
+                         server.packet_drops)
+    span_drops = _delta_since(server, "_last_spans_dropped",
+                              server.spans_dropped)
     out = [
         ssf_samples.count("veneur.worker.spans_dropped_total",
                           float(span_drops), None),
@@ -200,6 +211,101 @@ def _worker_samples(server, ms):
         out.append(ssf_samples.count(
             "veneur.worker.metrics_flushed_total", float(getattr(ms, mtype)),
             {"metric_type": mtype.rstrip("s")}))
+    return out
+
+
+def _delta_since(obj, last_attr: str, cur):
+    """Snapshot-once interval delta: ``cur`` must be read EXACTLY once by
+    the caller (re-reading the live counter for the reset would lose
+    anything counted between the reads)."""
+    delta = cur - getattr(obj, last_attr, 0)
+    setattr(obj, last_attr, cur)
+    return delta
+
+
+def _forward_samples(server):
+    """The documented veneur.forward.* set (README.md:260-266):
+    post_metrics_total, error_total, per-POST duration_ns, and
+    content_length_bytes — drained from whichever forwarder flavor
+    (HTTP / gRPC / native) is configured. Deltas cover the PREVIOUS
+    interval's forward, which runs off the flush path."""
+    from veneur_tpu.trace import samples as ssf_samples
+
+    f = server._forwarder
+    if f is None or not hasattr(f, "forwarded"):
+        return []
+    with f._lock:
+        fwd, errs = f.forwarded, f.errors
+        durs = list(f.post_durations)
+        lens = list(f.post_content_lengths)
+        f.post_durations.clear()
+        f.post_content_lengths.clear()
+    d_fwd = _delta_since(f, "_last_reported_forwarded", fwd)
+    d_err = _delta_since(f, "_last_reported_errors", errs)
+    out = [
+        ssf_samples.count("veneur.forward.post_metrics_total",
+                          float(d_fwd), None),
+        ssf_samples.count("veneur.forward.error_total", float(d_err),
+                          None),
+    ]
+    out.extend(ssf_samples.timing("veneur.forward.duration_ns", s,
+                                  {"part": "post"}) for s in durs)
+    out.extend(ssf_samples.histogram(
+        "veneur.forward.content_length_bytes", float(n), None)
+        for n in lens)
+    return out
+
+
+def _import_samples(server):
+    """veneur.import.request_error_total (README.md:275), summed per
+    protocol over whichever import servers this (global) instance runs."""
+    from veneur_tpu.trace import samples as ssf_samples
+
+    out = []
+    for attr, proto in (("import_server", "grpc"),
+                        ("native_import_server", "native")):
+        srv = getattr(server, attr, None)
+        if srv is None or not hasattr(srv, "import_errors"):
+            continue
+        delta = _delta_since(srv, "_last_reported_import_errors",
+                             srv.import_errors)
+        out.append(ssf_samples.count("veneur.import.request_error_total",
+                                     float(delta), {"protocol": proto}))
+    return out
+
+
+def _sink_samples(server, sink_elapsed: dict):
+    """Per-sink flush telemetry (README.md:260-264): duration_ns tagged
+    by sink (with marshal/post part tags where the sink records them),
+    error_total deltas, and POST content_length_bytes."""
+    from veneur_tpu.trace import samples as ssf_samples
+
+    out = []
+    for sink in server.metric_sinks:
+        name = sink.name
+        if name in sink_elapsed:
+            out.append(ssf_samples.timing(
+                "veneur.flush.duration_ns", sink_elapsed[name],
+                {"sink": name}))
+        if hasattr(sink, "flush_errors"):
+            delta = _delta_since(sink, "_last_reported_flush_errors",
+                                 sink.flush_errors)
+            out.append(ssf_samples.count("veneur.flush.error_total",
+                                         float(delta), {"sink": name}))
+        if hasattr(sink, "drain_flush_telemetry"):
+            for kind, value in sink.drain_flush_telemetry():
+                if kind == "marshal_s":
+                    out.append(ssf_samples.timing(
+                        "veneur.flush.duration_ns", value,
+                        {"sink": name, "part": "marshal"}))
+                elif kind == "post_s":
+                    out.append(ssf_samples.timing(
+                        "veneur.flush.duration_ns", value,
+                        {"sink": name, "part": "post"}))
+                elif kind == "content_length_bytes":
+                    out.append(ssf_samples.histogram(
+                        "veneur.flush.content_length_bytes", float(value),
+                        {"sink": name}))
     return out
 
 

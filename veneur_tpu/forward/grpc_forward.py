@@ -125,6 +125,9 @@ class GRPCForwarder:
         self._lock = threading.Lock()
         self.forwarded = 0
         self.errors = 0
+        # per-send telemetry, drained into veneur.forward.* self-metrics
+        self.post_durations = []
+        self.post_content_lengths = []
 
     # native MetricList chunks cap well under the channel's 256 MB limit
     CHUNK_BYTES = 64 * 1024 * 1024
@@ -147,8 +150,11 @@ class GRPCForwarder:
                 for k, v in parent_span.context_as_parent().items())
         total = sum(rows for _, rows in frames)
         sent_rows = 0
+        attempted_lens = []  # only frames actually put on the wire
+        t0 = time.perf_counter()
         try:
             for payload, rows in frames:
+                attempted_lens.append(len(payload))
                 self._send_raw(payload, timeout=self.timeout,
                                metadata=metadata)
                 sent_rows += rows
@@ -161,6 +167,10 @@ class GRPCForwarder:
             log.warning("failed to forward %d metrics to %s "
                         "(~%d sent before the failure): %s",
                         total, self.addr, sent_rows, e)
+        finally:
+            with self._lock:
+                self.post_durations.append(time.perf_counter() - t0)
+                self.post_content_lengths.extend(attempted_lens)
 
     def close(self):
         self._channel.close()

@@ -10,9 +10,11 @@ from __future__ import annotations
 import json
 import logging
 import threading
+import time
 import urllib.error
 import urllib.request
 import zlib
+from typing import List
 
 from veneur_tpu.forward.convert import (json_metrics_from_state,
                                         reference_json_metrics_from_state)
@@ -23,12 +25,15 @@ log = logging.getLogger("veneur.forward.http")
 def post_helper(url: str, payload, timeout: float = 10.0,
                 compress: bool = True, headers: dict = None,
                 method: str = "POST", precompressed: bool = False,
-                raw_body: bytes = None) -> int:
+                raw_body: bytes = None, out_info: dict = None) -> int:
     """POST a JSON payload, optionally deflated (http/http.go:123-247).
     Returns the HTTP status (including non-2xx); raises only on transport
     errors. precompressed=True sends ``payload`` bytes as an
     already-deflated JSON body; raw_body sends pre-serialized
-    UNCOMPRESSED JSON bytes (both are the native serializers' outputs)."""
+    UNCOMPRESSED JSON bytes (both are the native serializers' outputs).
+    ``out_info`` (if given) receives ``content_length`` — the POST body
+    size after compression, for the veneur.*.content_length_bytes
+    self-metrics (README.md:262)."""
     hdrs = {"Content-Type": "application/json"}
     if raw_body is not None:
         body = raw_body
@@ -40,6 +45,8 @@ def post_helper(url: str, payload, timeout: float = 10.0,
         if compress:
             body = zlib.compress(body)
             hdrs["Content-Encoding"] = "deflate"
+    if out_info is not None:
+        out_info["content_length"] = len(body)
     if headers:
         hdrs.update(headers)
     req = urllib.request.Request(url, data=body, headers=hdrs, method=method)
@@ -72,6 +79,10 @@ class HTTPForwarder:
         self._lock = threading.Lock()
         self.forwarded = 0
         self.errors = 0
+        # per-POST telemetry, drained by the flusher into the canonical
+        # veneur.forward.* self-metrics (README.md:260-266)
+        self.post_durations: List[float] = []
+        self.post_content_lengths: List[int] = []
 
     def forward(self, state, parent_span=None):
         # the JSON wire is per-row; columnar digest planes (a columnar
@@ -91,9 +102,11 @@ class HTTPForwarder:
             # propagate the flush span's context so the global's import
             # span stitches into the same trace (http/http.go:184-188)
             headers = parent_span.context_as_parent()
+        info = {}
+        t0 = time.perf_counter()
         try:
             status = post_helper(url, metrics, timeout=self.timeout,
-                                 headers=headers)
+                                 headers=headers, out_info=info)
             if 200 <= status < 300:
                 with self._lock:
                     self.forwarded += len(metrics)
@@ -106,3 +119,8 @@ class HTTPForwarder:
                 self.errors += 1
             log.warning("failed to forward %d metrics to %s: %s",
                         len(metrics), url, e)
+        finally:
+            with self._lock:
+                self.post_durations.append(time.perf_counter() - t0)
+                if "content_length" in info:
+                    self.post_content_lengths.append(info["content_length"])

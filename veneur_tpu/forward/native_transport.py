@@ -27,6 +27,7 @@ import logging
 import socket
 import struct
 import threading
+import time
 from typing import Optional
 
 log = logging.getLogger("veneur.forward.native")
@@ -218,6 +219,9 @@ class NativeForwarder:
         self._lock = threading.Lock()
         self.forwarded = 0
         self.errors = 0
+        # per-send telemetry, drained into veneur.forward.* self-metrics
+        self.post_durations = []
+        self.post_content_lengths = []
 
     def _connect(self) -> socket.socket:
         s = socket.create_connection((self._host, self._port),
@@ -235,6 +239,16 @@ class NativeForwarder:
         if not frames:
             return
         total = sum(rows for _, rows in frames)
+        attempted_lens: list = []  # only frames actually put on the wire
+        t_start = time.perf_counter()
+        try:
+            self._forward_frames(frames, total, attempted_lens)
+        finally:
+            with self._lock:
+                self.post_durations.append(time.perf_counter() - t_start)
+                self.post_content_lengths.extend(attempted_lens)
+
+    def _forward_frames(self, frames, total, attempted_lens):
         # a kept-alive connection can be stale (global restarted while
         # we idled): if NOTHING was acked yet, one fresh-connection
         # retry costs nothing and saves the interval
@@ -245,6 +259,7 @@ class NativeForwarder:
                 if self._sock is None:
                     self._sock = self._connect()
                 for payload, rows in frames:
+                    attempted_lens.append(len(payload))
                     self._sock.sendall(struct.pack(">I", len(payload)))
                     self._sock.sendall(payload)
                     ack = _read_exact(self._sock, 4)

@@ -298,7 +298,7 @@ class Server:
         self.packet_drops = 0
         self.spans_dropped = 0
         self._last_spans_dropped = 0
-        self._span_drop_lock = threading.Lock()
+        self._counter_lock = threading.Lock()  # all ingest counters
         self._last_span_drop_log = 0.0
         self._last_packet_errors = 0
         self._last_packet_drops = 0
@@ -326,7 +326,8 @@ class Server:
             else:
                 self.store.process_metric(p.parse_metric(packet))
         except p.ParseError as e:
-            self.packet_errors += 1
+            with self._counter_lock:
+                self.packet_errors += 1
             log.debug("rejected packet %r: %s", packet[:100], e)
             return False
         return True
@@ -341,7 +342,8 @@ class Server:
         try:
             span = wire.parse_ssf(datagram)
         except Exception as e:
-            self.packet_errors += 1
+            with self._counter_lock:
+                self.packet_errors += 1
             log.debug("rejected SSF packet: %s", e)
             return
         self.handle_ssf(span)
@@ -357,7 +359,7 @@ class Server:
             # per drop would flood the log (and the GIL) at exactly the
             # moment the pipeline is saturated — count every drop, log
             # at most once a second
-            with self._span_drop_lock:
+            with self._counter_lock:
                 # locked: many reader/stream threads shed here at once,
                 # and an unlocked += loses counts exactly when drops
                 # spike — the condition this counter exists to measure
@@ -383,7 +385,8 @@ class Server:
                 except Exception as e:
                     # a whole frame was consumed, so the stream is at a clean
                     # boundary — keep reading (server.go:888-895)
-                    self.packet_errors += 1
+                    with self._counter_lock:
+                        self.packet_errors += 1
                     log.debug("bad SSF message: %s", e)
                     continue
                 if span is None:
@@ -581,7 +584,8 @@ class Server:
                 batches = reader.drain()
                 drops = reader.drops()
                 if drops != last_drops:
-                    self.packet_drops += drops - last_drops
+                    with self._counter_lock:
+                        self.packet_drops += drops - last_drops
                     log.warning("native ingest dropped %d datagrams "
                                 "(pump falling behind)", drops - last_drops)
                     last_drops = drops
@@ -589,7 +593,8 @@ class Server:
                     self._stop.wait(0.005)
                     continue
                 for b in batches:
-                    self.packet_errors += int(b.parse_errors)
+                    with self._counter_lock:
+                        self.packet_errors += int(b.parse_errors)
                     for line in self.store.process_batch(b):
                         self.handle_metric_packet(line)
             except Exception:

@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import logging
 import threading
+import time
 from collections import deque
 from typing import Callable, Dict, List, Optional, Sequence
 
@@ -44,9 +45,10 @@ PostFn = Callable[..., int]
 
 
 def _default_post(url: str, payload, compress: bool = True,
-                  method: str = "POST", precompressed: bool = False) -> int:
+                  method: str = "POST", precompressed: bool = False,
+                  out_info: dict = None) -> int:
     return post_helper(url, payload, compress=compress, method=method,
-                       precompressed=precompressed)
+                       precompressed=precompressed, out_info=out_info)
 
 
 def _ok(status: int) -> bool:
@@ -79,10 +81,19 @@ class DatadogMetricSink(MetricSink):
         self._common_json: Optional[bytes] = None
         # _flush_part runs on one thread per chunk; guard the counter
         self._err_lock = threading.Lock()
+        # ("marshal_s"|"post_s"|"content_length_bytes", value) pairs the
+        # flusher drains into the canonical veneur.flush.* self-metrics
+        # (duration_ns part tags + content_length_bytes, README.md:260-264)
+        self._telemetry: List = []
 
     def _count_error(self) -> None:
         with self._err_lock:
             self.flush_errors += 1
+
+    def drain_flush_telemetry(self) -> List:
+        with self._err_lock:
+            out, self._telemetry = self._telemetry, []
+        return out
 
     @property
     def name(self) -> str:
@@ -99,6 +110,7 @@ class DatadogMetricSink(MetricSink):
 
         bodies: List[bytes] = []
         n_metrics = 0
+        t_marshal = time.perf_counter()
         for blk in batch.blocks:
             values = blk.values
             if (blk.type_codes == TYPE_COUNTER).any():
@@ -114,7 +126,9 @@ class DatadogMetricSink(MetricSink):
                 max_per_body=self.flush_max_per_body,
                 compress_level=self.compress_level))
             n_metrics += len(blk)
+        t_marshal = time.perf_counter() - t_marshal
         threads = []
+        t_post = time.perf_counter()
         for body in bodies:
             t = threading.Thread(target=self._flush_body, args=(body,),
                                  daemon=True)
@@ -122,6 +136,12 @@ class DatadogMetricSink(MetricSink):
             threads.append(t)
         for t in threads:
             t.join()
+        t_post = time.perf_counter() - t_post
+        with self._err_lock:
+            self._telemetry.append(("marshal_s", t_marshal))
+            self._telemetry.append(("post_s", t_post))
+            self._telemetry.extend(
+                ("content_length_bytes", len(b)) for b in bodies)
         self.metrics_flushed += n_metrics
         if batch.extras:
             self.flush(batch.extras)
@@ -149,7 +169,9 @@ class DatadogMetricSink(MetricSink):
             self._count_error()
 
     def flush(self, metrics: List[InterMetric]) -> None:
+        t_marshal = time.perf_counter()
         dd_metrics, checks = self.finalize_metrics(metrics)
+        t_marshal = time.perf_counter() - t_marshal
         if checks:
             # check_run takes an array but not deflate (datadog.go:113-116)
             try:
@@ -169,6 +191,7 @@ class DatadogMetricSink(MetricSink):
         workers = ((len(dd_metrics) - 1) // self.flush_max_per_body) + 1
         chunk_size = ((len(dd_metrics) - 1) // workers) + 1
         threads = []
+        t_post = time.perf_counter()
         for i in range(workers):
             chunk = dd_metrics[i * chunk_size:(i + 1) * chunk_size]
             t = threading.Thread(target=self._flush_part, args=(chunk,),
@@ -177,18 +200,32 @@ class DatadogMetricSink(MetricSink):
             threads.append(t)
         for t in threads:
             t.join()
+        t_post = time.perf_counter() - t_post
+        # same part-tagged telemetry the columnar path records, so the
+        # documented veneur.flush.* set does not depend on which flush
+        # path a deployment runs
+        with self._err_lock:
+            self._telemetry.append(("marshal_s", t_marshal))
+            self._telemetry.append(("post_s", t_post))
         self.metrics_flushed += len(dd_metrics)
 
     def _flush_part(self, chunk: List[dict]) -> None:
+        info = {}
         try:
             status = self.post(f"{self.dd_hostname}/api/v1/series"
-                               f"?api_key={self.api_key}", {"series": chunk})
+                               f"?api_key={self.api_key}", {"series": chunk},
+                               out_info=info)
             if not _ok(status):
                 log.warning("Datadog series flush returned HTTP %d", status)
                 self._count_error()
         except OSError:
             log.warning("error flushing metrics to Datadog", exc_info=True)
             self._count_error()
+        finally:
+            if "content_length" in info:
+                with self._err_lock:
+                    self._telemetry.append(
+                        ("content_length_bytes", info["content_length"]))
 
     def finalize_metrics(self, metrics: List[InterMetric]):
         """InterMetric → DDMetric/DDServiceCheck dicts (datadog.go:245-322)."""
