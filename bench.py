@@ -707,6 +707,95 @@ def bench_ssf_spans(duration: float = 3.0):
         server.shutdown()
 
 
+def bench_proxy_fanout(duration: float = 3.0, n_dests: int = 3,
+                       batch: int = 20000):
+    """Config #9: the consistent-hash proxy's metric fan-out end to end
+    — JSON metric batches through the REAL Proxy (ring hash, per-dest
+    bucketing, deflate, parallel POSTs) into in-process receivers that
+    read and 202 each body. Counterpart of the reference's unpublished
+    BenchmarkProxyServerSendMetrics (proxysrv/server_test.go:225) and
+    the sort-by-destination half of BenchmarkNewSortableJSONMetrics
+    (http_test.go:381); proxy + all receivers share one core here."""
+    import threading
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    from veneur_tpu.config import ProxyConfig
+    from veneur_tpu.discovery import StaticDiscoverer
+    from veneur_tpu.proxy.proxy import Proxy
+
+    received = [0]
+    rlock = threading.Lock()
+
+    class _Recv(BaseHTTPRequestHandler):
+        def do_POST(self):
+            n = int(self.headers.get("Content-Length") or 0)
+            while n > 0:
+                n -= len(self.rfile.read(min(n, 1 << 16)))
+            # count BEFORE the 202: the proxy unblocks on the response,
+            # so a post-response increment can land after the bench
+            # reads the counter
+            with rlock:
+                received[0] += 1
+            self.send_response(202)
+            self.end_headers()
+
+        def log_message(self, *a):  # noqa: N802 - stdlib naming
+            pass
+
+    servers, dests = [], []
+    for _ in range(n_dests):
+        srv = ThreadingHTTPServer(("127.0.0.1", 0), _Recv)
+        srv.daemon_threads = True
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        servers.append(srv)
+        dests.append(f"http://127.0.0.1:{srv.server_address[1]}")
+
+    proxy = Proxy(ProxyConfig(http_address="127.0.0.1:0",
+                              forward_timeout="10s"),
+                  discoverer=StaticDiscoverer(dests))
+    proxy.start()
+    try:
+        # one forwarding host's /import body: mixed counter/gauge JSON
+        # metrics across distinct series, the wire the proxy actually
+        # shards (handlers_global.go:28-43)
+        metrics = [{"name": f"svc.m.{i % 8192}",
+                    "type": "counter" if i % 2 else "gauge",
+                    "tags": [f"shard:{i % 13}"],
+                    "value": [float(i)]}
+                   for i in range(batch)]
+        proxy.proxy_metrics(metrics)  # warm connections/ring
+        with rlock:
+            received[0] = 0
+        base_proxied, base_errors = proxy.proxied, proxy.forward_errors
+        sent = 0
+        deadline = time.perf_counter() + duration
+        t0 = time.perf_counter()
+        while time.perf_counter() < deadline:
+            proxy.proxy_metrics(metrics)
+            sent += batch
+        wall = time.perf_counter() - t0
+        # a failed run must be distinguishable from a clean one: the
+        # headline only counts metrics the proxy ACKNOWLEDGED (its own
+        # proxied counter), with errors reported alongside
+        proxied = proxy.proxied - base_proxied
+        return {"metrics_per_s": int(proxied / wall),
+                "metrics_sent_per_s": int(sent / wall),
+                "forward_errors": proxy.forward_errors - base_errors,
+                "batch": batch,
+                "destinations": n_dests,
+                "bodies_received": received[0],
+                "note": "proxy + receivers on one shared core; each "
+                        "batch rides ring hash + per-dest bucketing + "
+                        "deflate + parallel POST, fully acknowledged "
+                        "before the next batch (proxy_metrics joins "
+                        "its POST threads)"}
+    finally:
+        proxy.shutdown()
+        for srv in servers:
+            srv.shutdown()
+            srv.server_close()  # shutdown() alone leaks the listen fd
+
+
 def bench_merge_global(num_series: int, digest_dtype: str = "bfloat16",
                        iters: int = 5):
     """Config #2c: the single-chip global-aggregator kernel — merge one
@@ -1572,6 +1661,7 @@ def _run_all(result):
         "bench_heavy_hitters_100m")
     configs["7_tls_handshakes"] = guarded(bench_tls_handshakes)
     configs["8_ssf_spans"] = guarded(bench_ssf_spans)
+    configs["9_proxy_fanout"] = guarded(bench_proxy_fanout)
 
 
 def _headline(result) -> dict:
@@ -1609,6 +1699,8 @@ def _headline(result) -> dict:
             "6_egress_1m": pick("6_egress_1m", "total_s"),
             "7_tls": pick("7_tls_handshakes", "ecdsa_p256_conn_s",
                           "rsa_2048_conn_s"),
+            "9_proxy": pick("9_proxy_fanout", "metrics_per_s",
+                            "forward_errors"),
         },
         "detail_file": "BENCH_DETAIL.json",
     }
