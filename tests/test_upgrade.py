@@ -11,8 +11,6 @@ import sys
 import threading
 import time
 
-import pytest
-
 from veneur_tpu.cli import upgrade
 
 
