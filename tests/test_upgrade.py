@@ -118,6 +118,117 @@ def test_replacement_argv_reexecs_same_interpreter():
                         "-f", "/etc/veneur.yaml"]
 
 
+def test_replacement_argv_prefers_recorded_startup_argv():
+    """An upgrade re-execs the argv the operator actually launched —
+    including flags beyond -f — when the CLI main recorded it."""
+    try:
+        upgrade.record_startup_argv(
+            "veneur_tpu.cli.server",
+            ["-f", "/etc/veneur.yaml", "--future-flag"])
+        argv = upgrade.replacement_argv("/etc/veneur.yaml",
+                                        "veneur_tpu.cli.server")
+        assert argv == [sys.executable, "-m", "veneur_tpu.cli.server",
+                        "-f", "/etc/veneur.yaml", "--future-flag"]
+    finally:
+        upgrade._reset_state_for_tests()
+    # without a recording, the constructed form is the fallback
+    argv = upgrade.replacement_argv("/etc/veneur.yaml",
+                                    "veneur_tpu.cli.server")
+    assert argv == [sys.executable, "-m", "veneur_tpu.cli.server",
+                    "-f", "/etc/veneur.yaml"]
+
+
+def test_request_shutdown_wins_handoff_race(monkeypatch):
+    """The round-4 advisor race: a shutdown request landing after the
+    replacement is ready but before the handoff's done.set() must still
+    stop the replacement. request_shutdown marks the stop under the
+    same lock the handoff checks, so the interleaving is closed."""
+    upgrade._reset_state_for_tests()
+    done = threading.Event()
+    killed = []
+
+    class FakeChild:
+        pid = 778
+
+        def kill(self):
+            killed.append(self.pid)
+
+        def wait(self, timeout=None):
+            return 0
+
+    def spawn_then_shutdown_request(argv, **kw):
+        # the operator's SIGTERM lands while the handoff thread holds a
+        # ready child but before it could set done: request_shutdown
+        # (not a bare done.set()) records operator intent atomically
+        upgrade.request_shutdown(done)
+        return FakeChild()
+
+    monkeypatch.setattr(upgrade, "spawn_replacement",
+                        spawn_then_shutdown_request)
+    h = upgrade.make_sigusr2_handler("/cfg.yaml", "veneur_tpu.cli.server",
+                                     done)
+    try:
+        h(signal.SIGUSR2, None)
+        deadline = time.monotonic() + 5
+        while not killed and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert killed == [778]
+    finally:
+        upgrade._reset_state_for_tests()
+
+
+def test_reap_unfinished_replacement_kills_starting_child():
+    """A shutdown arriving while the replacement is mid-startup (the
+    possibly minutes-long readiness wait): the CLI main's exit path
+    reaps the recorded not-yet-handed-off child."""
+    upgrade._reset_state_for_tests()
+    done = threading.Event()
+    argv = [sys.executable, "-c", "import time; time.sleep(600)"]
+    result = {}
+
+    def run_spawn():
+        result["child"] = upgrade.spawn_replacement(argv, ready_timeout=60.0)
+
+    t = threading.Thread(target=run_spawn)
+    t.start()
+    try:
+        # wait until the child is recorded as pending
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            with upgrade._state_lock:
+                if upgrade._pending_replacement is not None:
+                    break
+            time.sleep(0.01)
+        with upgrade._state_lock:
+            assert upgrade._pending_replacement is not None
+        # operator shutdown: main's exit path reaps the orphan
+        upgrade.request_shutdown(done)
+        upgrade.reap_unfinished_replacement()
+        t.join(timeout=30)
+        assert not t.is_alive()
+        # the spawn wait observed the killed child and reported failure
+        assert result["child"] is None
+        with upgrade._state_lock:
+            assert upgrade._pending_replacement is None
+    finally:
+        upgrade._reset_state_for_tests()
+        t.join(timeout=5)
+
+
+def test_spawn_refused_after_shutdown_requested():
+    """SIGUSR2 racing an already-requested shutdown must not upgrade."""
+    upgrade._reset_state_for_tests()
+    done = threading.Event()
+    upgrade.request_shutdown(done)
+    try:
+        argv = [sys.executable, "-c", "import time; time.sleep(600)"]
+        t0 = time.monotonic()
+        assert upgrade.spawn_replacement(argv, ready_timeout=60.0) is None
+        assert time.monotonic() - t0 < 30  # no readiness wait happened
+    finally:
+        upgrade._reset_state_for_tests()
+
+
 def test_usr2_coalesces_and_ignores_when_draining(monkeypatch):
     """Overlapping SIGUSR2s run one upgrade, and a signal arriving
     after the drain began must not spawn a second replacement (two
@@ -211,7 +322,11 @@ def test_overlap_probe_warns_on_second_instance(monkeypatch, caplog):
 
     from veneur_tpu import networking
 
+    # bind exactly as a real veneur UDP listener does (new_udp_socket:
+    # REUSEADDR + REUSEPORT) — a REUSEADDR probe would bind alongside
+    # this and never warn, which is the round-4 advisor finding
     first = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    first.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
     first.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEPORT, 1)
     first.bind(("127.0.0.1", 0))
     port = first.getsockname()[1]

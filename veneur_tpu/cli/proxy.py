@@ -22,6 +22,9 @@ def main(argv=None) -> int:
     ap.add_argument("-f", dest="config", required=True,
                     help="The config file to read for settings.")
     args = ap.parse_args(argv)
+    # record the exact launch command line so a SIGUSR2 upgrade
+    # re-execs what the operator ran, flags included
+    upgrade.record_startup_argv("veneur_tpu.cli.proxy", argv)
 
     try:
         config = read_proxy_config(args.config)
@@ -39,7 +42,9 @@ def main(argv=None) -> int:
 
     def handle_signal(signum, frame):
         log.info("Received signal %d, shutting down", signum)
-        done.set()
+        # marks the stop operator-requested before setting done, so a
+        # racing SIGUSR2 handoff cannot leave a replacement serving
+        upgrade.request_shutdown(done)
 
     # zero-downtime upgrade, same protocol as the server binary
     # (reference proxies run under the same einhorn handoff); the
@@ -56,7 +61,13 @@ def main(argv=None) -> int:
     log.info("Starting proxy on %s", config.http_address)
     upgrade.notify_ready()
     done.wait()
-    proxy.shutdown()
+    try:
+        proxy.shutdown()
+    finally:
+        # if shutdown raced an upgrade, the replacement's handoff never
+        # completed and it must not outlive this generation — even when
+        # the drain itself raised
+        upgrade.reap_unfinished_replacement(log)
     return 0
 
 

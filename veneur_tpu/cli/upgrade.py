@@ -48,6 +48,101 @@ READY_ENV = "VENEUR_READY_FD"
 # binary) uncached XLA compiles, which can take tens of seconds.
 DEFAULT_READY_TIMEOUT = 300.0
 
+# Upgrade/shutdown coordination. A SIGTERM/SIGINT can land at any point
+# during an upgrade — including between "replacement is ready" and
+# "hand off by setting done" — and in every such interleaving the
+# operator's intent is that the *service* stops, so a replacement whose
+# handoff never completed must not outlive this generation. The state
+# below makes the handoff decision atomic versus request_shutdown(),
+# and records any not-yet-handed-off replacement so the CLI mains can
+# reap it on the way out.
+_state_lock = threading.Lock()
+_stop_requested = False
+_pending_replacement: Optional["subprocess.Popen"] = None
+_upgrade_active = False
+_startup_argv: Optional[List[str]] = None
+
+
+def _reset_state_for_tests() -> None:
+    global _stop_requested, _pending_replacement, _startup_argv
+    global _upgrade_active
+    with _state_lock:
+        _stop_requested = False
+        _pending_replacement = None
+        _upgrade_active = False
+        _startup_argv = None
+
+
+def record_startup_argv(module: str,
+                        args: Optional[Sequence[str]] = None) -> None:
+    """Capture the command line this generation was launched with so an
+    upgrade re-execs exactly what the operator ran — flags included —
+    rather than a reconstruction that silently drops any option added
+    after ``-f``. Call from the CLI main before serving; also resets
+    the shutdown/handoff state for this (new) generation, which
+    matters when several mains run in one process (tests)."""
+    global _startup_argv, _stop_requested, _pending_replacement
+    global _upgrade_active
+    if args is None:
+        args = sys.argv[1:]
+    with _state_lock:
+        _startup_argv = [sys.executable, "-m", module, *args]
+        _stop_requested = False
+        _pending_replacement = None
+        _upgrade_active = False
+
+
+def request_shutdown(done: "threading.Event") -> None:
+    """The CLI signal handlers' shutdown path: marks the stop as
+    operator-requested *before* setting ``done`` so an in-flight
+    upgrade handoff cannot complete afterwards and leave a replacement
+    serving a service the operator asked to stop.
+
+    Deliberately lock-free: this runs inside a signal handler on the
+    main thread, and the main thread itself takes ``_state_lock`` in
+    ``reap_unfinished_replacement`` — a second SIGTERM landing there
+    would deadlock on a non-reentrant lock. The bare bool store is
+    GIL-atomic; the handoff reads it under ``_state_lock`` (and
+    re-checks after its ``done.set()``), which provides the ordering."""
+    global _stop_requested
+    _stop_requested = True
+    done.set()
+
+
+def reap_unfinished_replacement(logger: logging.Logger = log) -> None:
+    """Called by the CLI mains after ``done.wait()`` returns: if an
+    upgrade replacement was spawned but its drain handoff never
+    completed (shutdown raced the upgrade, or the main loop exited
+    while the replacement was still starting), kill it — the operator
+    asked the service to stop.
+
+    An upgrade thread may be inside the popen→record gap (forking a
+    large-RSS process takes real time), in which case the child exists
+    but is not yet visible here. ``_stop_requested`` is already set,
+    so that thread will abort-and-kill its child at the record point
+    moments later; wait briefly for the upgrade machinery to either
+    record a pending child or go idle before concluding there is
+    nothing to reap."""
+    global _pending_replacement
+    deadline = time.monotonic() + 15.0
+    while True:
+        with _state_lock:
+            child = _pending_replacement
+            _pending_replacement = None
+            still_spawning = _upgrade_active and child is None
+        if child is not None or not still_spawning:
+            break
+        if time.monotonic() >= deadline:
+            logger.warning("shutdown: an upgrade is still in flight with "
+                           "no recorded replacement after 15s; exiting "
+                           "anyway")
+            break
+        time.sleep(0.05)
+    if child is not None:
+        logger.warning("shutdown requested during an upgrade; stopping "
+                       "replacement pid %d", child.pid)
+        _reap(child)
+
 
 def notify_ready() -> bool:
     """Child side of the handshake: if this process was spawned as an
@@ -74,9 +169,14 @@ def notify_ready() -> bool:
 
 
 def replacement_argv(config_path: str, module: str) -> List[str]:
-    """The command line for the replacement generation. Re-exec the
-    same interpreter + module with the same config path — the einhorn
-    analogue of re-running the upgraded binary."""
+    """The command line for the replacement generation — the einhorn
+    analogue of re-running the upgraded binary. Prefers the startup
+    argv recorded by the CLI main (exactly what the operator launched,
+    any future flags included); falls back to reconstructing
+    ``python -m module -f config`` when none was recorded."""
+    with _state_lock:
+        if _startup_argv is not None:
+            return list(_startup_argv)
     return [sys.executable, "-m", module, "-f", config_path]
 
 
@@ -92,6 +192,7 @@ def spawn_replacement(argv: Sequence[str],
     has been killed and reaped, and the caller should keep serving).
     ``popen`` is injectable for tests.
     """
+    global _pending_replacement
     rfd, wfd = os.pipe()
     os.set_inheritable(wfd, True)
     env = dict(os.environ)
@@ -105,6 +206,22 @@ def spawn_replacement(argv: Sequence[str],
         return None
     os.close(wfd)  # child holds the only write end now
 
+    # Record the not-yet-handed-off child so a shutdown racing this
+    # (possibly minutes-long) readiness wait can reap it on the way
+    # out; if shutdown was already requested, don't upgrade at all.
+    with _state_lock:
+        if _stop_requested:
+            abort_now = True
+        else:
+            abort_now = False
+            _pending_replacement = child
+    if abort_now:
+        log.warning("upgrade: shutdown already requested; stopping "
+                    "replacement pid %d", child.pid)
+        _reap(child)
+        os.close(rfd)
+        return None
+
     try:
         deadline = time.monotonic() + ready_timeout
         while True:
@@ -113,6 +230,7 @@ def spawn_replacement(argv: Sequence[str],
                 log.error("upgrade: replacement pid %d not ready after "
                           "%.0fs; killing it and continuing to serve",
                           child.pid, ready_timeout)
+                _clear_pending(child)
                 _reap(child)
                 return None
             readable, _, _ = select.select([rfd], [], [], min(remain, 0.5))
@@ -130,17 +248,20 @@ def spawn_replacement(argv: Sequence[str],
                               "readiness pipe without becoming ready; "
                               "killing it and continuing to serve",
                               child.pid)
+                    _clear_pending(child)
                     _reap(child)
                 else:
                     log.error("upgrade: replacement pid %d exited with "
                               "%d before becoming ready; continuing to "
                               "serve", child.pid, rc)
+                    _clear_pending(child)
                 return None
             rc = child.poll()
             if rc is not None:
                 log.error("upgrade: replacement pid %d exited with %d "
                           "before becoming ready; continuing to serve",
                           child.pid, rc)
+                _clear_pending(child)
                 return None
     finally:
         os.close(rfd)
@@ -158,9 +279,12 @@ def make_sigusr2_handler(config_path: str, module: str,
     upgrading = threading.Lock()
 
     def do_upgrade():
+        global _upgrade_active
         if not upgrading.acquire(blocking=False):
             logger.info("SIGUSR2: an upgrade is already in progress")
             return
+        with _state_lock:
+            _upgrade_active = True
         try:
             if done.is_set():
                 logger.info("SIGUSR2: already draining; ignoring")
@@ -169,26 +293,59 @@ def make_sigusr2_handler(config_path: str, module: str,
             child = spawn_replacement(argv)
             if child is None:
                 return
-            if done.is_set():
+            # Atomic handoff decision: either the replacement becomes
+            # the new generation (done set here, pending cleared) or a
+            # shutdown request won the race and the replacement must
+            # not outlive this generation. request_shutdown() takes
+            # the same lock, so no SIGTERM can slip between this check
+            # and done.set().
+            global _pending_replacement
+            with _state_lock:
+                if done.is_set() or _stop_requested:
+                    handed_off = False
+                else:
+                    _pending_replacement = None
+                    done.set()
+                    # request_shutdown is lock-free (signal-handler
+                    # safe), so a stop can land between the check
+                    # above and done.set(); re-reading here shrinks
+                    # the undetectable window to post-handoff signals
+                    handed_off = not _stop_requested
+            if not handed_off:
                 # a shutdown signal arrived while the replacement was
                 # starting: the operator asked for the service to STOP,
                 # so the replacement must not outlive this generation
                 logger.warning("shutdown requested during the upgrade; "
                                "stopping replacement pid %d", child.pid)
+                _clear_pending(child)
                 _reap(child)
                 return
             logger.info("SIGUSR2: replacement serving; draining "
                         "this generation")
-            done.set()
         finally:
+            with _state_lock:
+                _upgrade_active = False
             upgrading.release()
 
     def handler(signum, frame):
+        global _upgrade_active
         logger.info("Received SIGUSR2, starting zero-downtime upgrade")
+        # mark the machinery active before the thread even exists
+        # (lock-free: this is a signal handler) so a shutdown racing
+        # the thread's first scheduling still waits for it in
+        # reap_unfinished_replacement rather than concluding idle
+        _upgrade_active = True
         threading.Thread(target=do_upgrade, name="binary-upgrade",
                          daemon=True).start()
 
     return handler
+
+
+def _clear_pending(child: "subprocess.Popen") -> None:
+    global _pending_replacement
+    with _state_lock:
+        if _pending_replacement is child:
+            _pending_replacement = None
 
 
 def _reap(child: "subprocess.Popen") -> None:

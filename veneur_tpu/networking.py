@@ -40,11 +40,16 @@ def warn_if_port_already_served(family: int, kind: int, host: str,
         # The probe is strictly best-effort: socket creation itself can
         # fail (e.g. EAFNOSUPPORT for an IPv6 wildcard on a v6-disabled
         # host) and must never break startup — the real bind reports
-        # the accurate error. REUSEADDR so server-side TIME_WAIT from
-        # an ordinary restart doesn't read as a live second instance; a
-        # real listener still conflicts. EACCES etc. stay quiet too.
+        # the accurate error. REUSEADDR only for TCP, where server-side
+        # TIME_WAIT from an ordinary restart would otherwise read as a
+        # live second instance; for UDP there is no TIME_WAIT, and a
+        # REUSEADDR probe would bind *alongside* a live listener that
+        # also set REUSEADDR (ours all do) — silencing exactly the
+        # split-ingest warning this probe exists to raise. EACCES etc.
+        # stay quiet too.
         probe = socket.socket(family, kind)
-        probe.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        if kind == socket.SOCK_STREAM:
+            probe.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         probe.bind((host, port))
     except OSError as e:
         if e.errno == errno.EADDRINUSE:
