@@ -82,21 +82,55 @@ def _digest_programs(mesh: Mesh, compression: float, k: int):
                                      vmin=s, vmax=s, recip=s)
     dig_spec = td_ops.TDigest(mean=sk, weight=sk, min=s, max=s)
 
-    def local_ingest(temp, rows, vals, wts):
+    def guarded_drain(temp, digest, rows_l, vals, wts, s_loc, axes):
+        # the dense/slab stores' shift guard, mesh form: the drain is
+        # row-local (no collective inside the cond), but the DECISION
+        # psums the shift/total masses over ``axes`` so every shard
+        # takes the same drain the dense store would on the same data
+        shifted, total = td_ops.shift_masses(
+            temp.sum_w, temp.sum_wm, rows_l, vals, wts, s_loc)
+        shifted = lax.psum(shifted, axes)
+        total = lax.psum(total, axes)
+        pred = shifted > td_ops.SHIFT_GUARD_FRAC * jnp.maximum(
+            total, jnp.finfo(jnp.float32).tiny)
+
+        def do_drain(args):
+            t, d = args
+            d2 = td_ops.drain_temp(d, t, compression)
+            t2 = t._replace(sum_w=jnp.zeros_like(t.sum_w),
+                            sum_wm=jnp.zeros_like(t.sum_wm))
+            return t2, d2
+
+        return lax.cond(pred, do_drain, lambda a: a, (temp, digest))
+
+    def local_ingest(temp, digest, rows, vals, wts):
         s_loc = temp.sum_w.shape[0]
+        rows_l = _relocal(rows, s_loc)
+        # hosts-sharded chunk: the guard masses psum over BOTH axes
+        # (each shard sees its sub-chunk x its rows)
+        axes = (SERIES_AXIS, HOSTS_AXIS) if hosts > 1 else SERIES_AXIS
+        temp, digest = guarded_drain(temp, digest, rows_l, vals, wts,
+                                     s_loc, axes)
+        # bin into a FRESH temp (the delta rides the hosts-axis
+        # collective) but anchor bin ids on the ACCUMULATED bins so
+        # ordered arrival stays value-coherent across chunks (the
+        # tdigest_sweep ordered-arrival regression)
         binned = td_ops.ingest_chunk(
             td_ops.init_temp(s_loc, k, compression),
-            _relocal(rows, s_loc), vals, wts, compression)
+            rows_l, vals, wts, compression,
+            acc_sum_w=temp.sum_w, acc_sum_wm=temp.sum_wm)
         if hosts > 1:
             binned = collectives.merge_temp(binned, HOSTS_AXIS)
-        return _add_temp(temp, binned)
+        return _add_temp(temp, binned), digest
 
     ingest = jax.jit(
-        shard_map(local_ingest, mesh=mesh, in_specs=(temp_spec, h, h, h),
-                  out_specs=temp_spec, check_vma=False),
-        donate_argnums=(0,))
+        shard_map(local_ingest, mesh=mesh,
+                  in_specs=(temp_spec, dig_spec, h, h, h),
+                  out_specs=(temp_spec, dig_spec), check_vma=False),
+        donate_argnums=(0, 1))
 
-    def local_import(temp, dmin, dmax, rows, means, wts, srows, smins, smaxs):
+    def local_import(temp, digest, dmin, dmax, rows, means, wts,
+                     srows, smins, smaxs):
         # NB: the import chunk is REPLICATED (not hosts-sharded): imported
         # centroid arrays arrive sorted by mean and staged sequentially, so
         # a hosts-axis split would hand each shard a systematically skewed
@@ -104,10 +138,16 @@ def _digest_programs(mesh: Mesh, compression: float, k: int):
         # quantile bands into the same bin. Every device bins the full
         # chunk and keeps its own rows; no collective is needed.
         s_loc = temp.sum_w.shape[0]
+        rows_l = _relocal(rows, s_loc)
+        # replicated chunk: psum the guard masses over SERIES only
+        # (hosts-lines compute identical values)
+        temp, digest = guarded_drain(temp, digest, rows_l, means, wts,
+                                     s_loc, SERIES_AXIS)
         binned = td_ops.ingest_chunk(
             td_ops.init_temp(s_loc, k, compression),
-            _relocal(rows, s_loc), means, wts, compression,
-            update_stats=False)
+            rows_l, means, wts, compression,
+            update_stats=False,
+            acc_sum_w=temp.sum_w, acc_sum_wm=temp.sum_wm)
         # imported centroids feed percentiles only, never local stats
         # (samplers.go:473-480)
         temp = temp._replace(sum_w=temp.sum_w + binned.sum_w,
@@ -115,13 +155,14 @@ def _digest_programs(mesh: Mesh, compression: float, k: int):
         sr = _relocal(srows, s_loc)
         dmin = dmin.at[sr].min(smins, mode="drop")
         dmax = dmax.at[sr].max(smaxs, mode="drop")
-        return temp, dmin, dmax
+        return temp, digest, dmin, dmax
 
     import_ = jax.jit(
         shard_map(local_import, mesh=mesh,
-                  in_specs=(temp_spec, s, s, rep, rep, rep, rep, rep, rep),
-                  out_specs=(temp_spec, s, s), check_vma=False),
-        donate_argnums=(0, 1, 2))
+                  in_specs=(temp_spec, dig_spec, s, s, rep, rep, rep,
+                            rep, rep, rep),
+                  out_specs=(temp_spec, dig_spec, s, s), check_vma=False),
+        donate_argnums=(0, 1, 2, 3))
 
     def local_flush(digest, temp, dmin, dmax, qs):
         drained, pcts = td_ops.drain_and_quantile(digest, temp, dmin, dmax,
@@ -221,7 +262,8 @@ class MeshDigestGroup(DigestGroup):
         self._device_dirty = True
         rows, vals, wts = self._rows, self._vals, self._wts
         self._new_sample_buffers()
-        self.temp = self._ingest_p(self.temp, rows, vals, wts)
+        self.temp, self.digest = self._ingest_p(self.temp, self.digest,
+                                                rows, vals, wts)
 
     def _drain_imports(self):
         if self._imp_fill == 0 and self._imp_stat_fill == 0:
@@ -234,8 +276,8 @@ class MeshDigestGroup(DigestGroup):
         stat_maxs = self._imp_stat_maxs
         imp = (self._imp_rows, self._imp_means, self._imp_wts)
         self._new_import_buffers()
-        self.temp, self.dmin, self.dmax = self._import_p(
-            self.temp, self.dmin, self.dmax, *imp,
+        self.temp, self.digest, self.dmin, self.dmax = self._import_p(
+            self.temp, self.digest, self.dmin, self.dmax, *imp,
             stat_rows, stat_mins, stat_maxs)
 
     def _run_flush(self, qs):

@@ -109,18 +109,59 @@ def _init_temp_slab(slab: int, k: int) -> TempSlab:
     )
 
 
-@partial(jax.jit, donate_argnums=(0,), static_argnums=(4, 5))
-def _ingest_slab(temp: TempSlab, rows, values, weights, slab: int,
-                 compression: float) -> TempSlab:
-    """Scatter one flat sample chunk into a slab's flat accumulators.
+def _guard_drain_slab(temp: TempSlab, digest: DigestSlab, rows, values,
+                      weights, slab: int, compression: float):
+    """The slab form of ops/tdigest.py's shift guard: when the chunk's
+    per-row value ranges are disjoint from what the accumulated bins
+    cover for enough chunk mass, drain the bins into the (storage-dtype)
+    digest planes first so the fresh bins re-anchor — a lax.cond, so
+    stationary traffic pays one cheap reduction, never the drain. Temp
+    scalar stats survive (interval aggregates; only the bins move)."""
+    k = temp.sum_w.shape[0] // slab
+    pred = td_ops.shift_pred(temp.sum_w, temp.sum_wm, rows, values,
+                             weights, slab)
+
+    def do_drain(args):
+        t, d = args
+        dt = d.mean.dtype
+        d32 = td_ops.TDigest(
+            mean=d.mean.reshape(slab, k).astype(jnp.float32),
+            weight=d.weight.reshape(slab, k).astype(jnp.float32),
+            min=d.dmin, max=d.dmax)
+        t32 = td_ops.TempCentroids(
+            sum_w=t.sum_w.reshape(slab, k),
+            sum_wm=t.sum_wm.reshape(slab, k),
+            count=t.count, vsum=t.vsum, vmin=t.vmin, vmax=t.vmax,
+            recip=t.recip)
+        drained = td_ops.drain_temp(d32, t32, compression)
+        d2 = DigestSlab(
+            mean=drained.mean.astype(dt).reshape(-1),
+            weight=drained.weight.astype(dt).reshape(-1),
+            dmin=drained.min, dmax=drained.max, count=d.count)
+        t2 = t._replace(sum_w=jnp.zeros_like(t.sum_w),
+                        sum_wm=jnp.zeros_like(t.sum_wm))
+        return t2, d2
+
+    return lax.cond(pred, do_drain, lambda a: a, (temp, digest))
+
+
+@partial(jax.jit, donate_argnums=(0, 1), static_argnums=(5, 6))
+def _ingest_slab(temp: TempSlab, digest: DigestSlab, rows, values, weights,
+                 slab: int, compression: float):
+    """Scatter one flat sample chunk into a slab's flat accumulators,
+    with the shift guard (returns (temp, digest)).
 
     rows: [N] LOCAL row ids; anything >= slab is padding / out-of-slab and
     must scatter nowhere (flat index >= slab*K with mode='drop')."""
     k = temp.sum_w.shape[0] // slab
     oor = rows >= slab
+    rows = jnp.where(oor, slab, rows)
+    weights = jnp.where(oor, 0.0, weights)
+    temp, digest = _guard_drain_slab(temp, digest, rows, values, weights,
+                                     slab, compression)
     r, v, w, b = td_ops.bin_flat_samples(
-        jnp.where(oor, slab, rows), values,
-        jnp.where(oor, 0.0, weights), slab, k, compression)
+        rows, values, weights, slab, k, compression,
+        acc_sum_w=temp.sum_w, acc_sum_wm=temp.sum_wm)
     live = w > 0
     vz = jnp.where(live, v, 0.0)
     flat = jnp.where(r >= slab, slab * k, r * k + b)
@@ -132,7 +173,7 @@ def _ingest_slab(temp: TempSlab, rows, values, weights, slab: int,
         vmin=temp.vmin.at[r].min(jnp.where(live, v, jnp.inf), mode="drop"),
         vmax=temp.vmax.at[r].max(jnp.where(live, v, -jnp.inf), mode="drop"),
         recip=temp.recip.at[r].add(jnp.where(live, w / v, 0.0), mode="drop"),
-    )
+    ), digest
 
 
 @partial(jax.jit, donate_argnums=(0, 1), static_argnums=(8, 9))
@@ -145,9 +186,13 @@ def _import_slab(temp: TempSlab, digest: DigestSlab, rows, means, weights,
     bound the final digest."""
     k = temp.sum_w.shape[0] // slab
     oor = rows >= slab
+    rows = jnp.where(oor, slab, rows)
+    weights = jnp.where(oor, 0.0, weights)
+    temp, digest = _guard_drain_slab(temp, digest, rows, means, weights,
+                                     slab, compression)
     r, v, w, b = td_ops.bin_flat_samples(
-        jnp.where(oor, slab, rows), means,
-        jnp.where(oor, 0.0, weights), slab, k, compression)
+        rows, means, weights, slab, k, compression,
+        acc_sum_w=temp.sum_w, acc_sum_wm=temp.sum_wm)
     live = w > 0
     vz = jnp.where(live, v, 0.0)
     flat = jnp.where(r >= slab, slab * k, r * k + b)
@@ -436,8 +481,9 @@ class SlabDigestBank:
     def ingest_slab(self, slab_idx: int, rows, values, weights):
         """Fold a flat chunk of samples whose rows are LOCAL to one slab."""
         assert self.mode == "local"
-        self.temps[slab_idx] = _ingest_slab(
-            self.temps[slab_idx], jnp.asarray(rows), jnp.asarray(values),
+        self.temps[slab_idx], self.digests[slab_idx] = _ingest_slab(
+            self.temps[slab_idx], self.digests[slab_idx],
+            jnp.asarray(rows), jnp.asarray(values),
             jnp.asarray(weights), self.slab_rows, self.compression)
 
     def ingest(self, rows, values, weights):
@@ -454,9 +500,9 @@ class SlabDigestBank:
             local = jnp.where((rows >= base)
                               & (rows < base + self.slab_rows),
                               rows - base, self.slab_rows)
-            self.temps[i] = _ingest_slab(
-                self.temps[i], local, values, weights, self.slab_rows,
-                self.compression)
+            self.temps[i], self.digests[i] = _ingest_slab(
+                self.temps[i], self.digests[i], local, values, weights,
+                self.slab_rows, self.compression)
 
     # -- global role: digest import --------------------------------------
 
@@ -650,6 +696,10 @@ class SlabDigestGroup:
                          weights: np.ndarray, dmin: float, dmax: float):
         row = self._row(key, tags)
         n = len(means)
+        # keep one digest's sorted centroid run inside one staging
+        # drain (see store.bulk_stage_import_centroids)
+        if self._imp_fill + n > self.chunk and n <= self.chunk:
+            self._drain_imports()
         start = 0
         while start < n:
             if self._imp_fill == self.chunk:
@@ -708,9 +758,10 @@ class SlabDigestGroup:
         rows, vals, wts = self._rows, self._vals, self._wts
         self._new_sample_buffers()
         for i, local, (v, w) in self._per_slab(rows, vals, wts):
-            self.temps[i] = _ingest_slab(
-                self.temps[i], jnp.asarray(local), jnp.asarray(v),
-                jnp.asarray(w), self.slab_rows, self.compression)
+            self.temps[i], self.digests[i] = _ingest_slab(
+                self.temps[i], self.digests[i], jnp.asarray(local),
+                jnp.asarray(v), jnp.asarray(w), self.slab_rows,
+                self.compression)
 
     def _drain_imports(self):
         if self._imp_fill == 0 and self._imp_stat_fill == 0:

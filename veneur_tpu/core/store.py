@@ -232,24 +232,30 @@ class ScalarGroup:
 # ---------------------------------------------------------------------------
 
 
-@partial(jax.jit, donate_argnums=(0,), static_argnums=(4,))
-def _ingest_samples(temp: td_ops.TempCentroids, rows, values, weights,
-                    compression):
-    return td_ops.ingest_chunk(temp, rows, values, weights, compression)
+@partial(jax.jit, donate_argnums=(0, 1), static_argnums=(5,))
+def _ingest_samples(digest: td_ops.TDigest, temp: td_ops.TempCentroids,
+                    rows, values, weights, compression):
+    """Shift-guarded ingest (ops/tdigest.py ingest_chunk_guarded): a
+    distribution step drains the bins into the digest before re-binning,
+    so ordered/shifting arrival cannot alias values across bins."""
+    return td_ops.ingest_chunk_guarded(digest, temp, rows, values, weights,
+                                       compression)
 
 
-@partial(jax.jit, donate_argnums=(0, 1, 2), static_argnums=(9,))
-def _ingest_centroids(temp: td_ops.TempCentroids, dmin, dmax, rows, means,
+@partial(jax.jit, donate_argnums=(0, 1, 2, 3), static_argnums=(10,))
+def _ingest_centroids(digest: td_ops.TDigest, temp: td_ops.TempCentroids,
+                      dmin, dmax, rows, means,
                       weights, stat_rows, stat_mins, stat_maxs, compression):
     """Fold imported digest centroids into the bin accumulators WITHOUT
     touching the local scalar stats (samplers.go:473-480). Imported
     per-digest min/max land in separate dmin/dmax arrays that only bound the
-    final digest."""
-    temp = td_ops.ingest_chunk(temp, rows, means, weights, compression,
-                               update_stats=False)
+    final digest. Shift-guarded like the sample path."""
+    digest, temp = td_ops.ingest_chunk_guarded(
+        digest, temp, rows, means, weights, compression,
+        update_stats=False)
     dmin = dmin.at[stat_rows].min(stat_mins, mode="drop")
     dmax = dmax.at[stat_rows].max(stat_maxs, mode="drop")
-    return temp, dmin, dmax
+    return digest, temp, dmin, dmax
 
 
 @partial(jax.jit, donate_argnums=(0, 1), static_argnums=(5,))
@@ -270,19 +276,50 @@ def bulk_stage_import_centroids(group, rows: np.ndarray, means: np.ndarray,
     """Shared bulk-import staging protocol for digest groups (dense and
     slab share the ``_imp_*`` buffer layout and drain rules): span copies
     into the import buffers, then drain when either the centroid buffer
-    or the stat lists fill."""
+    or the stat lists fill.
+
+    Drains align to ROW-RUN boundaries: a row's centroids arrive as one
+    sorted-by-mean run, and splitting that run across two staging
+    drains hands each drain a systematically skewed half — the
+    per-chunk quantile binning then aliases the halves into the same
+    bins (a single straddling row is far below the aggregate shift
+    guard's threshold). Runs longer than the whole chunk (can't happen
+    for digests: a run is <= K centroids << chunk) would fall back to
+    splitting."""
     n = len(rows)
+    # equal-row run boundaries (each run = one digest's sorted
+    # centroids), so span copies stay O(n/chunk), not O(runs)
+    if n:
+        run_ends = np.concatenate(
+            (np.flatnonzero(rows[1:] != rows[:-1]) + 1, [n]))
+    else:
+        run_ends = np.empty(0, np.int64)
     start = 0
     while start < n:
         if group._imp_fill == group.chunk:
             group._drain_imports()
-        take = min(group.chunk - group._imp_fill, n - start)
+        avail = group.chunk - group._imp_fill
+        limit = start + avail
+        if limit >= n:
+            end = n
+        else:
+            # largest run boundary that fits; a run longer than the
+            # remaining space drains first (partial buffer) or, when
+            # longer than a whole chunk, splits as a last resort
+            j = int(np.searchsorted(run_ends, limit, "right"))
+            end = int(run_ends[j - 1]) if j > 0 else 0
+            if end <= start:
+                if avail < group.chunk:
+                    group._drain_imports()
+                    continue
+                end = limit
+        take = end - start
         i = group._imp_fill
         group._imp_rows[i:i + take] = rows[start:start + take]
         group._imp_means[i:i + take] = means[start:start + take]
         group._imp_wts[i:i + take] = weights[start:start + take]
         group._imp_fill = i + take
-        start += take
+        start = end
     # stat triples stage in chunk-bounded spans too: one oversized drain
     # would pad the stat arrays past the bounded pow2 ladder and compile
     # a one-off _ingest_centroids variant (~20s each on TPU)
@@ -447,6 +484,11 @@ class DigestGroup:
         the shuffle."""
         row = self._row(key, tags)
         n = len(means)
+        # keep one digest's sorted centroid run inside one staging
+        # drain: a split run hands each drain a skewed half that the
+        # per-chunk binning aliases (see bulk_stage_import_centroids)
+        if self._imp_fill + n > self.chunk and n <= self.chunk:
+            self._drain_imports()
         start = 0
         while start < n:  # digests larger than one chunk span several drains
             if self._imp_fill == self.chunk:
@@ -485,9 +527,9 @@ class DigestGroup:
         self._device_dirty = True
         rows, vals, wts = self._rows, self._vals, self._wts
         self._new_sample_buffers()
-        self.temp = _ingest_samples(self.temp, jnp.asarray(rows),
-                                    jnp.asarray(vals), jnp.asarray(wts),
-                                    self.compression)
+        self.digest, self.temp = _ingest_samples(
+            self.digest, self.temp, jnp.asarray(rows),
+            jnp.asarray(vals), jnp.asarray(wts), self.compression)
 
     def _drain_imports(self):
         if self._imp_fill == 0 and self._imp_stat_fill == 0:
@@ -507,8 +549,8 @@ class DigestGroup:
         imp_rows, imp_means, imp_wts = (self._imp_rows, self._imp_means,
                                         self._imp_wts)
         self._new_import_buffers()
-        self.temp, self.dmin, self.dmax = _ingest_centroids(
-            self.temp, self.dmin, self.dmax,
+        self.digest, self.temp, self.dmin, self.dmax = _ingest_centroids(
+            self.digest, self.temp, self.dmin, self.dmax,
             jnp.asarray(imp_rows), jnp.asarray(imp_means),
             jnp.asarray(imp_wts), jnp.asarray(stat_rows),
             jnp.asarray(stat_mins), jnp.asarray(stat_maxs),
@@ -1342,7 +1384,10 @@ class MetricStore:
                 group = self.local_timers if m.scope == LOCAL_ONLY else self.timers
                 group.sample(m.key, m.tags, m.value, m.sample_rate)
             elif t == "set":
-                if "veneurtopk" in m.tags:
+                # bare-tag form from DogStatsD, scope form from the SSF
+                # lanes (whose "k:v" tag encoding never yields the bare
+                # string)
+                if "veneurtopk" in m.tags or m.scope == _TOPK_SCOPE:
                     self.heavy_hitters.sample(m.key, m.tags, str(m.value))
                 else:
                     group = (self.local_sets if m.scope == LOCAL_ONLY
