@@ -40,6 +40,10 @@ _lib_lock = threading.Lock()
 _build_error: Optional[str] = None
 
 
+class _VsBatch(ctypes.Structure):
+    pass  # fields set after _VtBatch (holds a VtBatch* for its metrics)
+
+
 class _VtBatch(ctypes.Structure):
     _fields_ = [
         ("capacity", ctypes.c_uint32),
@@ -60,6 +64,36 @@ class _VtBatch(ctypes.Structure):
         ("aux_len", ctypes.POINTER(ctypes.c_uint32)),
         ("arena", ctypes.POINTER(ctypes.c_char)),
     ]
+
+
+_VsBatch._fields_ = [
+    ("capacity", ctypes.c_uint32),
+    ("count", ctypes.c_uint32),
+    ("arena_cap", ctypes.c_uint32),
+    ("arena_len", ctypes.c_uint32),
+    ("decode_errors", ctypes.c_uint64),
+    ("invalid_samples", ctypes.c_uint64),
+    ("version", ctypes.POINTER(ctypes.c_int32)),
+    ("trace_id", ctypes.POINTER(ctypes.c_int64)),
+    ("span_id", ctypes.POINTER(ctypes.c_int64)),
+    ("parent_id", ctypes.POINTER(ctypes.c_int64)),
+    ("start_ns", ctypes.POINTER(ctypes.c_int64)),
+    ("end_ns", ctypes.POINTER(ctypes.c_int64)),
+    ("error", ctypes.POINTER(ctypes.c_uint8)),
+    ("indicator", ctypes.POINTER(ctypes.c_uint8)),
+    ("service_off", ctypes.POINTER(ctypes.c_uint32)),
+    ("service_len", ctypes.POINTER(ctypes.c_uint32)),
+    ("name_off", ctypes.POINTER(ctypes.c_uint32)),
+    ("name_len", ctypes.POINTER(ctypes.c_uint32)),
+    ("raw_off", ctypes.POINTER(ctypes.c_uint32)),
+    ("raw_len", ctypes.POINTER(ctypes.c_uint32)),
+    ("arena", ctypes.POINTER(ctypes.c_char)),
+    ("metrics", ctypes.POINTER(_VtBatch)),
+    ("slow_cap", ctypes.c_uint32),
+    ("slow_count", ctypes.c_uint32),
+    ("slow_off", ctypes.POINTER(ctypes.c_uint32)),
+    ("slow_len", ctypes.POINTER(ctypes.c_uint32)),
+]
 
 
 def _build() -> Optional[str]:
@@ -151,6 +185,31 @@ def _bind(lib):
         ctypes.c_void_p, ctypes.POINTER(_VtBatch),
         ctypes.POINTER(ctypes.c_uint32), ctypes.POINTER(ctypes.c_uint8),
         ctypes.POINTER(ctypes.c_uint32)]
+    lib.vs_batch_new.restype = ctypes.POINTER(_VsBatch)
+    lib.vs_batch_new.argtypes = [ctypes.c_uint32, ctypes.c_uint32,
+                                 ctypes.c_uint32, ctypes.c_uint32]
+    lib.vs_batch_free.argtypes = [ctypes.POINTER(_VsBatch)]
+    lib.vs_batch_reset.argtypes = [ctypes.POINTER(_VsBatch)]
+    lib.vs_decode_span.restype = ctypes.c_int
+    lib.vs_decode_span.argtypes = [
+        ctypes.c_char_p, ctypes.c_size_t, ctypes.POINTER(_VsBatch),
+        ctypes.c_char_p, ctypes.c_uint32]
+    lib.vs_reader_start.restype = ctypes.c_void_p
+    lib.vs_reader_start.argtypes = [
+        ctypes.c_char_p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+        ctypes.c_uint32, ctypes.c_uint32, ctypes.c_uint32,
+        ctypes.c_uint32, ctypes.c_int, ctypes.c_char_p]
+    lib.vs_reader_port.restype = ctypes.c_int
+    lib.vs_reader_port.argtypes = [ctypes.c_void_p]
+    lib.vs_reader_count.restype = ctypes.c_int
+    lib.vs_reader_count.argtypes = [ctypes.c_void_p]
+    lib.vs_reader_swap.restype = ctypes.POINTER(_VsBatch)
+    lib.vs_reader_swap.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    lib.vs_reader_packets.restype = ctypes.c_uint64
+    lib.vs_reader_packets.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    lib.vs_reader_drops.restype = ctypes.c_uint64
+    lib.vs_reader_drops.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    lib.vs_reader_stop.argtypes = [ctypes.c_void_p]
     return lib
 
 
@@ -304,6 +363,198 @@ class InternTable:
     def __del__(self):
         try:
             self.close()
+        except Exception:
+            pass
+
+
+class LazySpan:
+    """A decoded SSF span: hot header fields preloaded from the C++
+    span batch, everything else (tags map, embedded metrics, version)
+    materialized from the raw protobuf bytes on first touch — span
+    sinks that never read the cold fields (blackhole, counters-only)
+    never pay the Python protobuf decode. ``metrics_extracted`` tells
+    the metric-extraction sink the C++ lane already converted the
+    embedded samples (sinks/ssfmetrics.py)."""
+
+    __slots__ = ("trace_id", "id", "parent_id", "start_timestamp",
+                 "end_timestamp", "error", "indicator", "service",
+                 "name", "metrics_extracted", "_raw", "_pb")
+
+    def __init__(self, trace_id, id, parent_id, start_timestamp,
+                 end_timestamp, error, indicator, service, name, raw):
+        self.trace_id = trace_id
+        self.id = id
+        self.parent_id = parent_id
+        self.start_timestamp = start_timestamp
+        self.end_timestamp = end_timestamp
+        self.error = error
+        self.indicator = indicator
+        self.service = service
+        self.name = name
+        self.metrics_extracted = True
+        self._raw = raw
+        self._pb = None
+
+    @property
+    def pb(self):
+        if self._pb is None:
+            from veneur_tpu.protocol.gen.ssf import sample_pb2
+
+            span = sample_pb2.SSFSpan()
+            span.ParseFromString(self._raw)
+            self._pb = span
+        return self._pb
+
+    def SerializeToString(self):  # noqa: N802 - protobuf naming
+        return self._raw
+
+    def __getattr__(self, item):
+        # cold fields (tags, metrics, version, ...) delegate to the
+        # materialized protobuf; __getattr__ only fires for names not
+        # covered by __slots__
+        if item.startswith("_"):
+            raise AttributeError(item)
+        return getattr(self.pb, item)
+
+
+class SpanBatch:
+    """numpy/bytes copies of a VsBatch (safe after the C++ batch is
+    reused): span headers, the embedded-metric records as an ordinary
+    ParsedBatch (ready for MetricStore.process_batch), and the raw
+    bytes of slow-lane samples (STATUS / undecodable) for the Python
+    parser."""
+
+    __slots__ = ("count", "decode_errors", "invalid_samples",
+                 "metrics", "slow_samples", "_trace_id", "_span_id",
+                 "_parent_id", "_start", "_end", "_error", "_indicator",
+                 "_svc_off", "_svc_len", "_name_off", "_name_len",
+                 "_raw_off", "_raw_len", "_arena")
+
+    def __init__(self, b: "_VsBatch"):
+        n = b.count
+        self.count = n
+        self.decode_errors = b.decode_errors
+        self.invalid_samples = b.invalid_samples
+
+        def arr(ptr, dtype):
+            if n == 0:
+                return np.empty(0, dtype)
+            return np.ctypeslib.as_array(ptr, shape=(n,)).astype(
+                dtype, copy=True)
+
+        self._trace_id = arr(b.trace_id, np.int64)
+        self._span_id = arr(b.span_id, np.int64)
+        self._parent_id = arr(b.parent_id, np.int64)
+        self._start = arr(b.start_ns, np.int64)
+        self._end = arr(b.end_ns, np.int64)
+        self._error = arr(b.error, np.uint8)
+        self._indicator = arr(b.indicator, np.uint8)
+        self._svc_off = arr(b.service_off, np.uint32)
+        self._svc_len = arr(b.service_len, np.uint32)
+        self._name_off = arr(b.name_off, np.uint32)
+        self._name_len = arr(b.name_len, np.uint32)
+        self._raw_off = arr(b.raw_off, np.uint32)
+        self._raw_len = arr(b.raw_len, np.uint32)
+        self._arena = ctypes.string_at(b.arena, b.arena_len)
+        self.metrics = ParsedBatch(b.metrics.contents)
+        ns = b.slow_count
+        self.slow_samples = []
+        for i in range(ns):
+            off, ln = b.slow_off[i], b.slow_len[i]
+            self.slow_samples.append(self._arena[off:off + ln])
+
+    def span(self, i: int) -> LazySpan:
+        ro, rl = self._raw_off[i], self._raw_len[i]
+        so, sl = self._svc_off[i], self._svc_len[i]
+        no, nl = self._name_off[i], self._name_len[i]
+        return LazySpan(
+            int(self._trace_id[i]), int(self._span_id[i]),
+            int(self._parent_id[i]), int(self._start[i]),
+            int(self._end[i]), bool(self._error[i]),
+            bool(self._indicator[i]),
+            self._arena[so:so + sl].decode("utf-8", "replace"),
+            self._arena[no:no + nl].decode("utf-8", "replace"),
+            self._arena[ro:ro + rl])
+
+    def spans(self) -> List[LazySpan]:
+        return [self.span(i) for i in range(self.count)]
+
+
+def decode_spans(datagrams: List[bytes],
+                 indicator_timer_name: str = "") -> SpanBatch:
+    """Batch-decode bare SSFSpan datagrams natively (tests and the
+    direct-call path; the server uses NativeSSFReader)."""
+    lib = _load()
+    if lib is None:
+        raise RuntimeError(f"native ingest unavailable: {_build_error}")
+    total = sum(len(d) for d in datagrams)
+    ind = indicator_timer_name.encode()
+    b = lib.vs_batch_new(max(len(datagrams), 16), total + 64,
+                         max(32, len(datagrams) * 9),
+                         total * 2 + 1024)
+    try:
+        for d in datagrams:
+            lib.vs_decode_span(d, len(d), b, ind, len(ind))
+        return SpanBatch(b.contents)
+    finally:
+        lib.vs_batch_free(b)
+
+
+class NativeSSFReader:
+    """The C++ SSF reader pool: SO_REUSEPORT sockets drained with
+    recvmmsg, one SSFSpan decoded per datagram ON THE C++ THREADS (off
+    the GIL), embedded metric samples converted to parsed records
+    in-line. ``drain()`` swaps every reader's batch."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 num_readers: int = 1, rcvbuf: int = 2 * 1024 * 1024,
+                 span_cap: int = 32768, arena_cap: int = 32 * 1024 * 1024,
+                 metric_cap: int = 262144,
+                 metric_arena: int = 32 * 1024 * 1024,
+                 dgram_max: int = 8192,
+                 indicator_timer_name: str = ""):
+        lib = _load()
+        if lib is None:
+            raise RuntimeError(f"native ingest unavailable: {_build_error}")
+        self._lib = lib
+        self._handle = lib.vs_reader_start(
+            host.encode(), port, num_readers, rcvbuf, span_cap,
+            arena_cap, metric_cap, metric_arena, dgram_max,
+            indicator_timer_name.encode())
+        if not self._handle:
+            raise OSError(f"could not bind native SSF readers on "
+                          f"{host}:{port}")
+        self.port = lib.vs_reader_port(self._handle)
+        self.num_readers = lib.vs_reader_count(self._handle)
+
+    def drain(self) -> List[SpanBatch]:
+        out = []
+        for i in range(self.num_readers):
+            b = self._lib.vs_reader_swap(self._handle, i)
+            if b.contents.count or b.contents.decode_errors:
+                out.append(SpanBatch(b.contents))
+        return out
+
+    def packets(self) -> int:
+        return sum(self._lib.vs_reader_packets(self._handle, i)
+                   for i in range(self.num_readers))
+
+    def drops(self) -> int:
+        return sum(self._lib.vs_reader_drops(self._handle, i)
+                   for i in range(self.num_readers))
+
+    def stop(self) -> None:
+        if self._handle:
+            self._lib.vs_reader_stop(self._handle)
+            self._handle = None
+
+    def leak(self) -> None:
+        """See NativeUDPReader.leak."""
+        self._handle = None
+
+    def __del__(self):
+        try:
+            self.stop()
         except Exception:
             pass
 

@@ -763,3 +763,686 @@ extern "C" void vt_reader_stop(void* handle) {
   }
   delete pool;
 }
+
+// ---------------------------------------------------------------------------
+// SSF span batch lane (server.go:827-899, ssf/sample.proto)
+//
+// UDP SSF datagrams each carry one bare SSFSpan protobuf. The Python
+// path decodes them one ParseFromString at a time on the reader thread
+// — the round-4 verdict's last hot ingest lane without a batch twin.
+// Here the reader pool decodes spans on its C++ threads (off the GIL)
+// into a struct-of-arrays span batch whose EMBEDDED METRICS are
+// appended directly as VtBatch records, bit-identical to the Python
+// parse_metric_ssf conversion (parser.py:198-233 / parser.go:179-230):
+// "k:v" tags sorted bytewise, exact-key veneurlocalonly/globalonly
+// scope extraction, fnv1a(name+type+joined-tags) digest, set members
+// hashed with the FNV+fmix64 member hash. Indicator spans synthesize
+// the configured duration timer natively (parser.go:94-121). STATUS
+// samples (rare control-plane) and undecodable samples are surfaced as
+// raw byte ranges for the Python slow lane. The raw span bytes stay in
+// the arena so Python can materialize the full protobuf lazily for
+// span sinks that need it.
+
+namespace {
+
+// minimal proto3 walker (same shape as veneur_egress.cpp's Cursor —
+// the two .so files are compiled standalone, so a local copy)
+struct PbCursor {
+  const uint8_t* p;
+  const uint8_t* end;
+  bool ok = true;
+
+  uint64_t varint() {
+    uint64_t v = 0;
+    int shift = 0;
+    while (p < end && shift < 64) {
+      uint8_t b = *p++;
+      v |= static_cast<uint64_t>(b & 0x7F) << shift;
+      if (!(b & 0x80)) return v;
+      shift += 7;
+    }
+    ok = false;
+    return 0;
+  }
+  uint32_t fixed32() {
+    if (end - p < 4) { ok = false; return 0; }
+    uint32_t v;
+    memcpy(&v, p, 4);
+    p += 4;
+    return v;
+  }
+  float f32() {
+    uint32_t v = fixed32();
+    float f;
+    memcpy(&f, &v, 4);
+    return f;
+  }
+  uint32_t tag() {
+    if (p >= end) return 0;
+    uint64_t t = varint();
+    return ok ? static_cast<uint32_t>(t) : 0;
+  }
+  PbCursor sub() {
+    uint64_t n = varint();
+    if (!ok || static_cast<uint64_t>(end - p) < n) {
+      ok = false;
+      return {p, p};
+    }
+    PbCursor c{p, p + n};
+    p += n;
+    return c;
+  }
+  void skip(uint32_t wire_type) {
+    switch (wire_type) {
+      case 0: varint(); break;
+      case 1: if (end - p >= 8) p += 8; else ok = false; break;
+      case 2: {
+        uint64_t n = varint();
+        if (ok && static_cast<uint64_t>(end - p) >= n) p += n;
+        else ok = false;
+        break;
+      }
+      case 5: if (end - p >= 4) p += 4; else ok = false; break;
+      default: ok = false;
+    }
+  }
+};
+
+}  // namespace
+
+// Decoded span batch. Span string fields (service/name) are offsets into
+// `arena`, pointing INSIDE the span's raw bytes (raw_off/raw_len), which
+// hold the whole datagram for lazy full-protobuf materialization.
+// Embedded metric samples land in `metrics` as ordinary parsed records.
+extern "C" struct VsBatch {
+  uint32_t capacity;
+  uint32_t count;
+  uint32_t arena_cap;
+  uint32_t arena_len;
+  uint64_t decode_errors;    // undecodable datagrams
+  uint64_t invalid_samples;  // samples failing parse_metric_ssf validity
+  int32_t* version;
+  int64_t* trace_id;
+  int64_t* span_id;
+  int64_t* parent_id;
+  int64_t* start_ns;
+  int64_t* end_ns;
+  uint8_t* error;
+  uint8_t* indicator;
+  uint32_t* service_off;
+  uint32_t* service_len;
+  uint32_t* name_off;
+  uint32_t* name_len;
+  uint32_t* raw_off;
+  uint32_t* raw_len;
+  char* arena;
+  VtBatch* metrics;
+  // slow lane: STATUS / otherwise Python-only samples, raw bytes
+  uint32_t slow_cap;
+  uint32_t slow_count;
+  uint32_t* slow_off;
+  uint32_t* slow_len;
+};
+
+extern "C" VsBatch* vs_batch_new(uint32_t spans_cap, uint32_t arena_cap,
+                                 uint32_t metric_cap,
+                                 uint32_t metric_arena_cap) {
+  VsBatch* b = static_cast<VsBatch*>(calloc(1, sizeof(VsBatch)));
+  b->capacity = spans_cap;
+  b->arena_cap = arena_cap;
+  b->version = static_cast<int32_t*>(malloc(spans_cap * 4));
+  b->trace_id = static_cast<int64_t*>(malloc(spans_cap * 8));
+  b->span_id = static_cast<int64_t*>(malloc(spans_cap * 8));
+  b->parent_id = static_cast<int64_t*>(malloc(spans_cap * 8));
+  b->start_ns = static_cast<int64_t*>(malloc(spans_cap * 8));
+  b->end_ns = static_cast<int64_t*>(malloc(spans_cap * 8));
+  b->error = static_cast<uint8_t*>(malloc(spans_cap));
+  b->indicator = static_cast<uint8_t*>(malloc(spans_cap));
+  b->service_off = static_cast<uint32_t*>(malloc(spans_cap * 4));
+  b->service_len = static_cast<uint32_t*>(malloc(spans_cap * 4));
+  b->name_off = static_cast<uint32_t*>(malloc(spans_cap * 4));
+  b->name_len = static_cast<uint32_t*>(malloc(spans_cap * 4));
+  b->raw_off = static_cast<uint32_t*>(malloc(spans_cap * 4));
+  b->raw_len = static_cast<uint32_t*>(malloc(spans_cap * 4));
+  b->arena = static_cast<char*>(malloc(arena_cap));
+  b->metrics = vt_batch_new(metric_cap, metric_arena_cap);
+  b->slow_cap = spans_cap;
+  b->slow_off = static_cast<uint32_t*>(malloc(b->slow_cap * 4));
+  b->slow_len = static_cast<uint32_t*>(malloc(b->slow_cap * 4));
+  return b;
+}
+
+extern "C" void vs_batch_free(VsBatch* b) {
+  if (!b) return;
+  free(b->version); free(b->trace_id); free(b->span_id);
+  free(b->parent_id); free(b->start_ns); free(b->end_ns);
+  free(b->error); free(b->indicator);
+  free(b->service_off); free(b->service_len);
+  free(b->name_off); free(b->name_len);
+  free(b->raw_off); free(b->raw_len);
+  free(b->arena);
+  vt_batch_free(b->metrics);
+  free(b->slow_off); free(b->slow_len);
+  free(b);
+}
+
+extern "C" void vs_batch_reset(VsBatch* b) {
+  b->count = 0;
+  b->arena_len = 0;
+  b->decode_errors = 0;
+  b->invalid_samples = 0;
+  b->slow_count = 0;
+  vt_batch_reset(b->metrics);
+}
+
+namespace {
+
+inline uint32_t vs_arena_put(VsBatch* b, const char* data, size_t len) {
+  if (b->arena_len + len > b->arena_cap) return UINT32_MAX;
+  memcpy(b->arena + b->arena_len, data, len);
+  uint32_t off = b->arena_len;
+  b->arena_len += static_cast<uint32_t>(len);
+  return off;
+}
+
+// Append one decoded SSFSample as a parsed metric record, mirroring
+// parse_metric_ssf + valid_metric (parser.py:198-238). Returns false
+// only when the metrics batch/arena is full (caller drops the batch
+// accounting); invalid samples bump the counter and "succeed".
+bool append_ssf_sample(VsBatch* vb, uint32_t sample_metric,
+                       const char* name_p, size_t name_n,
+                       float value, float sample_rate,
+                       const char* member_p, size_t member_n,
+                       const std::vector<std::string>& kv_tags) {
+  VtBatch* mb = vb->metrics;
+  uint8_t rtype;
+  switch (sample_metric) {
+    case 0: rtype = kCounter; break;
+    case 1: rtype = kGauge; break;
+    case 2: rtype = kHistogram; break;
+    case 3: rtype = kSet; break;
+    default:
+      // unknown enum: parse error in the Python path too
+      vb->invalid_samples++;
+      return true;
+  }
+  if (name_n == 0 || (rtype == kSet && member_n == 0)) {
+    vb->invalid_samples++;  // valid_metric: name and value required
+    return true;
+  }
+  if (mb->count >= mb->capacity) return false;
+  uint32_t idx = mb->count;
+
+  // exact-key scope extraction; every matching key is removed and the
+  // LAST one seen wins, matching the dict iteration in parser.py:215-222
+  uint8_t scope = kMixed;
+  std::vector<const std::string*> keep;
+  keep.reserve(kv_tags.size());
+  for (const std::string& kv : kv_tags) {
+    size_t colon = kv.find(':');
+    size_t klen = colon == std::string::npos ? kv.size() : colon;
+    if (klen == 15 && memcmp(kv.data(), "veneurlocalonly", 15) == 0) {
+      scope = kLocalOnly;
+      continue;
+    }
+    if (klen == 16 && memcmp(kv.data(), "veneurglobalonly", 16) == 0) {
+      scope = kGlobalOnly;
+      continue;
+    }
+    keep.push_back(&kv);
+  }
+  std::sort(keep.begin(), keep.end(),
+            [](const std::string* a, const std::string* b) {
+              return *a < *b;
+            });
+  if (rtype == kSet) {
+    for (const std::string* kv : keep) {
+      // the SSF "k:v" encoding makes the tag "veneurtopk:<value>";
+      // match the KEY (parser.py parse_metric_ssf does the same)
+      if (kv->size() >= 10 && memcmp(kv->data(), "veneurtopk", 10) == 0 &&
+          (kv->size() == 10 || (*kv)[10] == ':')) {
+        scope = kTopK;
+        break;
+      }
+    }
+  }
+
+  uint32_t noff = arena_put(mb, name_p, name_n);
+  if (noff == UINT32_MAX) return false;
+  uint32_t h = fnv1a(name_p, name_n, kFnvInit);
+  h = fnv1a(kTypeNames[rtype], kTypeNameLens[rtype], h);
+
+  uint32_t toff = mb->arena_len;
+  uint32_t tlen = 0;
+  for (size_t i = 0; i < keep.size(); i++) {
+    if (i > 0) {
+      if (arena_put(mb, ",", 1) == UINT32_MAX) return false;
+      tlen += 1;
+    }
+    if (arena_put(mb, keep[i]->data(), keep[i]->size()) == UINT32_MAX)
+      return false;
+    tlen += static_cast<uint32_t>(keep[i]->size());
+  }
+  h = fnv1a(mb->arena + toff, tlen, h);
+
+  double dvalue = static_cast<double>(value);
+  uint32_t aoff = 0, alen = 0;
+  if (rtype == kSet) {
+    aoff = arena_put(mb, member_p, member_n);
+    if (aoff == UINT32_MAX) return false;
+    alen = static_cast<uint32_t>(member_n);
+    uint64_t mh = 14695981039346656037ULL;
+    for (size_t vi = 0; vi < member_n; vi++) {
+      mh = (mh ^ static_cast<uint8_t>(member_p[vi])) * 1099511628211ULL;
+    }
+    mh ^= mh >> 33;
+    mh *= 0xFF51AFD7ED558CCDULL;
+    mh ^= mh >> 33;
+    mh *= 0xC4CEB9FE1A85EC53ULL;
+    mh ^= mh >> 33;
+    memcpy(&dvalue, &mh, sizeof(dvalue));
+  }
+
+  mb->type[idx] = rtype;
+  mb->scope[idx] = scope;
+  mb->value[idx] = dvalue;
+  mb->sample_rate[idx] = sample_rate;
+  mb->digest[idx] = h;
+  mb->name_off[idx] = noff;
+  mb->name_len[idx] = static_cast<uint32_t>(name_n);
+  mb->tags_off[idx] = toff;
+  mb->tags_len[idx] = tlen;
+  mb->aux_off[idx] = aoff;
+  mb->aux_len[idx] = alen;
+  mb->count++;
+  return true;
+}
+
+}  // namespace
+
+// Decode one SSFSpan datagram into the batch. Returns 1 on success,
+// 0 when the batch is full or the bytes are not a decodable span (the
+// caller distinguishes via decode_errors).
+extern "C" int vs_decode_span(const char* data, size_t len, VsBatch* b,
+                              const char* ind_name, uint32_t ind_len) {
+  if (b->count >= b->capacity) return 0;
+  uint32_t roff = vs_arena_put(b, data, len);
+  if (roff == UINT32_MAX) return 0;
+
+  uint32_t idx = b->count;
+  int32_t version = 0;
+  int64_t trace_id = 0, span_id = 0, parent_id = 0, start_ns = 0,
+          end_ns = 0;
+  uint8_t err = 0, indicator = 0;
+  uint32_t svc_off = 0, svc_len = 0, nm_off = 0, nm_len = 0;
+
+  const uint8_t* base = reinterpret_cast<const uint8_t*>(data);
+  PbCursor c{base, base + len};
+  // sample submessage ranges, decoded after the span header so the
+  // indicator synthesis has service/error available
+  std::vector<std::pair<uint32_t, uint32_t>> samples;
+  while (c.ok) {
+    uint32_t t = c.tag();
+    if (t == 0) break;
+    uint32_t field = t >> 3, wt = t & 7;
+    switch (field) {
+      case 1: if (wt == 0) version = static_cast<int32_t>(c.varint());
+              else c.skip(wt); break;
+      case 2: if (wt == 0) trace_id = static_cast<int64_t>(c.varint());
+              else c.skip(wt); break;
+      case 3: if (wt == 0) span_id = static_cast<int64_t>(c.varint());
+              else c.skip(wt); break;
+      case 4: if (wt == 0) parent_id = static_cast<int64_t>(c.varint());
+              else c.skip(wt); break;
+      case 5: if (wt == 0) start_ns = static_cast<int64_t>(c.varint());
+              else c.skip(wt); break;
+      case 6: if (wt == 0) end_ns = static_cast<int64_t>(c.varint());
+              else c.skip(wt); break;
+      case 7: if (wt == 0) err = c.varint() ? 1 : 0;
+              else c.skip(wt); break;
+      case 8: {
+        if (wt != 2) { c.skip(wt); break; }
+        PbCursor s = c.sub();
+        svc_off = roff + static_cast<uint32_t>(s.p - base);
+        svc_len = static_cast<uint32_t>(s.end - s.p);
+        break;
+      }
+      case 10: {
+        if (wt != 2) { c.skip(wt); break; }
+        PbCursor s = c.sub();
+        samples.emplace_back(static_cast<uint32_t>(s.p - base),
+                             static_cast<uint32_t>(s.end - s.p));
+        break;
+      }
+      case 12: if (wt == 0) indicator = c.varint() ? 1 : 0;
+               else c.skip(wt); break;
+      case 13: {
+        if (wt != 2) { c.skip(wt); break; }
+        PbCursor s = c.sub();
+        nm_off = roff + static_cast<uint32_t>(s.p - base);
+        nm_len = static_cast<uint32_t>(s.end - s.p);
+        break;
+      }
+      default: c.skip(wt); break;
+    }
+  }
+  if (!c.ok) {
+    b->arena_len = roff;  // roll back the raw copy
+    b->decode_errors++;
+    return 0;
+  }
+
+  // embedded samples -> metric records (STATUS and broken samples go
+  // to the Python slow lane as raw bytes)
+  for (const auto& [soff, slen] : samples) {
+    PbCursor s{base + soff, base + soff + slen};
+    uint32_t metric = 0;
+    const char* name_p = nullptr;
+    size_t name_n = 0;
+    // absent sample_rate (proto3 default 0) means unsampled: weight
+    // 1.0, never 1/0 (matches parser.py parse_metric_ssf)
+    float value = 0.0f, rate = 0.0f;
+    const char* member_p = nullptr;
+    size_t member_n = 0;
+    std::vector<std::string> kv_tags;
+    bool slow = false;
+    while (s.ok) {
+      uint32_t t = s.tag();
+      if (t == 0) break;
+      uint32_t field = t >> 3, wt = t & 7;
+      switch (field) {
+        case 1: if (wt == 0) metric = static_cast<uint32_t>(s.varint());
+                else s.skip(wt); break;
+        case 2: {
+          if (wt != 2) { s.skip(wt); break; }
+          PbCursor ss = s.sub();
+          name_p = reinterpret_cast<const char*>(ss.p);
+          name_n = ss.end - ss.p;
+          break;
+        }
+        case 3: if (wt == 5) value = s.f32(); else s.skip(wt); break;
+        case 5: {
+          if (wt != 2) { s.skip(wt); break; }
+          PbCursor ss = s.sub();
+          member_p = reinterpret_cast<const char*>(ss.p);
+          member_n = ss.end - ss.p;
+          break;
+        }
+        case 7: if (wt == 5) rate = s.f32(); else s.skip(wt); break;
+        case 8: {
+          if (wt != 2) { s.skip(wt); break; }
+          PbCursor entry = s.sub();
+          const char* kp = nullptr; size_t kn = 0;
+          const char* vp = nullptr; size_t vn = 0;
+          while (entry.ok) {
+            uint32_t et = entry.tag();
+            if (et == 0) break;
+            uint32_t ef = et >> 3, ew = et & 7;
+            if (ef == 1 && ew == 2) {
+              PbCursor ks = entry.sub();
+              kp = reinterpret_cast<const char*>(ks.p);
+              kn = ks.end - ks.p;
+            } else if (ef == 2 && ew == 2) {
+              PbCursor vs = entry.sub();
+              vp = reinterpret_cast<const char*>(vs.p);
+              vn = vs.end - vs.p;
+            } else {
+              entry.skip(ew);
+            }
+          }
+          std::string kv;
+          kv.reserve(kn + 1 + vn);
+          kv.append(kp ? kp : "", kn);
+          kv.push_back(':');
+          kv.append(vp ? vp : "", vn);
+          kv_tags.push_back(std::move(kv));
+          break;
+        }
+        default: s.skip(wt); break;
+      }
+    }
+    if (!s.ok || metric == 4 || metric > 4) {
+      // STATUS (needs the status enum + message) or undecodable:
+      // Python slow lane on the raw sample bytes
+      slow = true;
+    }
+    if (slow) {
+      if (b->slow_count < b->slow_cap) {
+        b->slow_off[b->slow_count] = roff + soff;
+        b->slow_len[b->slow_count] = slen;
+        b->slow_count++;
+      } else {
+        b->invalid_samples++;
+      }
+      continue;
+    }
+    if (rate <= 0.0f) rate = 1.0f;
+    if (!append_ssf_sample(b, metric, name_p, name_n, value, rate,
+                           member_p, member_n, kv_tags)) {
+      // metrics batch full: surface the sample on the slow lane rather
+      // than dropping it silently
+      if (b->slow_count < b->slow_cap) {
+        b->slow_off[b->slow_count] = roff + soff;
+        b->slow_len[b->slow_count] = slen;
+        b->slow_count++;
+      } else {
+        b->invalid_samples++;
+      }
+    }
+  }
+
+  // indicator duration timer (parser.go:94-121): HISTOGRAM ns duration
+  // tagged error:bool + service, unit ns, rate 1.0
+  if (indicator && ind_len > 0) {
+    std::vector<std::string> tags;
+    std::string et("error:");
+    et += err ? "true" : "false";
+    tags.push_back(std::move(et));
+    std::string st("service:");
+    st.append(b->arena + svc_off, svc_len);
+    tags.push_back(std::move(st));
+    double dur = static_cast<double>(end_ns - start_ns);
+    // append via the shared helper; value passes through float, which
+    // would truncate long durations — write the record directly
+    VtBatch* mb = b->metrics;
+    if (mb->count < mb->capacity) {
+      uint32_t mi = mb->count;
+      uint32_t noff2 = arena_put(mb, ind_name, ind_len);
+      uint32_t toff2 = mb->arena_len;
+      uint32_t tlen2 = 0;
+      bool okp = noff2 != UINT32_MAX;
+      for (size_t i = 0; okp && i < tags.size(); i++) {
+        if (i > 0) {
+          okp = arena_put(mb, ",", 1) != UINT32_MAX;
+          tlen2 += 1;
+        }
+        if (okp) {
+          okp = arena_put(mb, tags[i].data(), tags[i].size())
+                != UINT32_MAX;
+          tlen2 += static_cast<uint32_t>(tags[i].size());
+        }
+      }
+      if (okp) {
+        uint32_t h = fnv1a(ind_name, ind_len, kFnvInit);
+        h = fnv1a(kTypeNames[kHistogram], kTypeNameLens[kHistogram], h);
+        h = fnv1a(mb->arena + toff2, tlen2, h);
+        mb->type[mi] = kHistogram;
+        mb->scope[mi] = kMixed;
+        mb->value[mi] = dur;
+        mb->sample_rate[mi] = 1.0f;
+        mb->digest[mi] = h;
+        mb->name_off[mi] = noff2;
+        mb->name_len[mi] = ind_len;
+        mb->tags_off[mi] = toff2;
+        mb->tags_len[mi] = tlen2;
+        mb->aux_off[mi] = 0;
+        mb->aux_len[mi] = 0;
+        mb->count++;
+      }
+    }
+  }
+
+  b->version[idx] = version;
+  b->trace_id[idx] = trace_id;
+  b->span_id[idx] = span_id;
+  b->parent_id[idx] = parent_id;
+  b->start_ns[idx] = start_ns;
+  b->end_ns[idx] = end_ns;
+  b->error[idx] = err;
+  b->indicator[idx] = indicator;
+  b->service_off[idx] = svc_off;
+  b->service_len[idx] = svc_len;
+  b->name_off[idx] = nm_off;
+  b->name_len[idx] = nm_len;
+  b->raw_off[idx] = roff;
+  b->raw_len[idx] = static_cast<uint32_t>(len);
+  b->count++;
+  return 1;
+}
+
+// ---------------------------------------------------------------------------
+// SSF reader pool: same recvmmsg/SO_REUSEPORT shape as the metric pool,
+// but each datagram decodes as one SSFSpan on the reader thread.
+
+namespace {
+
+struct SsfReader {
+  int fd = -1;
+  std::thread thread;
+  std::mutex mu;
+  VsBatch* active;
+  VsBatch* standby;
+  std::atomic<uint64_t> packets{0};
+  std::atomic<uint64_t> dropped_batches{0};
+};
+
+struct SsfReaderPool {
+  std::vector<SsfReader*> readers;
+  std::atomic<bool> stop{false};
+  int port = 0;
+  std::string indicator_name;
+};
+
+void ssf_reader_loop(SsfReaderPool* pool, SsfReader* r, int dgram_max) {
+  std::vector<char> bufs(static_cast<size_t>(kVlen) * dgram_max);
+  mmsghdr msgs[kVlen];
+  iovec iovs[kVlen];
+  for (int i = 0; i < kVlen; i++) {
+    iovs[i].iov_base = bufs.data() + static_cast<size_t>(i) * dgram_max;
+    iovs[i].iov_len = dgram_max;
+    memset(&msgs[i], 0, sizeof(mmsghdr));
+    msgs[i].msg_hdr.msg_iov = &iovs[i];
+    msgs[i].msg_hdr.msg_iovlen = 1;
+  }
+  const char* ind = pool->indicator_name.c_str();
+  uint32_t ind_len = static_cast<uint32_t>(pool->indicator_name.size());
+  pollfd pfd = {r->fd, POLLIN, 0};
+  while (!pool->stop.load(std::memory_order_relaxed)) {
+    int pr = poll(&pfd, 1, 100);
+    if (pr <= 0) continue;
+    int got = recvmmsg(r->fd, msgs, kVlen, MSG_DONTWAIT, nullptr);
+    if (got <= 0) continue;
+    std::lock_guard<std::mutex> lock(r->mu);
+    for (int i = 0; i < got; i++) {
+      const char* data = bufs.data() + static_cast<size_t>(i) * dgram_max;
+      size_t dlen = msgs[i].msg_len;
+      VsBatch* b = r->active;
+      if (b->count >= b->capacity ||
+          b->arena_len + dlen > b->arena_cap ||
+          b->metrics->count + 8 > b->metrics->capacity) {
+        // batch full and Python hasn't swapped: shed, like the metric
+        // pool (the kernel socket buffer is the real backpressure)
+        r->dropped_batches.fetch_add(1, std::memory_order_relaxed);
+        continue;
+      }
+      vs_decode_span(data, dlen, b, ind, ind_len);
+    }
+    r->packets.fetch_add(got, std::memory_order_relaxed);
+  }
+}
+
+}  // namespace
+
+extern "C" void* vs_reader_start(const char* ip, int port, int nreaders,
+                                 int rcvbuf, uint32_t span_cap,
+                                 uint32_t arena_cap, uint32_t metric_cap,
+                                 uint32_t metric_arena, int dgram_max,
+                                 const char* ind_name) {
+  if (dgram_max <= 0) dgram_max = 8192;
+  SsfReaderPool* pool = new SsfReaderPool();
+  pool->indicator_name = ind_name ? ind_name : "";
+  for (int i = 0; i < nreaders; i++) {
+    int fd = make_udp_socket(ip, port, rcvbuf);
+    if (fd < 0) {
+      for (SsfReader* r : pool->readers) {
+        close(r->fd);
+        vs_batch_free(r->active);
+        vs_batch_free(r->standby);
+        delete r;
+      }
+      delete pool;
+      return nullptr;
+    }
+    if (pool->port == 0) {
+      sockaddr_in bound;
+      socklen_t blen = sizeof(bound);
+      getsockname(fd, reinterpret_cast<sockaddr*>(&bound), &blen);
+      pool->port = ntohs(bound.sin_port);
+      port = pool->port;
+    }
+    SsfReader* r = new SsfReader();
+    r->fd = fd;
+    r->active = vs_batch_new(span_cap, arena_cap, metric_cap,
+                             metric_arena);
+    r->standby = vs_batch_new(span_cap, arena_cap, metric_cap,
+                              metric_arena);
+    pool->readers.push_back(r);
+  }
+  for (SsfReader* r : pool->readers) {
+    r->thread = std::thread(ssf_reader_loop, pool, r, dgram_max);
+  }
+  return pool;
+}
+
+extern "C" int vs_reader_port(void* handle) {
+  return static_cast<SsfReaderPool*>(handle)->port;
+}
+
+extern "C" int vs_reader_count(void* handle) {
+  return static_cast<int>(
+      static_cast<SsfReaderPool*>(handle)->readers.size());
+}
+
+extern "C" VsBatch* vs_reader_swap(void* handle, int idx) {
+  SsfReaderPool* pool = static_cast<SsfReaderPool*>(handle);
+  SsfReader* r = pool->readers[idx];
+  std::lock_guard<std::mutex> lock(r->mu);
+  VsBatch* filled = r->active;
+  vs_batch_reset(r->standby);
+  r->active = r->standby;
+  r->standby = filled;
+  return filled;
+}
+
+extern "C" uint64_t vs_reader_packets(void* handle, int idx) {
+  return static_cast<SsfReaderPool*>(handle)
+      ->readers[idx]->packets.load(std::memory_order_relaxed);
+}
+
+extern "C" uint64_t vs_reader_drops(void* handle, int idx) {
+  return static_cast<SsfReaderPool*>(handle)
+      ->readers[idx]->dropped_batches.load(std::memory_order_relaxed);
+}
+
+extern "C" void vs_reader_stop(void* handle) {
+  SsfReaderPool* pool = static_cast<SsfReaderPool*>(handle);
+  pool->stop.store(true);
+  for (SsfReader* r : pool->readers) {
+    if (r->thread.joinable()) r->thread.join();
+    close(r->fd);
+    vs_batch_free(r->active);
+    vs_batch_free(r->standby);
+    delete r;
+  }
+  delete pool;
+}

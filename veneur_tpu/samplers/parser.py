@@ -23,6 +23,7 @@ from veneur_tpu.protocol import constants as dogstatsd
 MIXED_SCOPE = 0
 LOCAL_ONLY = 1
 GLOBAL_ONLY = 2
+TOPK_SCOPE = 3  # veneur_ingest.cpp Scope::kTopK / store._TOPK_SCOPE
 
 _FNV1A_INIT32 = 0x811C9DC5
 _FNV1A_PRIME32 = 0x01000193
@@ -212,6 +213,7 @@ def parse_metric_ssf(sample) -> UDPMetric:
 
     scope = MIXED_SCOPE
     tags = []
+    topk = False
     for k, v in sample.tags.items():
         if k == "veneurlocalonly":
             scope = LOCAL_ONLY
@@ -219,15 +221,23 @@ def parse_metric_ssf(sample) -> UDPMetric:
         if k == "veneurglobalonly":
             scope = GLOBAL_ONLY
             continue
+        if k == "veneurtopk":
+            topk = True
         tags.append(f"{k}:{v}")
     tags.sort()
+    # heavy-hitter routing, matching the DogStatsD lane's veneurtopk
+    # tag (parse_line): only sets re-route; the tag stays in the list
+    if topk and sample.metric == ssf_pb2.SSFSample.SET:
+        scope = TOPK_SCOPE
     joined = ",".join(tags)
     h = fnv1a_32(joined, h)
     return UDPMetric(
         key=MetricKey(name=sample.name, type=mtype, joined_tags=joined),
         digest=h,
         value=value,
-        sample_rate=sample.sample_rate,
+        # proto3's absent-field default is 0; a zero rate would weight
+        # samples 1/0 downstream — absent means unsampled, i.e. 1.0
+        sample_rate=sample.sample_rate if sample.sample_rate > 0 else 1.0,
         tags=tags,
         scope=scope,
     )

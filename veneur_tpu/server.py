@@ -90,10 +90,20 @@ class _SinkIngestor:
             with self._drop_lock:
                 self.ingest_timeouts += 1
 
+    def offer_batch(self, spans: list) -> None:
+        """One queue hop for a whole decoded batch (the native SSF
+        lane): per-span queue ops would cap the pipeline far below the
+        C++ decoder's rate."""
+        try:
+            self.queue.put_nowait(spans)
+        except queue.Full:
+            with self._drop_lock:
+                self.ingest_timeouts += len(spans)
+
     def _work(self):
         while True:
             try:
-                span = self.queue.get(timeout=0.5)
+                item = self.queue.get(timeout=0.5)
             except queue.Empty:
                 # exit only once stopped AND drained, so shutdown's final
                 # flush never abandons spans already accepted off the
@@ -102,7 +112,16 @@ class _SinkIngestor:
                     return
                 continue
             try:
-                self.sink.ingest(span)
+                if type(item) is list:
+                    for span in item:
+                        try:
+                            self.sink.ingest(span)
+                        except Exception:
+                            self.ingest_errors += 1
+                            log.exception("span sink %s ingest failed",
+                                          self.sink.name)
+                else:
+                    self.sink.ingest(item)
             except Exception:
                 self.ingest_errors += 1
                 log.exception("span sink %s ingest failed", self.sink.name)
@@ -172,12 +191,19 @@ class SpanWorker:
     def work(self):
         while not self.stop.is_set():
             try:
-                span = self.chan.get(timeout=0.5)
+                item = self.chan.get(timeout=0.5)
             except queue.Empty:
                 continue
-            self.ingested += 1
-            for lane in self._lanes:
-                lane.offer(span)
+            if type(item) is list:
+                # a decoded native-lane batch: one channel hop for the
+                # whole batch, one lane hop per sink
+                self.ingested += len(item)
+                for lane in self._lanes:
+                    lane.offer_batch(item)
+            else:
+                self.ingested += 1
+                for lane in self._lanes:
+                    lane.offer(item)
 
     def flush(self):
         for lane in self._lanes:
@@ -284,6 +310,7 @@ class Server:
         self._guard = lambda fn: fn  # replaced in start()
         self._threads: List[threading.Thread] = []
         self._native_readers: List = []
+        self._native_ssf_readers: List = []  # subset of the above
         self._native_pumps: List[threading.Thread] = []
         self._span_workers: List[SpanWorker] = []
         self._flush_thread: Optional[threading.Thread] = None
@@ -348,6 +375,21 @@ class Server:
             return
         self.handle_ssf(span)
 
+    def _shed_spans(self, count: int):
+        """Shedding is the designed overload behavior; one warning per
+        drop would flood the log (and the GIL) at exactly the moment
+        the pipeline is saturated — count every drop (locked: many
+        reader/stream threads shed at once, and an unlocked += loses
+        counts exactly when drops spike), log at most once a second."""
+        with self._counter_lock:
+            self.spans_dropped += count
+            dropped = self.spans_dropped
+        now = time.monotonic()
+        if now - self._last_span_drop_log >= 1.0:
+            self._last_span_drop_log = now
+            log.warning("dropping spans; span channel is full "
+                        "(%d dropped since start)", dropped)
+
     def handle_ssf(self, span):
         """Route a span to the span workers (server.go:753-792). Spans that
         aren't valid traces but carry metrics still get their metrics
@@ -355,21 +397,17 @@ class Server:
         try:
             self.span_chan.put_nowait(span)
         except queue.Full:
-            # shedding is the designed overload behavior; one warning
-            # per drop would flood the log (and the GIL) at exactly the
-            # moment the pipeline is saturated — count every drop, log
-            # at most once a second
-            with self._counter_lock:
-                # locked: many reader/stream threads shed here at once,
-                # and an unlocked += loses counts exactly when drops
-                # spike — the condition this counter exists to measure
-                self.spans_dropped += 1
-                dropped = self.spans_dropped
-            now = time.monotonic()
-            if now - self._last_span_drop_log >= 1.0:
-                self._last_span_drop_log = now
-                log.warning("dropping spans; span channel is full "
-                            "(%d dropped since start)", dropped)
+            self._shed_spans(1)
+
+    def handle_ssf_batch(self, spans: list):
+        """Batched form of handle_ssf for the native lane: one channel
+        hop per decoded batch, shedding counted per span."""
+        if not spans:
+            return
+        try:
+            self.span_chan.put_nowait(spans)
+        except queue.Full:
+            self._shed_spans(len(spans))
 
     def handle_ssf_stream(self, conn):
         """Framed-SSF stream pump; a framing error poisons the stream and
@@ -465,6 +503,8 @@ class Server:
             self._threads.extend(threads)
             self.statsd_addrs.extend(bound)
         for addr in cfg.ssf_listen_addresses:
+            if self._try_native_ssf(addr):
+                continue
             threads, bound = networking.start_ssf(
                 addr, max(1, cfg.num_readers), cfg.read_buffer_size_bytes,
                 cfg.trace_max_length_bytes, self.handle_ssf_packet,
@@ -574,6 +614,104 @@ class Server:
         log.info("native ingest on udp port %d (%d readers)", reader.port,
                  reader.num_readers)
         return True
+
+    def _try_native_ssf(self, addr_spec: str) -> bool:
+        """Bring up the C++ SSF reader pool for a plain IPv4 UDP SSF
+        listener: datagrams decode as SSFSpan protobufs ON the C++
+        reader threads (off the GIL) and their embedded metrics arrive
+        as parsed records for the vectorized store path — the span
+        twin of the metric lane (round-4 verdict item #5; reference
+        path server.go:827-860). Returns False to fall back to the
+        Python readers."""
+        cfg = self.config
+        if not cfg.native_ingest:
+            return False
+        from veneur_tpu.protocol.addr import resolve_addr
+
+        try:
+            resolved = resolve_addr(addr_spec)
+        except ValueError:
+            return False
+        if (resolved.family != "udp" or resolved.scheme.endswith("6")
+                or ":" in (resolved.host or "")):
+            return False
+        from veneur_tpu import native
+
+        if not native.available():
+            return False
+        from veneur_tpu.networking import warn_if_port_already_served
+
+        warn_if_port_already_served(socket.AF_INET, socket.SOCK_DGRAM,
+                                    resolved.host or "0.0.0.0",
+                                    resolved.port)
+        try:
+            reader = native.NativeSSFReader(
+                host=resolved.host or "0.0.0.0", port=resolved.port,
+                num_readers=max(1, cfg.num_readers),
+                rcvbuf=cfg.read_buffer_size_bytes,
+                dgram_max=cfg.trace_max_length_bytes,
+                indicator_timer_name=cfg.indicator_span_timer_name)
+        except OSError as e:
+            log.warning("native SSF readers failed (%s); using Python "
+                        "readers", e)
+            return False
+        self._native_readers.append(reader)
+        self._native_ssf_readers.append(reader)
+        self.ssf_addrs.append((resolved.host or "0.0.0.0", reader.port))
+        t = threading.Thread(target=self._guard(self._native_ssf_pump),
+                             args=(reader,), name="native-ssf-pump",
+                             daemon=True)
+        t.start()
+        self._native_pumps.append(t)
+        log.info("native SSF ingest on udp port %d (%d readers)",
+                 reader.port, reader.num_readers)
+        return True
+
+    def _native_ssf_pump(self, reader):
+        """Drain decoded span batches: embedded metrics ride the
+        vectorized store path, spans go to the span workers as lazy
+        facades (full protobuf only materialized for sinks that read
+        cold fields), slow-lane samples (STATUS/undecodable) re-enter
+        the Python parser."""
+        from veneur_tpu.protocol.gen.ssf import sample_pb2
+
+        last_drops = 0
+        while not self._stop.is_set():
+            try:
+                batches = reader.drain()
+                drops = reader.drops()
+                if drops != last_drops:
+                    with self._counter_lock:
+                        self.packet_drops += drops - last_drops
+                    log.warning("native SSF ingest dropped %d datagrams "
+                                "(pump falling behind)",
+                                drops - last_drops)
+                    last_drops = drops
+                if not batches:
+                    self._stop.wait(0.005)
+                    continue
+                for b in batches:
+                    if b.decode_errors or b.invalid_samples:
+                        with self._counter_lock:
+                            self.packet_errors += int(b.decode_errors)
+                            self.packet_errors += int(b.invalid_samples)
+                    if b.metrics.count:
+                        for line in self.store.process_batch(b.metrics):
+                            self.handle_metric_packet(line)
+                    for raw in b.slow_samples:
+                        try:
+                            sample = sample_pb2.SSFSample()
+                            sample.ParseFromString(raw)
+                            m = p.parse_metric_ssf(sample)
+                            if p.valid_metric(m):
+                                self.store.process_metric(m)
+                        except Exception:
+                            with self._counter_lock:
+                                self.packet_errors += 1
+                    self.handle_ssf_batch(b.spans())
+            except Exception:
+                log.exception("native SSF pump iteration failed")
+                self._stop.wait(0.05)
 
     def _native_pump(self, reader):
         """Drain the reader pool's parsed batches into the store; raw

@@ -30,6 +30,11 @@ class MetricExtractionSink(SpanSink):
         return "metric_extraction"
 
     def ingest(self, span) -> None:
+        if getattr(span, "metrics_extracted", False):
+            # the native SSF lane already converted the embedded
+            # samples (and any indicator timer) into parsed records on
+            # the C++ reader threads (server._native_ssf_pump)
+            return
         metrics, invalid = p.convert_metrics(span)
         if invalid:
             log.error("parse errors on %d metrics", len(invalid))
