@@ -22,6 +22,32 @@ def test_example_yaml_loads_and_validates():
     assert cfg.forward_address == ""
 
 
+def test_example_host_yaml_loads_and_is_local():
+    """The per-host canonical config (the reference's
+    example_host.yaml): a LOCAL instance — forward_address set — with
+    the documented starting values."""
+    cfg = read_config(os.path.join(_ROOT, "example_host.yaml"))
+    cfg.validate()
+    cfg.apply_defaults()
+    assert cfg.forward_address == "http://127.0.0.1:8127"
+    assert cfg.parse_interval() == 10.0
+    assert cfg.statsd_listen_addresses == ["udp://localhost:8126"]
+    assert cfg.aggregates == ["min", "max", "count"]
+
+
+def test_example_host_yaml_has_no_unknown_keys():
+    import yaml
+
+    from veneur_tpu.config import Config
+
+    with open(os.path.join(_ROOT, "example_host.yaml")) as f:
+        data = yaml.safe_load(f)
+    fields = {f.name for f in
+              __import__("dataclasses").fields(Config)}
+    unknown = set(data) - fields
+    assert not unknown, unknown
+
+
 def test_example_proxy_yaml_loads():
     cfg = read_proxy_config(os.path.join(_ROOT, "example_proxy.yaml"))
     assert cfg.http_address == "0.0.0.0:8127"
