@@ -608,6 +608,8 @@ def bench_tls_handshakes(seconds: float = 2.5):
                     critical=False)
                 .sign(key, hashes.SHA256()))
 
+    from veneur_tpu import native
+
     out = {}
     for label, key in (
             ("ecdsa_p256", ec.generate_private_key(ec.SECP256R1())),
@@ -616,6 +618,7 @@ def bench_tls_handshakes(seconds: float = 2.5):
         cert = self_signed(key)
         stop = threading.Event()
         cert_path = key_path = None
+        reader = None
         try:
             with tempfile.NamedTemporaryFile("wb", suffix=".pem",
                                              delete=False) as cf:
@@ -629,47 +632,80 @@ def bench_tls_handshakes(seconds: float = 2.5):
                     serialization.PrivateFormat.PKCS8,
                     serialization.NoEncryption()))
 
-            ctx = make_server_tls_context(cert_path, key_path)
-            _, bound = start_statsd(
-                "tcp://127.0.0.1:0", num_readers=1, recv_buf=0,
-                metric_max_length=4096, handle_packet=lambda b: None,
-                stop=stop, tls_config=ctx)
-            port = bound[0][1]
-            cctx = ssl.create_default_context()
-            cctx.load_verify_locations(cert_path)
+            # the PRODUCTION listener: the native C++ TCP/TLS reader
+            # when it builds (the server's default wiring), the Python
+            # readers otherwise
+            use_native = native.available() and native.tls_available()
+            if use_native:
+                reader = native.NativeTLSReader(
+                    cert_path=cert_path, key_path=key_path)
+                port = reader.port
+            else:
+                ctx = make_server_tls_context(cert_path, key_path)
+                _, bound = start_statsd(
+                    "tcp://127.0.0.1:0", num_readers=1, recv_buf=0,
+                    metric_max_length=4096, handle_packet=lambda b: None,
+                    stop=stop, tls_config=ctx)
+                port = bound[0][1]
+            out[f"{label}_native_listener"] = use_native
 
-            def handshake():
-                with socket.create_connection(("127.0.0.1", port),
-                                              5) as raw:
-                    with cctx.wrap_socket(raw,
-                                          server_hostname="localhost"):
-                        pass
-
-            # warm once, then count completed handshakes for `seconds`;
-            # a transient reset costs one loop turn, not the config
-            for _ in range(3):
-                handshake()
-            n = errs = 0
-            deadline = time.perf_counter() + seconds
-            t0 = time.perf_counter()
-            try:
+            def rate(max_ver, secs):
+                # pre-resolved AF_INET connect: getaddrinfo per
+                # connection is bench-client tax, not server capacity
+                cctx = ssl.create_default_context()
+                cctx.load_verify_locations(cert_path)
+                if max_ver is not None:
+                    cctx.maximum_version = max_ver
+                n = errs = 0
+                deadline = time.perf_counter() + secs
+                t0 = time.perf_counter()
                 while time.perf_counter() < deadline:
+                    raw = socket.socket(socket.AF_INET,
+                                        socket.SOCK_STREAM)
                     try:
-                        handshake()
+                        raw.connect(("127.0.0.1", port))
+                        cctx.wrap_socket(
+                            raw, server_hostname="localhost").close()
                         n += 1
                     except OSError:
+                        # the failed fd must not leak toward EMFILE
+                        raw.close()
                         errs += 1
                         if errs > 50:
                             raise
+                return n / (time.perf_counter() - t0), errs
+
+            rate(None, 0.3)  # warm
+            # interleaved rounds + medians: single-window numbers swing
+            # +-20% run to run on this shared harness. A mid-run
+            # failure still reports the rounds measured up to that
+            # point (0 when nothing succeeded — a failed config must
+            # be distinguishable from a skipped one).
+            r13, r12, errs = [], [], 0
+            try:
+                for _ in range(5):
+                    r, e = rate(None, seconds / 2)
+                    r13.append(r)
+                    errs += e
+                    r, e = rate(ssl.TLSVersion.TLSv1_2, seconds / 2)
+                    r12.append(r)
+                    errs += e
             finally:
-                # a mid-window failure still reports the rate measured
-                # up to that point (0 when nothing succeeded — a failed
-                # config must be distinguishable from a skipped one)
-                elapsed = time.perf_counter() - t0
-                if elapsed > 0:
-                    out[f"{label}_conn_s"] = int(n / elapsed)
+                # the headline matches the reference's workload era:
+                # its ~700/s claim is "ECDH prime256v1", a
+                # TLS1.2-generation handshake; TLS1.3 rides alongside
+                out[f"{label}_conn_s"] = int(np.median(r12)) if r12 else 0
+                out[f"{label}_tls13_conn_s"] = \
+                    int(np.median(r13)) if r13 else 0
+                if r12 or r13:
+                    out[f"{label}_conn_s_max"] = int(max(r12 + r13))
+                if len(r12) < 5:
+                    out[f"{label}_partial"] = True
                 if errs:
                     out[f"{label}_transient_errors"] = errs
+                if reader is not None:
+                    out[f"{label}_handshake_failures"] = \
+                        reader.handshake_failures()
         except Exception as e:
             # keep the other key type's result (guarded() would drop all)
             out[f"{label}_error"] = f"{type(e).__name__}: {e}"[:120]
@@ -677,6 +713,8 @@ def bench_tls_handshakes(seconds: float = 2.5):
                 out[f"{label}_partial"] = True
         finally:
             stop.set()
+            if reader is not None:
+                reader.stop()
             for p in (cert_path, key_path):
                 if p is not None:
                     try:
@@ -686,9 +724,11 @@ def bench_tls_handshakes(seconds: float = 2.5):
     out["reference_readme_conn_s"] = {"ecdh_prime256v1": 700,
                                       "rsa_2048": 110}
     out["note"] = ("full handshake + close per connection against the "
-                   "production TLS statsd listener; client and server "
+                   "production statsd listener (native C++ TLS "
+                   "termination when available); client and server "
                    "share one core, as in the reference's "
-                   "localhost/1-CPU claim (README.md:346)")
+                   "localhost/1-CPU claim (README.md:346); medians "
+                   "over 5 interleaved rounds per TLS version")
     return out
 
 

@@ -116,7 +116,57 @@ class TestTLSListeners:
     def test_plain_tls_metrics_flow(self, certs):
         server, sink, addr = _server(certs, client_auth=False)
         try:
+            from veneur_tpu import native
+
+            if native.available() and native.tls_available():
+                # the whole matrix in this class must be exercising the
+                # NATIVE TLS listener when it is buildable — a silent
+                # fallback to the Python readers would make these tests
+                # prove nothing about the C++ accept path
+                assert any(type(r).__name__ == "NativeTLSReader"
+                           for r in server._native_readers)
             _send_tls(certs, addr, b"tls.counter:3|c\n")
+            assert _wait_processed(server, 1) == 1
+        finally:
+            server.shutdown()
+
+    def test_accepts_continue_past_256_with_held_connection(self, certs):
+        """Round-5 review regression: the native acceptor must keep
+        accepting past its old 256-thread reap point while a long-lived
+        connection stays open (statsd TLS clients hold connections)."""
+        from veneur_tpu import native
+
+        if not (native.available() and native.tls_available()):
+            pytest.skip("native TLS unavailable")
+        server, sink, addr = _server(certs, client_auth=False)
+        try:
+            ctx = _client_ctx(certs)
+            raw = socket.create_connection(addr, timeout=5)
+            held = ctx.wrap_socket(raw, server_hostname="localhost")
+            held.sendall(b"tls.held:1|c\n")
+            for i in range(280):
+                r = socket.create_connection(addr, timeout=5)
+                c = ctx.wrap_socket(r, server_hostname="localhost")
+                c.sendall(b"tls.churn:1|c\n")
+                c.close()
+            held.sendall(b"tls.held:1|c\n")
+            held.close()
+            assert _wait_processed(server, 282, timeout=20.0) == 282
+        finally:
+            server.shutdown()
+
+    def test_python_fallback_when_native_disabled(self, certs):
+        srv_crt, srv_key = certs["server"]
+        cfg = Config(statsd_listen_addresses=["tcp://127.0.0.1:0"],
+                     interval="86400s", aggregates=["count"],
+                     store_initial_capacity=32, store_chunk=128,
+                     native_ingest=False,
+                     tls_certificate=srv_crt, tls_key=srv_key)
+        server = Server(cfg, metric_sinks=[ChannelMetricSink()])
+        server.start()
+        try:
+            assert not server._native_readers
+            _send_tls(certs, server.statsd_addrs[0], b"tls.py:2|c\n")
             assert _wait_processed(server, 1) == 1
         finally:
             server.shutdown()

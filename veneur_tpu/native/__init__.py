@@ -101,7 +101,7 @@ def _build() -> Optional[str]:
     try:
         subprocess.run(
             ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-pthread",
-             "-o", _SO, _SRC],
+             "-o", _SO, _SRC, "-ldl"],
             check=True, capture_output=True, timeout=120)
         return None
     except FileNotFoundError:
@@ -210,6 +210,22 @@ def _bind(lib):
     lib.vs_reader_drops.restype = ctypes.c_uint64
     lib.vs_reader_drops.argtypes = [ctypes.c_void_p, ctypes.c_int]
     lib.vs_reader_stop.argtypes = [ctypes.c_void_p]
+    lib.vt_tls_available.restype = ctypes.c_int
+    lib.vt_tls_server_start.restype = ctypes.c_void_p
+    lib.vt_tls_server_start.argtypes = [
+        ctypes.c_char_p, ctypes.c_int, ctypes.c_char_p, ctypes.c_char_p,
+        ctypes.c_char_p, ctypes.c_uint32, ctypes.c_uint32, ctypes.c_int]
+    lib.vt_tls_server_port.restype = ctypes.c_int
+    lib.vt_tls_server_port.argtypes = [ctypes.c_void_p]
+    lib.vt_tls_server_swap.restype = ctypes.POINTER(_VtBatch)
+    lib.vt_tls_server_swap.argtypes = [ctypes.c_void_p]
+    lib.vt_tls_server_conns.restype = ctypes.c_uint64
+    lib.vt_tls_server_conns.argtypes = [ctypes.c_void_p]
+    lib.vt_tls_server_handshake_failures.restype = ctypes.c_uint64
+    lib.vt_tls_server_handshake_failures.argtypes = [ctypes.c_void_p]
+    lib.vt_tls_server_drops.restype = ctypes.c_uint64
+    lib.vt_tls_server_drops.argtypes = [ctypes.c_void_p]
+    lib.vt_tls_server_stop.argtypes = [ctypes.c_void_p]
     return lib
 
 
@@ -546,6 +562,74 @@ class NativeSSFReader:
     def stop(self) -> None:
         if self._handle:
             self._lib.vs_reader_stop(self._handle)
+            self._handle = None
+
+    def leak(self) -> None:
+        """See NativeUDPReader.leak."""
+        self._handle = None
+
+    def __del__(self):
+        try:
+            self.stop()
+        except Exception:
+            pass
+
+
+def tls_available() -> bool:
+    """True when the runtime libssl loaded (the TLS listener dlopens
+    the stable OpenSSL 3 C ABI — no headers needed at build time)."""
+    lib = _load()
+    return bool(lib is not None and lib.vt_tls_available())
+
+
+class NativeTLSReader:
+    """The C++ TCP/TLS statsd listener: accept, handshake, newline
+    framing and DogStatsD parsing all happen off the GIL; Python
+    drains parsed batches through the same swap protocol as the UDP
+    pool. Empty ``cert_path`` serves plaintext TCP; ``ca_path`` turns
+    on required client-cert auth (make_server_tls_context parity)."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 cert_path: str = "", key_path: str = "",
+                 ca_path: str = "", batch_records: int = 262144,
+                 batch_arena: int = 32 * 1024 * 1024,
+                 max_line: int = 4096):
+        lib = _load()
+        if lib is None:
+            raise RuntimeError(f"native ingest unavailable: {_build_error}")
+        if cert_path and not lib.vt_tls_available():
+            raise RuntimeError("libssl runtime unavailable")
+        self._lib = lib
+        self._handle = lib.vt_tls_server_start(
+            host.encode(), port, cert_path.encode(), key_path.encode(),
+            ca_path.encode(), batch_records, batch_arena, max_line)
+        if not self._handle:
+            raise OSError(
+                f"could not start native TLS listener on {host}:{port}")
+        self.port = lib.vt_tls_server_port(self._handle)
+        self.num_readers = 1
+
+    def drain(self) -> List[ParsedBatch]:
+        b = self._lib.vt_tls_server_swap(self._handle)
+        if b.contents.count or b.contents.parse_errors:
+            return [ParsedBatch(b.contents)]
+        return []
+
+    def conns(self) -> int:
+        return self._lib.vt_tls_server_conns(self._handle)
+
+    def handshake_failures(self) -> int:
+        return self._lib.vt_tls_server_handshake_failures(self._handle)
+
+    def packets(self) -> int:
+        return self.conns()
+
+    def drops(self) -> int:
+        return self._lib.vt_tls_server_drops(self._handle)
+
+    def stop(self) -> None:
+        if self._handle:
+            self._lib.vt_tls_server_stop(self._handle)
             self._handle = None
 
     def leak(self) -> None:

@@ -495,6 +495,8 @@ class Server:
         for addr in cfg.statsd_listen_addresses:
             if self._try_native_statsd(addr):
                 continue
+            if self._try_native_tcp(addr):
+                continue
             threads, bound = networking.start_statsd(
                 addr, max(1, cfg.num_readers), cfg.read_buffer_size_bytes,
                 cfg.metric_max_length, self.handle_packet, self._stop,
@@ -613,6 +615,60 @@ class Server:
         self._native_pumps.append(t)
         log.info("native ingest on udp port %d (%d readers)", reader.port,
                  reader.num_readers)
+        return True
+
+    def _try_native_tcp(self, addr_spec: str) -> bool:
+        """Bring up the C++ TCP/TLS statsd listener for a plain IPv4
+        TCP address: accept, TLS handshake (libssl via the stable C
+        ABI), newline framing and parsing all run off the GIL — the
+        fix for the Python TLS accept path topping out under the
+        reference's ~700 conn/s localhost claim (README.md:346).
+        Returns False to fall back to the Python readers (e.g. no
+        libssl at runtime, IPv6, or a resolve failure)."""
+        cfg = self.config
+        if not cfg.native_ingest:
+            return False
+        from veneur_tpu.protocol.addr import resolve_addr
+
+        try:
+            resolved = resolve_addr(addr_spec)
+        except ValueError:
+            return False
+        if (resolved.family != "tcp" or resolved.scheme.endswith("6")
+                or ":" in (resolved.host or "")):
+            return False
+        from veneur_tpu import native
+
+        if not native.available():
+            return False
+        use_tls = bool(cfg.tls_certificate and cfg.tls_key)
+        if use_tls and not native.tls_available():
+            return False
+        from veneur_tpu.networking import warn_if_port_already_served
+
+        warn_if_port_already_served(socket.AF_INET, socket.SOCK_STREAM,
+                                    resolved.host or "0.0.0.0",
+                                    resolved.port)
+        try:
+            reader = native.NativeTLSReader(
+                host=resolved.host or "0.0.0.0", port=resolved.port,
+                cert_path=cfg.tls_certificate if use_tls else "",
+                key_path=cfg.tls_key if use_tls else "",
+                ca_path=cfg.tls_authority_certificate if use_tls else "",
+                max_line=cfg.metric_max_length)
+        except (OSError, RuntimeError) as e:
+            log.warning("native TCP/TLS listener failed (%s); using "
+                        "Python readers", e)
+            return False
+        self._native_readers.append(reader)
+        self.statsd_addrs.append((resolved.host or "0.0.0.0", reader.port))
+        t = threading.Thread(target=self._guard(self._native_pump),
+                             args=(reader,), name="native-tcp-pump",
+                             daemon=True)
+        t.start()
+        self._native_pumps.append(t)
+        log.info("native %s statsd listener on tcp port %d",
+                 "TLS" if use_tls else "plaintext", reader.port)
         return True
 
     def _try_native_ssf(self, addr_spec: str) -> bool:
