@@ -1404,7 +1404,7 @@ def bench_forward_1m(num_series: int = 1 << 20):
 
 def bench_forward_10m(num_series: int = 10 * (1 << 20), intervals: int = 2,
                       rounds: int = 4, oracle_rows: int = 2048,
-                      oracle_extra: int = 252, slab_rows: int = 1 << 19):
+                      oracle_extra: int = 252, slab_rows: int = 1 << 18):
     """Config #2f: the flagship 10M-series packed forward as a DRIVER-
     RECORDED number (VERDICT round-4 item #1 — previously README prose).
 
@@ -2084,11 +2084,13 @@ def _run_all(result):
     result["vs_baseline_p50"] = round(
         num_series * base_us / 1e3 / histo["p50_ms"], 2)
     # north-star scale: 10M series on the one chip — bf16 resident
-    # digests (12.5 GB local / 4.2 GB merge-mode; see core/slab.py).
-    # 512k-row slabs keep the per-slab flush transients inside the
-    # ~3 GB of HBM the resident planes leave free.
+    # digests (~13.2 GB local incl. the round-5 anchor-summary planes /
+    # 4.2 GB merge-mode; see core/slab.py). 256k-row slabs keep the
+    # per-slab flush transients inside the ~2.3 GB of HBM the resident
+    # planes now leave free (512k slabs fit before the summary planes;
+    # their transients no longer do).
     configs["2b_histo_10m_bf16"] = guarded(
-        bench_histo_flush, 10 * (1 << 20), "bfloat16", 5, 4, 1 << 19)
+        bench_histo_flush, 10 * (1 << 20), "bfloat16", 5, 4, 1 << 18)
     configs["2c_merge_global_10m"] = guarded(
         bench_merge_global, 10 * (1 << 20))
     # the OTHER north-star metric: metrics/sec merged through the whole

@@ -216,12 +216,22 @@ class TestProcessBatchEquivalence:
                for m in pstore.flush([0.5, 0.99], AGG, False, now)[0]}
         assert set(nby) == set(pby)
         for i, vals in vals_by.items():
-            vals = np.asarray(vals)
-            span = vals.max() - vals.min()
+            vals = np.sort(np.asarray(vals))
+            span = vals[-1] - vals[0]
+            n_samp = len(vals)
             for q in (50, 99):
                 n = nby[f"gr.h{i}.{q}percentile"]
-                want = np.quantile(vals, q / 100)
-                assert abs(n - want) / span < 0.05, (i, q)
+                # accuracy vs the exact quantiles asserts the DOCUMENTED
+                # digest contract — rank error <= eps=0.02
+                # (tdigest/histo_test.go:11-25) — rather than an ad-hoc
+                # value-span bound that implicitly assumed a specific
+                # anchor resolution (a q99 value error in a thin tail is
+                # a small RANK error)
+                lo = np.searchsorted(vals, n, "left") / n_samp
+                hi = np.searchsorted(vals, n, "right") / n_samp
+                qq = q / 100
+                assert max(0.0, lo - qq, qq - hi) <= 0.02, (i, q)
+                # the two implementations must stay mutually close
                 assert abs(n - pby[f"gr.h{i}.{q}percentile"]) / span < 0.05
 
     def test_gauge_last_write_wins_in_batch(self):

@@ -313,12 +313,19 @@ class TestCapacityPlan:
         assert plan["num_slabs"] == 2
         assert plan["digest_bytes"] == 2 * ((1 << 20) * k * 2 * 2
                                             + (1 << 20) * 4 * 2)
-        assert plan["temp_bytes"] == 2 * ((1 << 20) * k * 4 * 2
-                                          + (1 << 20) * 4 * 5)
+        # 5 scalar stat planes + the round-5 anchor-summary planes
+        # (2 x BELOW_MASS_ANCHORS f32 per row)
+        assert plan["temp_bytes"] == 2 * (
+            (1 << 20) * k * 4 * 2
+            + (1 << 20) * 4 * (5 + 2 * td_ops.BELOW_MASS_ANCHORS))
 
     def test_north_star_fits_v5e(self):
-        """The 10M bf16 local plan stays under a 16 GB v5e-1 HBM."""
-        bank = SlabDigestBank(10_000_000, C, digest_dtype=jnp.bfloat16)
+        """The 10M bf16 local plan stays under a 16 GB v5e-1 HBM —
+        with 256k-row slabs since round 5: the anchor-summary planes
+        cost 64 B/row of residency, and the per-slab flush transients
+        (which scale with slab rows) must fit what is left."""
+        bank = SlabDigestBank(10_000_000, C, slab_rows=1 << 18,
+                              digest_dtype=jnp.bfloat16)
         plan = bank.hbm_bytes()
         resident = plan["total_bytes"] + plan["slab_transient_bytes"]
         assert resident < 15 * 2**30, f"{resident / 2**30:.1f} GB"

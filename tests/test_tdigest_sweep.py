@@ -24,20 +24,57 @@ class TestShiftGuard:
                                jnp.ones(flat.size, jnp.float32))
         # same range again: no shift
         assert not bool(td.shift_pred(
-            temp.sum_w, temp.sum_wm, jnp.asarray(flat),
+            temp.seg_w, temp.seg_wm, jnp.asarray(flat),
             jnp.asarray(low.astype(np.float32)),
             jnp.ones(flat.size, jnp.float32), rows))
         # disjoint range: shift
         assert bool(td.shift_pred(
-            temp.sum_w, temp.sum_wm, jnp.asarray(flat),
+            temp.seg_w, temp.seg_wm, jnp.asarray(flat),
             jnp.asarray((low + 1000).astype(np.float32)),
             jnp.ones(flat.size, jnp.float32), rows))
         # empty accumulator never triggers
         fresh = td.init_temp(rows)
         assert not bool(td.shift_pred(
-            fresh.sum_w, fresh.sum_wm, jnp.asarray(flat),
+            fresh.seg_w, fresh.seg_wm, jnp.asarray(flat),
             jnp.asarray(low.astype(np.float32)),
             jnp.ones(flat.size, jnp.float32), rows))
+        # nor do rows below the minimum accumulated mass (1-2 samples
+        # make a point-range summary; any value would read disjoint —
+        # the spurious-drain 4x ingest regression, round-5)
+        tiny = td.init_temp(rows)
+        tiny = td.ingest_chunk(tiny, jnp.asarray(flat[:rows]),
+                               jnp.asarray(low[:rows].astype(np.float32)),
+                               jnp.ones(rows, jnp.float32))
+        assert not bool(td.shift_pred(
+            tiny.seg_w, tiny.seg_wm, jnp.asarray(flat),
+            jnp.asarray((low + 1000).astype(np.float32)),
+            jnp.ones(flat.size, jnp.float32), rows))
+
+    def test_single_sample_chunks_never_vote(self):
+        """A chunk bringing one sample per row cannot trip the guard:
+        a lone stationary sample lands outside the segment-mean
+        envelope ~20% of the time at small n, which would re-open the
+        drain-churn regression for the realistic fleet shape
+        (round-5 review finding)."""
+        rows = 8
+        temp = td.init_temp(rows)
+        flat = np.tile(np.arange(rows, dtype=np.int32), 64)
+        vals = np.random.default_rng(3).uniform(0, 10, flat.size)
+        temp = td.ingest_chunk(temp, jnp.asarray(flat),
+                               jnp.asarray(vals.astype(np.float32)),
+                               jnp.ones(flat.size, jnp.float32))
+        one = np.arange(rows, dtype=np.int32)
+        # even a fully DISJOINT 1-sample-per-row chunk stays quiet...
+        assert not bool(td.shift_pred(
+            temp.seg_w, temp.seg_wm, jnp.asarray(one),
+            jnp.full(rows, 1e6, jnp.float32),
+            jnp.ones(rows, jnp.float32), rows))
+        # ...while a >=4-sample disjoint chunk still fires
+        four = np.repeat(np.arange(rows, dtype=np.int32), 4)
+        assert bool(td.shift_pred(
+            temp.seg_w, temp.seg_wm, jnp.asarray(four),
+            jnp.full(four.size, 1e6, jnp.float32),
+            jnp.ones(four.size, jnp.float32), rows))
 
     def test_guarded_ingest_drains_into_digest(self):
         """A hard step change moves the accumulated bins into the digest
@@ -97,9 +134,10 @@ class TestSweepEnvelope:
         assert cell["max_rank_err"] <= 0.02, cell
 
     def test_low_compression_binned_within_envelope(self):
-        """compression 20 gives k=24 < BELOW_MASS_ANCHORS; the anchor
-        count must clamp, not underflow to the last bin (round-5
-        review finding)."""
+        """The lowest accepted compression (k=24 bins mapping onto the
+        8 anchor segments) must stay inside a sane envelope — the
+        regime where a round-5 review found an anchor-index underflow
+        in an earlier (recomputed-summary) implementation."""
         cell = run_config("normal", 20.0, "binned16", "float32",
                           rows=4, n=1024, golden_rows=1)
         assert cell["max_rank_err"] <= 0.06, cell  # c=20 is coarse

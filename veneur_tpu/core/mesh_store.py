@@ -67,6 +67,7 @@ def _add_temp(a: td_ops.TempCentroids,
     """Elementwise accumulate: all TempCentroids fields are associative."""
     return td_ops.TempCentroids(
         sum_w=a.sum_w + b.sum_w, sum_wm=a.sum_wm + b.sum_wm,
+        seg_w=a.seg_w + b.seg_w, seg_wm=a.seg_wm + b.seg_wm,
         count=a.count + b.count, vsum=a.vsum + b.vsum,
         vmin=jnp.minimum(a.vmin, b.vmin), vmax=jnp.maximum(a.vmax, b.vmax),
         recip=a.recip + b.recip)
@@ -78,7 +79,8 @@ def _digest_programs(mesh: Mesh, compression: float, k: int):
         return _PROGRAMS[key]
     hosts = mesh.shape.get(HOSTS_AXIS, 1)
     sk, s, h, rep = P(SERIES_AXIS, None), P(SERIES_AXIS), P(HOSTS_AXIS), P()
-    temp_spec = td_ops.TempCentroids(sum_w=sk, sum_wm=sk, count=s, vsum=s,
+    temp_spec = td_ops.TempCentroids(sum_w=sk, sum_wm=sk, seg_w=sk,
+                                     seg_wm=sk, count=s, vsum=s,
                                      vmin=s, vmax=s, recip=s)
     dig_spec = td_ops.TDigest(mean=sk, weight=sk, min=s, max=s)
 
@@ -88,7 +90,7 @@ def _digest_programs(mesh: Mesh, compression: float, k: int):
         # psums the shift/total masses over ``axes`` so every shard
         # takes the same drain the dense store would on the same data
         shifted, total = td_ops.shift_masses(
-            temp.sum_w, temp.sum_wm, rows_l, vals, wts, s_loc)
+            temp.seg_w, temp.seg_wm, rows_l, vals, wts, s_loc)
         shifted = lax.psum(shifted, axes)
         total = lax.psum(total, axes)
         pred = shifted > td_ops.SHIFT_GUARD_FRAC * jnp.maximum(
@@ -98,7 +100,9 @@ def _digest_programs(mesh: Mesh, compression: float, k: int):
             t, d = args
             d2 = td_ops.drain_temp(d, t, compression)
             t2 = t._replace(sum_w=jnp.zeros_like(t.sum_w),
-                            sum_wm=jnp.zeros_like(t.sum_wm))
+                            sum_wm=jnp.zeros_like(t.sum_wm),
+                            seg_w=jnp.zeros_like(t.seg_w),
+                            seg_wm=jnp.zeros_like(t.seg_wm))
             return t2, d2
 
         return lax.cond(pred, do_drain, lambda a: a, (temp, digest))
@@ -118,7 +122,7 @@ def _digest_programs(mesh: Mesh, compression: float, k: int):
         binned = td_ops.ingest_chunk(
             td_ops.init_temp(s_loc, k, compression),
             rows_l, vals, wts, compression,
-            acc_sum_w=temp.sum_w, acc_sum_wm=temp.sum_wm)
+            acc_seg_w=temp.seg_w, acc_seg_wm=temp.seg_wm)
         if hosts > 1:
             binned = collectives.merge_temp(binned, HOSTS_AXIS)
         return _add_temp(temp, binned), digest
@@ -147,11 +151,13 @@ def _digest_programs(mesh: Mesh, compression: float, k: int):
             td_ops.init_temp(s_loc, k, compression),
             rows_l, means, wts, compression,
             update_stats=False,
-            acc_sum_w=temp.sum_w, acc_sum_wm=temp.sum_wm)
+            acc_seg_w=temp.seg_w, acc_seg_wm=temp.seg_wm)
         # imported centroids feed percentiles only, never local stats
         # (samplers.go:473-480)
         temp = temp._replace(sum_w=temp.sum_w + binned.sum_w,
-                             sum_wm=temp.sum_wm + binned.sum_wm)
+                             sum_wm=temp.sum_wm + binned.sum_wm,
+                             seg_w=temp.seg_w + binned.seg_w,
+                             seg_wm=temp.seg_wm + binned.seg_wm)
         sr = _relocal(srows, s_loc)
         dmin = dmin.at[sr].min(smins, mode="drop")
         dmax = dmax.at[sr].max(smaxs, mode="drop")
@@ -239,7 +245,8 @@ class MeshDigestGroup(DigestGroup):
 
     def _place(self):
         temp_sh = td_ops.TempCentroids(
-            sum_w=self._sk, sum_wm=self._sk, count=self._s, vsum=self._s,
+            sum_w=self._sk, sum_wm=self._sk, seg_w=self._sk,
+            seg_wm=self._sk, count=self._s, vsum=self._s,
             vmin=self._s, vmax=self._s, recip=self._s)
         dig_sh = td_ops.TDigest(mean=self._sk, weight=self._sk, min=self._s,
                                 max=self._s)

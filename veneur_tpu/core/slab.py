@@ -23,13 +23,18 @@ This bank re-plans the capacity:
     memory is slab-sized, and each Pallas operand stays under Mosaic's
     2 GiB (32-bit byte offset) limit.
 
-Capacity plan this buys on one 16 GB v5e-1 (K=104, 1M-row slabs):
+Capacity plan this buys on one 16 GB v5e-1 (K=104; resident figures
+include the round-5 anchor-summary planes, 64 B/row in local mode):
 
   | series | digest dtype | resident | role |
   |--------|--------------|----------|------|
-  |  4M    | f32          |  6.7 GB  | local (samples -> temp -> drain) |
-  | 10M    | bf16         | 12.6 GB  | local, the north-star config     |
+  |  4M    | f32          |  7.0 GB  | local (samples -> temp -> drain) |
+  | 10M    | bf16         | 13.2 GB  | local, the north-star config     |
   | 10M    | bf16, merge  |  4.3 GB  | global (imported digest merges)  |
+
+The 10M local config uses 256k-row slabs: per-slab flush transients
+scale with slab rows, and the ~2.3 GB the resident planes leave free
+no longer fits 512k-row transients.
 
 The 10M f32 local config needs ~16.7 GB resident and therefore two chips
 (or DP sharding via the mesh store, core/mesh_store.py) — that is the
@@ -76,10 +81,14 @@ class DigestSlab(NamedTuple):
 
 
 class TempSlab(NamedTuple):
-    """Interval accumulators for one slab (local role only), flat planes."""
+    """Interval accumulators for one slab (local role only), flat planes.
+    seg_w/seg_wm: the incremental anchor summary (ops/tdigest.py
+    TempCentroids.seg_*), flat [slab*A]."""
 
     sum_w: jax.Array     # [slab*K] f32
     sum_wm: jax.Array    # [slab*K] f32
+    seg_w: jax.Array     # [slab*A] f32
+    seg_wm: jax.Array    # [slab*A] f32
     count: jax.Array     # [slab] f32
     vsum: jax.Array      # [slab] f32
     vmin: jax.Array      # [slab] f32
@@ -98,9 +107,12 @@ def _init_digest_slab(slab: int, k: int, dtype) -> DigestSlab:
 
 
 def _init_temp_slab(slab: int, k: int) -> TempSlab:
+    a = td_ops.BELOW_MASS_ANCHORS
     return TempSlab(
         sum_w=jnp.zeros((slab * k,), jnp.float32),
         sum_wm=jnp.zeros((slab * k,), jnp.float32),
+        seg_w=jnp.zeros((slab * a,), jnp.float32),
+        seg_wm=jnp.zeros((slab * a,), jnp.float32),
         count=jnp.zeros((slab,), jnp.float32),
         vsum=jnp.zeros((slab,), jnp.float32),
         vmin=jnp.full((slab,), jnp.inf, jnp.float32),
@@ -118,7 +130,8 @@ def _guard_drain_slab(temp: TempSlab, digest: DigestSlab, rows, values,
     stationary traffic pays one cheap reduction, never the drain. Temp
     scalar stats survive (interval aggregates; only the bins move)."""
     k = temp.sum_w.shape[0] // slab
-    pred = td_ops.shift_pred(temp.sum_w, temp.sum_wm, rows, values,
+    a = td_ops.BELOW_MASS_ANCHORS
+    pred = td_ops.shift_pred(temp.seg_w, temp.seg_wm, rows, values,
                              weights, slab)
 
     def do_drain(args):
@@ -131,6 +144,8 @@ def _guard_drain_slab(temp: TempSlab, digest: DigestSlab, rows, values,
         t32 = td_ops.TempCentroids(
             sum_w=t.sum_w.reshape(slab, k),
             sum_wm=t.sum_wm.reshape(slab, k),
+            seg_w=t.seg_w.reshape(slab, a),
+            seg_wm=t.seg_wm.reshape(slab, a),
             count=t.count, vsum=t.vsum, vmin=t.vmin, vmax=t.vmax,
             recip=t.recip)
         drained = td_ops.drain_temp(d32, t32, compression)
@@ -139,7 +154,9 @@ def _guard_drain_slab(temp: TempSlab, digest: DigestSlab, rows, values,
             weight=drained.weight.astype(dt).reshape(-1),
             dmin=drained.min, dmax=drained.max, count=d.count)
         t2 = t._replace(sum_w=jnp.zeros_like(t.sum_w),
-                        sum_wm=jnp.zeros_like(t.sum_wm))
+                        sum_wm=jnp.zeros_like(t.sum_wm),
+                        seg_w=jnp.zeros_like(t.seg_w),
+                        seg_wm=jnp.zeros_like(t.seg_wm))
         return t2, d2
 
     return lax.cond(pred, do_drain, lambda a: a, (temp, digest))
@@ -161,13 +178,18 @@ def _ingest_slab(temp: TempSlab, digest: DigestSlab, rows, values, weights,
                                      slab, compression)
     r, v, w, b = td_ops.bin_flat_samples(
         rows, values, weights, slab, k, compression,
-        acc_sum_w=temp.sum_w, acc_sum_wm=temp.sum_wm)
+        acc_seg_w=temp.seg_w, acc_seg_wm=temp.seg_wm)
     live = w > 0
     vz = jnp.where(live, v, 0.0)
+    a = td_ops.BELOW_MASS_ANCHORS
     flat = jnp.where(r >= slab, slab * k, r * k + b)
+    flat_seg = jnp.where(r >= slab, slab * a,
+                         r * a + td_ops.seg_of_bins(b, k))
     return TempSlab(
         sum_w=temp.sum_w.at[flat].add(w, mode="drop"),
         sum_wm=temp.sum_wm.at[flat].add(w * vz, mode="drop"),
+        seg_w=temp.seg_w.at[flat_seg].add(w, mode="drop"),
+        seg_wm=temp.seg_wm.at[flat_seg].add(w * vz, mode="drop"),
         count=temp.count.at[r].add(w, mode="drop"),
         vsum=temp.vsum.at[r].add(w * vz, mode="drop"),
         vmin=temp.vmin.at[r].min(jnp.where(live, v, jnp.inf), mode="drop"),
@@ -192,13 +214,18 @@ def _import_slab(temp: TempSlab, digest: DigestSlab, rows, means, weights,
                                      slab, compression)
     r, v, w, b = td_ops.bin_flat_samples(
         rows, means, weights, slab, k, compression,
-        acc_sum_w=temp.sum_w, acc_sum_wm=temp.sum_wm)
+        acc_seg_w=temp.seg_w, acc_seg_wm=temp.seg_wm)
     live = w > 0
     vz = jnp.where(live, v, 0.0)
+    a = td_ops.BELOW_MASS_ANCHORS
     flat = jnp.where(r >= slab, slab * k, r * k + b)
+    flat_seg = jnp.where(r >= slab, slab * a,
+                         r * a + td_ops.seg_of_bins(b, k))
     temp = temp._replace(
         sum_w=temp.sum_w.at[flat].add(w, mode="drop"),
-        sum_wm=temp.sum_wm.at[flat].add(w * vz, mode="drop"))
+        sum_wm=temp.sum_wm.at[flat].add(w * vz, mode="drop"),
+        seg_w=temp.seg_w.at[flat_seg].add(w, mode="drop"),
+        seg_wm=temp.seg_wm.at[flat_seg].add(w * vz, mode="drop"))
     digest = digest._replace(
         dmin=digest.dmin.at[stat_rows].min(stat_mins, mode="drop"),
         dmax=digest.dmax.at[stat_rows].max(stat_maxs, mode="drop"))
@@ -223,8 +250,11 @@ def _flush_slab(digest: DigestSlab, temp: TempSlab, qs, slab: int,
         mean=digest.mean.reshape(slab, k).astype(jnp.float32),
         weight=digest.weight.reshape(slab, k).astype(jnp.float32),
         min=digest.dmin, max=digest.dmax)
+    a = td_ops.BELOW_MASS_ANCHORS
     t = td_ops.TempCentroids(
         sum_w=temp.sum_w.reshape(slab, k), sum_wm=temp.sum_wm.reshape(slab, k),
+        seg_w=temp.seg_w.reshape(slab, a),
+        seg_wm=temp.seg_wm.reshape(slab, a),
         count=temp.count, vsum=temp.vsum, vmin=temp.vmin, vmax=temp.vmax,
         recip=temp.recip)
     inf = jnp.full((slab,), jnp.inf, jnp.float32)
@@ -464,7 +494,8 @@ class SlabDigestBank:
         per_slab_digest = self.slab_rows * self.k * dsz * 2 \
             + self.slab_rows * 4 * 2
         per_slab_temp = (self.slab_rows * self.k * 4 * 2
-                         + self.slab_rows * 4 * 5) \
+                         + self.slab_rows * 4
+                         * (5 + 2 * td_ops.BELOW_MASS_ANCHORS)) \
             if self.mode == "local" else 0
         total = self.num_slabs * (per_slab_digest + per_slab_temp)
         return {

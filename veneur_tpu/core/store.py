@@ -413,6 +413,8 @@ class DigestGroup:
         self.temp = td_ops.TempCentroids(
             sum_w=jnp.pad(self.temp.sum_w, ((0, pad), (0, 0))),
             sum_wm=jnp.pad(self.temp.sum_wm, ((0, pad), (0, 0))),
+            seg_w=jnp.pad(self.temp.seg_w, ((0, pad), (0, 0))),
+            seg_wm=jnp.pad(self.temp.seg_wm, ((0, pad), (0, 0))),
             count=jnp.pad(self.temp.count, (0, pad)),
             vsum=jnp.pad(self.temp.vsum, (0, pad)),
             vmin=jnp.pad(self.temp.vmin, (0, pad), constant_values=np.inf),

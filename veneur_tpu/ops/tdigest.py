@@ -360,14 +360,26 @@ def cdf(state: TDigest, xs: jax.Array) -> jax.Array:
     return jnp.where(total > 0, est, jnp.nan)
 
 
-BELOW_MASS_ANCHORS = 32
+# 8 anchors = 64 B/row of f32 summary state: the 10M-series bf16
+# capacity plan (core/slab.py) has ~3 GB of headroom, and 32 anchors'
+# 256 B/row (2.6 GB at 10M) blew it — measured as RESOURCE_EXHAUSTED
+# across the 10M bench configs. f32 stays: bf16 scatter-adds stop
+# accumulating once a segment's mass crosses ~2^8 (8 mantissa bits),
+# which would silently re-chunk-relativize the anchoring for hot rows.
+BELOW_MASS_ANCHORS = 8
+
+
+def seg_of_bins(bins: jax.Array, capacity: int) -> jax.Array:
+    """Map k-bin ids onto the BELOW_MASS_ANCHORS quantile segments of
+    the incremental anchor summary (seg planes in TempCentroids)."""
+    return (bins * BELOW_MASS_ANCHORS) // max(capacity, 1)
 
 
 def bin_flat_samples(rows: jax.Array, values: jax.Array, weights: jax.Array,
                      num_series: int, capacity: int,
                      compression: float = DEFAULT_COMPRESSION,
-                     acc_sum_w: jax.Array | None = None,
-                     acc_sum_wm: jax.Array | None = None):
+                     acc_seg_w: jax.Array | None = None,
+                     acc_seg_wm: jax.Array | None = None):
     """Pre-cluster a flat batch of (row, value, weight) samples into k-bins.
 
     The streaming-ingest half of the TPU t-digest: instead of a per-digest
@@ -384,18 +396,21 @@ def bin_flat_samples(rows: jax.Array, values: jax.Array, weights: jax.Array,
     ``rows == num_series`` (they sort to the back and scatter with
     mode='drop'). Returns (rows, values, weights, bins) sorted by row.
 
-    acc_sum_w / acc_sum_wm ([S, K] or flat [S*K]):
-    the temp accumulator state BEFORE this chunk. When given, each
-    sample's quantile is estimated against the accumulated-plus-chunk
-    distribution (below-mass from a BELOW_MASS_ANCHORS-segment summary
-    of the accumulated bins + the exact within-chunk rank), so bins stay
-    VALUE-COHERENT across chunks. Without the correction, bin ids are
-    chunk-relative, and ordered arrival (a sorted replay, a step
-    change, a strong in-interval trend) aliases low early values with
-    high late values in the same bin — measured up to 0.44 rank error
-    in the accuracy sweep (analysis/tdigest_sweep.py, the regression
-    this argument fixes). On the first chunk the accumulator is empty
-    and the behavior is exactly the uncorrected one.
+    acc_seg_w / acc_seg_wm ([S, A] or flat [S*A], A=BELOW_MASS_ANCHORS):
+    the temp's INCREMENTAL anchor summary as accumulated BEFORE this
+    chunk (TempCentroids.seg_w/seg_wm — maintained by two extra
+    scatters per ingest, so the correction never re-reads the full
+    [S, K] bin planes). When given, each sample's quantile is
+    estimated against the accumulated-plus-chunk distribution
+    (interpolated below-mass from the summary + the exact within-chunk
+    rank), so bins stay VALUE-COHERENT across chunks. Without the
+    correction, bin ids are chunk-relative, and ordered arrival (a
+    sorted replay, a step change, a strong in-interval trend) aliases
+    low early values with high late values in the same bin — measured
+    up to 0.44 rank error in the accuracy sweep
+    (analysis/tdigest_sweep.py, the regression this argument fixes).
+    On the first chunk the summary is empty and the behavior is
+    exactly the uncorrected one.
     """
     values = values.astype(jnp.float32)
     weights = weights.astype(jnp.float32)
@@ -410,9 +425,9 @@ def bin_flat_samples(rows: jax.Array, values: jax.Array, weights: jax.Array,
     q_excl = excl - base
     totals = jnp.zeros((num_series + 1,), w.dtype).at[r].add(w, mode="drop")
     tot = jnp.maximum(totals[jnp.minimum(r, num_series)], jnp.finfo(w.dtype).tiny)
-    if acc_sum_w is not None:
+    if acc_seg_w is not None:
         below, acc_tot = _acc_below_mass(
-            r, v, acc_sum_w, acc_sum_wm, num_series)
+            r, v, acc_seg_w, acc_seg_wm, num_series)
         q_mid = (below + q_excl + 0.5 * w) / jnp.maximum(
             tot + acc_tot, jnp.finfo(w.dtype).tiny)
     else:
@@ -422,45 +437,34 @@ def bin_flat_samples(rows: jax.Array, values: jax.Array, weights: jax.Array,
     return r, v, w, bins
 
 
-def _acc_below_mass(r: jax.Array, v: jax.Array, acc_sum_w: jax.Array,
-                    acc_sum_wm: jax.Array, num_series: int):
-    """Per-sample accumulated mass below its value, from a
-    BELOW_MASS_ANCHORS-segment summary of the row's temp bins.
+def _acc_below_mass(r: jax.Array, v: jax.Array, acc_seg_w: jax.Array,
+                    acc_seg_wm: jax.Array, num_series: int):
+    """Per-sample accumulated mass below its value, from the temp's
+    incremental BELOW_MASS_ANCHORS-segment summary.
 
-    The accumulated bins are approximately quantile-ordered by bin
-    index (inductively: every previous chunk was binned by estimated
-    global quantile), so a monotone-envelope cummax over bin means
-    gives a valid coarse CDF without a per-row sort. Downsampling to
-    BELOW_MASS_ANCHORS segments bounds the extra ingest cost at
-    [N, A] elementwise work; LINEAR interpolation inside the segment a
-    value falls in keeps the estimate sharp for stationary traffic
-    (a step attribution would smear bins by a whole segment's mass as
-    the accumulated total grows).
+    Segments are quantile-ordered by construction (every previous
+    chunk was binned by estimated global quantile and its mass
+    scattered into seg_of_bins segments), so a cummax over the A
+    segment means gives a monotone coarse CDF; LINEAR interpolation
+    inside the segment a value falls in keeps the estimate sharp for
+    stationary traffic (a step attribution would smear bins by a whole
+    segment's mass as the accumulated total grows). All work is
+    [S, A] + [N, A] — the full [S, K] bin planes are never read.
 
     Returns (below [N], acc_total [N]) with zeros for rows that have
     accumulated nothing (first chunk == uncorrected behavior).
     """
-    acc_w2 = acc_sum_w.reshape(num_series, -1)
-    acc_m2 = acc_sum_wm.reshape(num_series, -1)
-    k = acc_w2.shape[1]
-    # low compressions give k < BELOW_MASS_ANCHORS; an anchor count
-    # above k would underflow idx[0] to -1 (wrapping to the LAST bin
-    # and corrupting the coarse CDF)
-    A = min(BELOW_MASS_ANCHORS, k)
-    live = acc_w2 > 0
-    means = jnp.where(live, acc_m2 / jnp.where(live, acc_w2, 1.0), -jnp.inf)
-    mono = jax.lax.cummax(means, axis=1)              # [S, K] envelope
-    cumw = jnp.cumsum(acc_w2, axis=1)                 # [S, K]
-    idx = (jnp.arange(1, A + 1) * k) // A - 1         # [A] anchor slots
-    a_mean = mono[:, idx]                             # [S, A]
-    a_cumw = cumw[:, idx]                             # [S, A]
-    a_dw = jnp.diff(a_cumw, axis=1, prepend=jnp.zeros_like(a_cumw[:, :1]))
+    a_w = acc_seg_w.reshape(num_series, BELOW_MASS_ANCHORS)
+    a_wm = acc_seg_wm.reshape(num_series, BELOW_MASS_ANCHORS)
+    live = a_w > 0
+    means = jnp.where(live, a_wm / jnp.where(live, a_w, 1.0), -jnp.inf)
+    mono = jax.lax.cummax(means, axis=1)              # [S, A] envelope
     rc = jnp.minimum(r, num_series - 1)
-    s_mean = a_mean[rc]                               # [N, A]
-    s_dw = a_dw[rc]                                   # [N, A]
+    s_mean = mono[rc]                                 # [N, A]
+    s_dw = a_w[rc]                                    # [N, A]
     # segment j spans (mean_{j-1}, mean_j]; its mass counts fully below
     # v when v clears the segment, fractionally (linear in value) when
-    # v falls inside it. -inf lower bounds (leading empty anchors)
+    # v falls inside it. -inf lower bounds (leading empty segments)
     # degrade to the step attribution.
     s_prev = jnp.concatenate(
         [jnp.full_like(s_mean[:, :1], -jnp.inf), s_mean[:, :-1]], axis=1)
@@ -471,19 +475,27 @@ def _acc_below_mass(r: jax.Array, v: jax.Array, acc_sum_w: jax.Array,
                  0.0, 1.0),
         (s_mean < v[:, None]).astype(jnp.float32))
     below = jnp.sum(s_dw * frac, axis=1)
-    # the bins' own accumulated mass, not temp.count: imports bin with
-    # update_stats=False, so count and bin mass can legitimately differ
-    acc_tot = cumw[rc, -1]
+    # the summary's own accumulated mass, not temp.count: imports bin
+    # with update_stats=False, so count and bin mass can differ
+    acc_tot = jnp.sum(s_dw, axis=1)
     return below, acc_tot
 
 
 class TempCentroids(NamedTuple):
     """Per-series accumulation of pre-clustered samples: the batched analogue
     of the reference's tempCentroids list, plus the Histo sampler's local
-    scalar stats (samplers.go:467-494)."""
+    scalar stats (samplers.go:467-494).
+
+    seg_w/seg_wm are the incremental BELOW_MASS_ANCHORS-segment anchor
+    summary (updated by the same scatters that fill the bins): the
+    quantile-anchoring correction and the shift guard read ONLY these
+    [S, A] planes, never the full [S, K] bins — keeping the per-chunk
+    ingest cost at scatter level."""
 
     sum_w: jax.Array       # [S, K] per-bin weight
     sum_wm: jax.Array      # [S, K] per-bin weighted mean sum
+    seg_w: jax.Array       # [S, A] anchor-segment weight
+    seg_wm: jax.Array      # [S, A] anchor-segment weighted mean sum
     count: jax.Array       # [S] total weight
     vsum: jax.Array        # [S] weighted sample sum
     vmin: jax.Array        # [S]
@@ -499,6 +511,8 @@ def init_temp(num_series: int, capacity: int | None = None,
     return TempCentroids(
         sum_w=jnp.zeros((num_series, k), jnp.float32),
         sum_wm=jnp.zeros((num_series, k), jnp.float32),
+        seg_w=jnp.zeros((num_series, BELOW_MASS_ANCHORS), jnp.float32),
+        seg_wm=jnp.zeros((num_series, BELOW_MASS_ANCHORS), jnp.float32),
         count=jnp.zeros((num_series,), jnp.float32),
         vsum=jnp.zeros((num_series,), jnp.float32),
         vmin=jnp.full((num_series,), jnp.inf, jnp.float32),
@@ -511,11 +525,11 @@ def ingest_chunk(temp: TempCentroids, rows: jax.Array, values: jax.Array,
                  weights: jax.Array,
                  compression: float = DEFAULT_COMPRESSION,
                  update_stats: bool = True,
-                 acc_sum_w: jax.Array | None = None,
-                 acc_sum_wm: jax.Array | None = None) -> TempCentroids:
+                 acc_seg_w: jax.Array | None = None,
+                 acc_seg_wm: jax.Array | None = None) -> TempCentroids:
     """Fold one flat chunk of samples into the temp accumulator.
 
-    acc_sum_w/acc_sum_wm default to ``temp``'s own accumulators (the
+    acc_seg_w/acc_seg_wm default to ``temp``'s own anchor summary (the
     quantile-anchoring state for bin coherence); the mesh store passes
     them explicitly because it bins each chunk into a FRESH temp and
     index-adds the delta after a hosts-axis collective.
@@ -524,23 +538,27 @@ def ingest_chunk(temp: TempCentroids, rows: jax.Array, values: jax.Array,
     chunks accumulate into the same bins, with bin ids anchored to the
     estimated GLOBAL quantile against the accumulated state (see
     bin_flat_samples' acc_* args), so bins stay value-coherent across
-    chunks even under ordered arrival.
+    chunks even under ordered arrival. The [S, A] anchor summary is
+    maintained by two extra scatters here.
 
     update_stats=False skips the local scalar stats: used when re-binning
     *imported* digest centroids, which contribute to percentiles but not to
     the host-local min/max/sum/avg/count/hmean (samplers.go:473-480).
     """
     num_series, capacity = temp.sum_w.shape
-    if acc_sum_w is None:
-        acc_sum_w, acc_sum_wm = temp.sum_w, temp.sum_wm
+    if acc_seg_w is None:
+        acc_seg_w, acc_seg_wm = temp.seg_w, temp.seg_wm
     r, v, w, b = bin_flat_samples(rows, values, weights, num_series, capacity,
-                                  compression, acc_sum_w=acc_sum_w,
-                                  acc_sum_wm=acc_sum_wm)
+                                  compression, acc_seg_w=acc_seg_w,
+                                  acc_seg_wm=acc_seg_wm)
     live = w > 0
     vz = jnp.where(live, v, 0.0)
+    sg = seg_of_bins(b, capacity)
     temp = temp._replace(
         sum_w=temp.sum_w.at[r, b].add(w, mode="drop"),
         sum_wm=temp.sum_wm.at[r, b].add(w * vz, mode="drop"),
+        seg_w=temp.seg_w.at[r, sg].add(w, mode="drop"),
+        seg_wm=temp.seg_wm.at[r, sg].add(w * vz, mode="drop"),
     )
     if not update_stats:
         return temp
@@ -554,20 +572,37 @@ def ingest_chunk(temp: TempCentroids, rows: jax.Array, values: jax.Array,
 
 
 SHIFT_GUARD_FRAC = 0.01
+# a row votes "shifted" only once its bins hold this much mass: with
+# 1-2 accumulated samples the summary's value range is a point, and
+# ANY new value reads as disjoint — which made the guard drain on
+# every chunk of ordinary traffic (a 4x ingest regression caught by
+# the round-5 bench artifact). Rows this small cannot alias anyway:
+# their handful of samples spread across distinct anchored bins.
+SHIFT_GUARD_MIN_MASS = 8.0
+# ... and only when the CHUNK brings this much mass for the row: a
+# single stationary sample lands outside the accumulated segment-mean
+# envelope with probability ~2/(n+1) (~20% at n=8), so 1-sample-per-row
+# chunks — the realistic fleet shape — would re-trigger the churn at
+# reduced frequency. Four samples all clearing the envelope on the
+# same side by chance is ~(1/(n+1))^4; a genuine step change with
+# >=4-sample chunks still fires, and sparser rows rely on the
+# quantile anchoring, whose misassignments stay value-local.
+SHIFT_GUARD_MIN_CHUNK_MASS = 4.0
 
 
-def shift_masses(acc_sum_w: jax.Array, acc_sum_wm: jax.Array,
+def shift_masses(acc_seg_w: jax.Array, acc_seg_wm: jax.Array,
                  rows: jax.Array, values: jax.Array, weights: jax.Array,
                  num_series: int):
     """(shifted_mass, total_mass) of a chunk against the accumulated
-    bins — the raw inputs of ``shift_pred``, exposed separately so the
-    mesh store can psum them over its axes before thresholding (every
-    shard must take the SAME drain decision the dense store would).
+    anchor summary — the raw inputs of ``shift_pred``, exposed
+    separately so the mesh store can psum them over its axes before
+    thresholding (every shard must take the SAME drain decision the
+    dense store would). Reads only the [S, A] summary planes.
 
     rows may carry the padding sentinel (== num_series); padding and
     zero weights are excluded everywhere."""
-    acc_w2 = acc_sum_w.reshape(num_series, -1)
-    acc_m2 = acc_sum_wm.reshape(num_series, -1)
+    acc_w2 = acc_seg_w.reshape(num_series, BELOW_MASS_ANCHORS)
+    acc_m2 = acc_seg_wm.reshape(num_series, BELOW_MASS_ANCHORS)
     live_b = acc_w2 > 0
     means = jnp.where(live_b, acc_m2 / jnp.where(live_b, acc_w2, 1.0),
                       jnp.nan)
@@ -585,14 +620,15 @@ def shift_masses(acc_sum_w: jax.Array, acc_sum_wm: jax.Array,
     cmass = jnp.zeros((num_series + 1,),
                       jnp.float32).at[rows].add(w_live,
                                                 mode="drop")[:num_series]
-    disjoint = (acc_mass > 0) & (cmass > 0) & ((cmin > amax)
-                                               | (cmax < amin))
+    disjoint = (acc_mass >= SHIFT_GUARD_MIN_MASS) \
+        & (cmass >= SHIFT_GUARD_MIN_CHUNK_MASS) \
+        & ((cmin > amax) | (cmax < amin))
     shifted = jnp.sum(jnp.where(disjoint, cmass, 0.0))
     total = jnp.sum(cmass)
     return shifted, total
 
 
-def shift_pred(acc_sum_w: jax.Array, acc_sum_wm: jax.Array,
+def shift_pred(acc_seg_w: jax.Array, acc_seg_wm: jax.Array,
                rows: jax.Array, values: jax.Array, weights: jax.Array,
                num_series: int,
                frac: float = SHIFT_GUARD_FRAC) -> jax.Array:
@@ -603,7 +639,7 @@ def shift_pred(acc_sum_w: jax.Array, acc_sum_wm: jax.Array,
     see analysis/tdigest_sweep.py's ordered-arrival regime). Callers
     guard with lax.cond: drain the temp into the digest first, then
     ingest against fresh bins. Stationary traffic never triggers."""
-    shifted, total = shift_masses(acc_sum_w, acc_sum_wm, rows, values,
+    shifted, total = shift_masses(acc_seg_w, acc_seg_wm, rows, values,
                                   weights, num_series)
     return shifted > frac * jnp.maximum(total,
                                         jnp.finfo(jnp.float32).tiny)
@@ -621,14 +657,16 @@ def ingest_chunk_guarded(digest: TDigest, temp: TempCentroids,
     drain — they are interval aggregates, only the BINS move into the
     digest. Returns (digest, temp)."""
     num_series = temp.sum_w.shape[0]
-    pred = shift_pred(temp.sum_w, temp.sum_wm, rows, values, weights,
+    pred = shift_pred(temp.seg_w, temp.seg_wm, rows, values, weights,
                       num_series)
 
     def do_drain(args):
         d, t = args
         d2 = drain_temp(d, t, compression)
         t2 = t._replace(sum_w=jnp.zeros_like(t.sum_w),
-                        sum_wm=jnp.zeros_like(t.sum_wm))
+                        sum_wm=jnp.zeros_like(t.sum_wm),
+                        seg_w=jnp.zeros_like(t.seg_w),
+                        seg_wm=jnp.zeros_like(t.seg_wm))
         return d2, t2
 
     digest, temp = lax.cond(pred, do_drain, lambda a: a, (digest, temp))

@@ -50,6 +50,8 @@ def merge_temp(temp: TempCentroids, axis: str) -> TempCentroids:
     return TempCentroids(
         sum_w=lax.psum(temp.sum_w, axis),
         sum_wm=lax.psum(temp.sum_wm, axis),
+        seg_w=lax.psum(temp.seg_w, axis),
+        seg_wm=lax.psum(temp.seg_wm, axis),
         count=lax.psum(temp.count, axis),
         vsum=lax.psum(temp.vsum, axis),
         vmin=lax.pmin(temp.vmin, axis),
