@@ -36,6 +36,34 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "tpu: hardware smoke subset; runs only under "
                    "VENEUR_TPU_TESTS=1 (real accelerator)")
+    config.addinivalue_line(
+        "markers", "slow: sleep-heavy / soak tests excluded from the "
+                   "tier-1 gate (-m 'not slow')")
+
+
+class FakeClock:
+    """A manually-advanced monotonic clock for resilience tests: inject
+    ``clock`` into Deadline/CircuitBreaker and ``sleep`` into
+    call_with_retry so backoff/expiry tests run in milliseconds."""
+
+    def __init__(self, start: float = 1000.0):
+        self.now = start
+        self.sleeps = []  # every sleep() duration, for backoff asserts
+
+    def __call__(self) -> float:
+        return self.now
+
+    def advance(self, seconds: float) -> None:
+        self.now += seconds
+
+    def sleep(self, seconds: float) -> None:
+        self.sleeps.append(seconds)
+        self.now += seconds
+
+
+@pytest.fixture
+def fake_clock():
+    return FakeClock()
 
 
 def pytest_collection_modifyitems(config, items):

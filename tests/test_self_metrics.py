@@ -94,10 +94,13 @@ class TestSinkSamples:
         errors = [s for s in samples
                   if s.name == "veneur.flush.error_total"]
         assert errors[0].value == 3
-        # drained: a second flush reports no stale parts and a 0 delta
+        # drained: a second flush reports no stale parts and 0 deltas
+        # (retries_total joined the documented set with the egress
+        # resilience layer, docs/resilience.md)
         samples2 = flusher._sink_samples(server, {})
-        assert _names(samples2) == ["veneur.flush.error_total"]
-        assert samples2[0].value == 0
+        assert _names(samples2) == ["veneur.flush.error_total",
+                                    "veneur.sink.datadog.retries_total"]
+        assert samples2[0].value == 0 and samples2[1].value == 0
 
     def test_datadog_columnar_flush_records_telemetry(self):
         import pytest

@@ -182,6 +182,30 @@ class Config:
     # mesh fan-in axis width (0 = auto: 2 when the device count is even)
     mesh_hosts: int = 0
 
+    # ---- egress resilience (veneur_tpu/resilience/, docs/resilience.md) --
+    # per-flush egress deadline budget: retries and breaker probes never
+    # push a flush past min(forward_timeout, interval). Parsed ONCE at
+    # load into forward_timeout_seconds; call sites never re-parse.
+    forward_timeout: str = ""
+    # number of RE-tries per egress operation (0 = single attempt;
+    # -1 = unset, defaults to 2)
+    retry_max: int = -1
+    # first backoff interval; subsequent retries double it with full
+    # jitter (uniform over [0, min(cap, base * 2^n)])
+    retry_base_interval: str = ""
+    # consecutive failures before a destination's breaker opens
+    breaker_failure_threshold: int = 0
+    # how long an open breaker waits before admitting a half-open probe
+    breaker_reset_timeout: str = ""
+    # deterministic fault injection for tests and soak runs (rate 0 =
+    # off). Same seed → same fault schedule. kinds: comma-separated
+    # subset of connect,timeout,http_5xx,partial_write; scope substring-
+    # filters operation names (forward.http, sink.datadog, proxy.post…)
+    fault_injection_rate: float = 0.0
+    fault_injection_seed: int = 0
+    fault_injection_kinds: str = ""
+    fault_injection_scope: str = ""
+
     def parse_interval(self) -> float:
         return parse_duration(self.interval)
 
@@ -222,6 +246,25 @@ class Config:
                 "digest_storage: slab and mesh_enabled are mutually "
                 "exclusive — the mesh store is its own capacity plan "
                 "(series sharded across chips); pick one")
+        if self.breaker_failure_threshold < 0:
+            raise ValueError(
+                f"breaker_failure_threshold must be >= 0 (0 = use the "
+                f"default, {_BREAKER_THRESHOLD_DEFAULT}; breakers cannot "
+                f"be disabled), got {self.breaker_failure_threshold}")
+        if not 0.0 <= self.fault_injection_rate <= 1.0:
+            raise ValueError(
+                f"fault_injection_rate must be in [0, 1], got "
+                f"{self.fault_injection_rate}")
+        if self.fault_injection_kinds:
+            from veneur_tpu.resilience.faults import ALL_KINDS
+
+            bad = [k.strip()
+                   for k in self.fault_injection_kinds.split(",")
+                   if k.strip() and k.strip() not in ALL_KINDS]
+            if bad:
+                raise ValueError(
+                    f"unknown fault_injection_kinds {bad}; known: "
+                    f"{list(ALL_KINDS)}")
 
     def apply_defaults(self):
         """Defaults + deprecation shims (config_parse.go:118-185)."""
@@ -270,12 +313,44 @@ class Config:
             self.datadog_span_buffer_size = 16384
         if not self.trace_max_length_bytes:
             self.trace_max_length_bytes = 16 * 1024
+        self.apply_resilience_defaults()
         return self
+
+    def apply_resilience_defaults(self):
+        return _apply_resilience_defaults(self)
+
+
+# the 0-means-default convention matches the other int knobs
+# (num_workers etc.); a breaker cannot be disabled, only tuned
+_BREAKER_THRESHOLD_DEFAULT = 5
+
+
+def _apply_resilience_defaults(cfg):
+    """Default + parse the shared egress-resilience knobs ONCE (the
+    round-1 audit policy: durations parse at load, call sites read the
+    float attributes, never re-parse). Idempotent; raises on malformed
+    durations. Shared by Config.apply_defaults and ProxyConfig.finalize."""
+    if not cfg.forward_timeout:
+        cfg.forward_timeout = "10s"
+    if cfg.retry_max < 0:
+        cfg.retry_max = 2
+    if not cfg.retry_base_interval:
+        cfg.retry_base_interval = "100ms"
+    if not cfg.breaker_failure_threshold:
+        cfg.breaker_failure_threshold = _BREAKER_THRESHOLD_DEFAULT
+    if not cfg.breaker_reset_timeout:
+        cfg.breaker_reset_timeout = "30s"
+    cfg.forward_timeout_seconds = parse_duration(cfg.forward_timeout)
+    cfg.retry_base_interval_seconds = parse_duration(cfg.retry_base_interval)
+    cfg.breaker_reset_timeout_seconds = parse_duration(
+        cfg.breaker_reset_timeout)
+    return cfg
 
 
 @dataclass
 class ProxyConfig:
-    """Proxy configuration (config_proxy.go:3-18)."""
+    """Proxy configuration (config_proxy.go:3-18), plus the shared
+    egress-resilience knobs (docs/resilience.md)."""
 
     consul_forward_service_name: str = ""
     consul_refresh_interval: str = ""
@@ -292,6 +367,31 @@ class ProxyConfig:
     trace_address: str = ""
     trace_api_address: str = ""
     grpc_forward_address: str = ""  # extension: gRPC proxy listener
+    # egress resilience, same semantics as the server Config's keys
+    retry_max: int = -1
+    retry_base_interval: str = ""
+    breaker_failure_threshold: int = 0
+    breaker_reset_timeout: str = ""
+    fault_injection_rate: float = 0.0
+    fault_injection_seed: int = 0
+    fault_injection_kinds: str = ""
+    fault_injection_scope: str = ""
+
+    def finalize(self) -> "ProxyConfig":
+        """Defaults + parse-once durations; idempotent (the Proxy calls
+        this defensively for configs constructed directly in tests)."""
+        if not self.consul_refresh_interval:
+            self.consul_refresh_interval = "30s"
+        if self.breaker_failure_threshold < 0:
+            raise ValueError(
+                f"breaker_failure_threshold must be >= 0 (0 = use the "
+                f"default, {_BREAKER_THRESHOLD_DEFAULT}; breakers cannot "
+                f"be disabled), got {self.breaker_failure_threshold}")
+        if not 0.0 <= self.fault_injection_rate <= 1.0:
+            raise ValueError(
+                f"fault_injection_rate must be in [0, 1], got "
+                f"{self.fault_injection_rate}")
+        return _apply_resilience_defaults(self)
 
 
 _DURATION_RE = re.compile(r"(\d+(?:\.\d+)?)(ns|us|µs|ms|s|m|h)")
@@ -386,8 +486,4 @@ def read_proxy_config(path: str, environ=None) -> ProxyConfig:
     _apply_env_overrides(cfg, environ)
     if unknown:
         log.warning("proxy config contains unknown keys: %s", sorted(unknown))
-    if not cfg.forward_timeout:
-        cfg.forward_timeout = "10s"
-    if not cfg.consul_refresh_interval:
-        cfg.consul_refresh_interval = "30s"
-    return cfg
+    return cfg.finalize()

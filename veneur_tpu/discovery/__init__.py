@@ -34,6 +34,38 @@ class StaticDiscoverer:
         return list(self._destinations)
 
 
+class RetryingDiscoverer:
+    """Wrap any discoverer with the shared retry/backoff substrate
+    (veneur_tpu/resilience) so one flaky Consul/k8s API response does
+    not cost a refresh cycle. The proxy retries its refresh loop
+    directly (proxy._refresh_ring, where the retry count feeds
+    /debug/vars); this wrapper is for library users driving a
+    discoverer themselves."""
+
+    def __init__(self, inner: "Discoverer", retry_policy=None,
+                 budget: float = 10.0, on_retry=None):
+        from veneur_tpu.resilience import RetryPolicy
+
+        self._inner = inner
+        self._policy = retry_policy or RetryPolicy()
+        self._budget = budget
+        self._on_retry = on_retry
+        self.retries = 0
+
+    def get_destinations_for_service(self, service_name: str) -> List[str]:
+        from veneur_tpu.resilience import Deadline, call_with_retry
+
+        def on_retry(retry_index, exc, pause):
+            self.retries += 1
+            if self._on_retry is not None:
+                self._on_retry(retry_index, exc, pause)
+
+        return call_with_retry(
+            lambda: self._inner.get_destinations_for_service(service_name),
+            self._policy, deadline=Deadline.after(self._budget),
+            retryable=(Exception,), on_retry=on_retry)
+
+
 class ConsulDiscoverer:
     """Healthy-instance query against the Consul HTTP API
     (consul.go:16-55): GET /v1/health/service/{name}?passing, one

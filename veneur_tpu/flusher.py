@@ -57,8 +57,21 @@ def _flush_once(server: "Server", span):
         server._span_flush_thread = span_flusher
         span_flusher.start()
     else:
+        # degradation must be observable, not just logged: counted here,
+        # emitted below as veneur.flush.span_flush_skipped_total
+        server._span_flush_skipped = getattr(
+            server, "_span_flush_skipped", 0) + 1
         log.warning("previous span flush still running; skipping this "
                     "interval's span flush")
+
+    # the flush deadline (resilience/deadline.py): egress retries across
+    # forwarders and sinks share one budget — min(forward_timeout,
+    # interval) — so backoff can never push a flush past the boundary
+    from veneur_tpu.resilience import Deadline
+
+    budget = min(server.interval,
+                 getattr(server.config, "forward_timeout_seconds", 10.0))
+    deadline = Deadline.after(budget)
 
     is_local = server.is_local()
     if is_local and server.forward_fn is None and not server._warned_no_forward:
@@ -108,6 +121,11 @@ def _flush_once(server: "Server", span):
                            {"part": "store"}),
         ssf_samples.count("veneur.flush.post_metrics_total",
                           float(len(final_metrics)), None),
+        ssf_samples.count(
+            "veneur.flush.span_flush_skipped_total",
+            float(_delta_since(server, "_last_span_flush_skipped",
+                               getattr(server, "_span_flush_skipped", 0))),
+            None),
         *_worker_samples(server, ms),
         *_forward_samples(server),
         *_import_samples(server),
@@ -120,14 +138,17 @@ def _flush_once(server: "Server", span):
         import inspect
 
         try:
-            span_aware = "parent_span" in inspect.signature(
-                server.forward_fn).parameters
+            fwd_params = inspect.signature(server.forward_fn).parameters
         except (TypeError, ValueError):
-            span_aware = False
-        if span_aware:
-            fwd = lambda: server.forward_fn(forwardable, parent_span=span)
-        else:
-            fwd = lambda: server.forward_fn(forwardable)
+            fwd_params = {}
+        kwargs = {}
+        if "parent_span" in fwd_params:
+            kwargs["parent_span"] = span
+        if "deadline" in fwd_params:
+            # the forward runs off the flush path but shares the flush
+            # budget: its retries must finish before the next interval
+            kwargs["deadline"] = deadline
+        fwd = lambda: server.forward_fn(forwardable, **kwargs)
         threading.Thread(target=fwd, daemon=True).start()
 
     if not final_metrics:
@@ -149,6 +170,10 @@ def _flush_once(server: "Server", span):
         return run
 
     for sink in server.metric_sinks:
+        # the interval's shared egress budget, read by each sink's retry
+        # loop (set before the thread starts; sinks only read it)
+        if hasattr(sink, "set_flush_deadline"):
+            sink.set_flush_deadline(deadline)
         if use_columnar and hasattr(sink, "flush_columnar"):
             t = threading.Thread(
                 target=timed(_flush_sink_columnar, sink, final_metrics),
@@ -236,18 +261,27 @@ def _forward_samples(server):
         return []
     with f._lock:
         fwd, errs = f.forwarded, f.errors
+        retries = getattr(f, "retries", 0)
         durs = list(f.post_durations)
         lens = list(f.post_content_lengths)
         f.post_durations.clear()
         f.post_content_lengths.clear()
     d_fwd = _delta_since(f, "_last_reported_forwarded", fwd)
     d_err = _delta_since(f, "_last_reported_errors", errs)
+    d_retries = _delta_since(f, "_last_reported_retries", retries)
     out = [
         ssf_samples.count("veneur.forward.post_metrics_total",
                           float(d_fwd), None),
         ssf_samples.count("veneur.forward.error_total", float(d_err),
                           None),
+        ssf_samples.count("veneur.forward.retries_total",
+                          float(d_retries), None),
     ]
+    breaker = getattr(f, "breaker", None)
+    if breaker is not None:
+        out.append(ssf_samples.gauge(
+            "veneur.breaker.state", breaker.state_gauge(),
+            {"destination": breaker.name or "forward"}))
     out.extend(ssf_samples.timing("veneur.forward.duration_ns", s,
                                   {"part": "post"}) for s in durs)
     out.extend(ssf_samples.histogram(
@@ -292,6 +326,16 @@ def _sink_samples(server, sink_elapsed: dict):
                                  sink.flush_errors)
             out.append(ssf_samples.count("veneur.flush.error_total",
                                          float(delta), {"sink": name}))
+        if hasattr(sink, "retries"):
+            delta = _delta_since(sink, "_last_reported_retries",
+                                 sink.retries)
+            out.append(ssf_samples.count(
+                f"veneur.sink.{name}.retries_total", float(delta), None))
+        breaker = getattr(sink, "breaker", None)
+        if breaker is not None:
+            out.append(ssf_samples.gauge(
+                "veneur.breaker.state", breaker.state_gauge(),
+                {"destination": breaker.name or name, "sink": name}))
         if hasattr(sink, "drain_flush_telemetry"):
             for kind, value in sink.drain_flush_telemetry():
                 if kind == "marshal_s":

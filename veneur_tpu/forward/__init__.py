@@ -39,22 +39,42 @@ __all__ = [
 
 def configure_forwarding(server):
     """Attach the configured forwarding client to a local server
-    (server.go:626-635 for the gRPC dial; flusher.go:66-75 for use)."""
+    (server.go:626-635 for the gRPC dial; flusher.go:66-75 for use).
+    Every transport flavor gets the same resilience surface from config:
+    retry policy, a breaker for the (single) upstream destination, the
+    parsed-once forward_timeout as its per-flush budget, and the fault
+    injector when a soak run configures one (docs/resilience.md)."""
+    from veneur_tpu.resilience import (CircuitBreaker, RetryPolicy,
+                                       faults_from_config)
+
     cfg = server.config
     if not cfg.forward_address:
         return None
+    timeout = getattr(cfg, "forward_timeout_seconds", 10.0)
+    resilience = dict(
+        timeout=timeout,
+        retry_policy=RetryPolicy.from_config(cfg),
+        breaker=CircuitBreaker(
+            failure_threshold=getattr(cfg, "breaker_failure_threshold", 0)
+            or 5,
+            reset_timeout=getattr(cfg, "breaker_reset_timeout_seconds", 30.0),
+            name=cfg.forward_address),
+        fault_injector=faults_from_config(cfg),
+    )
     if cfg.forward_address.startswith("native://"):
         from veneur_tpu.forward.native_transport import NativeForwarder
 
         fwd = NativeForwarder(
             cfg.forward_address,
-            reference_compat=cfg.forward_reference_compatible)
+            reference_compat=cfg.forward_reference_compatible,
+            **resilience)
         if not cfg.forward_packed_digests:
             fwd.wants_packed_digests = False
     elif cfg.forward_use_grpc:
         fwd = GRPCForwarder(
             cfg.forward_address,
-            reference_compat=cfg.forward_reference_compatible)
+            reference_compat=cfg.forward_reference_compatible,
+            **resilience)
         # rolling-upgrade escape hatch: a pre-round-4 global skips the
         # quantized wire fields (tdigest 16/17) and would import empty
         # digests — let operators keep the dense f64 wire until every
@@ -64,6 +84,7 @@ def configure_forwarding(server):
     else:
         fwd = HTTPForwarder(
             cfg.forward_address,
-            reference_compat=cfg.forward_reference_compatible)
+            reference_compat=cfg.forward_reference_compatible,
+            **resilience)
     server.forward_fn = fwd.forward
     return fwd

@@ -90,13 +90,22 @@ class GRPCForwarder:
 
     def __init__(self, addr: str, timeout: float = 10.0,
                  compression: float = 100.0,
-                 reference_compat: bool = False):
+                 reference_compat: bool = False,
+                 retry_policy=None, breaker=None, fault_injector=None):
+        from veneur_tpu.resilience import RetryPolicy
+
         if addr.startswith(("http://", "grpc://")):
             addr = addr.split("://", 1)[1]
         self.addr = addr
         self.timeout = timeout
         self.compression = compression
         self.reference_compat = reference_compat
+        # resilience: per-frame retry within the flush deadline (the
+        # channel redials transparently; the retry covers the RPC),
+        # optional destination breaker, optional fault injection
+        self.retry_policy = retry_policy or RetryPolicy()
+        self.breaker = breaker
+        self._faults = fault_injector
         # the heavy-hitter sketch rides MetricList.topk, an extension
         # field a reference global would skip — keep it off the wire
         # entirely when forwarding into a reference fleet (the local
@@ -125,6 +134,7 @@ class GRPCForwarder:
         self._lock = threading.Lock()
         self.forwarded = 0
         self.errors = 0
+        self.retries = 0
         # per-send telemetry, drained into veneur.forward.* self-metrics
         self.post_durations = []
         self.post_content_lengths = []
@@ -132,7 +142,43 @@ class GRPCForwarder:
     # native MetricList chunks cap well under the channel's 256 MB limit
     CHUNK_BYTES = 64 * 1024 * 1024
 
-    def forward(self, state, parent_span=None):
+    # status codes worth a retry: transient server/transport conditions,
+    # the gRPC analogue of 5xx/429 (a failed-precondition or invalid-
+    # argument response would fail identically on every attempt)
+    _RETRYABLE_CODES = frozenset((
+        grpc.StatusCode.UNAVAILABLE,
+        grpc.StatusCode.DEADLINE_EXCEEDED,
+        grpc.StatusCode.RESOURCE_EXHAUSTED,
+        grpc.StatusCode.ABORTED,
+        grpc.StatusCode.UNKNOWN,
+    ))
+
+    def _retryable_rpc(self, e) -> bool:
+        code = e.code() if isinstance(e, grpc.RpcError) else None
+        return code in self._RETRYABLE_CODES or isinstance(e, OSError)
+
+    def _count_retry(self, retry_index, exc, pause):
+        with self._lock:
+            self.retries += 1
+
+    def _rejected_by_breaker(self, consume_probe: bool) -> bool:
+        """The shared breaker gate: blocked() before the (expensive)
+        digest encode is paid (never consumes a half-open probe),
+        allow() at the send site (counts the probe)."""
+        if self.breaker is None:
+            return False
+        rejected = (not self.breaker.allow()) if consume_probe \
+            else self.breaker.blocked()
+        if rejected:
+            with self._lock:
+                self.errors += 1
+            log.warning("gRPC forward to %s skipped: circuit breaker "
+                        "open", self.addr)
+        return rejected
+
+    def forward(self, state, parent_span=None, deadline=None):
+        if self._rejected_by_breaker(consume_probe=False):
+            return
         # columnar digest planes encode natively — serialized MetricList
         # chunks straight from the packed arrays, no per-row Python
         # (flusher.go:424-473; the chunking bounds message size the way
@@ -148,19 +194,49 @@ class GRPCForwarder:
             metadata = tuple(
                 (k.lower(), v)
                 for k, v in parent_span.context_as_parent().items())
+        from veneur_tpu.resilience import Deadline, call_with_retry
+
         total = sum(rows for _, rows in frames)
         sent_rows = 0
         attempted_lens = []  # only frames actually put on the wire
         t0 = time.perf_counter()
+        if deadline is None:
+            deadline = Deadline.after(self.timeout)
+        if self._rejected_by_breaker(consume_probe=True):
+            return
         try:
+            # per-frame retry: already-sent frames are merged upstream
+            # and never resend; each attempt's RPC deadline is clamped
+            # so retries cannot overrun the flush interval
             for payload, rows in frames:
-                attempted_lens.append(len(payload))
-                self._send_raw(payload, timeout=self.timeout,
-                               metadata=metadata)
+                def send_frame(payload=payload):
+                    if self._faults is not None:
+                        self._faults.maybe_fail("forward.grpc")
+                    attempted_lens.append(len(payload))
+                    self._send_raw(payload,
+                                   timeout=deadline.clamp(self.timeout),
+                                   metadata=metadata)
+
+                call_with_retry(
+                    send_frame, self.retry_policy, deadline=deadline,
+                    retryable=(grpc.RpcError, OSError),
+                    retry_if=self._retryable_rpc,
+                    on_retry=self._count_retry)
                 sent_rows += rows
+            if self.breaker is not None:
+                self.breaker.record_success()
             with self._lock:
                 self.forwarded += sent_rows
-        except grpc.RpcError as e:
+        except (grpc.RpcError, OSError) as e:
+            # the gRPC analogue of the 4xx rule: a permanent status
+            # (INVALID_ARGUMENT, FAILED_PRECONDITION, ...) proves the
+            # destination is alive and must not trip its breaker —
+            # only transport-level/transient codes count
+            if self.breaker is not None:
+                if self._retryable_rpc(e):
+                    self.breaker.record_failure()
+                else:
+                    self.breaker.record_success()
             with self._lock:
                 self.errors += 1
                 self.forwarded += sent_rows

@@ -18,6 +18,8 @@ from typing import List
 
 from veneur_tpu.forward.convert import (json_metrics_from_state,
                                         reference_json_metrics_from_state)
+from veneur_tpu.resilience import (Deadline, RetryPolicy,
+                                   is_transient_status, post_with_retry)
 
 log = logging.getLogger("veneur.forward.http")
 
@@ -63,7 +65,9 @@ class HTTPForwarder:
 
     def __init__(self, addr: str, timeout: float = 10.0,
                  compression: float = 100.0,
-                 reference_compat: bool = False):
+                 reference_compat: bool = False,
+                 retry_policy: RetryPolicy = None,
+                 breaker=None, fault_injector=None):
         self.base = addr.rstrip("/")
         if not self.base.startswith(("http://", "https://")):
             self.base = "http://" + self.base
@@ -75,16 +79,51 @@ class HTTPForwarder:
         # has the local emit its own top-k instead)
         self.reference_compat = reference_compat
         self.supports_topk = not reference_compat
+        # resilience: shared retry/backoff within the flush deadline,
+        # optional destination breaker, optional fault injection
+        self.retry_policy = retry_policy or RetryPolicy()
+        self.breaker = breaker
+        self._faults = fault_injector
         # forward() runs on a fresh thread each flush; guard the counters
         self._lock = threading.Lock()
         self.forwarded = 0
         self.errors = 0
+        self.retries = 0
         # per-POST telemetry, drained by the flusher into the canonical
         # veneur.forward.* self-metrics (README.md:260-266)
         self.post_durations: List[float] = []
         self.post_content_lengths: List[int] = []
 
-    def forward(self, state, parent_span=None):
+    def _count_retry(self, retry_index, exc, pause):
+        with self._lock:
+            self.retries += 1
+
+    def _post(self, *args, **kwargs) -> int:
+        # resolve post_helper at call time (tests monkeypatch the
+        # module-level name); the fault wrap applies per call
+        fn = post_helper
+        if self._faults is not None:
+            fn = self._faults.wrap_post(fn, "forward.http")
+        return fn(*args, **kwargs)
+
+    def _rejected_by_breaker(self, consume_probe: bool) -> bool:
+        """The shared breaker gate: blocked() before serialization is
+        paid (never consumes a half-open probe), allow() at the send
+        site (counts the probe). Rejections count as errors."""
+        if self.breaker is None:
+            return False
+        rejected = (not self.breaker.allow()) if consume_probe \
+            else self.breaker.blocked()
+        if rejected:
+            with self._lock:
+                self.errors += 1
+            log.warning("forward to %s skipped: circuit breaker open",
+                        self.base)
+        return rejected
+
+    def forward(self, state, parent_span=None, deadline=None):
+        if self._rejected_by_breaker(consume_probe=False):
+            return
         # the JSON wire is per-row; columnar digest planes (a columnar
         # flush with gRPC-style planes) materialize to tuples first
         state.materialize_digests()
@@ -104,17 +143,38 @@ class HTTPForwarder:
             headers = parent_span.context_as_parent()
         info = {}
         t0 = time.perf_counter()
+        # the flush deadline bounds every attempt + backoff sleep; a
+        # standalone forward (no flusher) budgets its own timeout
+        if deadline is None:
+            deadline = Deadline.after(self.timeout)
+        if self._rejected_by_breaker(consume_probe=True):
+            return
         try:
-            status = post_helper(url, metrics, timeout=self.timeout,
-                                 headers=headers, out_info=info)
+            status = post_with_retry(
+                lambda: self._post(url, metrics,
+                                   timeout=deadline.clamp(self.timeout),
+                                   headers=headers, out_info=info),
+                self.retry_policy, deadline=deadline,
+                on_retry=self._count_retry)
             if 200 <= status < 300:
+                if self.breaker is not None:
+                    self.breaker.record_success()
                 with self._lock:
                     self.forwarded += len(metrics)
             else:
+                # a 4xx still proves the destination is alive; only
+                # transient statuses (5xx/429) count toward tripping
+                if self.breaker is not None:
+                    if is_transient_status(status):
+                        self.breaker.record_failure()
+                    else:
+                        self.breaker.record_success()
                 with self._lock:
                     self.errors += 1
                 log.warning("forward to %s returned HTTP %d", url, status)
         except (urllib.error.URLError, OSError) as e:
+            if self.breaker is not None:
+                self.breaker.record_failure()
             with self._lock:
                 self.errors += 1
             log.warning("failed to forward %d metrics to %s: %s",

@@ -205,7 +205,10 @@ class NativeForwarder:
 
     def __init__(self, addr: str, timeout: float = 10.0,
                  compression: float = 100.0,
-                 reference_compat: bool = False):
+                 reference_compat: bool = False,
+                 retry_policy=None, breaker=None, fault_injector=None):
+        from veneur_tpu.resilience import RetryPolicy
+
         if addr.startswith("native://"):
             addr = addr[len("native://"):]
         host, _, port = addr.rpartition(":")
@@ -215,24 +218,50 @@ class NativeForwarder:
         self.reference_compat = reference_compat
         self.supports_topk = not reference_compat
         self.wants_packed_digests = not reference_compat
+        # resilience: the shared retry loop replaces the old ad-hoc
+        # "one fresh-connection retry if nothing was acked" special case
+        # — a stale kept-alive connection is now just the first retry
+        self.retry_policy = retry_policy or RetryPolicy()
+        self.breaker = breaker
+        self._faults = fault_injector
         self._sock: Optional[socket.socket] = None
         self._lock = threading.Lock()
         self.forwarded = 0
         self.errors = 0
+        self.retries = 0
         # per-send telemetry, drained into veneur.forward.* self-metrics
         self.post_durations = []
         self.post_content_lengths = []
 
-    def _connect(self) -> socket.socket:
+    def _connect(self, deadline=None) -> socket.socket:
+        timeout = (deadline.clamp(self.timeout) if deadline is not None
+                   else self.timeout)
         s = socket.create_connection((self._host, self._port),
-                                     timeout=self.timeout)
-        s.settimeout(self.timeout)
+                                     timeout=timeout)
+        s.settimeout(timeout)
         s.sendall(MAGIC)
         return s
 
-    def forward(self, state, parent_span=None):
+    def _rejected_by_breaker(self, consume_probe: bool) -> bool:
+        """The shared breaker gate: blocked() before serialization is
+        paid (never consumes a half-open probe), allow() at the send
+        site (counts the probe). Rejections count as errors."""
+        if self.breaker is None:
+            return False
+        rejected = (not self.breaker.allow()) if consume_probe \
+            else self.breaker.blocked()
+        if rejected:
+            with self._lock:
+                self.errors += 1
+            log.warning("native forward to %s:%d skipped: circuit "
+                        "breaker open", self._host, self._port)
+        return rejected
+
+    def forward(self, state, parent_span=None, deadline=None):
         from veneur_tpu.forward.grpc_forward import encode_forwardable_frames
 
+        if self._rejected_by_breaker(consume_probe=False):
+            return
         frames = encode_forwardable_frames(
             state, self.compression, self.reference_compat,
             self.CHUNK_BYTES)
@@ -242,58 +271,86 @@ class NativeForwarder:
         attempted_lens: list = []  # only frames actually put on the wire
         t_start = time.perf_counter()
         try:
-            self._forward_frames(frames, total, attempted_lens)
+            self._forward_frames(frames, total, attempted_lens, deadline)
         finally:
             with self._lock:
                 self.post_durations.append(time.perf_counter() - t_start)
                 self.post_content_lengths.extend(attempted_lens)
 
-    def _forward_frames(self, frames, total, attempted_lens):
-        # a kept-alive connection can be stale (global restarted while
-        # we idled): if NOTHING was acked yet, one fresh-connection
-        # retry costs nothing and saves the interval
-        attempts = 2 if self._sock is not None else 1
-        for attempt in range(attempts):
-            sent_rows = 0
+    def _drop_socket(self):
+        if self._sock is not None:
             try:
-                if self._sock is None:
-                    self._sock = self._connect()
-                for payload, rows in frames:
-                    attempted_lens.append(len(payload))
-                    self._sock.sendall(struct.pack(">I", len(payload)))
-                    self._sock.sendall(payload)
-                    ack = _read_exact(self._sock, 4)
-                    if ack is None or len(ack) < 4:
-                        raise OSError("connection closed mid-ack")
-                    (merged,) = struct.unpack(">I", ack)
-                    if merged == ACK_ERROR:
-                        raise OSError("global rejected the frame")
-                    sent_rows += rows
-                with self._lock:
-                    self.forwarded += sent_rows
-                return
-            except OSError as e:
-                # drop the connection; retry now (stale case) or let the
-                # next interval reconnect
-                if self._sock is not None:
-                    try:
-                        self._sock.close()
-                    except OSError:
-                        pass
-                    self._sock = None
-                if attempt + 1 < attempts and sent_rows == 0:
-                    log.debug("native forward: stale connection to "
-                              "%s:%d, retrying fresh: %s", self._host,
-                              self._port, e)
-                    continue
-                with self._lock:
-                    self.errors += 1
-                    self.forwarded += sent_rows
-                log.warning("failed to forward %d metrics to "
-                            "native://%s:%d (~%d sent before the "
-                            "failure): %s", total, self._host,
-                            self._port, sent_rows, e)
-                return
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
+
+    def _forward_frames(self, frames, total, attempted_lens, deadline=None):
+        from veneur_tpu.resilience import Deadline, call_with_retry
+
+        if deadline is None:
+            deadline = Deadline.after(self.timeout)
+        if self._rejected_by_breaker(consume_probe=True):
+            return
+        # retries are allowed only while NOTHING has been acked (the
+        # old reconnect loop's rule, kept deliberately): after partial
+        # progress a resend of the in-flight frame could double-merge
+        # upstream if its ack — not the frame — was what got lost, so a
+        # mid-flush failure gives up (at-most-once after progress). The
+        # no-progress case keeps the first frame's ack-loss exposure
+        # the old code had; the framing protocol has no dedupe.
+        sent_rows = 0
+        next_frame = 0
+
+        def attempt():
+            nonlocal sent_rows, next_frame
+            if self._faults is not None:
+                self._faults.maybe_fail("forward.native")
+            if self._sock is None:
+                self._sock = self._connect(deadline)
+            while next_frame < len(frames):
+                payload, rows = frames[next_frame]
+                attempted_lens.append(len(payload))
+                self._sock.sendall(struct.pack(">I", len(payload)))
+                self._sock.sendall(payload)
+                ack = _read_exact(self._sock, 4)
+                if ack is None or len(ack) < 4:
+                    raise OSError("connection closed mid-ack")
+                (merged,) = struct.unpack(">I", ack)
+                if merged == ACK_ERROR:
+                    raise OSError("global rejected the frame")
+                sent_rows += rows
+                next_frame += 1
+
+        def on_retry(retry_index, exc, pause):
+            # retries run against a fresh connection
+            self._drop_socket()
+            with self._lock:
+                self.retries += 1
+            log.debug("native forward to %s:%d retrying (frame %d/%d): "
+                      "%s", self._host, self._port, next_frame,
+                      len(frames), exc)
+
+        try:
+            call_with_retry(attempt, self.retry_policy, deadline=deadline,
+                            retryable=(OSError,),
+                            retry_if=lambda e: sent_rows == 0,
+                            on_retry=on_retry)
+            if self.breaker is not None:
+                self.breaker.record_success()
+            with self._lock:
+                self.forwarded += sent_rows
+        except OSError as e:
+            self._drop_socket()
+            if self.breaker is not None:
+                self.breaker.record_failure()
+            with self._lock:
+                self.errors += 1
+                self.forwarded += sent_rows
+            log.warning("failed to forward %d metrics to "
+                        "native://%s:%d (~%d sent before the "
+                        "failure): %s", total, self._host,
+                        self._port, sent_rows, e)
 
     def close(self):
         if self._sock is not None:

@@ -18,13 +18,17 @@ from collections import defaultdict
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, List, Optional
 
-from veneur_tpu.config import ProxyConfig, parse_duration
-from veneur_tpu.discovery import ConsulDiscoverer, Discoverer, StaticDiscoverer
+from veneur_tpu.config import ProxyConfig
+from veneur_tpu.discovery import (ConsulDiscoverer, Discoverer,
+                                  RetryingDiscoverer, StaticDiscoverer)
 from veneur_tpu.forward.http_forward import post_helper
 from veneur_tpu.httpserv import (ImportError400, ReuseportHTTPServer,
                                  bounded_inflate,
                                  unmarshal_metrics_from_http)
 from veneur_tpu.proxy.consistent import ConsistentRing, EmptyRingError
+from veneur_tpu.resilience import (BreakerRegistry, Deadline, RetryPolicy,
+                                   faults_from_config, is_transient_status,
+                                   post_with_retry)
 
 log = logging.getLogger("veneur.proxy")
 
@@ -113,10 +117,26 @@ class Proxy:
 
     def __init__(self, config: ProxyConfig,
                  discoverer: Optional[Discoverer] = None):
+        from veneur_tpu.config import parse_duration
+
         self.config = config
-        self.forward_timeout = parse_duration(config.forward_timeout or "10s")
+        if not hasattr(config, "forward_timeout_seconds"):
+            # configs built directly (tests) skip read_proxy_config
+            config.finalize()
+        # parsed ONCE at load (config.finalize); never re-parsed here
+        self.forward_timeout = config.forward_timeout_seconds
         self.refresh_interval = parse_duration(
             config.consul_refresh_interval or "30s")
+        # egress resilience: retries inside the forward_timeout deadline
+        # and one breaker per ring destination (docs/resilience.md)
+        self.retry_policy = RetryPolicy.from_config(config)
+        self.breakers = BreakerRegistry(
+            failure_threshold=config.breaker_failure_threshold,
+            reset_timeout=config.breaker_reset_timeout_seconds)
+        self.fault_injector = faults_from_config(config)
+        self._post = (self.fault_injector.wrap_post(post_helper,
+                                                    "proxy.post")
+                      if self.fault_injector is not None else post_helper)
         self.service_name = config.consul_forward_service_name
         if discoverer is not None:
             self.discoverer = discoverer
@@ -160,7 +180,10 @@ class Proxy:
         self.proxied = 0
         self.traces_proxied = 0
         self.forward_errors = 0
+        self.forward_retries = 0
+        self.breaker_rejections = 0
         self.refresh_failures = 0
+        self.refresh_retries = 0
         self._lock = threading.Lock()
 
     # -- discovery ----------------------------------------------------------
@@ -177,9 +200,20 @@ class Proxy:
     def _refresh_ring(self, discoverer: Discoverer, service_name: str,
                       ring: ConsistentRing):
         """Re-resolve one ring's membership; a failure or empty result
-        keeps the previous ring (proxy.go:337-371)."""
+        keeps the previous ring (proxy.go:337-371). A flaky discovery
+        backend gets the shared retry/backoff (RetryingDiscoverer,
+        bounded by the refresh interval) before we fall back to the
+        last good ring."""
+
+        def on_retry(retry_index, exc, pause):
+            with self._lock:
+                self.refresh_retries += 1
+
+        retrying = RetryingDiscoverer(discoverer, self.retry_policy,
+                                      budget=self.refresh_interval,
+                                      on_retry=on_retry)
         try:
-            destinations = discoverer.get_destinations_for_service(
+            destinations = retrying.get_destinations_for_service(
                 service_name)
         except Exception as e:
             with self._lock:
@@ -194,6 +228,11 @@ class Proxy:
                         len(ring))
             return
         ring.set_members(destinations)
+        # breakers for departed destinations die with the membership
+        # (bounds the registry under weeks of pod churn); both rings'
+        # members stay retained
+        self.breakers.retain(set(self.ring.members())
+                             | set(self.trace_ring.members()))
         if ring is self.ring:
             self._last_destinations = list(destinations)
             if self.grpc_server is not None:
@@ -250,18 +289,53 @@ class Proxy:
         url = dest.rstrip("/")
         if not url.startswith(("http://", "https://")):
             url = "http://" + url
-        try:
-            status = post_helper(url + path, batch, compress=compress,
-                                 timeout=self.forward_timeout)
-            if not 200 <= status < 300:
-                raise OSError(f"destination returned HTTP {status}")
+        # per-destination breaker: a black-holed global is rejected
+        # instantly (its share of the interval is lost either way; the
+        # healthy destinations' POSTs are not held hostage) and probed
+        # again after the reset timeout. Ring membership is untouched —
+        # keep-last-good-ring semantics stay with discovery.
+        breaker = self.breakers.get(dest)
+        if not breaker.allow():
             with self._lock:
-                setattr(self, counter, getattr(self, counter) + len(batch))
+                self.forward_errors += 1
+                self.breaker_rejections += 1
+            log.debug("skipping %d %s to %s: circuit breaker open",
+                      len(batch), what, dest)
+            return
+
+        def on_retry(retry_index, exc, pause):
+            with self._lock:
+                self.forward_retries += 1
+
+        deadline = Deadline.after(self.forward_timeout)
+        try:
+            status = post_with_retry(
+                lambda: self._post(url + path, batch, compress=compress,
+                                   timeout=deadline.clamp(
+                                       self.forward_timeout)),
+                self.retry_policy, deadline=deadline, on_retry=on_retry)
         except Exception as e:
+            breaker.record_failure()
             with self._lock:
                 self.forward_errors += 1
             log.warning("failed to proxy %d %s to %s: %s",
                         len(batch), what, dest, e)
+            return
+        if 200 <= status < 300:
+            breaker.record_success()
+            with self._lock:
+                setattr(self, counter, getattr(self, counter) + len(batch))
+            return
+        # a 4xx still proves the destination is alive; only transient
+        # statuses (5xx/429) count toward tripping its breaker
+        if is_transient_status(status):
+            breaker.record_failure()
+        else:
+            breaker.record_success()
+        with self._lock:
+            self.forward_errors += 1
+        log.warning("failed to proxy %d %s to %s: destination returned "
+                    "HTTP %d", len(batch), what, dest, status)
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -307,8 +381,11 @@ class Proxy:
                 "proxied": self.proxied,
                 "traces_proxied": self.traces_proxied,
                 "forward_errors": self.forward_errors,
+                "forward_retries": self.forward_retries,
+                "breaker_rejections": self.breaker_rejections,
                 "refresh_failures": self.refresh_failures,
-            }}
+                "refresh_retries": self.refresh_retries,
+            }, "breakers": dict(self.breakers.states())}
 
         debug.mount(
             lambda path, fn: self._httpd.veneur_get_routes.__setitem__(

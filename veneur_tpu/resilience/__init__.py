@@ -1,0 +1,32 @@
+"""Unified egress resilience: retries, circuit breakers, deadline
+propagation, and deterministic fault injection.
+
+Every egress path — HTTP/gRPC/native forwarders, the proxy's ring
+fan-out, the Datadog/SignalFx/Kafka/LightStep sinks, discovery refresh
+— shares this substrate instead of hand-rolling its own failure
+handling. See ``docs/resilience.md`` for the model.
+"""
+
+from veneur_tpu.resilience.breaker import (BreakerOpen, BreakerRegistry,
+                                           CircuitBreaker)
+from veneur_tpu.resilience.deadline import Deadline, DeadlineExceeded
+from veneur_tpu.resilience.faults import FaultInjector
+from veneur_tpu.resilience.faults import from_config as faults_from_config
+from veneur_tpu.resilience.retry import (RetryPolicy, TransientStatusError,
+                                         call_with_retry, is_transient_status,
+                                         post_with_retry)
+
+__all__ = [
+    "BreakerOpen",
+    "BreakerRegistry",
+    "CircuitBreaker",
+    "Deadline",
+    "DeadlineExceeded",
+    "FaultInjector",
+    "RetryPolicy",
+    "TransientStatusError",
+    "call_with_retry",
+    "faults_from_config",
+    "is_transient_status",
+    "post_with_retry",
+]

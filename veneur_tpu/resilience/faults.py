@@ -1,0 +1,148 @@
+"""Deterministic, seeded fault injection for egress transports.
+
+Wraps any transport callable to inject connection errors, timeouts, 5xx
+responses, and partial writes at a configured rate. The schedule is a
+pure function of ``(seed, call index)`` — two runs with the same seed
+see the same faults at the same calls, whatever the pass/fail pattern
+in between — so soak runs and the ``tests/test_resilience.py`` suite
+reproduce exactly.
+
+Enabled via config (``fault_injection_rate`` > 0 on Config/ProxyConfig,
+plus ``fault_injection_seed`` / ``_kinds`` / ``_scope``) or the matching
+``VENEUR_FAULT_INJECTION_*`` env overrides the config loader already
+applies. ``scope`` substring-filters the operation names egress paths
+pass (``forward.http``, ``sink.datadog``, ``proxy.post``, ...), so a
+soak can target one path at a time.
+"""
+
+from __future__ import annotations
+
+import logging
+import random
+import threading
+from typing import Callable, Dict, Optional, Sequence, Tuple
+
+log = logging.getLogger("veneur.resilience.faults")
+
+KIND_CONNECT = "connect"
+KIND_TIMEOUT = "timeout"
+KIND_HTTP_5XX = "http_5xx"
+KIND_PARTIAL_WRITE = "partial_write"
+ALL_KINDS = (KIND_CONNECT, KIND_TIMEOUT, KIND_HTTP_5XX, KIND_PARTIAL_WRITE)
+
+# the status wrap_post returns for an injected 5xx
+INJECTED_STATUS = 503
+
+
+class InjectedFault(Exception):
+    """Marker mixin so logs can distinguish injected from real faults."""
+
+
+class InjectedConnectError(InjectedFault, ConnectionRefusedError):
+    pass
+
+
+class InjectedTimeout(InjectedFault, TimeoutError):
+    pass
+
+
+class InjectedPartialWrite(InjectedFault, BrokenPipeError):
+    pass
+
+
+class FaultInjector:
+    """A seeded fault schedule over a stream of transport operations."""
+
+    def __init__(self, rate: float, seed: int = 0,
+                 kinds: Sequence[str] = ALL_KINDS, scope: str = ""):
+        if not 0.0 <= rate <= 1.0:
+            raise ValueError(f"fault rate must be in [0, 1], got {rate}")
+        bad = [k for k in kinds if k not in ALL_KINDS]
+        if bad:
+            raise ValueError(f"unknown fault kinds {bad}; "
+                             f"known: {list(ALL_KINDS)}")
+        self.rate = rate
+        self.seed = seed
+        self.kinds = tuple(kinds) or ALL_KINDS
+        self.scope = scope
+        self._rng = random.Random(seed)
+        self._lock = threading.Lock()
+        self.calls = 0
+        self.injected: Dict[str, int] = {k: 0 for k in self.kinds}
+
+    def should_fail(self, op: str) -> Optional[str]:
+        """The kind to inject for this call, or None. Exactly two rng
+        draws per in-scope call, fail or not, so the schedule depends
+        only on the seed and the call index."""
+        if self.scope and self.scope not in op:
+            return None
+        with self._lock:
+            self.calls += 1
+            roll = self._rng.random()
+            kind = self.kinds[self._rng.randrange(len(self.kinds))]
+            if roll >= self.rate:
+                return None
+            self.injected[kind] += 1
+        log.debug("injecting %s fault into %s (call %d)",
+                  kind, op, self.calls)
+        return kind
+
+    def maybe_fail(self, op: str) -> None:
+        """Raise the scheduled fault, if any — the hook for socket-level
+        transports, where an injected 5xx surfaces as the peer's NAK
+        (an OSError) like a real one would."""
+        kind = self.should_fail(op)
+        if kind is None:
+            return
+        if kind == KIND_CONNECT:
+            raise InjectedConnectError(f"injected connect error ({op})")
+        if kind == KIND_TIMEOUT:
+            raise InjectedTimeout(f"injected timeout ({op})")
+        if kind == KIND_PARTIAL_WRITE:
+            raise InjectedPartialWrite(f"injected partial write ({op})")
+        raise OSError(f"injected upstream 5xx ({op})")
+
+    def wrap_post(self, post: Callable[..., int], op: str) -> Callable[..., int]:
+        """Wrap a post-style callable returning an HTTP status: an
+        injected 5xx returns ``INJECTED_STATUS`` without touching the
+        real transport; connect/timeout/partial-write raise before it."""
+
+        def wrapped(*args, **kwargs) -> int:
+            kind = self.should_fail(op)
+            if kind == KIND_HTTP_5XX:
+                return INJECTED_STATUS
+            if kind == KIND_CONNECT:
+                raise InjectedConnectError(f"injected connect error ({op})")
+            if kind == KIND_TIMEOUT:
+                raise InjectedTimeout(f"injected timeout ({op})")
+            if kind == KIND_PARTIAL_WRITE:
+                raise InjectedPartialWrite(f"injected partial write ({op})")
+            return post(*args, **kwargs)
+
+        return wrapped
+
+    def schedule(self, n: int) -> Tuple[Optional[str], ...]:
+        """The next ``n`` outcomes, consumed — test/debug helper for
+        asserting seeded determinism."""
+        return tuple(self.should_fail("schedule") for _ in range(n))
+
+
+def from_config(cfg) -> Optional[FaultInjector]:
+    """Build the configured injector, or None when fault injection is
+    off (the default; rate 0 means every transport runs clean)."""
+    rate = float(getattr(cfg, "fault_injection_rate", 0.0) or 0.0)
+    if rate <= 0.0:
+        return None
+    kinds_csv = getattr(cfg, "fault_injection_kinds", "") or ""
+    kinds = tuple(k.strip() for k in kinds_csv.split(",") if k.strip()) \
+        or ALL_KINDS
+    injector = FaultInjector(
+        rate=rate,
+        seed=int(getattr(cfg, "fault_injection_seed", 0) or 0),
+        kinds=kinds,
+        scope=getattr(cfg, "fault_injection_scope", "") or "")
+    log.warning("fault injection ACTIVE: rate=%.2f seed=%d kinds=%s "
+                "scope=%r — this instance will deliberately fail egress",
+                injector.rate, injector.seed, ",".join(injector.kinds),
+                injector.scope)
+    return injector

@@ -17,12 +17,21 @@ METRIC_KEY_TOTAL_METRICS_DROPPED = "sink.metrics_dropped_total"
 class MetricSink(abc.ABC):
     """A backend receiving the full flushed-metric batch every interval."""
 
+    # the current interval's egress budget, set by the flusher before the
+    # sink's flush thread starts; retry loops clamp their backoff to it
+    # so no sink can push a flush past the interval boundary
+    # (veneur_tpu/resilience/deadline.py)
+    flush_deadline = None
+
     @property
     @abc.abstractmethod
     def name(self) -> str: ...
 
     def start(self, trace_client=None) -> None:
         """Called once at server start."""
+
+    def set_flush_deadline(self, deadline) -> None:
+        self.flush_deadline = deadline
 
     @abc.abstractmethod
     def flush(self, metrics: List[InterMetric]) -> None: ...

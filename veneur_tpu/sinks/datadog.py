@@ -31,6 +31,7 @@ import numpy as np
 from veneur_tpu.forward.http_forward import post_helper
 from veneur_tpu.protocol import constants as dogstatsd
 from veneur_tpu.protocol import wire
+from veneur_tpu.resilience import RetryPolicy, post_with_retry
 from veneur_tpu.samplers.intermetric import InterMetric, MetricType
 from veneur_tpu.sinks.base import MetricSink, SpanSink
 
@@ -64,7 +65,9 @@ class DatadogMetricSink(MetricSink):
     def __init__(self, interval: float, flush_max_per_body: int,
                  hostname: str, tags: Sequence[str], dd_hostname: str,
                  api_key: str, post: Optional[PostFn] = None,
-                 compress_level: int = 1):
+                 compress_level: int = 1,
+                 retry_policy: Optional[RetryPolicy] = None,
+                 breaker=None, fault_injector=None):
         self.interval = interval
         self.flush_max_per_body = max(1, flush_max_per_body)
         self.hostname = hostname
@@ -72,6 +75,15 @@ class DatadogMetricSink(MetricSink):
         self.dd_hostname = dd_hostname.rstrip("/")
         self.api_key = api_key
         self.post = post or _default_post
+        if fault_injector is not None:
+            self.post = fault_injector.wrap_post(self.post, "sink.datadog")
+        # resilience: transport errors and 5xx retry with backoff inside
+        # the flush deadline the flusher sets each interval; a
+        # black-holed API endpoint trips the breaker and is rejected
+        # instantly until its half-open probe succeeds
+        self.retry_policy = retry_policy or RetryPolicy()
+        self.breaker = breaker
+        self.retries = 0
         # deflate level for the native columnar serializer (level 1 runs
         # ~2x the throughput of zlib's default 6 at a ~12% ratio cost —
         # the single-core deflate IS the large-flush bottleneck)
@@ -89,6 +101,36 @@ class DatadogMetricSink(MetricSink):
     def _count_error(self) -> None:
         with self._err_lock:
             self.flush_errors += 1
+
+    def _count_retry(self, retry_index, exc, pause) -> None:
+        with self._err_lock:
+            self.retries += 1
+
+    def _resilient_post(self, call) -> int:
+        """Run a POST closure under the shared retry loop (transport
+        errors and 5xx/429, backoff clamped to the flush deadline) and
+        the destination breaker. An open breaker raises OSError so call
+        sites count it through their existing error path."""
+        from veneur_tpu.resilience import is_transient_status
+
+        if self.breaker is not None and not self.breaker.allow():
+            raise OSError("datadog circuit breaker open")
+        try:
+            status = post_with_retry(call, self.retry_policy,
+                                     deadline=self.flush_deadline,
+                                     on_retry=self._count_retry)
+        except OSError:
+            if self.breaker is not None:
+                self.breaker.record_failure()
+            raise
+        if self.breaker is not None:
+            # a 4xx still proves the destination is alive; only
+            # transient statuses count toward tripping the breaker
+            if is_transient_status(status):
+                self.breaker.record_failure()
+            else:
+                self.breaker.record_success()
+        return status
 
     def drain_flush_telemetry(self) -> List:
         with self._err_lock:
@@ -158,9 +200,9 @@ class DatadogMetricSink(MetricSink):
 
     def _flush_body(self, body: bytes) -> None:
         try:
-            status = self.post(
+            status = self._resilient_post(lambda: self.post(
                 f"{self.dd_hostname}/api/v1/series"
-                f"?api_key={self.api_key}", body, precompressed=True)
+                f"?api_key={self.api_key}", body, precompressed=True))
             if not _ok(status):
                 log.warning("Datadog series flush returned HTTP %d", status)
                 self._count_error()
@@ -175,9 +217,9 @@ class DatadogMetricSink(MetricSink):
         if checks:
             # check_run takes an array but not deflate (datadog.go:113-116)
             try:
-                status = self.post(
+                status = self._resilient_post(lambda: self.post(
                     f"{self.dd_hostname}/api/v1/check_run"
-                    f"?api_key={self.api_key}", checks, compress=False)
+                    f"?api_key={self.api_key}", checks, compress=False))
                 if not _ok(status):
                     log.warning("Datadog check_run returned HTTP %d", status)
                     self._count_error()
@@ -212,9 +254,10 @@ class DatadogMetricSink(MetricSink):
     def _flush_part(self, chunk: List[dict]) -> None:
         info = {}
         try:
-            status = self.post(f"{self.dd_hostname}/api/v1/series"
-                               f"?api_key={self.api_key}", {"series": chunk},
-                               out_info=info)
+            status = self._resilient_post(
+                lambda: self.post(f"{self.dd_hostname}/api/v1/series"
+                                  f"?api_key={self.api_key}",
+                                  {"series": chunk}, out_info=info))
             if not _ok(status):
                 log.warning("Datadog series flush returned HTTP %d", status)
                 self._count_error()
@@ -316,9 +359,9 @@ class DatadogMetricSink(MetricSink):
         if not events:
             return
         try:
-            status = self.post(
+            status = self._resilient_post(lambda: self.post(
                 f"{self.dd_hostname}/intake?api_key={self.api_key}",
-                {"events": {"api": events}})
+                {"events": {"api": events}}))
             if not _ok(status):
                 log.warning("Datadog event intake returned HTTP %d", status)
                 self._count_error()
@@ -332,7 +375,8 @@ class DatadogSpanSink(SpanSink):
     (datadog.go:359-530)."""
 
     def __init__(self, trace_address: str, buffer_size: int = 16384,
-                 post: Optional[PostFn] = None):
+                 post: Optional[PostFn] = None,
+                 retry_policy: Optional[RetryPolicy] = None):
         self.trace_address = trace_address.rstrip("/")
         self.buffer_size = buffer_size
         # deque(maxlen) == the reference's container/ring: newest
@@ -340,7 +384,13 @@ class DatadogSpanSink(SpanSink):
         self._buffer: deque = deque(maxlen=buffer_size)
         self._lock = threading.Lock()
         self.post = post or _default_post
+        self.retry_policy = retry_policy or RetryPolicy()
+        self.retries = 0
         self.spans_flushed = 0
+
+    def _count_retry(self, retry_index, exc, pause) -> None:
+        with self._lock:
+            self.retries += 1
 
     @property
     def name(self) -> str:
@@ -379,8 +429,11 @@ class DatadogSpanSink(SpanSink):
         final_traces = list(trace_map.values())
         try:
             # /v0.3/traces takes PUT without deflate (datadog.go:510-515)
-            status = self.post(f"{self.trace_address}/v0.3/traces",
-                               final_traces, compress=False, method="PUT")
+            status = post_with_retry(
+                lambda: self.post(f"{self.trace_address}/v0.3/traces",
+                                  final_traces, compress=False,
+                                  method="PUT"),
+                self.retry_policy, on_retry=self._count_retry)
             if _ok(status):
                 self.spans_flushed += len(spans)
             else:

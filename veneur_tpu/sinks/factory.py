@@ -44,10 +44,25 @@ def span_sinks_configured(config: Config) -> bool:
 
 def create_sinks(config: Config) -> Tuple[List[MetricSink], List[SpanSink],
                                           List[Plugin]]:
+    from veneur_tpu.resilience import (CircuitBreaker, RetryPolicy,
+                                       faults_from_config)
+
     metric_sinks: List[MetricSink] = []
     span_sinks: List[SpanSink] = []
     plugins: List[Plugin] = []
     interval = parse_duration(config.interval)
+    # shared egress resilience (docs/resilience.md): one retry policy
+    # from the config knobs, one breaker per sink destination, and the
+    # fault injector when a soak run configures one
+    retry_policy = RetryPolicy.from_config(config)
+    fault_injector = faults_from_config(config)
+
+    def destination_breaker(name: str) -> CircuitBreaker:
+        return CircuitBreaker(
+            failure_threshold=config.breaker_failure_threshold or 5,
+            reset_timeout=getattr(config, "breaker_reset_timeout_seconds",
+                                  30.0),
+            name=name)
 
     if config.signalfx_api_key and config.signalfx_endpoint_base:
         per_tag = {}
@@ -65,7 +80,10 @@ def create_sinks(config: Config) -> Tuple[List[MetricSink], List[SpanSink],
                                   config.signalfx_api_key),
             vary_by=config.signalfx_vary_key_by,
             per_tag_clients=per_tag,
-            excluded_tags=config.tags_exclude))
+            excluded_tags=config.tags_exclude,
+            retry_policy=retry_policy,
+            breaker=destination_breaker(config.signalfx_endpoint_base),
+            fault_injector=fault_injector))
 
     if config.datadog_api_key and config.datadog_api_hostname:
         metric_sinks.append(DatadogMetricSink(
@@ -73,11 +91,15 @@ def create_sinks(config: Config) -> Tuple[List[MetricSink], List[SpanSink],
             flush_max_per_body=config.datadog_flush_max_per_body,
             hostname=config.hostname, tags=config.tags,
             dd_hostname=config.datadog_api_hostname,
-            api_key=config.datadog_api_key))
+            api_key=config.datadog_api_key,
+            retry_policy=retry_policy,
+            breaker=destination_breaker(config.datadog_api_hostname),
+            fault_injector=fault_injector))
     if config.datadog_trace_api_address:
         span_sinks.append(DatadogSpanSink(
             trace_address=config.datadog_trace_api_address,
-            buffer_size=config.datadog_span_buffer_size))
+            buffer_size=config.datadog_span_buffer_size,
+            retry_policy=retry_policy))
 
     if config.lightstep_collector_host:
         span_sinks.append(LightStepSpanSink(
@@ -86,7 +108,8 @@ def create_sinks(config: Config) -> Tuple[List[MetricSink], List[SpanSink],
             if config.lightstep_reconnect_period else 0.0,
             maximum_spans=config.lightstep_maximum_spans or 1024,
             num_clients=config.lightstep_num_clients,
-            access_token=config.lightstep_access_token))
+            access_token=config.lightstep_access_token,
+            retry_policy=retry_policy))
 
     if config.falconer_address:
         span_sinks.append(new_falconer_span_sink(config.falconer_address))
@@ -106,7 +129,8 @@ def create_sinks(config: Config) -> Tuple[List[MetricSink], List[SpanSink],
                     buffer_messages=config.kafka_metric_buffer_messages,
                     buffer_frequency=parse_duration(
                         config.kafka_metric_buffer_frequency)
-                    if config.kafka_metric_buffer_frequency else 0.0)))
+                    if config.kafka_metric_buffer_frequency else 0.0),
+                retry_policy=retry_policy))
         if config.kafka_span_topic:
             span_sinks.append(KafkaSpanSink(
                 brokers=config.kafka_broker,

@@ -146,7 +146,10 @@ class KafkaMetricSink(MetricSink):
     def __init__(self, brokers: str, metric_topic: str,
                  check_topic: str = "", event_topic: str = "",
                  config: Optional[ProducerConfig] = None,
-                 producer: Optional[Producer] = None):
+                 producer: Optional[Producer] = None,
+                 retry_policy=None):
+        from veneur_tpu.resilience import RetryPolicy
+
         if not metric_topic:
             raise ValueError("Cannot start Kafka metric sink with no topic")
         self.brokers = brokers
@@ -155,7 +158,19 @@ class KafkaMetricSink(MetricSink):
         self.event_topic = event_topic
         self.config = config or ProducerConfig()
         self.producer = producer
+        # kafka_retry_max rides ProducerConfig.retries (kafka.go:131)
+        # and sets the attempt budget; the backoff SHAPE comes from the
+        # shared config knobs (retry_base_interval) when the factory
+        # passes them
+        shape = retry_policy or RetryPolicy(base_interval=0.05,
+                                            max_interval=1.0)
+        self.retry_policy = RetryPolicy(
+            max_attempts=self.config.retries + 1,
+            base_interval=shape.base_interval,
+            max_interval=shape.max_interval)
         self.metrics_flushed = 0
+        self.flush_errors = 0
+        self.retries = 0
 
     @property
     def name(self) -> str:
@@ -165,9 +180,19 @@ class KafkaMetricSink(MetricSink):
         if self.producer is None:
             self.producer = new_producer(self.brokers, self.config)
 
+    def _count_retry(self, retry_index, exc, pause) -> None:
+        self.retries += 1
+
     def flush(self, metrics: List[InterMetric]) -> None:
+        from veneur_tpu.resilience import call_with_retry
+
         if not metrics or self.producer is None:
             return
+        # kafka_retry_max is honored HERE for every producer flavor —
+        # the optional kafka client and the bundled wire producer apply
+        # it to their own broker round-trips, but an injected producer
+        # (tests, custom transports) previously made it a dead knob
+        policy = self.retry_policy
         for m in metrics:
             if not m.is_acceptable_to(self.name):
                 continue
@@ -176,7 +201,22 @@ class KafkaMetricSink(MetricSink):
                 "tags": m.tags, "type": m.type.value, "message": m.message,
                 "hostname": m.hostname,
             }).encode("utf-8")
-            self.producer.produce(self.metric_topic, body)
+            try:
+                # producer flavors raise different exception types
+                # (socket errors, client library errors); all retryable
+                call_with_retry(
+                    lambda body=body: self.producer.produce(
+                        self.metric_topic, body),
+                    policy, deadline=self.flush_deadline,
+                    retryable=(Exception,), on_retry=self._count_retry)
+            except Exception:
+                # one undeliverable metric must not drop the rest of
+                # the batch
+                self.flush_errors += 1
+                log.warning("kafka produce to %s failed after %d "
+                            "attempt(s)", self.metric_topic,
+                            policy.max_attempts, exc_info=True)
+                continue
             self.metrics_flushed += 1
 
 

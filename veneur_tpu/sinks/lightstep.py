@@ -29,6 +29,7 @@ from urllib.parse import urlparse
 
 from veneur_tpu.forward.http_forward import post_helper
 from veneur_tpu.protocol import wire
+from veneur_tpu.resilience import RetryPolicy
 from veneur_tpu.sinks.base import SpanSink
 
 log = logging.getLogger("veneur.sinks.lightstep")
@@ -76,22 +77,33 @@ class HTTPReportingTracer(BufferingTracer):
     Failure semantics mirror the reference's client behavior: the batch
     in flight is dropped on a failed POST (spans are telemetry, not
     durable data), the buffer keeps absorbing new spans with
-    oldest-first drop, and retry waits back off linearly — the
-    batch-full wake is ignored while failing, so an outage under load
-    cannot turn into a tight connect loop (cf. trace/backend.go:135-180).
+    oldest-first drop, and retry waits back off exponentially with full
+    jitter (the shared ``resilience.RetryPolicy`` shape, floored at one
+    report interval) — the batch-full wake is ignored while failing, so
+    an outage under load cannot turn into a tight connect loop
+    (cf. trace/backend.go:135-180).
     """
 
     def __init__(self, host: str, port: int, plaintext: bool,
                  access_token: str, max_spans: int = 1024,
                  report_interval: float = 1.0, max_batch: int = 512,
-                 reconnect_period: float = 0.0, **_unused):
+                 reconnect_period: float = 0.0,
+                 retry_policy: Optional[RetryPolicy] = None,
+                 **_unused):
         super().__init__(max_spans=max_spans)
         scheme = "http" if plaintext else "https"
         self.url = f"{scheme}://{host}:{port}{REPORT_PATH}"
         self.access_token = access_token
         self.max_batch = max_batch
         self.report_interval = report_interval
+        # backoff shape only (the reporter loop never gives up; the
+        # buffer's oldest-first drop is the budget): base doubles from
+        # one report interval, capped at 32 intervals
+        self.retry_policy = retry_policy or RetryPolicy(
+            max_attempts=1, base_interval=report_interval,
+            max_interval=report_interval * 32)
         self.reported = 0
+        self.retries = 0
         self._wake = threading.Event()
         self._stop = threading.Event()
         self._failures = 0
@@ -128,9 +140,13 @@ class HTTPReportingTracer(BufferingTracer):
         while not self._stop.is_set():
             if self._failures:
                 # honor the backoff even if report() keeps setting the
-                # batch-full wake during an outage
-                self._stop.wait(self.report_interval
-                                * min(self._failures, 5))
+                # batch-full wake during an outage; exponential full
+                # jitter, floored at one report interval so a run of
+                # small jitter draws cannot tighten into a connect loop
+                pause = max(self.report_interval,
+                            self.retry_policy.backoff(self._failures - 1))
+                self.retries += 1
+                self._stop.wait(pause)
                 self._wake.clear()
             else:
                 self._wake.wait(timeout=self.report_interval)
@@ -163,7 +179,8 @@ class LightStepSpanSink(SpanSink):
     def __init__(self, collector: str, reconnect_period: float = 0.0,
                  maximum_spans: int = 1024, num_clients: int = 1,
                  access_token: str = "",
-                 tracer_factory: Optional[Callable[..., object]] = None):
+                 tracer_factory: Optional[Callable[..., object]] = None,
+                 retry_policy: Optional[RetryPolicy] = None):
         host = urlparse(collector if "//" in collector
                         else "//" + collector)
         try:
@@ -192,13 +209,17 @@ class LightStepSpanSink(SpanSink):
             factory = HTTPReportingTracer
         else:
             factory = lambda **kw: BufferingTracer(max_spans=maximum_spans)
-        self.tracers = [
-            factory(host=self.host, port=self.port,
-                    plaintext=self.plaintext, access_token=access_token,
-                    max_spans=maximum_spans,
-                    reconnect_period=self.reconnect_period)
-            for _ in range(n)
-        ]
+        tracer_kwargs = dict(host=self.host, port=self.port,
+                             plaintext=self.plaintext,
+                             access_token=access_token,
+                             max_spans=maximum_spans,
+                             reconnect_period=self.reconnect_period)
+        if retry_policy is not None:
+            # the config-driven backoff shape reaches the reporter;
+            # omitted (None) keeps the kwarg out so custom injected
+            # factories need not accept it
+            tracer_kwargs["retry_policy"] = retry_policy
+        self.tracers = [factory(**tracer_kwargs) for _ in range(n)]
         self._lock = threading.Lock()
         self._service_count: Dict[str, int] = {}
 

@@ -27,6 +27,7 @@ from typing import Dict, List, Optional, Sequence
 
 from veneur_tpu.forward.http_forward import post_helper
 from veneur_tpu.protocol import constants as dogstatsd
+from veneur_tpu.resilience import RetryPolicy, post_with_retry
 from veneur_tpu.samplers.intermetric import InterMetric, MetricType
 from veneur_tpu.sinks.base import MetricSink
 
@@ -53,9 +54,12 @@ class SignalFxClient:
                            headers={"X-Sf-Token": self.api_key})
 
     def submit(self, datapoints: List[dict]) -> int:
+        # non-destructive (no dp.pop): the retry loop may call submit
+        # again with the same datapoint list
         body: Dict[str, List[dict]] = {}
         for dp in datapoints:
-            body.setdefault(dp.pop("_sfx_type"), []).append(dp)
+            body.setdefault(dp.get("_sfx_type", "gauge"), []).append(
+                {k: v for k, v in dp.items() if k != "_sfx_type"})
         return self._post("/v2/datapoint", body)
 
     def submit_raw(self, body: bytes) -> int:
@@ -79,7 +83,9 @@ class SignalFxSink(MetricSink):
                  client: Optional[SignalFxClient] = None,
                  vary_by: str = "",
                  per_tag_clients: Optional[Dict[str, SignalFxClient]] = None,
-                 excluded_tags: Optional[Sequence[str]] = None):
+                 excluded_tags: Optional[Sequence[str]] = None,
+                 retry_policy: Optional[RetryPolicy] = None,
+                 breaker=None, fault_injector=None):
         self.hostname_tag = hostname_tag
         self.hostname = hostname
         self.common_dimensions = dict(common_dimensions or {})
@@ -87,6 +93,15 @@ class SignalFxSink(MetricSink):
         self.vary_by = vary_by
         self.clients_by_tag_value = dict(per_tag_clients or {})
         self.excluded_tags = set(excluded_tags or ())
+        # resilience: every submit (datapoints, raw bodies, events)
+        # retries transport errors and 5xx with backoff clamped to the
+        # flush deadline; one breaker covers the ingest endpoint
+        self.retry_policy = retry_policy or RetryPolicy()
+        self.breaker = breaker
+        self._faults = fault_injector
+        self._retry_lock = threading.Lock()
+        self.retries = 0
+        self.flush_errors = 0
         self.metrics_flushed = 0
         self.metrics_skipped = 0
         self.events_reported = 0
@@ -98,6 +113,39 @@ class SignalFxSink(MetricSink):
     def set_excluded_tags(self, excludes: Sequence[str]) -> None:
         """SetExcludedTags (signalfx.go:255-262)."""
         self.excluded_tags = set(excludes)
+
+    def _count_retry(self, retry_index, exc, pause) -> None:
+        with self._retry_lock:
+            self.retries += 1
+
+    def _count_error(self) -> None:
+        with self._retry_lock:
+            self.flush_errors += 1
+
+    def _resilient_submit(self, call) -> int:
+        """Run a submit closure under the shared retry loop and the
+        ingest-endpoint breaker; an open breaker raises OSError so call
+        sites log it through their existing error path."""
+        from veneur_tpu.resilience import is_transient_status
+
+        if self.breaker is not None and not self.breaker.allow():
+            raise OSError("signalfx circuit breaker open")
+        wrapped = (self._faults.wrap_post(call, "sink.signalfx")
+                   if self._faults is not None else call)
+        try:
+            status = post_with_retry(wrapped, self.retry_policy,
+                                     deadline=self.flush_deadline,
+                                     on_retry=self._count_retry)
+        except OSError:
+            if self.breaker is not None:
+                self.breaker.record_failure()
+            raise
+        if self.breaker is not None:
+            if is_transient_status(status):
+                self.breaker.record_failure()
+            else:
+                self.breaker.record_success()
+        return status
 
     def _client(self, key: str) -> SignalFxClient:
         return self.clients_by_tag_value.get(key, self.default_client)
@@ -155,12 +203,15 @@ class SignalFxSink(MetricSink):
 
         def submit_one(body: bytes) -> None:
             try:
-                status = self.default_client.submit_raw(body)
+                status = self._resilient_submit(
+                    lambda: self.default_client.submit_raw(body))
                 if status >= 300:
                     log.warning("signalfx datapoint submit returned "
                                 "HTTP %d", status)
+                    self._count_error()
             except OSError:
                 log.warning("could not submit to signalfx", exc_info=True)
+                self._count_error()
 
         threads = []
         for body in submissions:
@@ -209,12 +260,14 @@ class SignalFxSink(MetricSink):
 
     def _submit_one(self, client: SignalFxClient, points: List[dict]) -> None:
         try:
-            status = client.submit(points)
+            status = self._resilient_submit(lambda: client.submit(points))
             if status >= 300:
                 log.warning("signalfx datapoint submit returned HTTP %d "
                             "(%d points dropped)", status, len(points))
+                self._count_error()
         except OSError:
             log.warning("could not submit to signalfx", exc_info=True)
+            self._count_error()
 
     def flush_other_samples(self, samples) -> None:
         """Events only; other sample kinds are ignored
@@ -247,12 +300,15 @@ class SignalFxSink(MetricSink):
                 "timestamp": sample.timestamp * 1000,
             }
             try:
-                status = self.default_client.submit_event(event)
+                status = self._resilient_submit(
+                    lambda: self.default_client.submit_event(event))
                 if status >= 300:
                     log.warning("signalfx event submit returned HTTP %d",
                                 status)
+                    self._count_error()
                 else:
                     self.events_reported += 1
             except OSError:
                 log.warning("could not submit event to signalfx",
                             exc_info=True)
+                self._count_error()
