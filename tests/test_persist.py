@@ -1,0 +1,504 @@
+"""Crash-safe aggregation state: checkpoint round-trip, malformed-file
+discards, the flush-epoch write guard, truncate-on-flush, warm-restart
+recovery through a real Server, flush-staleness readiness, the flush
+watchdog, and the span-channel config validation fix.
+
+Everything here is tier-1 fast; the SIGKILL subprocess soak lives in
+``tests/test_persist_e2e.py`` (marker: ``slow``).
+"""
+
+import os
+import struct
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from veneur_tpu.config import Config, read_config
+from veneur_tpu.core.store import MetricStore
+from veneur_tpu.persist import (Checkpointer, CheckpointInvalid,
+                                deserialize, serialize, write_atomic)
+from veneur_tpu.samplers.intermetric import HistogramAggregates
+from veneur_tpu.samplers.parser import parse_metric
+from veneur_tpu.server import Server
+from veneur_tpu.sinks import ChannelMetricSink
+
+AGG = HistogramAggregates.from_names(["min", "max", "count", "sum"])
+
+
+def make_store(**kw):
+    kw.setdefault("initial_capacity", 32)
+    kw.setdefault("chunk", 128)
+    return MetricStore(**kw)
+
+
+def populate(store):
+    for _ in range(5):
+        store.process_metric(parse_metric(b"c1:2|c"))
+    store.process_metric(parse_metric(b"g1:7.5|g"))
+    store.process_metric(parse_metric(b"gc:3|c|#veneurglobalonly"))
+    for v in range(1, 21):
+        store.process_metric(parse_metric(f"h1:{v}|h|#env:dev".encode()))
+        store.process_metric(parse_metric(f"t1:{v}|ms".encode()))
+    for m in ("a", "b", "c", "a"):
+        store.process_metric(parse_metric(f"s1:{m}|s".encode()))
+    store.process_metric(parse_metric(b"hh:x|s|#veneurtopk"))
+    store.process_metric(parse_metric(b"hh:x|s|#veneurtopk"))
+    store.process_metric(parse_metric(b"hh:y|s|#veneurtopk"))
+
+
+def emissions(store, is_local=False):
+    final, fwd, ms = store.flush([0.5, 0.99], AGG, is_local=is_local,
+                                 now=100, forward=False, columnar=False)
+    return {(m.name, tuple(m.tags)): m.value for m in final}
+
+
+def checkpoint_bytes(store):
+    groups, _ = store.snapshot_state()
+    return serialize(groups, created_at=time.time(), interval=10.0)
+
+
+class TestRoundTrip:
+    @pytest.mark.parametrize("storage", ["dense", "slab"])
+    def test_full_state_roundtrip(self, tmp_path, storage):
+        kw = {"digest_storage": storage}
+        if storage == "slab":
+            kw["slab_rows"] = 256
+        store = make_store(**kw)
+        populate(store)
+        blob = checkpoint_bytes(store)
+        groups, manifest = deserialize(blob)
+
+        restored = make_store(**kw)
+        merged = restored.restore_state(groups)
+        assert merged > 0
+
+        want = emissions(store)
+        got = emissions(restored)
+        assert set(want) == set(got)
+        for key, v in want.items():
+            assert got[key] == pytest.approx(v, rel=1e-4), key
+
+    def test_snapshot_does_not_reset(self):
+        store = make_store()
+        populate(store)
+        store.snapshot_state()
+        # the full interval still flushes after the snapshot
+        e = emissions(store)
+        assert e[("c1", ())] == 10.0
+        assert e[("h1.count", ("env:dev",))] == 20.0
+
+    def test_restore_composes_with_live_traffic(self):
+        # recovery MERGES (import semantics): post-restart samples for
+        # the same series combine with the recovered state
+        store = make_store()
+        populate(store)
+        groups, _ = deserialize(checkpoint_bytes(store))[0], None
+        restored = make_store()
+        restored.restore_state(groups)
+        restored.process_metric(parse_metric(b"c1:2|c"))
+        for v in (30, 40):
+            restored.process_metric(
+                parse_metric(f"h1:{v}|h|#env:dev".encode()))
+        e = emissions(restored)
+        assert e[("c1", ())] == 12.0
+        assert e[("h1.count", ("env:dev",))] == 22.0
+        assert e[("h1.max", ("env:dev",))] == 40.0
+
+    def test_local_role_forwards_recovered_digests(self):
+        # a recovered LOCAL still forwards mergeable sketch state
+        store = make_store()
+        populate(store)
+        groups, _ = deserialize(checkpoint_bytes(store))
+        restored = make_store()
+        restored.restore_state(groups)
+        final, fwd, _ = restored.flush([0.5], AGG, is_local=True, now=1,
+                                       forward=True, columnar=False)
+        names = {h[0] for h in fwd.histograms}
+        assert "h1" in names
+        assert any(n == "gc" for n, _, _ in fwd.counters)
+        assert any(n == "s1" for n, _, _, _ in fwd.sets)
+
+    def test_hll_precision_mismatch_skips_only_sets(self):
+        store = make_store(hll_precision=12)
+        populate(store)
+        groups, _ = deserialize(checkpoint_bytes(store))
+        restored = make_store(hll_precision=14)
+        restored.restore_state(groups)
+        e = emissions(restored)
+        assert ("s1", ()) not in e          # skipped: wrong geometry
+        assert e[("c1", ())] == 10.0        # everything else restored
+
+
+class TestMalformedCheckpoints:
+    def _valid_blob(self):
+        store = make_store()
+        populate(store)
+        return checkpoint_bytes(store)
+
+    @pytest.mark.parametrize("name,corrupt", [
+        ("truncated", lambda b: b[: len(b) // 2]),
+        ("crc_flip", lambda b: b[:60] + bytes([b[60] ^ 0xFF]) + b[61:]),
+        ("bad_magic", lambda b: b"XXXX" + b[4:]),
+        ("bad_version", lambda b: b[:4] + struct.pack("<H", 99) + b[6:]),
+        ("garbage", lambda b: b"definitely not a checkpoint"),
+        ("empty", lambda b: b""),
+    ])
+    def test_discarded_cleanly(self, tmp_path, name, corrupt):
+        path = str(tmp_path / "v.ckpt")
+        with open(path, "wb") as f:
+            f.write(corrupt(self._valid_blob()))
+        store = make_store()
+        ck = Checkpointer(store, path, interval_s=1.0, max_age_s=3600)
+        assert ck.restore() == 0          # counted, never raised
+        assert ck.discard_total == 1
+        assert not os.path.exists(path)   # invalidated
+        assert emissions(store) == {}     # nothing half-applied
+
+    def test_deserialize_raises_typed(self):
+        blob = self._valid_blob()
+        with pytest.raises(CheckpointInvalid) as ei:
+            deserialize(blob[:10])
+        assert ei.value.reason == "truncated"
+
+    def test_stale_checkpoint_discarded(self, tmp_path):
+        path = str(tmp_path / "v.ckpt")
+        store = make_store()
+        populate(store)
+        groups, _ = store.snapshot_state()
+        write_atomic(path, serialize(groups,
+                                     created_at=time.time() - 3600,
+                                     interval=10.0))
+        fresh = make_store()
+        ck = Checkpointer(fresh, path, interval_s=1.0, max_age_s=20.0)
+        assert ck.restore() == 0
+        assert ck.discard_total == 1
+        assert not os.path.exists(path)
+
+
+class TestCheckpointer:
+    def test_atomic_write_leaves_no_scratch(self, tmp_path):
+        path = str(tmp_path / "v.ckpt")
+        store = make_store()
+        populate(store)
+        ck = Checkpointer(store, path, interval_s=1.0, max_age_s=3600)
+        assert ck.write_once()
+        assert os.path.exists(path)
+        assert not os.path.exists(path + ".tmp")
+        assert ck.last_write_bytes == os.path.getsize(path)
+        assert ck.last_write_duration_s > 0
+
+    def test_restore_merges_once_and_repersists(self, tmp_path):
+        path = str(tmp_path / "v.ckpt")
+        store = make_store()
+        populate(store)
+        Checkpointer(store, path, 1.0, 3600).write_once()
+
+        fresh = make_store()
+        ck = Checkpointer(fresh, path, 1.0, 3600)
+        assert ck.restore() > 0
+        assert ck.restore_total == 1
+        # the merged store was immediately re-persisted over the
+        # consumed file — on-disk state survives a crash loop
+        assert os.path.exists(path)
+        assert ck.restore() == 0          # at most once per process
+        assert ck.restore_total == 1
+        c1 = emissions(fresh)[("c1", ())]
+        assert c1 == 10.0                 # merged exactly once
+
+    def test_crash_loop_survives_repeated_restores(self, tmp_path):
+        # crash → restore → crash again BEFORE any background write:
+        # the re-persisted file must still recover the data
+        path = str(tmp_path / "v.ckpt")
+        store = make_store()
+        populate(store)
+        Checkpointer(store, path, 1.0, 3600).write_once()
+        for _ in range(3):  # three consecutive crash-loop iterations
+            fresh = make_store()
+            assert Checkpointer(fresh, path, 1.0, 3600).restore() > 0
+        assert emissions(fresh)[("c1", ())] == 10.0  # never amplified
+
+    def test_flush_epoch_guard_discards_racing_write(self, tmp_path):
+        # snapshot taken BEFORE a flush must not commit AFTER it: the
+        # flush emitted that state, persisting it would double-count
+        path = str(tmp_path / "v.ckpt")
+        store = make_store()
+        populate(store)
+        ck = Checkpointer(store, path, 1.0, 3600)
+        groups, epoch = store.snapshot_state()
+        store.flush([0.5], AGG, is_local=False, now=1, forward=False)
+        blob = serialize(groups, created_at=time.time(), interval=1.0)
+        with ck._io_lock:
+            committed = store.flush_epoch == epoch
+        assert not committed
+        # and write_once observes the same guard end-to-end: patch
+        # snapshot_state to return a stale epoch
+        real = store.snapshot_state
+        store.snapshot_state = lambda: (real()[0], epoch)
+        try:
+            assert ck.write_once() is False
+            assert ck.discarded_writes == 1
+            assert not os.path.exists(path)
+        finally:
+            store.snapshot_state = real
+
+    def test_post_flush_write_commits(self, tmp_path):
+        path = str(tmp_path / "v.ckpt")
+        store = make_store()
+        populate(store)
+        store.flush([0.5], AGG, is_local=False, now=1, forward=False)
+        ck = Checkpointer(store, path, 1.0, 3600)
+        assert ck.write_once() is True
+        assert os.path.exists(path)
+
+    def test_flush_landing_mid_write_removes_stale_file(
+            self, tmp_path, monkeypatch):
+        # the flush-path truncate is non-blocking, so a writer whose
+        # bytes were in flight across the flush must clean up itself
+        import veneur_tpu.persist.checkpoint as cp
+
+        path = str(tmp_path / "v.ckpt")
+        store = make_store()
+        populate(store)
+        ck = Checkpointer(store, path, 1.0, 3600)
+        real = cp.ckpt_format.write_atomic
+
+        def racing_write(p, blob):
+            n = real(p, blob)
+            store.flush_epoch += 1  # a flush lands mid-write
+            return n
+
+        monkeypatch.setattr(cp.ckpt_format, "write_atomic", racing_write)
+        assert ck.write_once() is False
+        assert ck.discarded_writes == 1
+        assert not os.path.exists(path)
+
+    def test_nonblocking_truncate_skips_behind_held_lock(self, tmp_path):
+        path = str(tmp_path / "v.ckpt")
+        store = make_store()
+        populate(store)
+        ck = Checkpointer(store, path, 1.0, 3600)
+        assert ck.write_once()
+        with ck._io_lock:  # a write is "in flight"
+            assert ck.truncate(blocking=False) is False
+            assert os.path.exists(path)  # skipped, not stalled
+        assert ck.truncate(blocking=False) is True
+        assert not os.path.exists(path)
+
+    def test_write_failure_is_visible(self, tmp_path):
+        # bad path: every write fails — the counters and the age gauge
+        # must deviate from the healthy baseline, not read 0 forever
+        import threading
+
+        from veneur_tpu.flusher import _checkpoint_samples
+
+        path = str(tmp_path / "missing-dir" / "v.ckpt")
+        store = make_store()
+        ck = Checkpointer(store, path, interval_s=0.01, max_age_s=3600)
+        stop = threading.Event()
+        t = threading.Thread(target=ck.run, args=(stop,), daemon=True)
+        t.start()
+        deadline = time.time() + 5.0
+        while ck.write_errors == 0 and time.time() < deadline:
+            time.sleep(0.01)
+        stop.set()
+        t.join(timeout=5.0)
+        assert ck.write_errors >= 1
+        assert ck.age_seconds() > 0.0  # grows from startup, never wrote
+
+        class FakeServer:
+            checkpointer = ck
+
+        samples = _checkpoint_samples(FakeServer())
+        by_name = {s.name: s.value for s in samples}
+        assert by_name["veneur.checkpoint.write_errors_total"] >= 1.0
+
+
+def make_server(tmp_path=None, **cfg_kwargs):
+    cfg_kwargs.setdefault("statsd_listen_addresses", [])
+    cfg_kwargs.setdefault("interval", "86400s")
+    cfg_kwargs.setdefault("store_initial_capacity", 32)
+    cfg_kwargs.setdefault("store_chunk", 128)
+    cfg_kwargs.setdefault("aggregates", ["min", "max", "count"])
+    cfg_kwargs.setdefault("percentiles", [0.5])
+    config = Config(**cfg_kwargs)
+    sink = ChannelMetricSink()
+    return Server(config, metric_sinks=[sink]), sink
+
+
+class TestServerIntegration:
+    def test_warm_restart_recovers_and_clean_flush_truncates(
+            self, tmp_path):
+        path = str(tmp_path / "v.ckpt")
+        # "crashing" instance: never started (no threads), state written
+        crashed, _ = make_server(checkpoint_path=path,
+                                 checkpoint_interval="3600s")
+        crashed.store.process_metric(parse_metric(b"c1:7|c"))
+        for v in range(1, 11):
+            crashed.store.process_metric(
+                parse_metric(f"lat:{v}|ms".encode()))
+        assert crashed.checkpointer.write_once()
+
+        server, sink = make_server(checkpoint_path=path,
+                                   checkpoint_interval="3600s")
+        server.start()
+        try:
+            assert server.checkpointer.restore_total == 1
+            server.flush()
+            batch = {m.name: m.value for m in sink.get_flush()}
+            assert batch["c1"] == 7.0
+            assert batch["lat.count"] == 10.0
+            assert batch["lat.50percentile"] == pytest.approx(5.5)
+            # the flush drained the recovered state -> checkpoint gone
+            assert not os.path.exists(path)
+            assert server.last_flush_time is not None
+            assert server.last_flush_ok
+        finally:
+            server.shutdown()
+
+    def test_malformed_checkpoint_never_prevents_startup(self, tmp_path):
+        path = str(tmp_path / "v.ckpt")
+        with open(path, "wb") as f:
+            f.write(b"\x00" * 1000)
+        server, sink = make_server(checkpoint_path=path)
+        server.start()
+        try:
+            assert server.checkpointer.discard_total == 1
+            server.store.process_metric(parse_metric(b"ok:1|c"))
+            server.flush()
+            assert any(m.name == "ok" for m in sink.get_flush())
+        finally:
+            server.shutdown()
+
+    def test_clean_shutdown_truncates_checkpoint(self, tmp_path):
+        path = str(tmp_path / "v.ckpt")
+        server, sink = make_server(checkpoint_path=path,
+                                   checkpoint_interval="3600s")
+        server.start()
+        server.store.process_metric(parse_metric(b"c1:3|c"))
+        assert server.checkpointer.write_once()
+        assert os.path.exists(path)
+        server.shutdown()  # final flush drains + truncates
+        assert not os.path.exists(path)
+        assert any(m.name == "c1" for m in sink.get_flush())
+
+
+class TestReadiness:
+    def test_ready_flips_503_on_stale_flush(self):
+        server, _ = make_server(interval="10s",
+                                http_address="127.0.0.1:0")
+        server.start()
+        try:
+            port = server.ops_server.port
+            base = f"http://127.0.0.1:{port}"
+            with urllib.request.urlopen(f"{base}/healthcheck/ready") as r:
+                assert r.status == 200
+            # a flush stamps freshness
+            server.flush()
+            assert server.last_flush_time is not None
+            # stale: last success older than 2x interval
+            server.last_flush_time = time.time() - 25.0
+            assert not server.is_ready()
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(f"{base}/healthcheck/ready")
+            assert ei.value.code == 503
+            # liveness unchanged
+            with urllib.request.urlopen(f"{base}/healthcheck") as r:
+                assert r.status == 200
+        finally:
+            server.shutdown()
+
+    def test_flush_age_tracks_successful_flush_only(self):
+        server, _ = make_server(interval="10s")
+        assert server.flush_age_seconds() < 5.0  # measured from init
+        server.last_flush_time = time.time() - 100.0
+        assert server.flush_age_seconds() == pytest.approx(100.0, abs=5.0)
+
+
+class TestFlushWatchdog:
+    def test_overrun_counts_and_names_slowest_sink(self, caplog):
+        # an (effectively) zero egress budget: the deadline is expired
+        # by the time the sinks finish -> the watchdog fires
+        server, sink = make_server(forward_timeout="1ms")
+        server.store.process_metric(parse_metric(b"c1:1|c"))
+        with caplog.at_level("WARNING", logger="veneur.flusher"):
+            server.flush()
+        assert server.flush_overruns >= 1
+        assert any("overran" in r.message and "slowest" in r.message
+                   for r in caplog.records)
+
+    def test_overrun_names_wedged_sink_over_completed_ones(self, caplog):
+        # a sink whose thread outlived the join never reports a timing;
+        # the watchdog must blame IT, not the slowest completed sink
+        from veneur_tpu.flusher import _check_flush_overrun
+        from veneur_tpu.resilience import Deadline
+
+        class _Sink:
+            def __init__(self, name):
+                self.name = name
+
+        class _Srv:
+            metric_sinks = [_Sink("wedgy"), _Sink("fine")]
+            flush_overruns = 0
+            _last_overrun_warn = 0.0
+
+        srv = _Srv()
+        with caplog.at_level("WARNING", logger="veneur.flusher"):
+            _check_flush_overrun(srv, Deadline.after(-1.0), 1.0,
+                                 {"fine": 0.5})
+        assert any("wedgy" in r.message and "still running" in r.message
+                   for r in caplog.records)
+        assert not any("slowest sink: fine" in r.message
+                       for r in caplog.records)
+
+    def test_overrun_warning_rate_limited(self, caplog):
+        server, sink = make_server(forward_timeout="1ms")
+        for _ in range(3):
+            server.store.process_metric(parse_metric(b"c1:1|c"))
+            server.flush()
+        assert server.flush_overruns >= 3
+        caplog.clear()
+        with caplog.at_level("WARNING", logger="veneur.flusher"):
+            server.store.process_metric(parse_metric(b"c1:1|c"))
+            server.flush()
+        # within the 30s window: counted but not re-logged
+        assert not any("overran" in r.message for r in caplog.records)
+
+
+class TestConfigValidation:
+    def test_negative_span_channel_capacity_rejected_at_load(
+            self, tmp_path):
+        p = tmp_path / "cfg.yaml"
+        p.write_text("span_channel_capacity: -1\n")
+        with pytest.raises(ValueError, match="span_channel_capacity"):
+            read_config(str(p))
+
+    def test_zero_span_channel_capacity_takes_bounded_default(
+            self, tmp_path):
+        p = tmp_path / "cfg.yaml"
+        p.write_text("span_channel_capacity: 0\n")
+        cfg = read_config(str(p))
+        assert cfg.span_channel_capacity == 100  # bounded, not unbounded
+
+    def test_checkpoint_keys_parse_once(self):
+        cfg = Config(checkpoint_interval="500ms").apply_defaults()
+        assert cfg.checkpoint_interval_seconds == pytest.approx(0.5)
+        assert cfg.checkpoint_max_age_intervals == 2.0
+        with pytest.raises(ValueError):
+            Config(checkpoint_interval="nonsense").apply_defaults()
+
+    def test_negative_checkpoint_max_age_rejected(self):
+        cfg = Config(checkpoint_max_age_intervals=-1.0)
+        cfg.apply_defaults()
+        with pytest.raises(ValueError,
+                           match="checkpoint_max_age_intervals"):
+            cfg.validate()
+
+    def test_server_derives_checkpoint_cadence_from_interval(
+            self, tmp_path):
+        path = str(tmp_path / "v.ckpt")
+        server, _ = make_server(interval="20s", checkpoint_path=path)
+        assert server.checkpointer.interval_s == pytest.approx(5.0)
+        assert server.checkpointer.max_age_s == pytest.approx(40.0)

@@ -206,6 +206,20 @@ class Config:
     fault_injection_kinds: str = ""
     fault_injection_scope: str = ""
 
+    # ---- crash-safe aggregation state (veneur_tpu/persist/) --------------
+    # where the interval checkpoint lives; empty disables checkpointing.
+    # The atomic-write scratch file is checkpoint_path + ".tmp".
+    checkpoint_path: str = ""
+    # how often the background thread snapshots the store — the at-most
+    # bound on data lost to a crash. Empty = interval / 4. Parsed ONCE
+    # at load into checkpoint_interval_seconds (0.0 = derive from the
+    # flush interval at server start).
+    checkpoint_interval: str = ""
+    # a checkpoint older than this many flush intervals at startup is
+    # stale (its data belongs to long-gone intervals) and is discarded
+    # instead of merged; 0 = default 2.0
+    checkpoint_max_age_intervals: float = 0.0
+
     def parse_interval(self) -> float:
         return parse_duration(self.interval)
 
@@ -251,6 +265,21 @@ class Config:
                 f"breaker_failure_threshold must be >= 0 (0 = use the "
                 f"default, {_BREAKER_THRESHOLD_DEFAULT}; breakers cannot "
                 f"be disabled), got {self.breaker_failure_threshold}")
+        if self.span_channel_capacity < 0:
+            # queue.Queue treats maxsize <= 0 as UNBOUNDED, which would
+            # silently defeat the span-shedding overload design; 0 takes
+            # the default (100) in apply_defaults, so only a negative
+            # could ever reach the Queue constructor — reject it
+            raise ValueError(
+                f"span_channel_capacity must be positive (0 = use the "
+                f"default, 100; a queue.Queue maxsize <= 0 is unbounded "
+                f"and defeats span shedding), got "
+                f"{self.span_channel_capacity}")
+        if self.checkpoint_max_age_intervals < 0:
+            raise ValueError(
+                f"checkpoint_max_age_intervals must be >= 0 (0 = use "
+                f"the default, 2.0), got "
+                f"{self.checkpoint_max_age_intervals}")
         if not 0.0 <= self.fault_injection_rate <= 1.0:
             raise ValueError(
                 f"fault_injection_rate must be in [0, 1], got "
@@ -313,6 +342,13 @@ class Config:
             self.datadog_span_buffer_size = 16384
         if not self.trace_max_length_bytes:
             self.trace_max_length_bytes = 16 * 1024
+        if not self.checkpoint_max_age_intervals:
+            self.checkpoint_max_age_intervals = 2.0
+        # parse-once (round-1 audit policy): 0.0 = unset, the server
+        # derives interval / 4 at start
+        self.checkpoint_interval_seconds = (
+            parse_duration(self.checkpoint_interval)
+            if self.checkpoint_interval else 0.0)
         self.apply_resilience_defaults()
         return self
 

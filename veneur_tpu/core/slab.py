@@ -936,3 +936,79 @@ class SlabDigestGroup:
             cols = cols[4:]
         return interner, _fill_stat_results(sel, cols, n, percentiles,
                                             out)
+
+    # -- checkpoint snapshot / restore (veneur_tpu/persist/) --------------
+
+    def snapshot_state(self) -> dict:
+        """Slab twin of ``DigestGroup.snapshot_state``: each slab's
+        interned prefix flattens (digest planes + pending temp bins)
+        into the same per-row centroid-run layout, WITHOUT resetting
+        any device state. Caller holds the store lock."""
+        from veneur_tpu.core.store import flatten_digest_state
+
+        self._drain_staging()
+        n = len(self.interner)
+        snap = {"kind": "digest", "names": list(self.interner.names),
+                "joined": list(self.interner.joined)}
+        if n == 0:
+            return snap
+        k = self.k
+        rows_p, means_p, weights_p, scalars_p = [], [], [], []
+        for i, d in enumerate(self.digests):
+            need = min(n - i * self.slab_rows, self.slab_rows)
+            if need <= 0:
+                break
+            t = self.temps[i]
+            (mean, weight, bin_w, bin_wm, dmn, dmx, cnt, vsum, vmin,
+             vmax, recip) = jax.device_get(
+                (d.mean.reshape(self.slab_rows, k)[:need],
+                 d.weight.reshape(self.slab_rows, k)[:need],
+                 t.sum_w.reshape(self.slab_rows, k)[:need],
+                 t.sum_wm.reshape(self.slab_rows, k)[:need],
+                 d.dmin[:need], d.dmax[:need], t.count[:need],
+                 t.vsum[:need], t.vmin[:need], t.vmax[:need],
+                 t.recip[:need]))
+            flat = flatten_digest_state(
+                np.asarray(mean, np.float32),
+                np.asarray(weight, np.float32),
+                np.asarray(bin_w, np.float32),
+                np.asarray(bin_wm, np.float32))
+            rows_p.append(flat["rows"] + np.int32(i * self.slab_rows))
+            means_p.append(flat["means"])
+            weights_p.append(flat["weights"])
+            scalars_p.append((np.asarray(dmn, np.float32),
+                              np.asarray(dmx, np.float32),
+                              np.asarray(cnt, np.float32),
+                              np.asarray(vsum, np.float32),
+                              np.asarray(vmin, np.float32),
+                              np.asarray(vmax, np.float32),
+                              np.asarray(recip, np.float32)))
+        snap["rows"] = np.concatenate(rows_p)
+        snap["means"] = np.concatenate(means_p)
+        snap["weights"] = np.concatenate(weights_p)
+        for j, nm in enumerate(("mins", "maxs", "count", "vsum", "vmin",
+                                "vmax", "recip")):
+            snap[nm] = np.concatenate([s[j] for s in scalars_p])
+        return snap
+
+    def restore_stats(self, rows: np.ndarray, count: np.ndarray,
+                      vsum: np.ndarray, vmin: np.ndarray,
+                      vmax: np.ndarray, recip: np.ndarray):
+        """Fold recovered per-row scalar stats into the per-slab temp
+        accumulators (see ``core.store._restore_temp_stats``; _per_slab
+        pads with out-of-range rows, which the scatter drops)."""
+        from veneur_tpu.core.store import _restore_temp_stats
+
+        if not len(rows):
+            return
+        self.ensure_capacity(int(rows.max()))
+        self._device_dirty = True
+        for i, local, (c, s, mn, mx, rc) in self._per_slab(
+                np.asarray(rows, np.int64), np.asarray(count, np.float32),
+                np.asarray(vsum, np.float32), np.asarray(vmin, np.float32),
+                np.asarray(vmax, np.float32),
+                np.asarray(recip, np.float32)):
+            self.temps[i] = _restore_temp_stats(
+                self.temps[i], jnp.asarray(local), jnp.asarray(c),
+                jnp.asarray(s), jnp.asarray(mn), jnp.asarray(mx),
+                jnp.asarray(rc))

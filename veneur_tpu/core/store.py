@@ -222,6 +222,19 @@ class ScalarGroup:
             hostnames, self.hostnames = self.hostnames, []
         return interner, values, messages, hostnames
 
+    def snapshot_state(self) -> dict:
+        """Host copy of the live group WITHOUT resetting it (the
+        checkpoint path, veneur_tpu/persist/): the caller holds the
+        store lock, so the copies are interval-coherent."""
+        n = len(self.interner)
+        snap = {"kind": "scalar", "names": list(self.interner.names),
+                "joined": list(self.interner.joined),
+                "values": self.values[:n].copy()}
+        if self.messages is not None:
+            snap["messages"] = list(self.messages[:n])
+            snap["hostnames"] = list(self.hostnames[:n])
+        return snap
+
     def fresh(self) -> "ScalarGroup":
         """Empty same-config twin (swap-on-flush generation swap)."""
         return ScalarGroup(self.kind, self.capacity)
@@ -268,6 +281,42 @@ def _flush_digests(digest: td_ops.TDigest, temp: td_ops.TempCentroids,
                                               compression)
     return (drained, pcts, temp.count, temp.vsum, temp.vmin, temp.vmax,
             temp.recip)
+
+
+@jax.jit
+def _restore_temp_stats(temp, rows, count, vsum, vmin, vmax, recip):
+    """Scatter a recovered interval's per-row scalar stats back into the
+    temp accumulators (checkpoint restore). The centroid half of a
+    restore rides the import path, which deliberately skips these
+    (update_stats=False, samplers.go:473-480); without this hook a warm
+    restart would keep the percentiles but lose the .count/.min/.max/
+    .sum/.hmean emissions of the recovered samples."""
+    return temp._replace(
+        count=temp.count.at[rows].add(count, mode="drop"),
+        vsum=temp.vsum.at[rows].add(vsum, mode="drop"),
+        vmin=temp.vmin.at[rows].min(vmin, mode="drop"),
+        vmax=temp.vmax.at[rows].max(vmax, mode="drop"),
+        recip=temp.recip.at[rows].add(recip, mode="drop"),
+    )
+
+
+def flatten_digest_state(mean: np.ndarray, weight: np.ndarray,
+                         bin_w: np.ndarray, bin_wm: np.ndarray) -> dict:
+    """Flatten [n, K] digest planes plus [n, K] pending temp bins into
+    per-row centroid runs sorted by (row, mean) — the exact layout
+    ``bulk_stage_import_centroids`` expects back at restore time.
+    Pending bins become centroids at (sum_wm/sum_w, sum_w), which is
+    how a drain would cluster them anyway."""
+    r1, c1 = np.nonzero(weight > 0)
+    r2, c2 = np.nonzero(bin_w > 0)
+    w2 = bin_w[r2, c2]
+    rows = np.concatenate([r1, r2]).astype(np.int32)
+    means = np.concatenate([mean[r1, c1],
+                            bin_wm[r2, c2] / w2]).astype(np.float64)
+    weights = np.concatenate([weight[r1, c1], w2]).astype(np.float64)
+    order = np.lexsort((means, rows))
+    return {"rows": rows[order], "means": means[order],
+            "weights": weights[order]}
 
 
 def bulk_stage_import_centroids(group, rows: np.ndarray, means: np.ndarray,
@@ -641,6 +690,58 @@ class DigestGroup:
         self.digest = self.temp = self.dmin = self.dmax = None
         self._device_dirty = False
 
+    def snapshot_state(self) -> dict:
+        """Host copy of the live sketch state WITHOUT resetting it (the
+        checkpoint path, veneur_tpu/persist/): digest-plane centroids
+        plus pending temp-bin centroids flatten to per-row runs, and the
+        interval's scalar stats ride alongside so a restore rebuilds
+        both the mergeable sketch and the local-aggregate emissions.
+        Caller holds the store lock."""
+        self._drain_staging()
+        n = len(self.interner)
+        snap = {"kind": "digest", "names": list(self.interner.names),
+                "joined": list(self.interner.joined)}
+        if n == 0:
+            return snap
+        (mean, weight, bin_w, bin_wm, imp_min, imp_max, dmn, dmx,
+         cnt, vsum, vmin, vmax, recip) = jax.device_get(
+            (self.digest.mean[:n], self.digest.weight[:n],
+             self.temp.sum_w[:n], self.temp.sum_wm[:n],
+             self.dmin[:n], self.dmax[:n],
+             self.digest.min[:n], self.digest.max[:n],
+             self.temp.count[:n], self.temp.vsum[:n], self.temp.vmin[:n],
+             self.temp.vmax[:n], self.temp.recip[:n]))
+        snap.update(flatten_digest_state(
+            np.asarray(mean, np.float32), np.asarray(weight, np.float32),
+            np.asarray(bin_w, np.float32), np.asarray(bin_wm, np.float32)))
+        # digest-bound extrema (import path stat args); the interval's
+        # observed extrema travel separately as temp stats
+        snap["mins"] = np.minimum(np.asarray(imp_min, np.float32),
+                                  np.asarray(dmn, np.float32))
+        snap["maxs"] = np.maximum(np.asarray(imp_max, np.float32),
+                                  np.asarray(dmx, np.float32))
+        for nm, arr in (("count", cnt), ("vsum", vsum), ("vmin", vmin),
+                        ("vmax", vmax), ("recip", recip)):
+            snap[nm] = np.asarray(arr, np.float32)
+        return snap
+
+    def restore_stats(self, rows: np.ndarray, count: np.ndarray,
+                      vsum: np.ndarray, vmin: np.ndarray,
+                      vmax: np.ndarray, recip: np.ndarray):
+        """Fold recovered per-row scalar stats into the temp
+        accumulators (see ``_restore_temp_stats``)."""
+        if not len(rows):
+            return
+        self.ensure_capacity(int(rows.max()))
+        self._device_dirty = True
+        self.temp = _restore_temp_stats(
+            self.temp, jnp.asarray(rows, jnp.int32),
+            jnp.asarray(count, jnp.float32),
+            jnp.asarray(vsum, jnp.float32),
+            jnp.asarray(vmin, jnp.float32),
+            jnp.asarray(vmax, jnp.float32),
+            jnp.asarray(recip, jnp.float32))
+
 
 # ---------------------------------------------------------------------------
 # Device-side set groups (HyperLogLog)
@@ -850,6 +951,20 @@ class SetGroup:
     def _reset_registers(self):
         self.registers = jnp.zeros((self.capacity, self.m), jnp.int8)
         self._device_dirty = False
+
+    def snapshot_state(self) -> dict:
+        """Host copy of the live registers WITHOUT resetting (the
+        checkpoint path, veneur_tpu/persist/). Caller holds the store
+        lock."""
+        self._drain_staging()
+        n = len(self.interner)
+        snap = {"kind": "set", "precision": self.precision,
+                "names": list(self.interner.names),
+                "joined": list(self.interner.joined)}
+        if n:
+            snap["registers"] = np.asarray(
+                jax.device_get(self.registers[:n]), np.uint8)
+        return snap
 
 
 # ---------------------------------------------------------------------------
@@ -1097,6 +1212,35 @@ class HeavyHitterGroup:
         self._device_dirty = False
         self._members.clear()
         return interner, out, fwd
+
+    def snapshot_state(self) -> dict:
+        """Host copy of the live sketch WITHOUT resetting (the
+        checkpoint path, veneur_tpu/persist/): the count-min table plus
+        each series' top-k candidates in the import_sketch layout.
+        Caller holds the store lock."""
+        self._drain_samples()
+        n = len(self.interner)
+        snap = {"kind": "topk", "depth": self.depth, "width": self.width,
+                "names": list(self.interner.names),
+                "joined": list(self.interner.joined)}
+        if n == 0:
+            return snap
+        hi, lo, ct, table = jax.device_get(
+            (self.sketch.topk_hi[:n], self.sketch.topk_lo[:n],
+             self.sketch.topk_counts[:n], self.sketch.table))
+        snap["table"] = np.asarray(table, np.float32)
+        # vectorized live-slot extraction: this runs under the store
+        # lock every checkpoint_interval, so no O(n*k) Python loop
+        live_r, live_c = np.nonzero(np.asarray(ct) > 0)
+        series = [{"keys": [], "members": []} for _ in range(n)]
+        for r, c in zip(live_r.tolist(), live_c.tolist()):
+            pair = (int(hi[r, c]), int(lo[r, c]))
+            s = series[r]
+            s["keys"].append(pair)
+            s["members"].append(
+                self._members.get((pair[0] << 32) | pair[1]))
+        snap["series"] = series
+        return snap
 
 
 # ---------------------------------------------------------------------------
@@ -1359,6 +1503,11 @@ class MetricStore:
         self.hll_precision = hll_precision
         self.processed = 0
         self.imported = 0
+        # bumped at every generation swap; a checkpoint writer snapshots
+        # (groups, epoch) under the lock and must discard the write if
+        # the epoch moved before it commits (the flush drained — and
+        # will emit — the state the snapshot captured)
+        self.flush_epoch = 0
         # C++ memos of the Interner's series -> row mappings (ingest batch
         # path and MetricList import path); reset at flush (rows restart
         # with fresh interners)
@@ -1763,6 +1912,141 @@ class MetricStore:
                        for name, tags, keys, members in series]
             self.heavy_hitters.import_sketch(table, entries)
 
+    # -- checkpoint snapshot / restore (veneur_tpu/persist/) ---------------
+
+    # the metric-type string each group's keys carry, for rebuilding
+    # MetricKeys at restore time
+    _GROUP_TYPES = {
+        "counters": "counter", "global_counters": "counter",
+        "gauges": "gauge", "global_gauges": "gauge",
+        "local_status_checks": "status",
+        "histograms": "histogram", "local_histograms": "histogram",
+        "timers": "timer", "local_timers": "timer",
+        "sets": "set", "local_sets": "set", "heavy_hitters": "set"}
+
+    def snapshot_state(self) -> Tuple[Dict[str, dict], int]:
+        """Host-side snapshot of every group WITHOUT resetting
+        anything. Each group snapshots under its own lock hold, so
+        ingest interleaves between groups and the stall is bounded by
+        the largest single group's device fetch, not the whole store's;
+        disk IO is the caller's job, off-lock entirely. Returns
+        ``(groups, flush_epoch)``: the writer must discard the snapshot
+        if the epoch moved before it commits — which also covers a
+        flush swap landing BETWEEN group holds (the mixed snapshot's
+        epoch no longer matches, so it is dropped and the next cadence
+        retries)."""
+        with self._lock:
+            epoch = self.flush_epoch
+        groups = {}
+        for name in self._GEN_GROUPS:
+            with self._lock:
+                groups[name] = getattr(self, name).snapshot_state()
+        return groups, epoch
+
+    def restore_state(self, groups: Dict[str, dict]) -> int:
+        """Merge a recovered snapshot into the live store with the same
+        semantics as the import path (counters add, gauges last-write,
+        digests re-enter the centroid binning pipeline, sets register-
+        max, count-min tables add) — so recovery composes with global
+        aggregation exactly like a forwarded sketch would. Returns the
+        number of series merged. Unknown groups and config mismatches
+        (HLL precision, count-min geometry) skip that group with a
+        warning; nothing here raises."""
+        merged = 0
+        with self._lock:
+            for name, snap in groups.items():
+                tname = self._GROUP_TYPES.get(name)
+                target = getattr(self, name, None)
+                if (tname is None or target is None
+                        or not isinstance(snap, dict)):
+                    log.warning("checkpoint restore: unknown group %r; "
+                                "skipping", name)
+                    continue
+                try:
+                    merged += self._restore_group(name, tname, target,
+                                                  snap)
+                except Exception:
+                    log.exception("checkpoint restore: group %s failed; "
+                                  "skipping it", name)
+        return merged
+
+    def _restore_group(self, name: str, tname: str, target,
+                       snap: dict) -> int:
+        kind = snap.get("kind")
+        names, joined = snap.get("names", []), snap.get("joined", [])
+        n = len(names)
+
+        def keys():
+            for i in range(n):
+                jt = joined[i]
+                yield i, MetricKey(name=names[i], type=tname,
+                                   joined_tags=jt), \
+                    (jt.split(",") if jt else [])
+
+        if kind == "scalar":
+            values = snap.get("values", ())
+            messages = snap.get("messages")
+            hostnames = snap.get("hostnames")
+            for i, key, tags in keys():
+                if messages is not None:
+                    target.sample(key, tags, float(values[i]), 1.0,
+                                  message=messages[i],
+                                  hostname=hostnames[i])
+                else:
+                    target.combine(key, tags, values[i])
+            return n
+        if kind == "digest":
+            if n == 0:
+                return 0
+            row_map = np.empty(n, np.int32)
+            for i, key, tags in keys():
+                row_map[i] = target._row(key, tags)
+            rows = row_map[np.asarray(snap["rows"], np.int64)]
+            mins, maxs = snap["mins"], snap["maxs"]
+            finite = np.isfinite(mins)
+            bulk_stage_import_centroids(
+                target, rows, snap["means"], snap["weights"],
+                row_map[finite], mins[finite], maxs[finite])
+            target.restore_stats(row_map, snap["count"], snap["vsum"],
+                                 snap["vmin"], snap["vmax"],
+                                 snap["recip"])
+            return n
+        if kind == "set":
+            if snap.get("precision") != target.precision:
+                log.warning("checkpoint restore: %s has HLL precision "
+                            "%s, store runs %d; skipping the group",
+                            name, snap.get("precision"),
+                            target.precision)
+                return 0
+            registers = snap.get("registers", ())
+            for i, key, tags in keys():
+                target.import_registers(key, tags, registers[i])
+            return n
+        if kind == "topk":
+            table = snap.get("table")
+            if table is None or n == 0:
+                return 0
+            if (snap.get("depth"), snap.get("width")) != (target.depth,
+                                                          target.width):
+                log.warning("checkpoint restore: %s count-min geometry "
+                            "%sx%s != store %dx%d; skipping the group",
+                            name, snap.get("depth"), snap.get("width"),
+                            target.depth, target.width)
+                return 0
+            series = snap.get("series", [])
+            entries = []
+            for i, key, tags in keys():
+                s = series[i] if i < len(series) else {"keys": [],
+                                                       "members": []}
+                entries.append((key, tags,
+                                [tuple(p) for p in s["keys"]],
+                                s["members"]))
+            target.import_sketch(np.asarray(table, np.float32), entries)
+            return n
+        log.warning("checkpoint restore: group %s has unknown kind %r; "
+                    "skipping", name, kind)
+        return 0
+
     # -- flush -------------------------------------------------------------
 
     def summary(self) -> MetricsSummary:
@@ -1830,6 +2114,7 @@ class MetricStore:
         gen.imported = self.imported
         self.processed = 0
         self.imported = 0
+        self.flush_epoch += 1
         self._kind_groups = None  # holds refs to the retired groups
         if self._native_table is not None:
             self._native_table.reset()

@@ -24,12 +24,21 @@ log = logging.getLogger("veneur.flusher")
 
 
 def flush_once(server: "Server"):
-    """One interval flush, wrapped in a self-trace span (flusher.go:26-29)."""
+    """One interval flush, wrapped in a self-trace span (flusher.go:26-29).
+    Records flush-staleness state on the server: a completed pass stamps
+    ``last_flush_time`` (what /healthcheck/ready and
+    ``veneur.flush.age_seconds`` read); a raising one marks
+    ``last_flush_ok`` False and leaves the stamp stale."""
     from veneur_tpu.trace import Trace
     span = Trace.start_trace("veneur.flush")
     span.name = "flush"
     try:
         _flush_once(server, span)
+        server.last_flush_time = time.time()
+        server.last_flush_ok = True
+    except Exception:
+        server.last_flush_ok = False
+        raise
     finally:
         span.client_record(getattr(server, "trace_client", None))
 
@@ -114,6 +123,15 @@ def _flush_once(server: "Server", span):
         digest_format=digest_format)
     flush_elapsed = time.perf_counter() - t0
     log.debug("store flush took %.1f ms (%s)", flush_elapsed * 1e3, ms)
+    # the store just drained: any existing checkpoint captured state
+    # that is now flushing to sinks — truncate it so a restart can
+    # never merge (and double-flush) an already-emitted interval.
+    # Non-blocking: a checkpoint write in flight holds the IO lock for
+    # its full write+fsync, and the writer's own post-commit epoch
+    # check removes the stale file instead
+    ckpt = getattr(server, "checkpointer", None)
+    if ckpt is not None:
+        ckpt.truncate(blocking=False)
     # the canonical self-metric set (README.md:248-277) rides on the
     # flush span and re-enters the pipeline through the extraction sink
     span.add(
@@ -126,9 +144,19 @@ def _flush_once(server: "Server", span):
             float(_delta_since(server, "_last_span_flush_skipped",
                                getattr(server, "_span_flush_skipped", 0))),
             None),
+        ssf_samples.gauge("veneur.flush.age_seconds",
+                          server.flush_age_seconds()
+                          if hasattr(server, "flush_age_seconds")
+                          else 0.0, None),
+        ssf_samples.count(
+            "veneur.flush.overrun_total",
+            float(_delta_since(server, "_last_flush_overruns",
+                               getattr(server, "flush_overruns", 0))),
+            None),
         *_worker_samples(server, ms),
         *_forward_samples(server),
         *_import_samples(server),
+        *_checkpoint_samples(server),
         *_runtime_samples())
 
     # local → global forwarding happens off the flush path
@@ -187,6 +215,7 @@ def _flush_once(server: "Server", span):
         threads.append(t)
     for t in threads:
         t.join(timeout=30.0)
+    _check_flush_overrun(server, deadline, budget, sink_elapsed)
     # total time across the parallel sink POSTs (README.md:264), plus
     # the per-sink breakdown and each sink's errors/marshal/post parts
     span.add(ssf_samples.timing("veneur.flush.total_duration_ns",
@@ -206,6 +235,71 @@ def _flush_once(server: "Server", span):
             log.exception("plugin %s flush failed", plugin.name)
 
     span_flusher.join(timeout=10.0)
+
+
+def _check_flush_overrun(server, deadline, budget: float,
+                         sink_elapsed: dict):
+    """Flush watchdog: the egress deadline (resilience/deadline.py) is
+    supposed to make an overrun impossible — retries clamp to it — so
+    one actually expiring means a sink ignored its budget (wedged
+    socket, un-clamped path). Count it (veneur.flush.overrun_total) and
+    name the slowest sink, rate-limited to one warning per 30s so a
+    persistently slow sink can't flood the log every interval."""
+    if not deadline.expired():
+        return
+    server.flush_overruns = getattr(server, "flush_overruns", 0) + 1
+    now = time.monotonic()
+    if now - getattr(server, "_last_overrun_warn", 0.0) < 30.0:
+        return
+    server._last_overrun_warn = now
+    # a sink whose thread outlived the join timeout never reported a
+    # timing — IT is the culprit, not the slowest completed one
+    wedged = [s.name for s in getattr(server, "metric_sinks", [])
+              if s.name not in sink_elapsed]
+    if wedged:
+        slowest = f"sink(s) still running: {', '.join(wedged)}"
+    elif sink_elapsed:
+        name, took = max(sink_elapsed.items(), key=lambda kv: kv[1])
+        slowest = f"slowest sink: {name} ({took:.2f}s)"
+    else:
+        slowest = "no sink timings recorded"
+    log.warning("flush overran its %.1fs egress deadline; %s "
+                "(%d overruns since start)", budget, slowest,
+                server.flush_overruns)
+
+
+def _checkpoint_samples(server):
+    """veneur.checkpoint.* self-metrics (persist/checkpoint.py):
+    last write's duration/bytes, current checkpoint age, and
+    restore/discard counters as interval deltas."""
+    from veneur_tpu.trace import samples as ssf_samples
+
+    ckpt = getattr(server, "checkpointer", None)
+    if ckpt is None:
+        return []
+    out = [
+        ssf_samples.timing("veneur.checkpoint.write_duration_ns",
+                           ckpt.last_write_duration_s, None),
+        ssf_samples.gauge("veneur.checkpoint.bytes",
+                          float(ckpt.last_write_bytes), None),
+        ssf_samples.gauge("veneur.checkpoint.age_seconds",
+                          ckpt.age_seconds(), None),
+        ssf_samples.count(
+            "veneur.checkpoint.restore_total",
+            float(_delta_since(ckpt, "_last_reported_restores",
+                               ckpt.restore_total)), None),
+        ssf_samples.count(
+            "veneur.checkpoint.discard_total",
+            float(_delta_since(ckpt, "_last_reported_discards",
+                               ckpt.discard_total)), None),
+        # a checkpointer that can never write (bad path, full/read-only
+        # disk) must be visible before the next crash proves it
+        ssf_samples.count(
+            "veneur.checkpoint.write_errors_total",
+            float(_delta_since(ckpt, "_last_reported_write_errors",
+                               ckpt.write_errors)), None),
+    ]
+    return out
 
 
 def _worker_samples(server, ms):

@@ -3,7 +3,9 @@
 Mirrors the goji mux in ``/root/reference/http.go:21-51`` and the global
 import handler ``handlers_global.go:60-213``:
 
-    GET  /healthcheck   → "ok"
+    GET  /healthcheck   → "ok" (liveness; always)
+    GET  /healthcheck/ready → "ready", or 503 once the last successful
+                          flush is older than 2x the interval
     GET  /version       → version string
     GET  /builddate     → build date (import time here)
     POST /import        → JSON (optionally deflate) list of forwarded
@@ -303,6 +305,24 @@ class OpsServer:
                   trace_client=getattr(server, "trace_client", None),
                   import_workers=getattr(cfg, "http_import_workers", 2),
                   import_queue=getattr(cfg, "http_import_queue", 64))
+
+        def ready(query):
+            # readiness, as distinct from the /healthcheck liveness
+            # probe: 503 once the last successful flush goes stale
+            # (policy lives in Server.readiness), so an orchestrator
+            # can stop routing to — without restarting — an instance
+            # that is alive but not draining
+            ok, age, limit = server.readiness()
+            if ok:
+                return 200, "ready", "text/plain"
+            detail = ("; last flush attempt FAILED"
+                      if not getattr(server, "last_flush_ok", True)
+                      else "")
+            return (503,
+                    f"last successful flush {age:.1f}s ago "
+                    f"(limit {limit:.1f}s){detail}", "text/plain")
+
+        ops.add_route("/healthcheck/ready", ready)
         ops.add_route("/config", lambda query: (
             200, json.dumps({k: v for k, v in vars(server.config).items()
                              if "key" not in k and "secret" not in k

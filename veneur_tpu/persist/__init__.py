@@ -1,0 +1,14 @@
+"""Crash-safe aggregation state: interval checkpointing + warm restart.
+
+See ``persist/checkpoint.py`` for the model and ``persist/format.py``
+for the on-disk layout; config surface is ``checkpoint_path`` /
+``checkpoint_interval`` / ``checkpoint_max_age_intervals``
+(``docs/resilience.md``).
+"""
+
+from veneur_tpu.persist.checkpoint import Checkpointer
+from veneur_tpu.persist.format import (CheckpointInvalid, deserialize,
+                                       read_file, serialize, write_atomic)
+
+__all__ = ["Checkpointer", "CheckpointInvalid", "serialize",
+           "deserialize", "write_atomic", "read_file"]

@@ -320,6 +320,33 @@ class Server:
                 config.tls_certificate, config.tls_key,
                 config.tls_authority_certificate)
 
+        # flush-staleness readiness (GET /healthcheck/ready): wall-clock
+        # of the last SUCCESSFUL flush (None until one lands; age is
+        # measured from start() before that) and whether the last
+        # attempt succeeded
+        self.last_flush_time: Optional[float] = None
+        self.last_flush_ok = True
+        self._started_wall = time.time()
+        # flush watchdog (veneur.flush.overrun_total)
+        self.flush_overruns = 0
+        self._last_overrun_warn = 0.0
+
+        # crash-safe state: interval checkpointing + warm-restart
+        # recovery (veneur_tpu/persist/, docs/resilience.md)
+        self.checkpointer = None
+        self._ckpt_thread: Optional[threading.Thread] = None
+        if config.checkpoint_path:
+            from veneur_tpu.persist import Checkpointer
+
+            ckpt_interval = (config.checkpoint_interval_seconds
+                             or self.interval / 4.0)
+            self.checkpointer = Checkpointer(
+                self.store, config.checkpoint_path,
+                interval_s=ckpt_interval,
+                max_age_s=(config.checkpoint_max_age_intervals
+                           * self.interval),
+                hostname=self.hostname)
+
         # ingest error/telemetry counters
         self.packet_errors = 0
         self.packet_drops = 0
@@ -477,6 +504,15 @@ class Server:
 
             self._guard = profiled_guard
             log.info("profiling enabled; stats written on shutdown")
+        # warm-restart recovery BEFORE any listener or worker ingests:
+        # a valid, fresh checkpoint merges into the (still-empty) store
+        # with import semantics and is re-persisted from the merged
+        # state; malformed/stale files discard without ever failing
+        # startup (persist/checkpoint.py)
+        self._started_wall = time.time()
+        if self.checkpointer is not None:
+            self.checkpointer.restore()
+
         # shared per-sink ingest lanes: every worker feeds the same lanes,
         # so each sink has one ingest thread and one flush barrier
         span_lanes = make_span_lanes(self.span_sinks, self._stop)
@@ -545,6 +581,14 @@ class Server:
             target=self._guard(self._flush_loop), name="flush-ticker",
             daemon=True)
         self._flush_thread.start()
+        if self.checkpointer is not None:
+            self._ckpt_thread = threading.Thread(
+                target=self._guard(
+                    lambda: self.checkpointer.run(self._stop)),
+                name="checkpoint", daemon=True)
+            self._ckpt_thread.start()
+            log.info("checkpointing to %s every %.1fs",
+                     self.checkpointer.path, self.checkpointer.interval_s)
         log.info("veneur server started (role=%s, interval=%.1fs)",
                  "local" if self.is_local() else "global", self.interval)
 
@@ -802,6 +846,29 @@ class Server:
 
         flush_once(self)
 
+    # -- flush-staleness readiness -----------------------------------------
+
+    def flush_age_seconds(self) -> float:
+        """Seconds since the last SUCCESSFUL flush (since start() before
+        the first one) — what an orchestrator's readiness probe and the
+        ``veneur.flush.age_seconds`` self-metric read."""
+        base = self.last_flush_time or self._started_wall
+        return max(0.0, time.time() - base)
+
+    def readiness(self) -> tuple:
+        """(ready, age_seconds, limit_seconds): the ONE place the
+        flush-staleness policy lives — ready while the last successful
+        flush is no older than 2x the interval. A wedged flush loop
+        (hung device program, deadlocked sink) goes unready here while
+        /healthcheck (liveness) stays ok, so an orchestrator routes
+        away without killing the process."""
+        age = self.flush_age_seconds()
+        limit = 2.0 * self.interval
+        return age <= limit, age, limit
+
+    def is_ready(self) -> bool:
+        return self.readiness()[0]
+
     # keys whose change a live reload cannot honor: sockets stay bound
     # (SO_REUSEPORT makes a rolling restart the path for these) and the
     # store's device geometry is allocated once
@@ -814,7 +881,11 @@ class Server:
                       "mesh_enabled", "mesh_hosts",
                       "store_initial_capacity", "store_chunk",
                       "span_channel_capacity", "num_span_workers",
-                      "enable_profiling", "sentry_dsn")
+                      "enable_profiling", "sentry_dsn",
+                      # the checkpointer binds its path/cadence at
+                      # construction (its thread is already running)
+                      "checkpoint_path", "checkpoint_interval",
+                      "checkpoint_max_age_intervals")
 
     def reload(self, config: "Config"):
         """SIGHUP graceful reload (the reference's HUP path,
@@ -943,6 +1014,12 @@ class Server:
         # drain runs, or two passes would drain the store concurrently
         if self._flush_thread is not None:
             self._flush_thread.join(timeout=5.0)
+        # the checkpoint writer too: a snapshot in flight across the
+        # final flush would either lose the epoch race (wasted) or
+        # resurrect a post-flush file the clean shutdown then fails to
+        # truncate
+        if self._ckpt_thread is not None:
+            self._ckpt_thread.join(timeout=10.0)
         try:
             self.flush()
         except Exception:
