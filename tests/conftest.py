@@ -66,6 +66,32 @@ def fake_clock():
     return FakeClock()
 
 
+@pytest.fixture
+def tsan_lite():
+    """TSan-lite (veneur_tpu/lint/tsan.py): wrap a MetricStore's
+    ``@requires_lock`` group mutators and record lock state at each
+    call. Usage::
+
+        rec = tsan_lite(store)      # arms immediately
+        ... drive threads ...
+        rec.assert_clean()
+
+    Everything armed in the test is disarmed at teardown."""
+    from veneur_tpu.lint.tsan import LockStateRecorder
+
+    recorders = []
+
+    def arm(store):
+        rec = LockStateRecorder(store)
+        rec.arm()
+        recorders.append(rec)
+        return rec
+
+    yield arm
+    for rec in recorders:
+        rec.disarm()
+
+
 def pytest_collection_modifyitems(config, items):
     if RUN_TPU:
         skip = pytest.mark.skip(

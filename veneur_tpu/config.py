@@ -14,7 +14,7 @@ import os
 import re
 import socket
 from dataclasses import dataclass, field
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List
 
 import yaml
 
@@ -90,6 +90,9 @@ class Config:
     lightstep_num_clients: int = 0
     lightstep_reconnect_period: str = ""
     metric_max_length: int = 0
+    # like block_profile_rate: accepted for reference-config
+    # compatibility but REJECTED when set (Go-runtime mutex profiling
+    # has no Python equivalent; validate() errors)
     mutex_profile_fraction: int = 0
     num_readers: int = 0
     num_span_workers: int = 0

@@ -21,7 +21,6 @@ from veneur_tpu.samplers.intermetric import (
     Aggregate,
     InterMetric,
     MetricType,
-    route_info,
 )
 
 Arenas = Tuple[bytes, np.ndarray, np.ndarray]  # blob, offsets u32, lengths u32

@@ -1,0 +1,45 @@
+"""Lock-discipline annotations for the aggregation hot path.
+
+The reference leans on Go's race detector to keep the worker/flush
+concurrency honest; this module is the Python side of our substitute:
+a zero-cost annotation registry that ``veneur_tpu.lint`` (the
+lock-discipline pass, docs/static-analysis.md) and the TSan-lite test
+fixture (``veneur_tpu/lint/tsan.py``) both read.
+
+``@requires_lock("store")`` marks a method/function whose body mutates
+(or snapshots) group state and therefore must only run while the owning
+``MetricStore._lock`` is held — either lexically inside a
+``with self._lock:`` block or from a caller that itself carries the
+same annotation (the static pass walks that call chain).
+
+``@acquires_lock("store")`` marks a method that takes the lock itself;
+call sites need no protection of their own.
+
+Both are runtime no-ops beyond stamping attributes: the hot ingest path
+(one annotated call per native batch) must not pay a wrapper frame.
+"""
+
+from __future__ import annotations
+
+REQUIRES_LOCK_ATTR = "__requires_lock__"
+ACQUIRES_LOCK_ATTR = "__acquires_lock__"
+
+
+def requires_lock(name: str):
+    """Caller must hold lock ``name`` (e.g. ``"store"``) around the call."""
+
+    def deco(fn):
+        setattr(fn, REQUIRES_LOCK_ATTR, name)
+        return fn
+
+    return deco
+
+
+def acquires_lock(name: str):
+    """The function takes lock ``name`` internally; callers stay lock-free."""
+
+    def deco(fn):
+        setattr(fn, ACQUIRES_LOCK_ATTR, name)
+        return fn
+
+    return deco

@@ -58,6 +58,7 @@ import numpy as np
 from jax import lax
 
 from veneur_tpu.ops import tdigest as td_ops
+from veneur_tpu.core.locking import requires_lock
 from veneur_tpu.ops.tdigest_pallas import _next_pow2
 
 SLAB_ROWS_DEFAULT = 1 << 20
@@ -660,6 +661,7 @@ class SlabDigestGroup:
         return SlabDigestGroup(self.slab_rows, self.chunk,
                                self.compression, self.digest_dtype)
 
+    @requires_lock("store")
     def ensure_capacity(self, max_row: int):
         while max_row >= self.capacity:
             self.digests.append(
@@ -671,6 +673,7 @@ class SlabDigestGroup:
             self._imp_rows[self._imp_fill:] = self.capacity
             self._imp_stat_rows[self._imp_stat_fill:] = self.capacity
 
+    @requires_lock("store")
     def _row(self, key, tags) -> int:
         row = self.interner.intern(key, tags)
         if row >= self.capacity:
@@ -696,6 +699,7 @@ class SlabDigestGroup:
         self._imp_stat_maxs = np.full(self.chunk, -np.inf, np.float32)
         self._imp_stat_fill = 0
 
+    @requires_lock("store")
     def sample(self, key, tags, value: float, sample_rate: float):
         row = self._row(key, tags)
         i = self._fill
@@ -706,6 +710,7 @@ class SlabDigestGroup:
         if self._fill == self.chunk:
             self._drain_samples()
 
+    @requires_lock("store")
     def sample_many(self, rows: np.ndarray, vals: np.ndarray,
                     wts: np.ndarray):
         n = len(rows)
@@ -723,6 +728,7 @@ class SlabDigestGroup:
         if self._fill == self.chunk:
             self._drain_samples()
 
+    @requires_lock("store")
     def import_centroids(self, key, tags, means: np.ndarray,
                          weights: np.ndarray, dmin: float, dmax: float):
         row = self._row(key, tags)
@@ -751,6 +757,7 @@ class SlabDigestGroup:
             if self._imp_stat_fill == self.chunk:
                 self._drain_imports()
 
+    @requires_lock("store")
     def import_centroids_bulk(self, rows: np.ndarray, means: np.ndarray,
                               weights: np.ndarray, stat_rows,
                               stat_mins, stat_maxs):
@@ -939,6 +946,7 @@ class SlabDigestGroup:
 
     # -- checkpoint snapshot / restore (veneur_tpu/persist/) --------------
 
+    @requires_lock("store")
     def snapshot_state(self) -> dict:
         """Slab twin of ``DigestGroup.snapshot_state``: each slab's
         interned prefix flattens (digest planes + pending temp bins)
@@ -991,6 +999,7 @@ class SlabDigestGroup:
             snap[nm] = np.concatenate([s[j] for s in scalars_p])
         return snap
 
+    @requires_lock("store")
     def restore_stats(self, rows: np.ndarray, count: np.ndarray,
                       vsum: np.ndarray, vmin: np.ndarray,
                       vmax: np.ndarray, recip: np.ndarray):

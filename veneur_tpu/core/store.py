@@ -56,6 +56,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from veneur_tpu.ops import hll as hll_ops
+from veneur_tpu.core.locking import acquires_lock, requires_lock
 from veneur_tpu.ops import tdigest as td_ops
 from veneur_tpu.samplers.intermetric import (
     Aggregate,
@@ -152,6 +153,7 @@ class ScalarGroup:
     def __len__(self):
         return len(self.interner)
 
+    @requires_lock("store")
     def _row(self, key: MetricKey, tags: List[str]) -> int:
         row = self.interner.intern(key, tags)
         if row >= self.capacity:
@@ -164,6 +166,7 @@ class ScalarGroup:
             self.hostnames.append("")
         return row
 
+    @requires_lock("store")
     def sample(self, key: MetricKey, tags: List[str], value: float,
                sample_rate: float, message: str = "", hostname: str = ""):
         row = self._row(key, tags)
@@ -181,6 +184,7 @@ class ScalarGroup:
                 self.messages[row] = message
                 self.hostnames[row] = hostname
 
+    @requires_lock("store")
     def ensure_capacity(self, max_row: int):
         """Grow so max_row is addressable (bulk paths bypass _row)."""
         while max_row >= self.capacity:
@@ -190,11 +194,13 @@ class ScalarGroup:
                 [self.values, np.zeros(self.capacity - len(self.values),
                                        self.values.dtype)])
 
+    @requires_lock("store")
     def add_many(self, rows: np.ndarray, contribs: np.ndarray):
         """Bulk counter accumulate (native ingest path); contribs already
         carry the truncating int64(value) * int64(1/rate) Go semantics."""
         np.add.at(self.values, rows, contribs)
 
+    @requires_lock("store")
     def set_many(self, rows: np.ndarray, vals: np.ndarray):
         """Bulk gauge write, last-write-wins per row in input order."""
         # np fancy assignment leaves duplicate-index order unspecified, so
@@ -202,6 +208,7 @@ class ScalarGroup:
         urows, last = np.unique(rows[::-1], return_index=True)
         self.values[urows] = vals[::-1][last]
 
+    @requires_lock("store")
     def combine(self, key: MetricKey, tags: List[str], value: float):
         """Merge imported state: counters add, gauges/status overwrite
         (samplers.go:195-212, 276-289)."""
@@ -222,6 +229,7 @@ class ScalarGroup:
             hostnames, self.hostnames = self.hostnames, []
         return interner, values, messages, hostnames
 
+    @requires_lock("store")
     def snapshot_state(self) -> dict:
         """Host copy of the live group WITHOUT resetting it (the
         checkpoint path, veneur_tpu/persist/): the caller holds the
@@ -319,6 +327,7 @@ def flatten_digest_state(mean: np.ndarray, weight: np.ndarray,
             "weights": weights[order]}
 
 
+@requires_lock("store")
 def bulk_stage_import_centroids(group, rows: np.ndarray, means: np.ndarray,
                                 weights: np.ndarray, stat_rows,
                                 stat_mins, stat_maxs):
@@ -448,6 +457,7 @@ class DigestGroup:
     def __len__(self):
         return len(self.interner)
 
+    @requires_lock("store")
     def _row(self, key: MetricKey, tags: List[str]) -> int:
         row = self.interner.intern(key, tags)
         if row >= self.capacity:
@@ -484,6 +494,7 @@ class DigestGroup:
         self._imp_rows[self._imp_fill:] = self.capacity
         self._imp_stat_rows[self._imp_stat_fill:] = self.capacity
 
+    @requires_lock("store")
     def ensure_capacity(self, max_row: int):
         """Grow so max_row is addressable (bulk paths bypass _row)."""
         while max_row >= self.capacity:
@@ -495,6 +506,7 @@ class DigestGroup:
         re-grows interval over interval."""
         return DigestGroup(self.capacity, self.chunk, self.compression)
 
+    @requires_lock("store")
     def sample_many(self, rows: np.ndarray, vals: np.ndarray,
                     wts: np.ndarray):
         """Bulk staging append for the native ingest path: one numpy copy
@@ -514,6 +526,7 @@ class DigestGroup:
         if self._fill == self.chunk:
             self._drain_samples()
 
+    @requires_lock("store")
     def sample(self, key: MetricKey, tags: List[str], value: float,
                sample_rate: float):
         row = self._row(key, tags)
@@ -526,6 +539,7 @@ class DigestGroup:
         if self._fill == self.chunk:
             self._drain_samples()
 
+    @requires_lock("store")
     def import_centroids(self, key: MetricKey, tags: List[str],
                          means: np.ndarray, weights: np.ndarray,
                          dmin: float, dmax: float):
@@ -563,6 +577,7 @@ class DigestGroup:
             if self._imp_stat_fill == self.chunk:
                 self._drain_imports()
 
+    @requires_lock("store")
     def import_centroids_bulk(self, rows: np.ndarray, means: np.ndarray,
                               weights: np.ndarray, stat_rows,
                               stat_mins, stat_maxs):
@@ -690,6 +705,7 @@ class DigestGroup:
         self.digest = self.temp = self.dmin = self.dmax = None
         self._device_dirty = False
 
+    @requires_lock("store")
     def snapshot_state(self) -> dict:
         """Host copy of the live sketch state WITHOUT resetting it (the
         checkpoint path, veneur_tpu/persist/): digest-plane centroids
@@ -725,6 +741,7 @@ class DigestGroup:
             snap[nm] = np.asarray(arr, np.float32)
         return snap
 
+    @requires_lock("store")
     def restore_stats(self, rows: np.ndarray, count: np.ndarray,
                       vsum: np.ndarray, vmin: np.ndarray,
                       vmax: np.ndarray, recip: np.ndarray):
@@ -808,6 +825,7 @@ class SetGroup:
     def __len__(self):
         return len(self.interner)
 
+    @requires_lock("store")
     def _row(self, key: MetricKey, tags: List[str]) -> int:
         row = self.interner.intern(key, tags)
         if row >= self.capacity:
@@ -822,6 +840,7 @@ class SetGroup:
                                  ((0, self.capacity - old), (0, 0)))
         self._rows[self._fill:] = self.capacity
 
+    @requires_lock("store")
     def ensure_capacity(self, max_row: int):
         """Grow so max_row is addressable (bulk paths bypass _row)."""
         while max_row >= self.capacity:
@@ -831,6 +850,7 @@ class SetGroup:
         """Empty same-config twin (swap-on-flush generation swap)."""
         return SetGroup(self.capacity, self.chunk, self.precision)
 
+    @requires_lock("store")
     def sample_many(self, rows: np.ndarray, hashes: np.ndarray):
         """Bulk staging append of pre-hashed members (uint64) from the
         native ingest path."""
@@ -851,6 +871,7 @@ class SetGroup:
         if self._fill == self.chunk:
             self._drain_samples()
 
+    @requires_lock("store")
     def sample(self, key: MetricKey, tags: List[str], member: str):
         row = self._row(key, tags)
         h = hll_ops.hash_member(member.encode("utf-8"))
@@ -862,6 +883,7 @@ class SetGroup:
         if self._fill == self.chunk:
             self._drain_samples()
 
+    @requires_lock("store")
     def import_registers(self, key: MetricKey, tags: List[str],
                          registers: np.ndarray):
         """Merge a forwarded sketch: elementwise register max
@@ -879,6 +901,7 @@ class SetGroup:
         if len(self._imp_rows) >= IMPORT_DRAIN_BATCH:
             self._drain_imports()
 
+    @requires_lock("store")
     def import_registers_row(self, row: int, registers: np.ndarray):
         """Row-addressed variant for the native import path (the row was
         already interned through the C++ table)."""
@@ -952,6 +975,7 @@ class SetGroup:
         self.registers = jnp.zeros((self.capacity, self.m), jnp.int8)
         self._device_dirty = False
 
+    @requires_lock("store")
     def snapshot_state(self) -> dict:
         """Host copy of the live registers WITHOUT resetting (the
         checkpoint path, veneur_tpu/persist/). Caller holds the store
@@ -1046,6 +1070,7 @@ class HeavyHitterGroup:
             h = ((h ^ b) * 16777619) & 0xFFFFFFFF
         return h
 
+    @requires_lock("store")
     def _row(self, key: MetricKey, tags: List[str]) -> int:
         row = self.interner.intern(key, tags)
         if row >= self.capacity:
@@ -1055,6 +1080,7 @@ class HeavyHitterGroup:
                                                  ",".join(tags))
         return row
 
+    @requires_lock("store")
     def ensure_capacity(self, max_row: int):
         while max_row >= self.capacity:
             self._drain_samples()
@@ -1076,6 +1102,7 @@ class HeavyHitterGroup:
         if len(self._members) < self.MEMO_LIMIT:
             self._members[h] = member
 
+    @requires_lock("store")
     def sample(self, key: MetricKey, tags: List[str], member: str,
                weight: float = 1.0):
         row = self._row(key, tags)
@@ -1090,6 +1117,7 @@ class HeavyHitterGroup:
         if self._fill == self.chunk:
             self._drain_samples()
 
+    @requires_lock("store")
     def sample_many(self, rows: np.ndarray, hashes: np.ndarray,
                     members=None):
         """Bulk append from the native batch path; members (bytes) feed
@@ -1127,6 +1155,7 @@ class HeavyHitterGroup:
     def _drain_staging(self):
         self._drain_samples()
 
+    @requires_lock("store")
     def import_sketch(self, table: np.ndarray, series: List[tuple]):
         """Merge a forwarded heavy-hitter sketch: the count-min table
         adds elementwise, and each series' forwarded top-k keys become
@@ -1213,6 +1242,7 @@ class HeavyHitterGroup:
         self._members.clear()
         return interner, out, fwd
 
+    @requires_lock("store")
     def snapshot_state(self) -> dict:
         """Host copy of the live sketch WITHOUT resetting (the
         checkpoint path, veneur_tpu/persist/): the count-min table plus
@@ -1517,6 +1547,7 @@ class MetricStore:
 
     # -- ingest ------------------------------------------------------------
 
+    @acquires_lock("store")
     def process_metric(self, m: UDPMetric):
         """Dispatch one parsed sample to its scope-class (worker.go:267-310)."""
         with self._lock:
@@ -1550,6 +1581,7 @@ class MetricStore:
                     message=m.message, hostname=m.hostname)
             # unknown types are dropped, as in the reference
 
+    @acquires_lock("store")
     def process_batch(self, batch) -> List[bytes]:
         """Vectorized ingest of a native ParsedBatch (veneur_tpu.native):
         one lock acquisition per batch, one interning dict hit per record,
@@ -1643,6 +1675,7 @@ class MetricStore:
                 self.heavy_hitters)
         return self._kind_groups[kind]
 
+    @requires_lock("store")
     def _intern_native(self, t: int, sc: int, name_b: bytes,
                        tags_b: bytes) -> Tuple[int, object, int]:
         """Slow path of the native-batch cache: decode strings, pick the
@@ -1683,17 +1716,20 @@ class MetricStore:
 
     # -- import (global-aggregator ingest) ---------------------------------
 
+    @acquires_lock("store")
     def import_counter(self, key: MetricKey, tags: List[str], value: int):
         """Imported counters are global by definition (worker.go:313-326)."""
         with self._lock:
             self.imported += 1
             self.global_counters.combine(key, tags, value)
 
+    @acquires_lock("store")
     def import_gauge(self, key: MetricKey, tags: List[str], value: float):
         with self._lock:
             self.imported += 1
             self.global_gauges.combine(key, tags, value)
 
+    @acquires_lock("store")
     def import_digest(self, key: MetricKey, tags: List[str],
                       means: np.ndarray, weights: np.ndarray,
                       dmin: float, dmax: float):
@@ -1702,6 +1738,7 @@ class MetricStore:
             group = self.timers if key.type == "timer" else self.histograms
             group.import_centroids(key, tags, means, weights, dmin, dmax)
 
+    @acquires_lock("store")
     def import_digests_bulk(self, entries: List[tuple]):
         """Merge many forwarded digests in one pass: one lock hold, one
         flat staging append per group instead of a per-metric call chain
@@ -1745,12 +1782,14 @@ class MetricStore:
                                             flat_wts, stat_rows,
                                             stat_mins, stat_maxs)
 
+    @acquires_lock("store")
     def import_set(self, key: MetricKey, tags: List[str],
                    registers: np.ndarray):
         with self._lock:
             self.imported += 1
             self.sets.import_registers(key, tags, registers)
 
+    @acquires_lock("store")
     def import_columnar(self, dec, data: bytes) -> Tuple[int, int]:
         """Merge a natively-decoded MetricList (native/egress.py
         DecodedMetricList) in one pass: C++ row assignment, numpy bulk
@@ -1900,6 +1939,7 @@ class MetricStore:
             self.imported += n_ok
             return n_ok, n_err
 
+    @acquires_lock("store")
     def import_topk(self, table: np.ndarray, series: List[tuple]):
         """Merge a forwarded heavy-hitter sketch (see
         HeavyHitterGroup.import_sketch); series entries carry plain
@@ -1924,6 +1964,7 @@ class MetricStore:
         "timers": "timer", "local_timers": "timer",
         "sets": "set", "local_sets": "set", "heavy_hitters": "set"}
 
+    @acquires_lock("store")
     def snapshot_state(self) -> Tuple[Dict[str, dict], int]:
         """Host-side snapshot of every group WITHOUT resetting
         anything. Each group snapshots under its own lock hold, so
@@ -1943,6 +1984,7 @@ class MetricStore:
                 groups[name] = getattr(self, name).snapshot_state()
         return groups, epoch
 
+    @acquires_lock("store")
     def restore_state(self, groups: Dict[str, dict]) -> int:
         """Merge a recovered snapshot into the live store with the same
         semantics as the import path (counters add, gauges last-write,
@@ -1970,6 +2012,7 @@ class MetricStore:
                                   "skipping it", name)
         return merged
 
+    @requires_lock("store")
     def _restore_group(self, name: str, tname: str, target,
                        snap: dict) -> int:
         kind = snap.get("kind")
@@ -2052,6 +2095,7 @@ class MetricStore:
     def summary(self) -> MetricsSummary:
         return _summarize(self)
 
+    @acquires_lock("store")
     def flush(self, percentiles: List[float], aggregates: HistogramAggregates,
               is_local: bool, now: int, forward: bool = True,
               forward_topk: bool = True, columnar: bool = False,
@@ -2100,6 +2144,7 @@ class MetricStore:
                    "local_histograms", "local_timers", "sets", "local_sets",
                    "heavy_hitters")
 
+    @requires_lock("store")
     def _swap_generation(self) -> "_Generation":
         """Retire every group behind an empty twin; caller holds _lock.
         Also snapshots the interval tallies and invalidates the native

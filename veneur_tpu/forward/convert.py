@@ -17,7 +17,7 @@ from typing import Dict, List
 
 import numpy as np
 
-from veneur_tpu.protocol import forward_pb2, metricpb_pb2, tdigest_pb2
+from veneur_tpu.protocol import forward_pb2, metricpb_pb2
 
 log = logging.getLogger("veneur.forward.convert")
 

@@ -1,0 +1,32 @@
+"""veneur_tpu.lint — project-native static analysis.
+
+The Python/JAX substitute for the toolchain the reference leans on
+(``go vet``, the race detector, "imported and not used"). Five passes,
+all AST-based, no third-party lint dependency:
+
+- ``lock-discipline``  — ``@requires_lock`` call sites hold the store
+  lock (``lint/locks.py``; runtime twin in ``lint/tsan.py``)
+- ``jax-purity``       — no host syncs / Python branching inside
+  jit-traced hot paths (``lint/purity.py``)
+- ``config-drift``     — Config/ProxyConfig ↔ example yamls ↔ docs,
+  bidirectionally (``lint/configdrift.py``)
+- ``metric-registry``  — one ``veneur.*`` name, one tag schema, all
+  documented (``lint/metricnames.py``)
+- ``dead-code``        — unused module-level imports, unreachable
+  statements (``lint/deadcode.py``)
+
+Run ``python -m veneur_tpu.lint`` (non-zero exit on findings); tier-1
+CI runs the same passes over the real package via tests/test_lint.py.
+See docs/static-analysis.md.
+"""
+
+from veneur_tpu.lint.framework import (Baseline, Finding, Project, PASSES,
+                                       run_passes)
+# importing the pass modules registers them in PASSES
+from veneur_tpu.lint import locks as _locks            # noqa: F401
+from veneur_tpu.lint import purity as _purity          # noqa: F401
+from veneur_tpu.lint import configdrift as _configdrift  # noqa: F401
+from veneur_tpu.lint import metricnames as _metricnames  # noqa: F401
+from veneur_tpu.lint import deadcode as _deadcode      # noqa: F401
+
+__all__ = ["Baseline", "Finding", "Project", "PASSES", "run_passes"]

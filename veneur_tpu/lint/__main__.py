@@ -1,0 +1,102 @@
+"""``python -m veneur_tpu.lint`` — the single lint entry point.
+
+Exit status: 0 when every finding is covered by the baseline (and no
+baseline entry is stale), 1 when new findings (or stale baseline
+entries) exist, 2 on usage errors.
+
+    python -m veneur_tpu.lint                    # human output
+    python -m veneur_tpu.lint --json             # machine output
+    python -m veneur_tpu.lint --passes jax-purity,dead-code
+    python -m veneur_tpu.lint --update-baseline  # grandfather current set
+    python -m veneur_tpu.lint --metrics-table    # self-metrics registry md
+    python -m veneur_tpu.lint --config-table     # config-key reference md
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from veneur_tpu.lint import PASSES, Baseline, Project, run_passes
+from veneur_tpu.lint.configdrift import config_table
+from veneur_tpu.lint.metricnames import metrics_table
+
+
+def _default_root() -> str:
+    # the repo root is the parent of the installed package directory
+    here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    return os.path.dirname(here)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m veneur_tpu.lint",
+        description="veneur_tpu project-native static analysis")
+    ap.add_argument("--root", default=_default_root(),
+                    help="repo root (default: alongside the package)")
+    ap.add_argument("--passes", default="",
+                    help=f"comma-separated subset of {sorted(PASSES)}")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="machine-readable findings on stdout")
+    ap.add_argument("--baseline", default=None,
+                    help="baseline file (default: <root>/lint_baseline.json)")
+    ap.add_argument("--update-baseline", action="store_true",
+                    help="write current findings as the new baseline "
+                         "(then fill in each entry's reason!)")
+    ap.add_argument("--metrics-table", action="store_true",
+                    help="print the self-metrics registry markdown and exit")
+    ap.add_argument("--config-table", action="store_true",
+                    help="print the config-key reference markdown and exit")
+    args = ap.parse_args(argv)
+
+    project = Project(args.root)
+    if args.metrics_table:
+        print(metrics_table(project))
+        return 0
+    if args.config_table:
+        print(config_table(project))
+        return 0
+
+    only = [p.strip() for p in args.passes.split(",") if p.strip()] or None
+    try:
+        findings = run_passes(project, only)
+    except KeyError as e:
+        print(e.args[0], file=sys.stderr)
+        return 2
+
+    baseline_path = args.baseline or os.path.join(args.root,
+                                                  "lint_baseline.json")
+    baseline = Baseline.load(baseline_path)
+    if args.update_baseline:
+        baseline.save(findings)
+        print(f"wrote {len(findings)} finding(s) to {baseline_path}; "
+              f"fill in every 'reason'")
+        return 0
+
+    new, grandfathered, stale = baseline.split(findings)
+
+    if args.as_json:
+        print(json.dumps({
+            "findings": [f.as_json() for f in new],
+            "grandfathered": [f.as_json() for f in grandfathered],
+            "stale_baseline": stale,
+        }, indent=2))
+    else:
+        for f in new:
+            print(f.render())
+        for key in stale:
+            print(f"stale baseline entry (code fixed? remove it): {key}")
+        if new or stale:
+            print(f"\n{len(new)} finding(s), {len(stale)} stale baseline "
+                  f"entr(ies); {len(grandfathered)} grandfathered")
+        else:
+            print(f"clean: 0 findings across "
+                  f"{len(only) if only else len(PASSES)} pass(es), "
+                  f"{len(grandfathered)} grandfathered")
+    return 1 if (new or stale) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

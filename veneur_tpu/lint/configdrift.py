@@ -1,0 +1,178 @@
+"""Config-drift pass: Config/ProxyConfig ↔ example yamls ↔ docs, both ways.
+
+The reference ships ``example.yaml`` files that double as the de-facto
+key reference; a key that exists in code but not in the examples (or
+vice versa) is exactly the drift this repo accumulated across the
+resilience/persist PRs. The contract enforced here:
+
+- every ``Config`` dataclass field appears in ``example.yaml`` or
+  ``example_host.yaml`` (``ProxyConfig`` → ``example_proxy.yaml``) —
+  unless the field is marked deprecated/rejected in ``config.py``
+  (a ``# deprecated`` / ``REJECTED`` comment on or directly above it);
+- every key in those yamls parses into a dataclass field (the loader
+  only *warns* on unknown keys, so a typo'd example would otherwise
+  ship silently);
+- every live (non-deprecated) field is documented: its name appears in
+  README.md or some ``docs/*.md`` (docs/config.md is the generated
+  reference; ``--config-table`` regenerates it).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Set, Tuple
+
+import yaml
+
+from veneur_tpu.lint.framework import Finding, Project, register
+
+CONFIG_FILE = "veneur_tpu/config.py"
+_SERVER_YAMLS = ["example.yaml", "example_host.yaml"]
+_PROXY_YAMLS = ["example_proxy.yaml"]
+_EXEMPT_RE = re.compile(r"deprecated|REJECTED", re.IGNORECASE)
+
+
+def dataclass_fields(project: Project, cls_name: str) -> Dict[str, Tuple[int, bool]]:
+    """field name -> (line, exempt) for one dataclass in config.py.
+    ``exempt`` = the field (or the comment block right above it) is
+    marked deprecated/rejected, so example/doc presence is not required."""
+    sf = project.files[CONFIG_FILE]
+    out: Dict[str, Tuple[int, bool]] = {}
+    for node in ast.walk(sf.tree):
+        if not isinstance(node, ast.ClassDef) or node.name != cls_name:
+            continue
+        for stmt in node.body:
+            if not isinstance(stmt, ast.AnnAssign) \
+                    or not isinstance(stmt.target, ast.Name):
+                continue
+            line = stmt.lineno
+            exempt = False
+            if _EXEMPT_RE.search(sf.lines[line - 1]):
+                exempt = True
+            else:
+                # scan the contiguous comment block directly above
+                i = line - 2
+                while i >= 0 and sf.lines[i].strip().startswith("#"):
+                    if _EXEMPT_RE.search(sf.lines[i]):
+                        exempt = True
+                        break
+                    i -= 1
+            out[stmt.target.id] = (line, exempt)
+    return out
+
+
+def _yaml_keys(project: Project, relpath: str) -> Set[str]:
+    text = project.read(relpath)
+    if text is None:
+        return set()
+    data = yaml.safe_load(text) or {}
+    return set(data) if isinstance(data, dict) else set()
+
+
+def _word_in(name: str, text: str) -> bool:
+    return re.search(rf"(?<![A-Za-z0-9_]){re.escape(name)}(?![A-Za-z0-9_])",
+                     text) is not None
+
+
+@register("config-drift")
+def run(project: Project) -> List[Finding]:
+    findings: List[Finding] = []
+    if CONFIG_FILE not in project.files:
+        return findings
+    sf = project.files[CONFIG_FILE]
+    docs = project.docs_text()
+
+    for cls_name, yamls in (("Config", _SERVER_YAMLS),
+                            ("ProxyConfig", _PROXY_YAMLS)):
+        fields = dataclass_fields(project, cls_name)
+        example_keys: Set[str] = set()
+        for y in yamls:
+            example_keys |= _yaml_keys(project, y)
+
+        for name, (line, exempt) in sorted(fields.items()):
+            if exempt:
+                continue
+            if sf.suppressed(line, "config-drift"):
+                continue
+            if name not in example_keys:
+                findings.append(Finding(
+                    pass_name="config-drift", code="field-not-in-example",
+                    file=CONFIG_FILE, line=line,
+                    anchor=f"{cls_name}.{name}",
+                    message=(f"{cls_name}.{name} has no example entry in "
+                             f"{' / '.join(yamls)} (add it, or mark the "
+                             f"field deprecated in config.py)")))
+            if not _word_in(name, docs):
+                findings.append(Finding(
+                    pass_name="config-drift", code="field-not-in-docs",
+                    file=CONFIG_FILE, line=line,
+                    anchor=f"{cls_name}.{name}",
+                    message=(f"{cls_name}.{name} is undocumented — not "
+                             f"mentioned in README.md or docs/*.md "
+                             f"(docs/config.md is the generated "
+                             f"reference: `python -m veneur_tpu.lint "
+                             f"--config-table`)")))
+
+        # reverse direction: every example key must parse into a field
+        for y in yamls:
+            for key in sorted(_yaml_keys(project, y)):
+                if key not in fields:
+                    findings.append(Finding(
+                        pass_name="config-drift", code="unparsed-yaml-key",
+                        file=y, line=1, anchor=key,
+                        message=(f"{y} sets `{key}`, which no {cls_name} "
+                                 f"field parses — the loader silently "
+                                 f"warns and drops it")))
+    return findings
+
+
+def config_table(project: Project) -> str:
+    """Markdown reference of every config key (for docs/config.md)."""
+    sf = project.files[CONFIG_FILE]
+    lines = ["# Configuration key reference", "",
+             "Generated by `python -m veneur_tpu.lint --config-table`; the",
+             "config-drift lint pass fails when a key here goes stale.",
+             "Defaults shown are the dataclass defaults before",
+             "`apply_defaults()` fills in derived values.", ""]
+    for cls_name, title in (("Config", "Server (`example.yaml` / "
+                             "`example_host.yaml`)"),
+                            ("ProxyConfig", "Proxy (`example_proxy.yaml`)")):
+        fields = dataclass_fields(project, cls_name)
+        lines += [f"## {title}", "", "| key | default | notes |",
+                  "|---|---|---|"]
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.ClassDef) or node.name != cls_name:
+                continue
+            for stmt in node.body:
+                if not isinstance(stmt, ast.AnnAssign) \
+                        or not isinstance(stmt.target, ast.Name):
+                    continue
+                name = stmt.target.id
+                default = ast.unparse(stmt.value) if stmt.value is not None \
+                    else ""
+                _, exempt = fields[name]
+                src_line = sf.lines[stmt.lineno - 1]
+                note = ""
+                if "#" in src_line:
+                    note = src_line.split("#", 1)[1].strip()
+                else:
+                    # the contiguous comment block directly above the
+                    # field (skipping section-divider comments)
+                    block = []
+                    i = stmt.lineno - 2
+                    while i >= 0 and sf.lines[i].strip().startswith("#"):
+                        text = sf.lines[i].strip().lstrip("#").strip()
+                        if not text.startswith("----"):
+                            block.append(text)
+                        i -= 1
+                    note = " ".join(reversed(block))
+                    if len(note) > 160:
+                        note = note[:157] + "..."
+                if exempt and not note:
+                    note = "deprecated"
+                note = note.replace("|", "\\|")
+                default = default.replace("|", "\\|")
+                lines.append(f"| `{name}` | `{default}` | {note} |")
+        lines.append("")
+    return "\n".join(lines)

@@ -1,0 +1,116 @@
+"""Dead-code pass: unused module-level imports, unreachable statements.
+
+The trivial-but-constant hygiene Go gets from the compiler ("imported
+and not used" is a build error). Two checks:
+
+- ``unused-import``: a module-level import (including ones nested in
+  ``try:``/``if TYPE_CHECKING:`` blocks) whose bound name is never read.
+  Usage counts ``ast.Name`` loads, attribute roots, decorators, *and*
+  word-occurrences inside string constants (string type annotations
+  under ``from __future__ import annotations``). ``__init__.py`` files
+  are skipped entirely — there an import IS the re-export surface.
+- ``unreachable``: statements in the same block after an unconditional
+  ``return`` / ``raise`` / ``continue`` / ``break``.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import List, Set
+
+from veneur_tpu.lint.framework import (Finding, Project, qualname,
+                                       register)
+
+
+def _bound_imports(tree: ast.Module):
+    """Yield (bound_name, node) for module-level imports, walking into
+    If/Try wrappers (TYPE_CHECKING blocks, optional-dep guards)."""
+
+    def visit(body):
+        for stmt in body:
+            if isinstance(stmt, ast.Import):
+                for a in stmt.names:
+                    yield (a.asname or a.name.split(".")[0]), stmt
+            elif isinstance(stmt, ast.ImportFrom):
+                if stmt.module == "__future__":
+                    continue  # compiler directive, not a binding
+                for a in stmt.names:
+                    if a.name == "*":
+                        continue
+                    yield (a.asname or a.name), stmt
+            elif isinstance(stmt, ast.If):
+                yield from visit(stmt.body)
+                yield from visit(stmt.orelse)
+            elif isinstance(stmt, ast.Try):
+                yield from visit(stmt.body)
+                for h in stmt.handlers:
+                    yield from visit(h.body)
+                yield from visit(stmt.orelse)
+                yield from visit(stmt.finalbody)
+
+    yield from visit(tree.body)
+
+
+def _used_names(tree: ast.Module) -> Set[str]:
+    used: Set[str] = set()
+    strings: List[str] = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load):
+            used.add(node.id)
+        elif isinstance(node, ast.Constant) and isinstance(node.value, str):
+            strings.append(node.value)
+        elif isinstance(node, ast.Global):
+            used.update(node.names)
+    blob = "\n".join(strings)
+    words = set(re.findall(r"[A-Za-z_][A-Za-z0-9_]*", blob))
+    return used | words
+
+
+_TERMINATORS = (ast.Return, ast.Raise, ast.Continue, ast.Break)
+
+
+@register("dead-code")
+def run(project: Project) -> List[Finding]:
+    findings: List[Finding] = []
+    for sf in project.files.values():
+        is_init = sf.relpath.endswith("__init__.py")
+        if not is_init:
+            used = _used_names(sf.tree)
+            for name, node in _bound_imports(sf.tree):
+                if name == "_" or name.startswith("__"):
+                    continue
+                if name in used:
+                    continue
+                if sf.suppressed(node.lineno, "unused-import"):
+                    continue
+                findings.append(Finding(
+                    pass_name="dead-code", code="unused-import",
+                    file=sf.relpath, line=node.lineno, anchor=name,
+                    message=f"module-level import `{name}` is never used"))
+
+        parents = sf.parents
+        for node in ast.walk(sf.tree):
+            body_lists = []
+            for attr in ("body", "orelse", "finalbody"):
+                block = getattr(node, attr, None)
+                if isinstance(block, list):
+                    body_lists.append(block)
+            for block in body_lists:
+                for i, stmt in enumerate(block[:-1]):
+                    if isinstance(stmt, _TERMINATORS):
+                        nxt = block[i + 1]
+                        if sf.suppressed(nxt.lineno, "unreachable"):
+                            break
+                        kind = type(stmt).__name__.lower()
+                        # line-free anchor (baseline stability): the
+                        # enclosing def/class scope plus terminator kind
+                        findings.append(Finding(
+                            pass_name="dead-code", code="unreachable",
+                            file=sf.relpath, line=nxt.lineno,
+                            anchor=f"{qualname(stmt, parents)}:"
+                                   f"after-{kind}",
+                            message=(f"unreachable code after the "
+                                     f"{kind} on line {stmt.lineno}")))
+                        break
+    return findings

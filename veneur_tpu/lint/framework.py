@@ -1,0 +1,293 @@
+"""Core of ``veneur_tpu.lint``: findings, sources, baseline, pass registry.
+
+Self-contained (stdlib ``ast`` + ``yaml`` which the package already
+requires); no third-party lint dependency. Each pass is a callable
+``(Project) -> List[Finding]`` registered in ``PASSES``; the runner in
+``__main__.py`` diff's findings against a *file-anchored* baseline so
+grandfathered findings can be carried explicitly (and justified in the
+baseline file) without pinning line numbers.
+
+Inline suppression: append ``# lint: ok(<code>)`` to the offending line
+(optionally followed by a reason). The pragma is per-line and per-code,
+so a suppression can never silently widen.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional
+
+_PRAGMA_RE = re.compile(r"#\s*lint:\s*ok\(([a-z0-9_,\- ]+)\)")
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One violation. ``anchor`` is a stable, line-free identifier inside
+    the file (usually the enclosing function or the offending symbol) so
+    baseline entries survive unrelated edits."""
+
+    pass_name: str
+    code: str
+    file: str       # repo-relative path
+    line: int       # 1-based; informational, not part of the baseline key
+    anchor: str
+    message: str
+
+    def key(self) -> str:
+        return f"{self.pass_name}:{self.code}:{self.file}:{self.anchor}"
+
+    def render(self) -> str:
+        return (f"{self.file}:{self.line}: [{self.pass_name}/{self.code}] "
+                f"{self.message}")
+
+    def as_json(self) -> dict:
+        return {"pass": self.pass_name, "code": self.code, "file": self.file,
+                "line": self.line, "anchor": self.anchor,
+                "message": self.message}
+
+
+class SourceFile:
+    """A parsed python source: AST plus per-line pragma suppressions."""
+
+    def __init__(self, path: str, relpath: str, text: str):
+        self.path = path
+        self.relpath = relpath
+        self.text = text
+        self.lines = text.splitlines()
+        self.tree = ast.parse(text, filename=path)
+        self._parents: Optional[Dict[ast.AST, ast.AST]] = None
+        # pragmas live in actual COMMENT tokens only — pragma-shaped
+        # text inside a string/docstring must not become a suppression
+        self._pragmas: Dict[int, set] = {}
+        import io
+        import tokenize
+
+        try:
+            for tok in tokenize.generate_tokens(io.StringIO(text).readline):
+                if tok.type != tokenize.COMMENT:
+                    continue
+                m = _PRAGMA_RE.search(tok.string)
+                if m:
+                    self._pragmas.setdefault(tok.start[0], set()).update(
+                        c.strip() for c in m.group(1).split(","))
+        except tokenize.TokenError:  # pragma: no cover - ast.parse passed
+            pass
+
+    def suppressed(self, line: int, code: str) -> bool:
+        return code in self._pragmas.get(line, ())
+
+    @property
+    def parents(self) -> Dict[ast.AST, ast.AST]:
+        """Child -> parent map for this file's AST, built once."""
+        if self._parents is None:
+            self._parents = parent_map(self.tree)
+        return self._parents
+
+
+class Project:
+    """The analyzed tree: every ``veneur_tpu/**/*.py`` parsed once, plus
+    the repo-level artifacts (example yamls, markdown docs) the drift
+    passes compare against."""
+
+    def __init__(self, root: str, package: str = "veneur_tpu"):
+        self.root = os.path.abspath(root)
+        self.package = package
+        self.files: Dict[str, SourceFile] = {}
+        pkg_dir = os.path.join(self.root, package)
+        for dirpath, dirnames, filenames in os.walk(pkg_dir):
+            dirnames[:] = sorted(d for d in dirnames if d != "__pycache__")
+            # generated protobuf modules are not ours to lint; match on
+            # the package-RELATIVE path so a checkout under some
+            # /home/gen/... prefix doesn't skip everything
+            rel_dir = os.path.relpath(dirpath, pkg_dir).replace(os.sep, "/")
+            if rel_dir == "gen" or rel_dir.startswith("gen/") \
+                    or "/gen/" in rel_dir or rel_dir.endswith("/gen"):
+                continue
+            for fn in sorted(filenames):
+                if not fn.endswith(".py"):
+                    continue
+                path = os.path.join(dirpath, fn)
+                rel = os.path.relpath(path, self.root).replace(os.sep, "/")
+                with open(path, encoding="utf-8") as f:
+                    text = f.read()
+                try:
+                    self.files[rel] = SourceFile(path, rel, text)
+                except SyntaxError as e:  # pragma: no cover - never ships
+                    raise SyntaxError(f"{rel}: {e}") from e
+
+    # -- repo artifacts ----------------------------------------------------
+
+    def read(self, relpath: str) -> Optional[str]:
+        path = os.path.join(self.root, relpath)
+        if not os.path.exists(path):
+            return None
+        with open(path, encoding="utf-8") as f:
+            return f.read()
+
+    def docs_text(self) -> str:
+        """Concatenated markdown the drift passes treat as "the docs":
+        README.md plus everything under docs/."""
+        parts = []
+        for rel in ["README.md"]:
+            t = self.read(rel)
+            if t:
+                parts.append(t)
+        docs_dir = os.path.join(self.root, "docs")
+        if os.path.isdir(docs_dir):
+            for fn in sorted(os.listdir(docs_dir)):
+                if fn.endswith(".md"):
+                    t = self.read(os.path.join("docs", fn))
+                    if t:
+                        parts.append(t)
+        return "\n".join(parts)
+
+    def module_name(self, relpath: str) -> str:
+        """veneur_tpu/ops/tdigest.py -> veneur_tpu.ops.tdigest"""
+        mod = relpath[:-3].replace("/", ".")
+        if mod.endswith(".__init__"):
+            mod = mod[: -len(".__init__")]
+        return mod
+
+
+@dataclass
+class Baseline:
+    """Explicit grandfathered findings. Each entry keys a finding by
+    (pass, code, file, anchor) — file-anchored, line-free — and carries a
+    human justification that the runner refuses to leave empty."""
+
+    path: str
+    entries: Dict[str, str] = field(default_factory=dict)  # key -> reason
+
+    @classmethod
+    def load(cls, path: str) -> "Baseline":
+        bl = cls(path=path)
+        if os.path.exists(path):
+            with open(path, encoding="utf-8") as f:
+                data = json.load(f)
+            for e in data.get("findings", []):
+                key = (f"{e['pass']}:{e['code']}:{e['file']}:{e['anchor']}")
+                bl.entries[key] = e.get("reason", "")
+        return bl
+
+    def save(self, findings: List[Finding]):
+        data = {
+            "_comment": (
+                "Grandfathered veneur_tpu.lint findings. Every entry MUST "
+                "carry a non-empty 'reason'; remove entries as the code "
+                "they excuse is fixed (stale entries fail the run)."),
+            "findings": [
+                {"pass": f.pass_name, "code": f.code, "file": f.file,
+                 "anchor": f.anchor,
+                 "reason": self.entries.get(f.key(), "TODO: justify")}
+                for f in sorted(findings, key=lambda f: f.key())
+            ],
+        }
+        with open(self.path, "w", encoding="utf-8") as f:
+            json.dump(data, f, indent=2, sort_keys=False)
+            f.write("\n")
+
+    def split(self, findings: List[Finding]):
+        """(new, grandfathered, stale_baseline_keys). An entry whose
+        reason is empty or still the "TODO" placeholder does NOT
+        grandfather anything — justification is the price of entry."""
+        keys = {f.key() for f in findings}
+        new, old = [], []
+        for f in findings:
+            reason = self.entries.get(f.key(), "").strip()
+            if reason and not reason.startswith("TODO"):
+                old.append(f)
+            else:
+                new.append(f)
+        stale = sorted(k for k in self.entries if k not in keys)
+        return new, old, stale
+
+
+# -- shared AST helpers ---------------------------------------------------
+
+def parent_map(tree: ast.AST) -> Dict[ast.AST, ast.AST]:
+    parents: Dict[ast.AST, ast.AST] = {}
+    for node in ast.walk(tree):
+        for child in ast.iter_child_nodes(node):
+            parents[child] = node
+    return parents
+
+
+def enclosing_function(node: ast.AST, parents: Dict[ast.AST, ast.AST]):
+    cur = parents.get(node)
+    while cur is not None:
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return cur
+        cur = parents.get(cur)
+    return None
+
+
+def qualname(node: ast.AST, parents: Dict[ast.AST, ast.AST]) -> str:
+    """Dotted path of classes/functions enclosing (and including) node."""
+    names = []
+    cur: Optional[ast.AST] = node
+    while cur is not None:
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef,
+                            ast.ClassDef)):
+            names.append(cur.name)
+        cur = parents.get(cur)
+    return ".".join(reversed(names)) or "<module>"
+
+
+def dotted(node: ast.AST) -> Optional[str]:
+    """ast.Attribute/Name chain -> "a.b.c", or None for anything else."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def import_aliases(tree: ast.Module) -> Dict[str, str]:
+    """Import name -> fully qualified module/symbol path, collected from
+    the WHOLE file (module level, ``if TYPE_CHECKING:``/``try:`` blocks,
+    and function-local imports — the lazy-import idiom the hot modules
+    use to break cycles). Scoping is flattened: a name means the same
+    target everywhere in one file, which holds across this codebase."""
+    aliases: Dict[str, str] = {}
+    for stmt in ast.walk(tree):
+        if isinstance(stmt, ast.Import):
+            for a in stmt.names:
+                aliases[a.asname or a.name.split(".")[0]] = a.name
+        elif isinstance(stmt, ast.ImportFrom) and stmt.module \
+                and stmt.level == 0:
+            for a in stmt.names:
+                aliases[a.asname or a.name] = f"{stmt.module}.{a.name}"
+    return aliases
+
+
+PassFn = Callable[[Project], List[Finding]]
+PASSES: Dict[str, PassFn] = {}
+
+
+def register(name: str):
+    def deco(fn: PassFn) -> PassFn:
+        PASSES[name] = fn
+        return fn
+
+    return deco
+
+
+def run_passes(project: Project,
+               only: Optional[List[str]] = None) -> List[Finding]:
+    names = only if only else list(PASSES)
+    unknown = [n for n in names if n not in PASSES]
+    if unknown:
+        raise KeyError(f"unknown lint pass(es) {unknown}; "
+                       f"known: {sorted(PASSES)}")
+    findings: List[Finding] = []
+    for name in names:
+        findings.extend(PASSES[name](project))
+    findings.sort(key=lambda f: (f.file, f.line, f.code))
+    return findings

@@ -1,0 +1,275 @@
+"""Lock-discipline pass: ``@requires_lock`` call sites must hold the lock.
+
+The aggregation store's concurrency contract (core/store.py): group
+state mutates only under ``MetricStore._lock``; flushes mutate only
+*retired* generations they exclusively own. Go's race detector enforced
+this in the reference — here the contract is spelled as annotations
+(``veneur_tpu/core/locking.py``) and this pass walks every call site:
+
+A call to a ``@requires_lock(L)``-annotated function is legal when it is
+
+  1. lexically inside a ``with <expr>._lock:`` block (the convention:
+     the owning object's ``_lock`` attribute IS lock ``L``), or
+  2. inside a function annotated ``@requires_lock(L)`` itself — the
+     obligation propagates to *that* function's call sites, which this
+     pass checks in turn (the call-graph walk), or
+  3. suppressed inline (``# lint: ok(unlocked-call)`` — e.g. a retired
+     flush generation the caller exclusively owns) or baselined.
+
+Receiver resolution is a light, conservative type inference
+(``self.attr = GroupClass(...)`` bindings, local aliases, annotated
+parameters, conditional/tuple assignments). Where the receiver cannot
+be resolved, the bare method name matches only when it is unambiguous —
+i.e. no *unannotated or lock-acquiring* definition elsewhere in the
+package shares the name (so ``store.snapshot_state()``, which acquires
+internally, never false-positives against the groups' snapshot_state).
+
+What the static walk cannot see (dynamic dispatch, getattr) is covered
+at runtime by the TSan-lite fixture (``veneur_tpu/lint/tsan.py``).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from veneur_tpu.lint.framework import (Finding, Project, SourceFile, dotted,
+                                       qualname, register)
+
+_DECOS = {"requires_lock": "requires", "acquires_lock": "acquires"}
+
+
+def _lock_decoration(fn: ast.FunctionDef) -> Optional[Tuple[str, str]]:
+    """('requires'|'acquires', lock_name) if the def carries one."""
+    for dec in fn.decorator_list:
+        if not isinstance(dec, ast.Call):
+            continue
+        name = dotted(dec.func)
+        if name is None:
+            continue
+        kind = _DECOS.get(name.split(".")[-1])
+        if kind and dec.args and isinstance(dec.args[0], ast.Constant) \
+                and isinstance(dec.args[0].value, str):
+            return kind, dec.args[0].value
+    return None
+
+
+class _Registry:
+    """Annotated definitions across the whole package."""
+
+    def __init__(self):
+        # method name -> set of lock names it may require
+        self.requires: Dict[str, Set[str]] = {}
+        # (class name, method name) -> lock name, for resolved receivers
+        self.by_class: Dict[Tuple[str, str], str] = {}
+        # class names owning at least one @requires_lock method
+        self.group_classes: Set[str] = set()
+        # module-level @requires_lock functions: bare name -> lock
+        self.functions: Dict[str, str] = {}
+        # names that ALSO exist as unannotated/acquiring defs somewhere,
+        # making a bare-name match unsafe
+        self.ambiguous: Set[str] = set()
+
+
+def _build_registry(project: Project) -> _Registry:
+    reg = _Registry()
+    plain_defs: Set[str] = set()
+    for sf in project.files.values():
+        parents = sf.parents
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.FunctionDef):
+                continue
+            deco = _lock_decoration(node)
+            owner = parents.get(node)
+            in_class = isinstance(owner, ast.ClassDef)
+            if deco and deco[0] == "requires":
+                lock = deco[1]
+                reg.requires.setdefault(node.name, set()).add(lock)
+                if in_class:
+                    reg.by_class[(owner.name, node.name)] = lock
+                    reg.group_classes.add(owner.name)
+                else:
+                    reg.functions[node.name] = lock
+            else:
+                plain_defs.add(node.name)
+    reg.ambiguous = set(reg.requires) & plain_defs
+    return reg
+
+
+def _class_attr_types(sf: SourceFile) -> Dict[str, Dict[str, Set[str]]]:
+    """class name -> {self-attribute -> possible class names} from
+    ``self.attr = ClassName(...)`` assignments anywhere in the class."""
+    out: Dict[str, Dict[str, Set[str]]] = {}
+
+    def ctor_names(value: ast.AST) -> Set[str]:
+        names: Set[str] = set()
+        if isinstance(value, ast.Call):
+            name = dotted(value.func)
+            if name:
+                names.add(name.split(".")[-1])
+        elif isinstance(value, ast.IfExp):
+            names |= ctor_names(value.body)
+            names |= ctor_names(value.orelse)
+        return names
+
+    for cls in ast.walk(sf.tree):
+        if not isinstance(cls, ast.ClassDef):
+            continue
+        attrs = out.setdefault(cls.name, {})
+        for node in ast.walk(cls):
+            if not isinstance(node, ast.Assign):
+                continue
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Attribute) \
+                        and isinstance(tgt.value, ast.Name) \
+                        and tgt.value.id == "self":
+                    got = ctor_names(node.value)
+                    if got:
+                        attrs.setdefault(tgt.attr, set()).update(got)
+    return out
+
+
+def _infer_locals(fn: ast.FunctionDef, self_attrs: Dict[str, Set[str]],
+                  known_classes: Set[str]) -> Dict[str, Set[str]]:
+    """variable -> possible class names, for receivers local to ``fn``."""
+    env: Dict[str, Set[str]] = {}
+
+    for arg in list(fn.args.args) + list(fn.args.kwonlyargs):
+        ann = arg.annotation
+        name = None
+        if isinstance(ann, ast.Constant) and isinstance(ann.value, str):
+            name = ann.value.strip('"')
+        elif ann is not None:
+            name = dotted(ann)
+        if name and name.split(".")[-1] in known_classes:
+            env[arg.arg] = {name.split(".")[-1]}
+
+    def expr_types(value: ast.AST) -> Set[str]:
+        types: Set[str] = set()
+        if isinstance(value, ast.Call):
+            name = dotted(value.func)
+            if name and name.split(".")[-1] in known_classes:
+                types.add(name.split(".")[-1])
+        elif isinstance(value, ast.Attribute) \
+                and isinstance(value.value, ast.Name) \
+                and value.value.id == "self":
+            types |= self_attrs.get(value.attr, set())
+        elif isinstance(value, ast.Name):
+            types |= env.get(value.id, set())
+        elif isinstance(value, ast.IfExp):
+            types |= expr_types(value.body)
+            types |= expr_types(value.orelse)
+        return types
+
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Assign):
+            continue
+        for tgt in node.targets:
+            if isinstance(tgt, ast.Name):
+                got = expr_types(node.value)
+                if got:
+                    env.setdefault(tgt.id, set()).update(got)
+            elif isinstance(tgt, ast.Tuple) \
+                    and isinstance(node.value, ast.Tuple) \
+                    and len(tgt.elts) == len(node.value.elts):
+                for t, v in zip(tgt.elts, node.value.elts):
+                    if isinstance(t, ast.Name):
+                        got = expr_types(v)
+                        if got:
+                            env.setdefault(t.id, set()).update(got)
+    return env
+
+
+def _holds_lock(node: ast.AST, parents: Dict[ast.AST, ast.AST],
+                lock: str) -> bool:
+    """Inside ``with <expr>._lock:`` or inside a function that itself
+    ``@requires_lock`` the same lock. An ``@acquires_lock`` function
+    does NOT blanket-exempt its body — only its actual ``with`` blocks
+    hold the lock (code before/after them is exactly where an unlocked
+    mutation would hide)."""
+    cur = parents.get(node)
+    while cur is not None:
+        if isinstance(cur, ast.With):
+            for item in cur.items:
+                name = dotted(item.context_expr)
+                if name and name.split(".")[-1] == "_lock":
+                    return True
+        if isinstance(cur, ast.FunctionDef):
+            deco = _lock_decoration(cur)
+            if deco and deco[0] == "requires" and deco[1] == lock:
+                return True
+        cur = parents.get(cur)
+    return False
+
+
+@register("lock-discipline")
+def run(project: Project) -> List[Finding]:
+    reg = _build_registry(project)
+    findings: List[Finding] = []
+    if not reg.requires and not reg.functions:
+        return findings
+
+    for sf in project.files.values():
+        parents = sf.parents
+        attr_types = _class_attr_types(sf)
+        local_env_cache: Dict[ast.FunctionDef, Dict[str, Set[str]]] = {}
+
+        def receiver_types(call: ast.Call) -> Set[str]:
+            recv = call.func.value  # type: ignore[union-attr]
+            encl = parents.get(call)
+            while encl is not None and not isinstance(encl, ast.FunctionDef):
+                encl = parents.get(encl)
+            cls = parents.get(encl) if encl is not None else None
+            self_attrs = attr_types.get(cls.name, {}) \
+                if isinstance(cls, ast.ClassDef) else {}
+            if isinstance(recv, ast.Attribute) \
+                    and isinstance(recv.value, ast.Name) \
+                    and recv.value.id == "self":
+                return self_attrs.get(recv.attr, set())
+            if isinstance(recv, ast.Name) and encl is not None:
+                if encl not in local_env_cache:
+                    local_env_cache[encl] = _infer_locals(
+                        encl, self_attrs, reg.group_classes)
+                return local_env_cache[encl].get(recv.id, set())
+            return set()
+
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            lock = None
+            method = None
+            if isinstance(node.func, ast.Attribute):
+                method = node.func.attr
+                locks = reg.requires.get(method)
+                if not locks:
+                    continue
+                rtypes = receiver_types(node)
+                resolved = {reg.by_class[(t, method)] for t in rtypes
+                            if (t, method) in reg.by_class}
+                if resolved:
+                    lock = sorted(resolved)[0]
+                elif rtypes:
+                    continue  # resolved to a class without the contract
+                elif method not in reg.ambiguous:
+                    lock = sorted(locks)[0]
+                else:
+                    continue  # ambiguous bare name, unresolvable receiver
+            elif isinstance(node.func, ast.Name):
+                method = node.func.id
+                lock = reg.functions.get(method)
+                if lock is None:
+                    continue
+            else:
+                continue
+            if _holds_lock(node, parents, lock):
+                continue
+            if sf.suppressed(node.lineno, "unlocked-call"):
+                continue
+            anchor = f"{qualname(node, parents)}->{method}"
+            findings.append(Finding(
+                pass_name="lock-discipline", code="unlocked-call",
+                file=sf.relpath, line=node.lineno, anchor=anchor,
+                message=(f"call to @requires_lock({lock!r}) method "
+                         f"{method}() outside a `with ..._lock:` block and "
+                         f"outside any @requires_lock({lock!r}) function")))
+    return findings

@@ -1,0 +1,198 @@
+"""Self-metric registry pass: one name, one tag schema, all documented.
+
+Every ``veneur.*`` self-metric is emitted through the SSF sample
+constructors (``veneur_tpu/trace/samples.py``: ``count`` / ``gauge`` /
+``timing`` / ``histogram`` / ``set_sample`` / ``status``). This pass
+collects every such call site whose name literal (or f-string, with
+placeholders normalized to ``<name>``-style holes) starts with
+``veneur.`` and enforces:
+
+- **tag-schema coherence**: a name emitted from several sites must use
+  compatible tag-key sets — identical, or one a subset of the other
+  (optional tags like ``part`` are fine; two sites with *disjoint* keys
+  are two different metrics wearing one name). Sites passing a
+  non-literal tags expression are skipped (unknowable statically).
+- **documentation**: every emitted name appears in README.md or
+  docs/*.md. ``docs/static-analysis.md`` carries the generated registry
+  table (``python -m veneur_tpu.lint --metrics-table``), so the fix for
+  a finding here is one regeneration away.
+
+The collected registry also backs ``metrics_table()``.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set
+
+from veneur_tpu.lint.framework import (Finding, Project, dotted,
+                                       import_aliases, register)
+
+_SAMPLE_FNS = {"count": "counter", "gauge": "gauge", "timing": "timer",
+               "histogram": "histogram", "set_sample": "set",
+               "status": "status"}
+_SAMPLES_MODULE = "veneur_tpu.trace.samples"
+
+
+def _name_in_docs(name: str, docs: str) -> bool:
+    """Exact-name match: `veneur.flush` must NOT count as documented just
+    because `veneur.flush.age_seconds` is (dots are name separators)."""
+    import re
+
+    return re.search(
+        rf"(?<![A-Za-z0-9_.]){re.escape(name)}(?![A-Za-z0-9_.])",
+        docs) is not None
+
+
+@dataclass
+class Emission:
+    name: str                    # normalized: f-string holes -> <expr>
+    kind: str                    # counter/gauge/...
+    file: str
+    line: int
+    tag_keys: Optional[Set[str]]  # None = not statically knowable
+
+
+@dataclass
+class Registry:
+    emissions: List[Emission] = field(default_factory=list)
+
+    def by_name(self) -> Dict[str, List[Emission]]:
+        out: Dict[str, List[Emission]] = {}
+        for e in self.emissions:
+            out.setdefault(e.name, []).append(e)
+        return out
+
+
+def _normalize_name(node: ast.AST) -> Optional[str]:
+    """String constant or f-string -> normalized metric name."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    if isinstance(node, ast.JoinedStr):
+        parts = []
+        for v in node.values:
+            if isinstance(v, ast.Constant):
+                parts.append(str(v.value))
+            elif isinstance(v, ast.FormattedValue):
+                inner = dotted(v.value)
+                hole = inner.split(".")[-1] if inner else "..."
+                parts.append(f"<{hole}>")
+        return "".join(parts)
+    return None
+
+
+def _tag_keys(node: Optional[ast.AST]) -> Optional[Set[str]]:
+    if node is None or (isinstance(node, ast.Constant)
+                        and node.value is None):
+        return set()
+    if isinstance(node, ast.Dict):
+        keys: Set[str] = set()
+        for k in node.keys:
+            if isinstance(k, ast.Constant) and isinstance(k.value, str):
+                keys.add(k.value)
+            else:
+                return None
+        return keys
+    return None
+
+
+def collect(project: Project) -> Registry:
+    reg = Registry()
+    for sf in project.files.values():
+        aliases = import_aliases(sf.tree)
+        sample_aliases = {a for a, target in aliases.items()
+                          if target == _SAMPLES_MODULE}
+        # `from veneur_tpu.trace.samples import count` style
+        fn_aliases = {a: target.rsplit(".", 1)[1]
+                      for a, target in aliases.items()
+                      if target.startswith(_SAMPLES_MODULE + ".")}
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            kind = None
+            if isinstance(node.func, ast.Attribute) \
+                    and isinstance(node.func.value, ast.Name) \
+                    and node.func.value.id in sample_aliases:
+                kind = _SAMPLE_FNS.get(node.func.attr)
+            elif isinstance(node.func, ast.Name):
+                kind = _SAMPLE_FNS.get(fn_aliases.get(node.func.id, ""))
+            if kind is None or not node.args:
+                continue
+            name = _normalize_name(node.args[0])
+            if name is None or not name.startswith("veneur."):
+                continue
+            tags_node = node.args[2] if len(node.args) >= 3 else None
+            for kw in node.keywords:
+                if kw.arg == "tags":
+                    tags_node = kw.value
+            reg.emissions.append(Emission(
+                name=name, kind=kind, file=sf.relpath, line=node.lineno,
+                tag_keys=_tag_keys(tags_node)))
+    return reg
+
+
+@register("metric-registry")
+def run(project: Project) -> List[Finding]:
+    reg = collect(project)
+    docs = project.docs_text()
+    findings: List[Finding] = []
+    for name, emissions in sorted(reg.by_name().items()):
+        known = [e for e in emissions if e.tag_keys is not None]
+        # tag-schema coherence: every pair must be subset-compatible
+        conflict = None
+        for i, a in enumerate(known):
+            for b in known[i + 1:]:
+                if not (a.tag_keys <= b.tag_keys
+                        or b.tag_keys <= a.tag_keys):
+                    conflict = (a, b)
+                    break
+            if conflict:
+                break
+        first = emissions[0]
+        sf = project.files[first.file]
+        if conflict:
+            a, b = conflict
+            # a pragma on EITHER conflicting site (its own file) suppresses
+            if not (project.files[a.file].suppressed(a.line, "tag-conflict")
+                    or project.files[b.file].suppressed(b.line,
+                                                        "tag-conflict")):
+                findings.append(Finding(
+                    pass_name="metric-registry", code="tag-conflict",
+                    file=a.file, line=a.line, anchor=name,
+                    message=(f"`{name}` emitted with conflicting tag sets: "
+                             f"{sorted(a.tag_keys)} ({a.file}:{a.line}) vs "
+                             f"{sorted(b.tag_keys)} ({b.file}:{b.line}) — "
+                             f"same name, two schemas")))
+        if not _name_in_docs(name, docs) \
+                and not sf.suppressed(first.line, "undocumented"):
+            findings.append(Finding(
+                pass_name="metric-registry", code="undocumented",
+                file=first.file, line=first.line, anchor=name,
+                message=(f"self-metric `{name}` is not documented in "
+                         f"README.md or docs/*.md — regenerate the registry "
+                         f"table (`python -m veneur_tpu.lint "
+                         f"--metrics-table`) into docs/static-analysis.md")))
+    return findings
+
+
+def metrics_table(project: Project) -> str:
+    """Markdown self-metrics registry (for docs/static-analysis.md)."""
+    reg = collect(project)
+    lines = ["| name | type | tags | emitted from |", "|---|---|---|---|"]
+    for name, emissions in sorted(reg.by_name().items()):
+        kinds = sorted({e.kind for e in emissions})
+        tag_union: Set[str] = set()
+        unknown = False
+        for e in emissions:
+            if e.tag_keys is None:
+                unknown = True
+            else:
+                tag_union |= e.tag_keys
+        tags = ", ".join(f"`{t}`" for t in sorted(tag_union)) or "—"
+        if unknown:
+            tags += " (+dynamic)"
+        sites = sorted({e.file for e in emissions})
+        lines.append(f"| `{name}` | {'/'.join(kinds)} | {tags} | "
+                     f"{', '.join(f'`{s}`' for s in sites)} |")
+    return "\n".join(lines)

@@ -1,0 +1,508 @@
+"""JAX hot-path purity pass: no host syncs inside traced code.
+
+The per-interval flush is one batched device program (ops/, parallel/,
+core/ jit programs); a single host sync inside traced code — ``.item()``,
+``float()`` on an array, ``np.asarray`` on a tracer, ``block_until_ready``,
+Python ``if`` on a traced value — either breaks tracing outright or, via
+implicit ``__bool__``/``__array__`` fallbacks, stalls the whole merge on
+a device round-trip. Go's vet has no analogue for this; this pass is ours.
+
+Mechanics:
+
+1. **Hot roots**: functions decorated ``@jax.jit`` / ``@jit`` /
+   ``@(functools.)partial(jax.jit, ...)``, plus every function referenced
+   inside a ``jax.jit(...)`` call expression (covers
+   ``jax.jit(shard_map(self._local_step, ...))`` and
+   ``jax.jit(cm_ops.update, ...)``). ``static_argnums``/``static_argnames``
+   mark parameters as trace-time constants.
+2. **Call-graph propagation**: a function called from hot code with at
+   least one traced argument becomes hot itself, with exactly the
+   parameters that received traced values marked traced (so a helper
+   that only ever receives static config — ``size_bound(compression)``
+   under ``static_argnums`` — is NOT flagged for its ``int()``).
+   Resolution covers same-module names, ``self.method``, and
+   cross-module aliases (``td_ops.ingest_chunk``).
+3. **Taint**: traced parameters taint expressions derived from them;
+   ``.shape``/``.ndim``/``.dtype``/``len()`` and friends launder the
+   taint (they are static under tracing).
+
+Findings: ``host-sync`` (sync calls on tainted values) and
+``traced-branch`` (``if``/``while`` on a tainted test). Suppress a
+deliberate edge with ``# lint: ok(host-sync)`` / ``# lint: ok(traced-branch)``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from veneur_tpu.lint.framework import (Finding, Project, SourceFile, dotted,
+                                       import_aliases, qualname,
+                                       register)
+
+# attribute reads that are static under tracing (shapes are compile-time)
+_STATIC_ATTRS = {"shape", "ndim", "dtype", "size", "capacity", "batch_shape",
+                 "at"}
+# receiver methods whose call is a host sync
+_SYNC_METHODS = {"item", "tolist", "block_until_ready"}
+# numpy calls that materialize (and therefore fetch) their argument
+_NP_MATERIALIZERS = {"asarray", "array", "ascontiguousarray", "copy",
+                     "concatenate", "stack", "frombuffer", "copyto"}
+# builtins whose call on a traced value forces __bool__/__float__ sync
+_SYNC_BUILTINS = {"float", "int", "bool", "complex"}
+# builtins that return static values even on traced args
+_TAINT_LAUNDERING = {"len", "range", "isinstance", "hasattr", "type",
+                     "enumerate"}
+
+FnKey = Tuple[str, str]  # (relpath, qualified function name)
+
+# jax.lax combinators whose function-valued arguments trace with fully
+# traced parameters (cond/scan callbacks etc.)
+_LAX_HOFS = {"cond", "switch", "scan", "while_loop", "fori_loop", "map",
+             "associative_scan", "custom_root"}
+
+
+def walk_shallow(fn: ast.AST):
+    """ast.walk that does not descend into nested function/lambda bodies
+    (those are analyzed as functions of their own)."""
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+class _FnInfo:
+    def __init__(self, sf: SourceFile, node: ast.FunctionDef, qual: str,
+                 cls: Optional[str]):
+        self.sf = sf
+        self.node = node
+        self.qual = qual
+        self.cls = cls
+        self.params = [a.arg for a in (node.args.posonlyargs + node.args.args)
+                       if a.arg != "self"]
+        self.kwonly = [a.arg for a in node.args.kwonlyargs]
+        self.traced: Set[str] = set()
+
+
+def _collect_functions(project: Project) -> Dict[FnKey, _FnInfo]:
+    fns: Dict[FnKey, _FnInfo] = {}
+    for sf in project.files.values():
+        parents = sf.parents
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.FunctionDef):
+                owner = parents.get(node)
+                cls = owner.name if isinstance(owner, ast.ClassDef) else None
+                fns[(sf.relpath, qualname(node, parents))] = _FnInfo(
+                    sf, node, qualname(node, parents), cls)
+    return fns
+
+
+def _np_aliases(sf: SourceFile) -> Set[str]:
+    return {alias for alias, target in import_aliases(sf.tree).items()
+            if target == "numpy" or target.startswith("numpy.")}
+
+
+def _jax_aliases(sf: SourceFile) -> Set[str]:
+    return {alias for alias, target in import_aliases(sf.tree).items()
+            if target == "jax"}
+
+
+def _static_params(call_kwargs: List[ast.keyword],
+                   params: List[str]) -> Set[str]:
+    """Map static_argnums/static_argnames keywords onto parameter names."""
+    static: Set[str] = set()
+
+    def const_values(node) -> list:
+        if isinstance(node, ast.Constant):
+            return [node.value]
+        if isinstance(node, (ast.Tuple, ast.List)):
+            return [e.value for e in node.elts if isinstance(e, ast.Constant)]
+        return []
+
+    for kw in call_kwargs:
+        if kw.arg == "static_argnums":
+            for idx in const_values(kw.value):
+                if isinstance(idx, int) and 0 <= idx < len(params):
+                    static.add(params[idx])
+        elif kw.arg == "static_argnames":
+            for name in const_values(kw.value):
+                if isinstance(name, str):
+                    static.add(name)
+    return static
+
+
+def _jit_decoration(fn: ast.FunctionDef) -> Optional[List[ast.keyword]]:
+    """The jit kwargs if the def is jit-decorated, else None."""
+    for dec in fn.decorator_list:
+        name = dotted(dec) if not isinstance(dec, ast.Call) else \
+            dotted(dec.func)
+        if name is None:
+            continue
+        base = name.split(".")[-1]
+        if base in ("jit", "pmap"):
+            return dec.keywords if isinstance(dec, ast.Call) else []
+        if base == "partial" and isinstance(dec, ast.Call) and dec.args:
+            inner = dotted(dec.args[0])
+            if inner and inner.split(".")[-1] in ("jit", "pmap"):
+                return dec.keywords
+    return None
+
+
+def _fn_refs(expr: ast.AST) -> List[ast.AST]:
+    """Name/Attribute nodes inside ``expr`` that could reference functions
+    (direct refs plus callees/args of wrapper calls like shard_map)."""
+    refs: List[ast.AST] = []
+    for node in ast.walk(expr):
+        if isinstance(node, (ast.Name, ast.Attribute)):
+            refs.append(node)
+    return refs
+
+
+class _Resolver:
+    """Resolve a call/function reference to a FnKey."""
+
+    def __init__(self, project: Project, fns: Dict[FnKey, _FnInfo]):
+        self.project = project
+        self.fns = fns
+        self.mod_of_rel = {rel: project.module_name(rel)
+                           for rel in project.files}
+        self.rel_of_mod = {m: r for r, m in self.mod_of_rel.items()}
+        self._alias_cache: Dict[str, Dict[str, str]] = {}
+
+    def aliases(self, sf: SourceFile) -> Dict[str, str]:
+        if sf.relpath not in self._alias_cache:
+            self._alias_cache[sf.relpath] = import_aliases(sf.tree)
+        return self._alias_cache[sf.relpath]
+
+    def resolve(self, ref: ast.AST, sf: SourceFile, cls: Optional[str],
+                scope: Optional[str] = None) -> Optional[FnKey]:
+        name = dotted(ref)
+        if name is None:
+            return None
+        parts = name.split(".")
+        if parts[0] == "self" and len(parts) == 2 and cls:
+            key = (sf.relpath, f"{cls}.{parts[1]}")
+            return key if key in self.fns else None
+        if len(parts) == 1:
+            # innermost enclosing scope first (closures), then module level
+            prefix = scope.split(".") if scope else []
+            while prefix:
+                key = (sf.relpath, ".".join(prefix + [parts[0]]))
+                if key in self.fns:
+                    return key
+                prefix.pop()
+            key = (sf.relpath, parts[0])
+            if key in self.fns:
+                return key
+            # `from mod import fn` alias
+            target = self.aliases(sf).get(parts[0])
+            if target and "." in target:
+                mod, fn = target.rsplit(".", 1)
+                rel = self.rel_of_mod.get(mod)
+                if rel:
+                    key = (rel, fn)
+                    return key if key in self.fns else None
+            return None
+        if len(parts) == 2:
+            # module alias:  td_ops.ingest_chunk
+            target = self.aliases(sf).get(parts[0])
+            if target:
+                rel = self.rel_of_mod.get(target)
+                if rel:
+                    key = (rel, parts[1])
+                    return key if key in self.fns else None
+        return None
+
+
+def _assignment_order(fn: ast.FunctionDef):
+    nodes = [n for n in walk_shallow(fn)
+             if isinstance(n, (ast.Assign, ast.AugAssign, ast.AnnAssign,
+                               ast.For))]
+    return sorted(nodes, key=lambda n: n.lineno)
+
+
+class _Summaries:
+    """Per-function return-taint summaries: does taint on the parameters
+    ever reach a ``return``? Functions that only read static facts of
+    their arguments (``pallas_ok(x)`` checking shapes and the backend,
+    ``_precision_of(registers)`` reading ``shape``) return trace-time
+    constants, and callers must not treat their results as traced."""
+
+    def __init__(self, fns: Dict[FnKey, "_FnInfo"], resolver: "_Resolver"):
+        self.fns = fns
+        self.resolver = resolver
+        self._cache: Dict[FnKey, bool] = {}
+        self._in_progress: Set[FnKey] = set()
+
+    def returns_tainted(self, key: FnKey) -> bool:
+        if key in self._cache:
+            return self._cache[key]
+        if key in self._in_progress:
+            return True  # recursion: stay conservative
+        self._in_progress.add(key)
+        try:
+            info = self.fns[key]
+            probe = _FnInfo(info.sf, info.node, info.qual, info.cls)
+            probe.traced = set(info.params) | set(info.kwonly)
+            taint = _Taint(probe, set(), summaries=self)
+            result = False
+            for node in walk_shallow(info.node):
+                if isinstance(node, ast.Return) and node.value is not None \
+                        and taint.is_tainted(node.value):
+                    result = True
+                    break
+        finally:
+            self._in_progress.discard(key)
+        self._cache[key] = result
+        return result
+
+    def call_returns_static(self, call: ast.Call, sf: SourceFile,
+                            cls: Optional[str]) -> bool:
+        key = self.resolver.resolve(call.func, sf, cls)
+        return key is not None and not self.returns_tainted(key)
+
+
+class _Taint:
+    """Forward may-taint analysis over one function body."""
+
+    def __init__(self, info: _FnInfo, np_names: Set[str],
+                 summaries: Optional[_Summaries] = None):
+        self.tainted: Set[str] = set(info.traced)
+        self.np_names = np_names
+        self._summaries = summaries
+        self._sf = info.sf
+        self._cls = info.cls
+        for _ in range(2):  # two passes to cover loop-carried taint
+            for node in _assignment_order(info.node):
+                self._transfer(node)
+
+    def _transfer(self, node):
+        if isinstance(node, ast.For):
+            if self.is_tainted(node.iter):
+                for t in ast.walk(node.target):
+                    if isinstance(t, ast.Name):
+                        self.tainted.add(t.id)
+            return
+        value = node.value
+        if value is None:
+            return
+        if not self.is_tainted(value):
+            return
+        targets = node.targets if isinstance(node, ast.Assign) \
+            else [node.target]
+        for tgt in targets:
+            for t in ast.walk(tgt):
+                if isinstance(t, ast.Name):
+                    self.tainted.add(t.id)
+
+    def is_tainted(self, expr: ast.AST) -> bool:
+        if isinstance(expr, ast.Name):
+            return expr.id in self.tainted
+        if isinstance(expr, ast.Attribute):
+            if expr.attr in _STATIC_ATTRS:
+                return False
+            return self.is_tainted(expr.value)
+        if isinstance(expr, ast.Subscript):
+            return self.is_tainted(expr.value)
+        if isinstance(expr, ast.Call):
+            fname = dotted(expr.func)
+            if fname and fname.split(".")[-1] in _TAINT_LAUNDERING:
+                return False
+            if self._summaries is not None and self._summaries \
+                    .call_returns_static(expr, self._sf, self._cls):
+                return False
+            if isinstance(expr.func, ast.Attribute) \
+                    and self.is_tainted(expr.func.value):
+                return True
+            return any(self.is_tainted(a) for a in expr.args) or \
+                any(self.is_tainted(k.value) for k in expr.keywords)
+        if isinstance(expr, ast.BinOp):
+            return self.is_tainted(expr.left) or self.is_tainted(expr.right)
+        if isinstance(expr, ast.UnaryOp):
+            return self.is_tainted(expr.operand)
+        if isinstance(expr, ast.BoolOp):
+            return any(self.is_tainted(v) for v in expr.values)
+        if isinstance(expr, ast.Compare):
+            if all(isinstance(op, (ast.Is, ast.IsNot)) for op in expr.ops):
+                return False  # `x is None` is a trace-time constant test
+            return self.is_tainted(expr.left) or \
+                any(self.is_tainted(c) for c in expr.comparators)
+        if isinstance(expr, ast.IfExp):
+            return self.is_tainted(expr.body) or \
+                self.is_tainted(expr.orelse) or self.is_tainted(expr.test)
+        if isinstance(expr, (ast.Tuple, ast.List)):
+            return any(self.is_tainted(e) for e in expr.elts)
+        if isinstance(expr, ast.Starred):
+            return self.is_tainted(expr.value)
+        return False
+
+
+def _find_hot_roots(project: Project, fns: Dict[FnKey, _FnInfo],
+                    resolver: _Resolver) -> Dict[FnKey, Set[str]]:
+    """FnKey -> traced param names, for every jit/pmap entry point."""
+    hot: Dict[FnKey, Set[str]] = {}
+
+    def mark(key: FnKey, static: Set[str]):
+        info = fns[key]
+        traced = {p for p in info.params if p not in static}
+        hot.setdefault(key, set()).update(traced)
+
+    for sf in project.files.values():
+        jax_names = _jax_aliases(sf)
+        parents = sf.parents
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.FunctionDef):
+                kwargs = _jit_decoration(node)
+                if kwargs is not None:
+                    key = (sf.relpath, qualname(node, parents))
+                    mark(key, _static_params(
+                        kwargs, fns[key].params + fns[key].kwonly))
+            elif isinstance(node, ast.Call):
+                fname = dotted(node.func)
+                if fname is None:
+                    continue
+                parts = fname.split(".")
+                is_jit = (parts[-1] in ("jit", "pmap")
+                          and (len(parts) == 1 or parts[0] in jax_names
+                               or parts[0] == "jax"))
+                if not is_jit or not node.args:
+                    continue
+                encl_cls = None
+                cur = parents.get(node)
+                while cur is not None:
+                    if isinstance(cur, ast.ClassDef):
+                        encl_cls = cur.name
+                        break
+                    cur = parents.get(cur)
+                scope = qualname(node, parents)
+                for ref in _fn_refs(node.args[0]):
+                    key = resolver.resolve(ref, sf, encl_cls,
+                                           scope=scope or None)
+                    if key is not None:
+                        mark(key, _static_params(
+                            node.keywords,
+                            fns[key].params + fns[key].kwonly))
+    return hot
+
+
+def _propagate(fns: Dict[FnKey, _FnInfo], hot: Dict[FnKey, Set[str]],
+               resolver: _Resolver, summaries: _Summaries):
+    """Spread hotness through calls that pass traced values."""
+    for key, traced in hot.items():
+        fns[key].traced = set(traced)
+    work = list(hot)
+    np_cache: Dict[str, Set[str]] = {}
+    while work:
+        key = work.pop()
+        info = fns[key]
+        sf = info.sf
+        if sf.relpath not in np_cache:
+            np_cache[sf.relpath] = _np_aliases(sf)
+        taint = _Taint(info, np_cache[sf.relpath], summaries=summaries)
+        for node in walk_shallow(info.node):
+            if not isinstance(node, ast.Call):
+                continue
+            fname = dotted(node.func)
+            if fname and fname.split(".")[-1] in _LAX_HOFS:
+                # cond/scan/while_loop callbacks trace with every
+                # parameter traced
+                for arg in node.args:
+                    for ref in _fn_refs(arg):
+                        cb = resolver.resolve(ref, sf, info.cls,
+                                              scope=info.qual)
+                        if cb is None:
+                            continue
+                        cb_info = fns[cb]
+                        cb_params = set(cb_info.params)
+                        if not cb_params <= cb_info.traced:
+                            cb_info.traced |= cb_params
+                            hot.setdefault(cb, set()).update(cb_params)
+                            work.append(cb)
+                continue
+            callee = resolver.resolve(node.func, sf, info.cls,
+                                      scope=info.qual)
+            if callee is None:
+                continue
+            cinfo = fns[callee]
+            traced_params: Set[str] = set()
+            for i, arg in enumerate(node.args):
+                if isinstance(arg, ast.Starred):
+                    continue
+                if i < len(cinfo.params) and taint.is_tainted(arg):
+                    traced_params.add(cinfo.params[i])
+            for kw in node.keywords:
+                if kw.arg and taint.is_tainted(kw.value):
+                    traced_params.add(kw.arg)
+            if traced_params and not traced_params <= cinfo.traced:
+                cinfo.traced |= traced_params
+                hot.setdefault(callee, set()).update(traced_params)
+                work.append(callee)
+
+
+@register("jax-purity")
+def run(project: Project) -> List[Finding]:
+    fns = _collect_functions(project)
+    resolver = _Resolver(project, fns)
+    summaries = _Summaries(fns, resolver)
+    hot = _find_hot_roots(project, fns, resolver)
+    _propagate(fns, hot, resolver, summaries)
+
+    findings: List[Finding] = []
+    for key in sorted(hot):
+        info = fns[key]
+        if not info.traced:
+            continue
+        sf = info.sf
+        np_names = _np_aliases(sf)
+        jax_names = _jax_aliases(sf) | {"jax"}
+        taint = _Taint(info, np_names, summaries=summaries)
+
+        def emit(node, code: str, what: str):
+            if sf.suppressed(node.lineno, code):
+                return
+            findings.append(Finding(
+                pass_name="jax-purity", code=code, file=sf.relpath,
+                line=node.lineno, anchor=f"{info.qual}:{what}",
+                message=(f"{what} inside jit-traced {info.qual}() — this "
+                         f"host-syncs (stalls) the batched device program"
+                         if code == "host-sync" else
+                         f"{what} inside jit-traced {info.qual}() — Python "
+                         f"control flow on a traced value fails or "
+                         f"retraces; use lax.cond/select/where")))
+
+        for node in walk_shallow(info.node):
+            if isinstance(node, ast.Call):
+                fname = dotted(node.func)
+                if isinstance(node.func, ast.Attribute) \
+                        and node.func.attr in _SYNC_METHODS \
+                        and taint.is_tainted(node.func.value):
+                    emit(node, "host-sync", f".{node.func.attr}() call")
+                elif fname:
+                    parts = fname.split(".")
+                    tainted_arg = any(taint.is_tainted(a)
+                                      for a in node.args)
+                    if len(parts) == 2 and parts[0] in np_names \
+                            and parts[1] in _NP_MATERIALIZERS \
+                            and tainted_arg:
+                        emit(node, "host-sync",
+                             f"{fname}() on a traced value")
+                    elif len(parts) == 2 and parts[0] in jax_names \
+                            and parts[1] in ("device_get",
+                                             "block_until_ready") \
+                            and tainted_arg:
+                        emit(node, "host-sync", f"{fname}() call")
+                    elif len(parts) == 1 and parts[0] in _SYNC_BUILTINS \
+                            and node.args \
+                            and taint.is_tainted(node.args[0]):
+                        emit(node, "host-sync",
+                             f"{parts[0]}() on a traced value")
+            elif isinstance(node, (ast.If, ast.While)):
+                if taint.is_tainted(node.test):
+                    kind = "if" if isinstance(node, ast.If) else "while"
+                    emit(node, "traced-branch",
+                         f"`{kind}` on a traced value (line {node.lineno})")
+    return findings

@@ -1,0 +1,152 @@
+"""TSan-lite: runtime lock-state recorder for the static pass's blind spots.
+
+The lock-discipline pass walks lexical call sites; it cannot see dynamic
+dispatch (``getattr``, callables passed around) or verify that the
+``with self._lock`` it accepted is the *store's* lock. This shim closes
+the loop at test time, the way the reference leans on ``go test -race``:
+
+    rec = LockStateRecorder(store)
+    with rec:
+        ... drive ingest/flush/checkpoint threads ...
+    rec.assert_clean()
+
+While armed, every ``@requires_lock("store")``-annotated method on every
+group object owned by the store is wrapped; each call records whether
+the calling thread actually holds ``store._lock`` at that moment
+(``RLock._is_owned``). Mutations on *retired* flush generations are
+exempt by design (swap-on-flush hands the flusher exclusive ownership)
+— the wrapper honors the ``_retired`` flag the store already sets.
+
+Wrapping is per-instance (bound attributes on the group objects), so
+parallel tests and the ingest fast path outside the context manager pay
+nothing. The pytest fixture ``tsan_lite`` (tests/conftest.py) wires
+this up; see docs/static-analysis.md.
+"""
+
+from __future__ import annotations
+
+import functools
+import threading
+from dataclasses import dataclass
+from typing import List
+
+from veneur_tpu.core.locking import REQUIRES_LOCK_ATTR
+
+
+@dataclass
+class UnlockedMutation:
+    group: str
+    method: str
+    thread: str
+
+    def __str__(self):
+        return (f"{self.group}.{self.method}() called on thread "
+                f"{self.thread} without holding the store lock")
+
+
+class LockStateRecorder:
+    """Wraps a MetricStore's group mutators; records unlocked calls."""
+
+    def __init__(self, store):
+        self.store = store
+        self.violations: List[UnlockedMutation] = []
+        self._vlock = threading.Lock()
+        self._wrapped: List[tuple] = []
+        # one violation per outermost annotated call: sample() calling
+        # _row() unlocked is ONE mutation, not two
+        self._tls = threading.local()
+
+    # -- arm / disarm ------------------------------------------------------
+
+    def __enter__(self):
+        self.arm()
+        return self
+
+    def __exit__(self, *exc):
+        self.disarm()
+        return False
+
+    def arm(self):
+        from veneur_tpu.core.store import MetricStore
+
+        gen_groups = getattr(type(self.store), "_GEN_GROUPS",
+                             MetricStore._GEN_GROUPS)
+        for attr in gen_groups:
+            group = getattr(self.store, attr, None)
+            if group is not None:
+                self._wrap_group(attr, group)
+        # a flush swaps every group for a fresh (unwrapped) twin; hook
+        # the swap so coverage survives flushes instead of silently
+        # ending at the first one
+        rec = self
+        orig_swap = self.store._swap_generation
+
+        @functools.wraps(orig_swap)
+        def swap_and_rearm(*args, **kwargs):
+            gen = orig_swap(*args, **kwargs)
+            for attr in gen_groups:
+                group = getattr(rec.store, attr, None)
+                if group is not None:
+                    rec._wrap_group(attr, group)
+            return gen
+
+        self.store._swap_generation = swap_and_rearm
+        self._wrapped.append((self.store, "_swap_generation",
+                              swap_and_rearm))
+
+    def disarm(self):
+        for obj, name, _wrapper in self._wrapped:
+            try:
+                delattr(obj, name)
+            except AttributeError:
+                pass
+        self._wrapped.clear()
+
+    def _wrap_group(self, group_name: str, group):
+        for name in dir(type(group)):
+            fn = getattr(type(group), name, None)
+            if not callable(fn) \
+                    or getattr(fn, REQUIRES_LOCK_ATTR, None) is None:
+                continue
+            bound = getattr(group, name)
+            wrapper = self._make_wrapper(group_name, name, bound, group)
+            setattr(group, name, wrapper)
+            self._wrapped.append((group, name, wrapper))
+
+    def _make_wrapper(self, group_name: str, method: str, bound, group):
+        rec = self
+
+        @functools.wraps(bound)
+        def wrapper(*args, **kwargs):
+            depth = getattr(rec._tls, "depth", 0)
+            # retired generations are exclusively owned by the flusher;
+            # off-lock mutation there is the design, not a race
+            if depth == 0 and not getattr(group, "_retired", False) \
+                    and not rec._lock_held():
+                with rec._vlock:
+                    rec.violations.append(UnlockedMutation(
+                        group=group_name, method=method,
+                        thread=threading.current_thread().name))
+            rec._tls.depth = depth + 1
+            try:
+                return bound(*args, **kwargs)
+            finally:
+                rec._tls.depth = depth
+
+        return wrapper
+
+    def _lock_held(self) -> bool:
+        lock = self.store._lock
+        is_owned = getattr(lock, "_is_owned", None)
+        if is_owned is not None:  # RLock: exact ownership check
+            return bool(is_owned())
+        return bool(lock.locked())  # plain Lock: held by *someone*
+
+    # -- assertions --------------------------------------------------------
+
+    def assert_clean(self):
+        if self.violations:
+            lines = "\n  ".join(str(v) for v in self.violations[:20])
+            raise AssertionError(
+                f"TSan-lite: {len(self.violations)} unlocked group "
+                f"mutation(s):\n  {lines}")

@@ -32,8 +32,6 @@ reference's tests (eps=0.02, ``tdigest/histo_test.go:11-25``).
 
 from __future__ import annotations
 
-import math
-from functools import partial
 from typing import NamedTuple, Sequence
 
 import jax

@@ -25,13 +25,11 @@ TPU (tests run on the CPU mesh) and for batch ranks other than 2.
 from __future__ import annotations
 
 import functools
-import math
 
 import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.experimental import pallas as pl
-from jax.experimental.pallas import tpu as pltpu
 
 _ROWS = 128          # series rows per kernel block
 _KCHUNK = 16         # output bins reduced per inner step

@@ -21,10 +21,8 @@ pre-clusters samples into k-scale bins whose (sum_w, sum_wm) accumulators are
 
 from __future__ import annotations
 
-from functools import partial
 
 import jax
-import jax.numpy as jnp
 from jax import lax
 
 from veneur_tpu.ops import tdigest as td_ops

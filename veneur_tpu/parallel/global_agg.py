@@ -20,7 +20,6 @@ flush is a batched quantile/estimate gather.
 
 from __future__ import annotations
 
-from functools import partial
 from typing import NamedTuple
 
 import jax

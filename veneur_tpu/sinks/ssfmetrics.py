@@ -12,7 +12,7 @@ import logging
 from typing import Callable
 
 from veneur_tpu.samplers import parser as p
-from .base import MetricSink, SpanSink
+from .base import SpanSink
 
 log = logging.getLogger("veneur.sinks.ssfmetrics")
 
