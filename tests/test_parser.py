@@ -196,3 +196,135 @@ def test_split_lines():
     assert list(split_lines(b"a:1|c\nb:2|g\n")) == [b"a:1|c", b"b:2|g"]
     assert list(split_lines(b"a:1|c")) == [b"a:1|c"]
     assert list(split_lines(b"\n\na:1|c\n\n")) == [b"a:1|c"]
+
+
+class TestAdversarialQuarantine:
+    """Numerics-quarantine gate (ISSUE 4): poisoned-but-parseable lines
+    raise QuarantineError with a machine reason (so the server counts
+    them into veneur.overload.quarantined_total) and NOTHING crashes or
+    launders into digest state."""
+
+    def _reason(self, packet, **kw):
+        from veneur_tpu.samplers.parser import QuarantineError
+
+        with pytest.raises(QuarantineError) as ei:
+            parse_metric(packet, **kw)
+        return ei.value.reason
+
+    @pytest.mark.parametrize("packet", [
+        b"a:nan|g", b"a:NaN|h", b"a:inf|c", b"a:-inf|ms",
+        b"a:1e999|g",  # float() overflows straight to inf
+    ])
+    def test_non_finite_reason(self, packet):
+        assert self._reason(packet) == "not_finite"
+
+    @pytest.mark.parametrize("packet", [
+        b"a:1e308|h",   # finite f64, but inf after the f32 staging cast
+        b"a:-1e308|ms",
+        b"a:9.3e18|c",  # finite, but overflows the int64 counter lane
+        b"a:-1e300|c",
+    ])
+    def test_out_of_range_reason(self, packet):
+        assert self._reason(packet) == "out_of_range"
+
+    def test_counter_max_magnitude_still_parses(self):
+        # just inside the int64 lane: must NOT quarantine
+        m = parse_metric(b"a:4e18|c")
+        assert m.value == 4e18
+
+    def test_gauge_large_finite_ok(self):
+        # gauges are float64 host-side; 1e308 is representable there
+        assert parse_metric(b"a:1e308|g").value == 1e308
+
+    @pytest.mark.parametrize("packet", [
+        b"a:1|c|@0", b"a:1|c|@-0.5", b"a:1|c|@1.5", b"a:1|c|@nan",
+        # denormal-tiny rates: the f32 reciprocal weight would be inf
+        # (and int(inf) would kill the reader thread on the counter lane)
+        b"a:1|c|@1e-300", b"a:1|h|@4e-39",
+    ])
+    def test_absurd_sample_rates(self, packet):
+        assert self._reason(packet) == "bad_rate"
+
+    def test_store_survives_denormal_rate_without_parser(self):
+        # defense in depth: the SSF/native lanes can hand the store a
+        # rate the DogStatsD parser never sees — int(inf) must not raise
+        from veneur_tpu.core.store import MetricStore
+        from veneur_tpu.samplers.parser import MetricKey
+
+        store = MetricStore()
+        k = MetricKey(name="c", type="counter")
+        store.counters.sample(k, [], 1.0, 1e-300)
+        kh = MetricKey(name="h", type="histogram")
+        store.histograms.sample(kh, [], 1.0, 1e-300)
+        assert store.quarantine.snapshot()["bad_rate"] == 2
+        assert len(store.counters) == 0 and len(store.histograms) == 0
+
+    def test_quarantine_is_a_parse_error(self):
+        # existing rejection paths (packet_errors accounting, tests)
+        # must keep catching these
+        from veneur_tpu.samplers.parser import QuarantineError
+
+        assert issubclass(QuarantineError, ParseError)
+
+    def test_oversized_tags_truncate_and_count(self):
+        from veneur_tpu.overload import Quarantine
+
+        q = Quarantine()
+        tags = ",".join(f"tag{i:04d}:{'v' * 20}" for i in range(100))
+        m = parse_metric(b"a:1|c|#" + tags.encode(), max_tag_length=64,
+                         quarantine=q)
+        assert len(m.key.joined_tags) <= 64
+        # the cut lands on a tag boundary: every surviving tag is whole
+        assert all(t.startswith("tag") for t in m.tags)
+        assert q.snapshot()["oversized_tags"] == 1
+
+    def test_tag_cap_not_counted_when_under(self):
+        from veneur_tpu.overload import Quarantine
+
+        q = Quarantine()
+        m = parse_metric(b"a:1|c|#x:1,y:2", max_tag_length=64,
+                         quarantine=q)
+        assert m.tags == ["x:1", "y:2"]
+        assert q.total() == 0
+
+    def test_ssf_nan_quarantined(self):
+        from veneur_tpu.protocol import ssf_pb2
+        from veneur_tpu.samplers.parser import (QuarantineError,
+                                                parse_metric_ssf)
+
+        sample = ssf_pb2.SSFSample(
+            metric=ssf_pb2.SSFSample.HISTOGRAM, name="x",
+            value=float("nan"), sample_rate=1.0)
+        with pytest.raises(QuarantineError) as ei:
+            parse_metric_ssf(sample)
+        assert ei.value.reason == "not_finite"
+
+    def test_store_survives_adversarial_flood(self):
+        """End-to-end belt: a burst of poison through the server's
+        packet path — nothing raises, quarantine accounts every drop."""
+        from veneur_tpu.core.store import MetricStore
+        from veneur_tpu.samplers.intermetric import HistogramAggregates
+        from veneur_tpu.samplers.parser import QuarantineError
+
+        store = MetricStore()
+        q = store.quarantine
+        bad = [b"a:nan|h", b"a:inf|h", b"a:1e308|h", b"a:-1e308|ms",
+               b"a:9.3e18|c", b"b:1|c|@0"]
+        good = [b"a:1|h", b"a:2|h", b"c:3|c"]
+        for packet in bad * 10 + good:
+            try:
+                store.process_metric(parse_metric(packet, quarantine=q))
+            except QuarantineError as e:
+                q.count(e.reason)
+        assert q.total() == len(bad) * 10
+        snap = q.snapshot()
+        assert snap["not_finite"] == 20
+        assert snap["out_of_range"] == 30
+        assert snap["bad_rate"] == 10
+        agg = HistogramAggregates.from_names(["min", "max", "count"])
+        final, _, _ = store.flush([0.5], agg, is_local=False, now=1)
+        by_name = {m.name: m.value for m in final}
+        # only the clean samples aggregated
+        assert by_name["a.count"] == 2.0
+        assert by_name["a.max"] == 2.0
+        assert by_name["c"] == 3.0

@@ -209,6 +209,32 @@ class Config:
     fault_injection_kinds: str = ""
     fault_injection_scope: str = ""
 
+    # ---- hot-path overload safety (veneur_tpu/overload.py) ---------------
+    # hard per-scope-class series cap (INCLUDING the one overflow row):
+    # past it, first-sight series collapse into veneur.overload.overflow
+    # (counts preserved, identities dropped) instead of growing device
+    # state. 0 = default (1M); negative rejected. A cardinality flood
+    # then costs one row, not an OOM plus grow-ladder recompiles.
+    max_series: int = 0
+    # joined-tag-string length cap per series; oversized tag sets
+    # truncate at a tag boundary (counted as quarantined
+    # oversized_tags). 0 = default (1024); negative rejected.
+    max_tag_length: int = 0
+    # admission-control watermarks over the pipeline pressure signal
+    # (span-channel/lane fill, group occupancy): >= low freezes
+    # first-sight series, >= high sheds raw spans, >= hard sheds statsd
+    # datagrams at the socket. 0 = defaults (0.7 / 0.85 / 0.97); must
+    # satisfy 0 < low < high < hard <= 1.
+    overload_low_watermark: float = 0.0
+    overload_high_watermark: float = 0.0
+    overload_hard_watermark: float = 0.0
+    # flush-kernel compute breaker (resilience/compute.py): consecutive
+    # Pallas-merge failures before flushes stop attempting the kernel
+    # (0 = default 2), and how long an open breaker waits before one
+    # flush probes it again (parse-once; default 60s)
+    compute_breaker_failure_threshold: int = 0
+    compute_breaker_reset_timeout: str = ""
+
     # ---- crash-safe aggregation state (veneur_tpu/persist/) --------------
     # where the interval checkpoint lives; empty disables checkpointing.
     # The atomic-write scratch file is checkpoint_path + ".tmp".
@@ -278,6 +304,28 @@ class Config:
                 f"default, 100; a queue.Queue maxsize <= 0 is unbounded "
                 f"and defeats span shedding), got "
                 f"{self.span_channel_capacity}")
+        if self.max_series < 0:
+            raise ValueError(
+                f"max_series must be positive (0 = use the default, "
+                f"{_MAX_SERIES_DEFAULT}; an unbounded store fails open "
+                f"under a cardinality flood), got {self.max_series}")
+        if self.max_tag_length < 0:
+            raise ValueError(
+                f"max_tag_length must be positive (0 = use the default, "
+                f"{_MAX_TAG_LENGTH_DEFAULT}), got {self.max_tag_length}")
+        if self.compute_breaker_failure_threshold < 0:
+            raise ValueError(
+                f"compute_breaker_failure_threshold must be >= 0 (0 = "
+                f"use the default, 2; the compute breaker cannot be "
+                f"disabled), got {self.compute_breaker_failure_threshold}")
+        marks = (self.overload_low_watermark or _OVERLOAD_LOW_DEFAULT,
+                 self.overload_high_watermark or _OVERLOAD_HIGH_DEFAULT,
+                 self.overload_hard_watermark or _OVERLOAD_HARD_DEFAULT)
+        if not 0.0 < marks[0] < marks[1] < marks[2] <= 1.0:
+            raise ValueError(
+                f"overload watermarks must satisfy 0 < low < high < "
+                f"hard <= 1 (after 0-means-default substitution), got "
+                f"{marks[0]}/{marks[1]}/{marks[2]}")
         if self.checkpoint_max_age_intervals < 0:
             raise ValueError(
                 f"checkpoint_max_age_intervals must be >= 0 (0 = use "
@@ -288,15 +336,16 @@ class Config:
                 f"fault_injection_rate must be in [0, 1], got "
                 f"{self.fault_injection_rate}")
         if self.fault_injection_kinds:
-            from veneur_tpu.resilience.faults import ALL_KINDS
+            from veneur_tpu.resilience.faults import ALL_KINDS, INGEST_KINDS
 
+            known = ALL_KINDS + INGEST_KINDS
             bad = [k.strip()
                    for k in self.fault_injection_kinds.split(",")
-                   if k.strip() and k.strip() not in ALL_KINDS]
+                   if k.strip() and k.strip() not in known]
             if bad:
                 raise ValueError(
                     f"unknown fault_injection_kinds {bad}; known: "
-                    f"{list(ALL_KINDS)}")
+                    f"{list(known)}")
 
     def apply_defaults(self):
         """Defaults + deprecation shims (config_parse.go:118-185)."""
@@ -347,6 +396,24 @@ class Config:
             self.trace_max_length_bytes = 16 * 1024
         if not self.checkpoint_max_age_intervals:
             self.checkpoint_max_age_intervals = 2.0
+        # overload-safety defaults (veneur_tpu/overload.py); the
+        # compute-breaker timeout follows the parse-once policy
+        if not self.max_series:
+            self.max_series = _MAX_SERIES_DEFAULT
+        if not self.max_tag_length:
+            self.max_tag_length = _MAX_TAG_LENGTH_DEFAULT
+        if not self.overload_low_watermark:
+            self.overload_low_watermark = _OVERLOAD_LOW_DEFAULT
+        if not self.overload_high_watermark:
+            self.overload_high_watermark = _OVERLOAD_HIGH_DEFAULT
+        if not self.overload_hard_watermark:
+            self.overload_hard_watermark = _OVERLOAD_HARD_DEFAULT
+        if not self.compute_breaker_failure_threshold:
+            self.compute_breaker_failure_threshold = 2
+        if not self.compute_breaker_reset_timeout:
+            self.compute_breaker_reset_timeout = "60s"
+        self.compute_breaker_reset_timeout_seconds = parse_duration(
+            self.compute_breaker_reset_timeout)
         # parse-once (round-1 audit policy): 0.0 = unset, the server
         # derives interval / 4 at start
         self.checkpoint_interval_seconds = (
@@ -362,6 +429,13 @@ class Config:
 # the 0-means-default convention matches the other int knobs
 # (num_workers etc.); a breaker cannot be disabled, only tuned
 _BREAKER_THRESHOLD_DEFAULT = 5
+# overload-safety defaults (see veneur_tpu/overload.py, which holds the
+# canonical copies the controller falls back to)
+_MAX_SERIES_DEFAULT = 1 << 20
+_MAX_TAG_LENGTH_DEFAULT = 1024
+_OVERLOAD_LOW_DEFAULT = 0.7
+_OVERLOAD_HIGH_DEFAULT = 0.85
+_OVERLOAD_HARD_DEFAULT = 0.97
 
 
 def _apply_resilience_defaults(cfg):

@@ -287,7 +287,10 @@ class MeshDigestGroup(DigestGroup):
             self.temp, self.digest, self.dmin, self.dmax, *imp,
             stat_rows, stat_mins, stat_maxs)
 
-    def _run_flush(self, qs):
+    def _run_flush(self, qs, use_pallas: bool = True):
+        # the sharded programs compile once per mesh at import; the
+        # compute ladder's retry re-runs the same program here (the
+        # mesh path has no separate kernel variant to fall back to)
         return self._flush_p(self.digest, self.temp, self.dmin, self.dmax,
                              jnp.asarray(qs, jnp.float32))
 

@@ -48,6 +48,7 @@ ImportMetricGRPC -> tdigest.Merge (worker.go:354-398) for the global one.
 
 from __future__ import annotations
 
+import logging
 import math
 from functools import partial
 from typing import List, NamedTuple, Optional, Sequence
@@ -60,6 +61,8 @@ from jax import lax
 from veneur_tpu.ops import tdigest as td_ops
 from veneur_tpu.core.locking import requires_lock
 from veneur_tpu.ops.tdigest_pallas import _next_pow2
+
+log = logging.getLogger("veneur.slab")
 
 SLAB_ROWS_DEFAULT = 1 << 20
 
@@ -123,7 +126,8 @@ def _init_temp_slab(slab: int, k: int) -> TempSlab:
 
 
 def _guard_drain_slab(temp: TempSlab, digest: DigestSlab, rows, values,
-                      weights, slab: int, compression: float):
+                      weights, slab: int, compression: float,
+                      use_pallas: bool = True):
     """The slab form of ops/tdigest.py's shift guard: when the chunk's
     per-row value ranges are disjoint from what the accumulated bins
     cover for enough chunk mass, drain the bins into the (storage-dtype)
@@ -149,7 +153,8 @@ def _guard_drain_slab(temp: TempSlab, digest: DigestSlab, rows, values,
             seg_wm=t.seg_wm.reshape(slab, a),
             count=t.count, vsum=t.vsum, vmin=t.vmin, vmax=t.vmax,
             recip=t.recip)
-        drained = td_ops.drain_temp(d32, t32, compression)
+        drained = td_ops.drain_temp(d32, t32, compression,
+                                    use_pallas=use_pallas)
         d2 = DigestSlab(
             mean=drained.mean.astype(dt).reshape(-1),
             weight=drained.weight.astype(dt).reshape(-1),
@@ -163,9 +168,9 @@ def _guard_drain_slab(temp: TempSlab, digest: DigestSlab, rows, values,
     return lax.cond(pred, do_drain, lambda a: a, (temp, digest))
 
 
-@partial(jax.jit, donate_argnums=(0, 1), static_argnums=(5, 6))
+@partial(jax.jit, donate_argnums=(0, 1), static_argnums=(5, 6, 7))
 def _ingest_slab(temp: TempSlab, digest: DigestSlab, rows, values, weights,
-                 slab: int, compression: float):
+                 slab: int, compression: float, use_pallas: bool = True):
     """Scatter one flat sample chunk into a slab's flat accumulators,
     with the shift guard (returns (temp, digest)).
 
@@ -176,7 +181,8 @@ def _ingest_slab(temp: TempSlab, digest: DigestSlab, rows, values, weights,
     rows = jnp.where(oor, slab, rows)
     weights = jnp.where(oor, 0.0, weights)
     temp, digest = _guard_drain_slab(temp, digest, rows, values, weights,
-                                     slab, compression)
+                                     slab, compression,
+                                     use_pallas=use_pallas)
     r, v, w, b = td_ops.bin_flat_samples(
         rows, values, weights, slab, k, compression,
         acc_seg_w=temp.seg_w, acc_seg_wm=temp.seg_wm)
@@ -199,10 +205,10 @@ def _ingest_slab(temp: TempSlab, digest: DigestSlab, rows, values, weights,
     ), digest
 
 
-@partial(jax.jit, donate_argnums=(0, 1), static_argnums=(8, 9))
+@partial(jax.jit, donate_argnums=(0, 1), static_argnums=(8, 9, 10))
 def _import_slab(temp: TempSlab, digest: DigestSlab, rows, means, weights,
                  stat_rows, stat_mins, stat_maxs, slab: int,
-                 compression: float):
+                 compression: float, use_pallas: bool = True):
     """Fold imported digest CENTROIDS into a slab's accumulators without
     touching the local scalar stats (samplers.go:473-480); imported
     per-digest extrema land on the digest's dmin/dmax planes and only
@@ -212,7 +218,8 @@ def _import_slab(temp: TempSlab, digest: DigestSlab, rows, means, weights,
     rows = jnp.where(oor, slab, rows)
     weights = jnp.where(oor, 0.0, weights)
     temp, digest = _guard_drain_slab(temp, digest, rows, means, weights,
-                                     slab, compression)
+                                     slab, compression,
+                                     use_pallas=use_pallas)
     r, v, w, b = td_ops.bin_flat_samples(
         rows, means, weights, slab, k, compression,
         acc_seg_w=temp.seg_w, acc_seg_wm=temp.seg_wm)
@@ -233,10 +240,10 @@ def _import_slab(temp: TempSlab, digest: DigestSlab, rows, means, weights,
     return temp, digest
 
 
-@partial(jax.jit, donate_argnums=(0, 1), static_argnums=(3, 4, 5, 6))
+@partial(jax.jit, donate_argnums=(0, 1), static_argnums=(3, 4, 5, 6, 7))
 def _flush_slab(digest: DigestSlab, temp: TempSlab, qs, slab: int,
                 compression: float, want_digest: bool = True,
-                want_fresh: bool = True):
+                want_fresh: bool = True, use_pallas: bool = True):
     """Drain one slab's temp into its digests and emit percentiles.
 
     Returns (fresh empty digest+temp for the next interval — or None/None
@@ -260,7 +267,8 @@ def _flush_slab(digest: DigestSlab, temp: TempSlab, qs, slab: int,
         recip=temp.recip)
     inf = jnp.full((slab,), jnp.inf, jnp.float32)
     drained, pcts = td_ops.drain_and_quantile(d, t, inf, -inf, qs,
-                                              compression)
+                                              compression,
+                                              use_pallas=use_pallas)
     if want_digest:
         out_mean = drained.mean.astype(dt).reshape(-1)
         out_weight = drained.weight.astype(dt).reshape(-1)
@@ -599,7 +607,12 @@ class SlabDigestBank:
                 jax.block_until_ready(t.sum_w)
 
 
-class SlabDigestGroup:
+from veneur_tpu.core.store import OverloadLimited  # noqa: E402  (cycle-safe:
+# store imports nothing from slab at module top level)
+from veneur_tpu.overload import F32_ABS_MAX, MIN_SAMPLE_RATE  # noqa: E402
+
+
+class SlabDigestGroup(OverloadLimited):
     """Drop-in ``DigestGroup`` replacement backed by slab state: the
     store-facing adapter that makes the 10M-series capacity plan a server
     configuration (``digest_storage: slab``) rather than a bench harness.
@@ -675,7 +688,7 @@ class SlabDigestGroup:
 
     @requires_lock("store")
     def _row(self, key, tags) -> int:
-        row = self.interner.intern(key, tags)
+        row = self._intern_row(key, tags)
         if row >= self.capacity:
             self.ensure_capacity(row)
         return row
@@ -701,6 +714,16 @@ class SlabDigestGroup:
 
     @requires_lock("store")
     def sample(self, key, tags, value: float, sample_rate: float):
+        # numerics quarantine, mirroring DigestGroup.sample: nothing
+        # non-finite (or that goes non-finite in f32) reaches the planes
+        if not math.isfinite(value) or abs(value) > F32_ABS_MAX:
+            self._quarantine_samples(
+                "not_finite" if not math.isfinite(value)
+                else "out_of_range")
+            return
+        if not MIN_SAMPLE_RATE <= sample_rate <= 1:
+            self._quarantine_samples("bad_rate")
+            return
         row = self._row(key, tags)
         i = self._fill
         self._rows[i] = row
@@ -713,6 +736,14 @@ class SlabDigestGroup:
     @requires_lock("store")
     def sample_many(self, rows: np.ndarray, vals: np.ndarray,
                     wts: np.ndarray):
+        from veneur_tpu.core.store import _scrub_float_batch
+
+        ok = _scrub_float_batch(self._quarantine, vals,
+                                abs_max=F32_ABS_MAX, weights=wts)
+        nbad = len(rows) - int(ok.sum())
+        if nbad:
+            self.scrubbed += nbad
+            rows, vals, wts = rows[ok], vals[ok], wts[ok]
         n = len(rows)
         start = 0
         while start < n:
@@ -799,7 +830,7 @@ class SlabDigestGroup:
             self.temps[i], self.digests[i] = _ingest_slab(
                 self.temps[i], self.digests[i], jnp.asarray(local),
                 jnp.asarray(v), jnp.asarray(w), self.slab_rows,
-                self.compression)
+                self.compression, self._pallas_allowed())
 
     def _drain_imports(self):
         if self._imp_fill == 0 and self._imp_stat_fill == 0:
@@ -831,7 +862,8 @@ class SlabDigestGroup:
                 jnp.asarray(c_local), jnp.asarray(c_pad[0]),
                 jnp.asarray(c_pad[1]), jnp.asarray(s_local),
                 jnp.asarray(s_pad[0]), jnp.asarray(s_pad[1]),
-                self.slab_rows, self.compression)
+                self.slab_rows, self.compression,
+                self._pallas_allowed())
 
     def _drain_staging(self):
         self._drain_samples()
@@ -867,11 +899,17 @@ class SlabDigestGroup:
         at 1M rows every f32 array is 4 MB of transfer, and a default
         min/max/count aggregate config never reads sum/recip/median.
         Unfetched keys come back zero-filled (their emissions are masked
-        off by the aggregate config that chose not to fetch them)."""
+        off by the aggregate config that chose not to fetch them).
+
+        Like ``DigestGroup.flush``, the device half runs behind the
+        compute-breaker ladder (resilience/compute.py); the interner
+        swap happens only after the programs + fetches succeed, so a
+        failed ladder leaves the group recoverable for the store's
+        re-merge rung."""
         self._drain_staging()
         n = len(self.interner)
-        interner, self.interner = self.interner, self._interner_cls()
         if n == 0:
+            interner, self.interner = self.interner, self._interner_cls()
             if self._retired:
                 self.digests = []
                 self.temps = []
@@ -881,22 +919,49 @@ class SlabDigestGroup:
             self._new_sample_buffers()
             self._new_import_buffers()
             return interner, {}
+        from veneur_tpu.core.store import run_compute_ladder
+
+        out = run_compute_ladder(
+            self._compute,
+            lambda use_pallas: self._flush_fetch(
+                n, percentiles, want_digests, want_stats, use_pallas))
+        interner, self.interner = self.interner, self._interner_cls()
+        self._device_dirty = False
+        if self._retired:
+            self.digests = []
+            self.temps = []
+        else:
+            self._new_sample_buffers()
+            self._new_import_buffers()
+        return interner, out
+
+    def _flush_fetch(self, n: int, percentiles, want_digests, want_stats,
+                     use_pallas: bool) -> dict:
+        """One complete flush attempt over every slab (device programs +
+        host fetches into the result dict). The fresh planes each slab's
+        program returns are committed to ``self`` only once EVERY slab
+        succeeded: a mid-loop kernel failure must leave the group's
+        references intact for the fallback rung / the store's re-merge
+        (on a backend that honors donation the consumed inputs are gone
+        either way, and the ladder degrades to the checkpoint bound)."""
         packed = want_digests == "packed"
         sel = _select_stats(want_stats)
         qs = jnp.asarray(list(percentiles) + [0.5], jnp.float32)
         parts = []
         pk_counts, pk_means, pk_wts = [], [], []
+        new_digests = list(self.digests)
+        new_temps = list(self.temps)
         for i in range(len(self.digests)):
             need = min(n - i * self.slab_rows, self.slab_rows)
             # want_digest=False also skips the device-side cast+write of
             # the drained planes, not just the host fetch; a retired
             # generation additionally skips allocating fresh slabs (its
             # donated planes free outright, slab by slab)
-            (self.digests[i], self.temps[i], mean, weight, dmin, dmax,
+            (new_digests[i], new_temps[i], mean, weight, dmin, dmax,
              pcts, count, vsum, vmin, vmax, recip) = _flush_slab(
                 self.digests[i], self.temps[i], qs, self.slab_rows,
                 self.compression, bool(want_digests),
-                not self._retired)
+                not self._retired, use_pallas)
             if need <= 0:
                 continue
             k = self.k
@@ -923,13 +988,8 @@ class SlabDigestGroup:
             parts.append(jax.device_get(
                 planes + tuple(stats[nm][:need] for nm in sel)))
         cols = [np.concatenate(c, axis=0) for c in zip(*parts)]
-        self._device_dirty = False
-        if self._retired:
-            self.digests = []
-            self.temps = []
-        else:
-            self._new_sample_buffers()
-            self._new_import_buffers()
+        # every slab's program + fetch succeeded: commit the fresh planes
+        self.digests, self.temps = new_digests, new_temps
         out = {}
         if packed:
             out["digest_min"], out["digest_max"] = cols[:2]
@@ -941,8 +1001,7 @@ class SlabDigestGroup:
             (out["digest_mean"], out["digest_weight"], out["digest_min"],
              out["digest_max"]) = cols[:4]
             cols = cols[4:]
-        return interner, _fill_stat_results(sel, cols, n, percentiles,
-                                            out)
+        return _fill_stat_results(sel, cols, n, percentiles, out)
 
     # -- checkpoint snapshot / restore (veneur_tpu/persist/) --------------
 

@@ -58,6 +58,8 @@ import numpy as np
 from veneur_tpu.ops import hll as hll_ops
 from veneur_tpu.core.locking import acquires_lock, requires_lock
 from veneur_tpu.ops import tdigest as td_ops
+from veneur_tpu.overload import (F32_ABS_MAX, MIN_SAMPLE_RATE,
+                                 OVERFLOW_NAME, Quarantine)
 from veneur_tpu.samplers.intermetric import (
     Aggregate,
     HistogramAggregates,
@@ -127,11 +129,186 @@ class Interner:
 
 
 # ---------------------------------------------------------------------------
+# Overload limits shared by every group (bounded cardinality + quarantine)
+# ---------------------------------------------------------------------------
+
+# int64 counter lanes: reject any sample whose Go-semantics contribution
+# int64(value) * int64(1/rate) could overflow (a crash via numpy's
+# OverflowError, or a silent wrap in the bulk path)
+COUNTER_CONTRIB_MAX = float(1 << 63)
+
+
+def _scrub_counter_batch(quarantine, vals, rates) -> np.ndarray:
+    """Admissibility mask for a bulk counter span; rejects counted per
+    reason into the shared quarantine ledger (None = just mask). The
+    bound mirrors the lane's ACTUAL Go-truncation semantics —
+    int64(value) * int64(float32(1)/float32(rate)) — so a sample the
+    statsd scalar path admits is never miscounted as poison here, and
+    a rate whose f32 reciprocal overflows to inf (rate < ~3e-39) is
+    caught before the undefined inf->int64 cast."""
+    finite = np.isfinite(vals)
+    with np.errstate(divide="ignore", invalid="ignore", over="ignore"):
+        recip = np.where((rates > 0) & np.isfinite(rates),
+                         np.float32(1.0) / rates.astype(np.float32),
+                         np.inf)
+    rate_ok = np.isfinite(recip)
+    mult = np.trunc(np.where(rate_ok, recip, 1.0)).astype(np.float64)
+    # the bound backs off from 2^63 by more than f64's representation
+    # spacing there (2^10): a float-compared product a hair past the
+    # boundary must quarantine, never silently wrap int64
+    inrange = (np.abs(np.trunc(vals)) * np.maximum(mult, 1.0)
+               < COUNTER_CONTRIB_MAX - 4096.0)
+    ok = finite & rate_ok & inrange
+    if quarantine is not None and not ok.all():
+        n_nf = int((~finite).sum())
+        n_br = int((finite & ~rate_ok).sum())
+        n_or = int((finite & rate_ok & ~inrange).sum())
+        if n_nf:
+            quarantine.count("not_finite", n_nf)
+        if n_br:
+            quarantine.count("bad_rate", n_br)
+        if n_or:
+            quarantine.count("out_of_range", n_or)
+    return ok
+
+
+def _scrub_float_batch(quarantine, vals, abs_max=None,
+                       weights=None) -> np.ndarray:
+    """Admissibility mask for bulk float samples. Gauges (float64
+    host-side) pass abs_max=None; digest staging passes
+    abs_max=F32_ABS_MAX plus the 1/rate weights — for already-f32
+    inputs the range check is redundant with isfinite (an overflow is
+    inf by then), but it keeps a future float64 caller from laundering
+    1e308 into the planes."""
+    finite = np.isfinite(vals)
+    ok = finite
+    n_or = 0
+    if abs_max is not None:
+        inr = np.abs(vals) <= abs_max
+        n_or = int((finite & ~inr).sum())
+        ok = ok & inr
+    n_br = 0
+    if weights is not None:
+        wok = np.isfinite(weights) & (weights > 0)
+        n_br = int((ok & ~wok).sum())
+        ok = ok & wok
+    if quarantine is not None:
+        n_nf = int((~finite).sum())
+        if n_nf:
+            quarantine.count("not_finite", n_nf)
+        if n_or:
+            quarantine.count("out_of_range", n_or)
+        if n_br:
+            quarantine.count("bad_rate", n_br)
+    return ok
+
+
+class OverloadLimited:
+    """Bounded-cardinality + quarantine plumbing every store group
+    shares. All knobs are class-attribute defaults (unbounded, inert):
+    ``MetricStore`` stamps the instance attributes at construction and
+    re-stamps each generation's fresh twin at the flush swap, so groups
+    constructed directly (tests, benches) behave exactly as before.
+
+    Past ``max_series`` (which INCLUDES the overflow row itself) — or
+    while the overload controller freezes first-sight series — new
+    series collapse into one per-group overflow row named
+    ``veneur.overload.overflow`` tagged ``group:<name>``: counts are
+    preserved and flushed, identities are dropped, and the slab/dense
+    planes stop growing (the pow2 grow ladder cannot be recompile-churned
+    by a cardinality flood). ``veneur.``-prefixed self-metrics are
+    exempt from the FREEZE (they are the operator's only view into the
+    overload) but not from the hard cap."""
+
+    max_series = 0          # 0 = unbounded
+    overflow_label = ""     # group attr name, tags the overflow row
+    _overflow_type = "gauge"
+    _overflow_row = -1
+    spilled = 0             # samples absorbed by the overflow row
+    scrubbed = 0            # samples quarantined at the group boundary
+    _overload = None        # overload.OverloadController
+    _quarantine = None      # overload.Quarantine (shared ledger)
+    _compute = None         # resilience.compute.ComputeBreaker
+
+    def _intern_row(self, key: MetricKey, tags: List[str]) -> int:
+        """Interner hit -> its row; first-sight -> a fresh row, or the
+        overflow row past the cap / under an admission freeze. Callers
+        still grow capacity when the returned row is new."""
+        interner = self.interner
+        row = interner.rows.get(key)
+        if row is not None:
+            return row
+        ms = self.max_series
+        if ms and len(interner) >= (ms if self._overflow_row >= 0
+                                    else ms - 1):
+            return self._spill_row()
+        ctl = self._overload
+        if (ctl is not None and ctl.freeze_new_series()
+                and not key.name.startswith("veneur.")):
+            return self._spill_row()
+        return interner.intern(key, tags)
+
+    def _spill_row(self) -> int:
+        if self._overflow_row < 0:
+            tag = f"group:{self.overflow_label or 'unknown'}"
+            okey = MetricKey(name=OVERFLOW_NAME, type=self._overflow_type,
+                             joined_tags=tag)
+            self._overflow_row = self.interner.intern(okey, [tag])
+        self.spilled += 1
+        return self._overflow_row
+
+    def _quarantine_samples(self, reason: str, n: int = 1) -> None:
+        self.scrubbed += n
+        q = self._quarantine
+        if q is not None:
+            q.count(reason, n)
+
+    def _pallas_allowed(self) -> bool:
+        """Staging drains stay off the Pallas kernel while its breaker
+        is not closed (never consumes the half-open probe — only the
+        flush path probes)."""
+        c = self._compute
+        return c is None or not c.degraded()
+
+
+def run_compute_ladder(compute, attempt):
+    """The flush-kernel ladder shared by the dense and slab digest
+    groups (resilience/compute.py): ``attempt(use_pallas)`` runs one
+    complete device-program-plus-fetch pass. Pallas rung while the
+    breaker is closed (or as its half-open probe) → XLA rung; raises
+    only once BOTH rungs fail (the store's re-merge rung follows).
+
+    Honesty note on rung 2's reach: the flush programs DONATE their
+    device inputs, so on a backend that honors donation a failure
+    mid-execution (true TPU preemption) consumes them and the retry —
+    and the re-merge snapshot — fail too; the interval then degrades to
+    PR 2's checkpoint bound. Rung 2 fully covers the failures that
+    raise BEFORE execution: Mosaic compile errors after a config
+    change, injected preflight faults, and trace-time errors."""
+    if compute is None:
+        return attempt(True)
+    if compute.probe():
+        try:
+            compute.preflight()
+            out = attempt(True)
+            compute.record_success()
+            return out
+        except Exception:
+            compute.record_failure()
+            log.warning("digest flush kernel failed; re-running this "
+                        "interval on the XLA fallback path",
+                        exc_info=True)
+    out = attempt(False)
+    compute.count_fallback()
+    return out
+
+
+# ---------------------------------------------------------------------------
 # Host-side scalar groups
 # ---------------------------------------------------------------------------
 
 
-class ScalarGroup:
+class ScalarGroup(OverloadLimited):
     """Counters / gauges / status checks: host numpy state.
 
     kind: "counter" (int64 accumulate, samplers.go:141-143),
@@ -155,7 +332,7 @@ class ScalarGroup:
 
     @requires_lock("store")
     def _row(self, key: MetricKey, tags: List[str]) -> int:
-        row = self.interner.intern(key, tags)
+        row = self._intern_row(key, tags)
         if row >= self.capacity:
             self.capacity *= _GROW_FACTOR
             self.values = np.concatenate(
@@ -169,16 +346,35 @@ class ScalarGroup:
     @requires_lock("store")
     def sample(self, key: MetricKey, tags: List[str], value: float,
                sample_rate: float, message: str = "", hostname: str = ""):
-        row = self._row(key, tags)
+        # defensive numerics quarantine: the parser rejects these on the
+        # statsd/SSF lanes, but samples also arrive via restore/import
+        # shims — a NaN gauge or an int64-overflowing counter must never
+        # reach state (numpy raises OverflowError on the latter)
+        if not math.isfinite(value):
+            self._quarantine_samples("not_finite")
+            return
         if self.kind == "counter":
             # Go semantics: value += int64(sample) * int64(1/rate)
             # (samplers.go:141-143) — both factors truncate toward zero,
             # and the reciprocal is a float32 division (UDPMetric's
-            # SampleRate is float32), matching the native batch path
-            self.values[row] += (int(value)
-                                 * int(np.float32(1.0)
-                                       / np.float32(sample_rate)))
+            # SampleRate is float32), matching the native batch path.
+            # The rate is bounded BEFORE the reciprocal: a denormal-tiny
+            # rate underflows f32, 1/rate overflows to inf, and int(inf)
+            # raises OverflowError — one poisoned packet would kill the
+            # reader thread
+            if not MIN_SAMPLE_RATE <= sample_rate <= 1:
+                self._quarantine_samples("bad_rate")
+                return
+            contrib = (int(value)
+                       * int(np.float32(1.0) / np.float32(sample_rate)))
+            if abs(contrib) >= COUNTER_CONTRIB_MAX:
+                self._quarantine_samples("out_of_range")
+                return
+            # _row may grow (replace) the values array: resolve it first
+            row = self._row(key, tags)
+            self.values[row] += contrib
         else:
+            row = self._row(key, tags)
             self.values[row] = value
             if self.messages is not None:
                 self.messages[row] = message
@@ -212,8 +408,14 @@ class ScalarGroup:
     def combine(self, key: MetricKey, tags: List[str], value: float):
         """Merge imported state: counters add, gauges/status overwrite
         (samplers.go:195-212, 276-289)."""
+        if not math.isfinite(value):
+            self._quarantine_samples("not_finite")
+            return
         row = self._row(key, tags)
         if self.kind == "counter":
+            if abs(value) >= COUNTER_CONTRIB_MAX:
+                self._quarantine_samples("out_of_range")
+                return
             self.values[row] += int(value)
         else:
             self.values[row] = value
@@ -253,40 +455,47 @@ class ScalarGroup:
 # ---------------------------------------------------------------------------
 
 
-@partial(jax.jit, donate_argnums=(0, 1), static_argnums=(5,))
+@partial(jax.jit, donate_argnums=(0, 1), static_argnums=(5, 6))
 def _ingest_samples(digest: td_ops.TDigest, temp: td_ops.TempCentroids,
-                    rows, values, weights, compression):
+                    rows, values, weights, compression,
+                    use_pallas=True):
     """Shift-guarded ingest (ops/tdigest.py ingest_chunk_guarded): a
     distribution step drains the bins into the digest before re-binning,
-    so ordered/shifting arrival cannot alias values across bins."""
+    so ordered/shifting arrival cannot alias values across bins.
+    ``use_pallas`` is a trace-time static: False keeps the guard drain
+    on the XLA path while the compute breaker is open."""
     return td_ops.ingest_chunk_guarded(digest, temp, rows, values, weights,
-                                       compression)
+                                       compression, use_pallas=use_pallas)
 
 
-@partial(jax.jit, donate_argnums=(0, 1, 2, 3), static_argnums=(10,))
+@partial(jax.jit, donate_argnums=(0, 1, 2, 3), static_argnums=(10, 11))
 def _ingest_centroids(digest: td_ops.TDigest, temp: td_ops.TempCentroids,
                       dmin, dmax, rows, means,
-                      weights, stat_rows, stat_mins, stat_maxs, compression):
+                      weights, stat_rows, stat_mins, stat_maxs, compression,
+                      use_pallas=True):
     """Fold imported digest centroids into the bin accumulators WITHOUT
     touching the local scalar stats (samplers.go:473-480). Imported
     per-digest min/max land in separate dmin/dmax arrays that only bound the
     final digest. Shift-guarded like the sample path."""
     digest, temp = td_ops.ingest_chunk_guarded(
         digest, temp, rows, means, weights, compression,
-        update_stats=False)
+        update_stats=False, use_pallas=use_pallas)
     dmin = dmin.at[stat_rows].min(stat_mins, mode="drop")
     dmax = dmax.at[stat_rows].max(stat_maxs, mode="drop")
     return digest, temp, dmin, dmax
 
 
-@partial(jax.jit, donate_argnums=(0, 1), static_argnums=(5,))
+@partial(jax.jit, donate_argnums=(0, 1), static_argnums=(5, 6))
 def _flush_digests(digest: td_ops.TDigest, temp: td_ops.TempCentroids,
-                   dmin, dmax, qs, compression):
+                   dmin, dmax, qs, compression, use_pallas=True):
     """The per-interval flush program: one compress + one batched quantile
     gather for the whole group (the Histo.Flush hot loop of
-    samplers.go:511-636 over all series at once)."""
+    samplers.go:511-636 over all series at once). ``use_pallas=False``
+    is the compute breaker's fallback rung: the same math compiled
+    without the fused kernel (resilience/compute.py)."""
     drained, pcts = td_ops.drain_and_quantile(digest, temp, dmin, dmax, qs,
-                                              compression)
+                                              compression,
+                                              use_pallas=use_pallas)
     return (drained, pcts, temp.count, temp.vsum, temp.vmin, temp.vmax,
             temp.recip)
 
@@ -398,7 +607,7 @@ def bulk_stage_import_centroids(group, rows: np.ndarray, means: np.ndarray,
         group._drain_imports()
 
 
-class DigestGroup:
+class DigestGroup(OverloadLimited):
     """One scope-class of histograms/timers as a dense t-digest batch."""
 
     # set by MetricStore._swap_generation: a retired group's flush drops
@@ -459,7 +668,7 @@ class DigestGroup:
 
     @requires_lock("store")
     def _row(self, key: MetricKey, tags: List[str]) -> int:
-        row = self.interner.intern(key, tags)
+        row = self._intern_row(key, tags)
         if row >= self.capacity:
             self._grow()
         return row
@@ -510,7 +719,16 @@ class DigestGroup:
     def sample_many(self, rows: np.ndarray, vals: np.ndarray,
                     wts: np.ndarray):
         """Bulk staging append for the native ingest path: one numpy copy
-        per chunk span instead of a Python call per sample."""
+        per chunk span instead of a Python call per sample. Non-finite
+        values/weights are scrubbed here — after the f32 cast, so a
+        1e308 that became inf is caught too — rather than laundered
+        into digest state."""
+        ok = _scrub_float_batch(self._quarantine, vals,
+                                abs_max=F32_ABS_MAX, weights=wts)
+        nbad = len(rows) - int(ok.sum())
+        if nbad:
+            self.scrubbed += nbad
+            rows, vals, wts = rows[ok], vals[ok], wts[ok]
         n = len(rows)
         start = 0
         while start < n:
@@ -529,6 +747,18 @@ class DigestGroup:
     @requires_lock("store")
     def sample(self, key: MetricKey, tags: List[str], value: float,
                sample_rate: float):
+        # numerics quarantine (defense in depth behind the parser): a
+        # NaN/Inf or f32-overflowing value would poison the digest's
+        # centroid means; a rate outside [MIN_SAMPLE_RATE, 1] yields a
+        # non-finite or non-positive f32 weight
+        if not math.isfinite(value) or abs(value) > F32_ABS_MAX:
+            self._quarantine_samples(
+                "not_finite" if not math.isfinite(value)
+                else "out_of_range")
+            return
+        if not MIN_SAMPLE_RATE <= sample_rate <= 1:
+            self._quarantine_samples("bad_rate")
+            return
         row = self._row(key, tags)
         i = self._fill
         self._rows[i] = row
@@ -595,7 +825,8 @@ class DigestGroup:
         self._new_sample_buffers()
         self.digest, self.temp = _ingest_samples(
             self.digest, self.temp, jnp.asarray(rows),
-            jnp.asarray(vals), jnp.asarray(wts), self.compression)
+            jnp.asarray(vals), jnp.asarray(wts), self.compression,
+            self._pallas_allowed())
 
     def _drain_imports(self):
         if self._imp_fill == 0 and self._imp_stat_fill == 0:
@@ -620,17 +851,18 @@ class DigestGroup:
             jnp.asarray(imp_rows), jnp.asarray(imp_means),
             jnp.asarray(imp_wts), jnp.asarray(stat_rows),
             jnp.asarray(stat_mins), jnp.asarray(stat_maxs),
-            self.compression)
+            self.compression, self._pallas_allowed())
 
     def _drain_staging(self):
         self._drain_samples()
         self._drain_imports()
 
-    def _run_flush(self, qs):
+    def _run_flush(self, qs, use_pallas: bool = True):
         """Execute the jitted flush program (override point for the
-        mesh-sharded store)."""
+        mesh-sharded store; ``use_pallas=False`` is the compute
+        breaker's fallback rung — same math, no fused kernel)."""
         return _flush_digests(self.digest, self.temp, self.dmin, self.dmax,
-                              qs, self.compression)
+                              qs, self.compression, use_pallas)
 
     def flush(self, percentiles: List[float], want_digests=True,
               want_stats=None):
@@ -643,11 +875,16 @@ class DigestGroup:
         want_digests="packed" compacts + quantizes them on device first
         (core/slab.py:_pack_slab) and fetches only the live centroids at
         4 bytes each — see SlabDigestGroup.flush, which also documents
-        the ``want_stats`` fetch selection."""
+        the ``want_stats`` fetch selection.
+
+        The device half runs behind the compute-breaker ladder
+        (resilience/compute.py): a runtime kernel failure retries this
+        same interval on the XLA fallback, and only a double failure
+        raises — the store then re-merges the generation (rung 3)."""
         self._drain_staging()
         n = len(self.interner)
-        interner, self.interner = self.interner, Interner()
         if n == 0:
+            interner, self.interner = self.interner, Interner()
             if self._retired:
                 self._drop_device()
             elif self._device_dirty:
@@ -659,12 +896,41 @@ class DigestGroup:
             # device->host fetches (each fetch is a full round trip when
             # the chip sits behind a network tunnel)
             return interner, {}
+        out = self._flush_compute(n, percentiles, want_digests, want_stats)
+        # the interner swap and device reset happen only AFTER the
+        # device programs + fetches succeeded: on a ladder failure the
+        # group still holds its state for the store's re-merge rung
+        interner, self.interner = self.interner, Interner()
+        if self._retired:
+            self._drop_device()
+        else:
+            self._init_device()
+            self._init_staging()
+        return interner, out
+
+    def _flush_compute(self, n: int, percentiles, want_digests,
+                       want_stats) -> dict:
+        """The flush's device programs behind the per-kernel breaker;
+        see :func:`run_compute_ladder` (incl. the donation caveat on
+        what rung 2 can and cannot recover)."""
+        return run_compute_ladder(
+            self._compute,
+            lambda use_pallas: self._flush_fetch(
+                n, percentiles, want_digests, want_stats, use_pallas))
+
+    def _flush_fetch(self, n: int, percentiles, want_digests, want_stats,
+                     use_pallas: bool) -> dict:
+        """One complete flush attempt: device program + host fetch into
+        the result dict. No group state besides the (donated) device
+        planes is touched, so an attempt that failed before execution
+        can be retried."""
         packed = want_digests == "packed"
         from veneur_tpu.core.slab import _fill_stat_results, _select_stats
 
         sel = _select_stats(want_stats)
         qs = jnp.asarray(list(percentiles) + [0.5], jnp.float32)
-        digest, pcts, count, vsum, vmin, vmax, recip = self._run_flush(qs)
+        digest, pcts, count, vsum, vmin, vmax, recip = self._run_flush(
+            qs, use_pallas)
         # one batched transfer instead of eleven round trips
         planes = ()
         out = {}
@@ -692,12 +958,7 @@ class DigestGroup:
              out["digest_max"]) = fetched[:4]
             fetched = fetched[4:]
         _fill_stat_results(sel, fetched, n, percentiles, out)
-        if self._retired:
-            self._drop_device()
-        else:
-            self._init_device()
-            self._init_staging()
-        return interner, out
+        return out
 
     def _drop_device(self):
         """Free a retired generation's device state at the earliest
@@ -788,7 +1049,7 @@ def _estimate_all(registers):
                             _precision_of(registers))
 
 
-class SetGroup:
+class SetGroup(OverloadLimited):
     """One scope-class of Set metrics as a dense [S, 2^p] register tensor.
 
     Registers are int8 (max value 64-p+1 = 51): at the reference's precision
@@ -827,7 +1088,7 @@ class SetGroup:
 
     @requires_lock("store")
     def _row(self, key: MetricKey, tags: List[str]) -> int:
-        row = self.interner.intern(key, tags)
+        row = self._intern_row(key, tags)
         if row >= self.capacity:
             self._grow()
         return row
@@ -997,7 +1258,7 @@ class SetGroup:
 # ---------------------------------------------------------------------------
 
 
-class HeavyHitterGroup:
+class HeavyHitterGroup(OverloadLimited):
     """Set-type metrics tagged ``veneurtopk``: instead of cardinality,
     count per-member frequencies in one shared salted count-min table
     (veneur_tpu/ops/countmin.py) and keep a per-series top-k list.
@@ -1072,12 +1333,15 @@ class HeavyHitterGroup:
 
     @requires_lock("store")
     def _row(self, key: MetricKey, tags: List[str]) -> int:
-        row = self.interner.intern(key, tags)
+        row = self._intern_row(key, tags)
         if row >= self.capacity:
             self.ensure_capacity(row)
         if self._sids_np[row] == 0:  # first sight (or the 2^-32 rehash)
-            self._sids_np[row] = self.stable_sid(key.name,
-                                                 ",".join(tags))
+            # derive the sid from the row's INTERNED identity, not the
+            # sample's key: past the cardinality cap the row is the
+            # overflow row and must hash as such on every instance
+            self._sids_np[row] = self.stable_sid(self.interner.names[row],
+                                                 self.interner.joined[row])
         return row
 
     @requires_lock("store")
@@ -1297,6 +1561,11 @@ class MetricsSummary:
     # flush so concurrent increments are never lost
     processed: int = 0
     imported: int = 0
+    # overload accounting (veneur.overload.*): samples absorbed by each
+    # group's overflow row and samples scrubbed at the group boundary,
+    # keyed by group attr name; only non-zero groups appear
+    spilled: Dict[str, int] = field(default_factory=dict)
+    scrubbed: Dict[str, int] = field(default_factory=dict)
 
 
 class PackedDigestPlanes(NamedTuple):
@@ -1452,6 +1721,16 @@ class _Generation:
 def _summarize(g) -> "MetricsSummary":
     """Group-count summary for any group container (the live store or a
     retired generation) — one mapping, two callers."""
+    spilled = {}
+    scrubbed = {}
+    for name in MetricStore._GEN_GROUPS:
+        grp = getattr(g, name, None)
+        if grp is None:
+            continue
+        if getattr(grp, "spilled", 0):
+            spilled[name] = grp.spilled
+        if getattr(grp, "scrubbed", 0):
+            scrubbed[name] = grp.scrubbed
     return MetricsSummary(
         counters=len(g.counters), gauges=len(g.gauges),
         histograms=len(g.histograms), sets=len(g.sets),
@@ -1459,7 +1738,8 @@ def _summarize(g) -> "MetricsSummary":
         global_gauges=len(g.global_gauges),
         local_histograms=len(g.local_histograms),
         local_sets=len(g.local_sets), local_timers=len(g.local_timers),
-        local_status_checks=len(g.local_status_checks))
+        local_status_checks=len(g.local_status_checks),
+        spilled=spilled, scrubbed=scrubbed)
 
 
 class MetricStore:
@@ -1472,7 +1752,8 @@ class MetricStore:
                  mesh=None, digest_storage: str = "dense",
                  digest_dtype: str = "float32", slab_rows: int = 1 << 20,
                  topk_depth: int = 4, topk_width: int = 1 << 16,
-                 topk_k: int = 32):
+                 topk_k: int = 32, max_series: int = 0,
+                 max_tag_length: int = 0, compute=None, overload=None):
         self._lock = threading.RLock()
         # serializes whole flush() calls (the store lock itself is held
         # only for the generation swap — see flush())
@@ -1531,6 +1812,18 @@ class MetricStore:
                                               depth=topk_depth,
                                               width=topk_width, k=topk_k)
         self.hll_precision = hll_precision
+        # overload-safety plumbing (veneur_tpu/overload.py,
+        # resilience/compute.py): bounded per-group cardinality, the
+        # shared quarantine ledger, the flush-kernel breaker, and the
+        # (optional, attached by the server) admission controller
+        from veneur_tpu.resilience.compute import ComputeBreaker
+
+        self.max_series = max_series
+        self.max_tag_length = max_tag_length
+        self.compute = compute if compute is not None else ComputeBreaker()
+        self.quarantine = Quarantine()
+        self._overload = overload
+        self._configure_overload_groups()
         self.processed = 0
         self.imported = 0
         # bumped at every generation swap; a checkpoint writer snapshots
@@ -1545,11 +1838,59 @@ class MetricStore:
         self._mlist_table = None
         self._kind_groups = None
 
+    # -- overload plumbing (veneur_tpu/overload.py) ------------------------
+
+    def set_overload(self, controller) -> None:
+        """Attach the server's admission controller; groups consult it
+        for the first-sight series freeze (level >= 1)."""
+        self._overload = controller
+        self._configure_overload_groups()
+
+    def _configure_overload_groups(self) -> None:
+        for name in self._GEN_GROUPS:
+            self._apply_overload_attrs(name, getattr(self, name))
+
+    def _apply_overload_attrs(self, name: str, g) -> None:
+        """Stamp one group's overload instance attrs (OverloadLimited's
+        class defaults keep directly-constructed groups inert). Re-run
+        on every fresh twin at the generation swap."""
+        g.max_series = self.max_series
+        g.overflow_label = name
+        g._overflow_type = self._GROUP_TYPES[name]
+        g._overload = self._overload
+        g._quarantine = self.quarantine
+        g._compute = self.compute
+
+    def _truncate_tags(self, joined: str) -> str:
+        """Hard per-series tag-length cap: cut the joined tag string at
+        the last whole tag inside ``max_tag_length`` (identities merge —
+        that is the point: an adversarial tag bomb must stop costing
+        memory at the cap). Counted per occurrence."""
+        from veneur_tpu.samplers.parser import truncate_joined_tags
+
+        limit = self.max_tag_length
+        if not limit or len(joined) <= limit:
+            return joined
+        self.quarantine.count("oversized_tags")
+        return truncate_joined_tags(joined, limit)
+
     # -- ingest ------------------------------------------------------------
 
     @acquires_lock("store")
     def process_metric(self, m: UDPMetric):
-        """Dispatch one parsed sample to its scope-class (worker.go:267-310)."""
+        """Dispatch one parsed sample to its scope-class (worker.go:267-310).
+
+        The tag-length cap re-checks here because this is the ONE choke
+        point every lane shares: the statsd parser caps at parse, but
+        SSF-borne samples (UDP spans, the native slow lane, extraction-
+        sink metrics) arrive with unbounded joined tags."""
+        key = m.key
+        if (self.max_tag_length
+                and len(key.joined_tags) > self.max_tag_length):
+            joined = self._truncate_tags(key.joined_tags)
+            m.key = key = MetricKey(name=key.name, type=key.type,
+                                    joined_tags=joined)
+            m.tags = joined.split(",") if joined else []
         with self._lock:
             self.processed += 1
             t = m.key.type
@@ -1642,11 +1983,34 @@ class MetricStore:
                 group = self._group_for_kind(kind)
                 group.ensure_capacity(int(grp_rows.max()))
                 if kind in (_K_COUNTER, _K_GLOBAL_COUNTER):
-                    # int64(value) * int64(1/rate), both truncating
+                    # numerics quarantine: NaN/Inf values cast to int64
+                    # garbage and oversized contributions overflow the
+                    # exact counter lanes — scrub before the cast
+                    ok = _scrub_counter_batch(self.quarantine,
+                                              values[sel], rates[sel])
+                    if not ok.all():
+                        group.scrubbed += len(sel) - int(ok.sum())
+                        sel = sel[ok]
+                        grp_rows = grp_rows[ok]
+                        if not len(sel):
+                            continue
+                    # int64(value) * int64(float32(1)/float32(rate)),
+                    # both truncating (samplers.go:141-143) — the SAME
+                    # f32 reciprocal the scrub mask bounded, so nothing
+                    # admitted can wrap the int64 product
+                    recips = (np.float32(1.0)
+                              / rates[sel].astype(np.float32))
                     contribs = (values[sel].astype(np.int64)
-                                * (1.0 / rates[sel]).astype(np.int64))
+                                * recips.astype(np.int64))
                     group.add_many(grp_rows, contribs)
                 elif kind in (_K_GAUGE, _K_GLOBAL_GAUGE):
+                    ok = _scrub_float_batch(self.quarantine, values[sel])
+                    if not ok.all():
+                        group.scrubbed += len(sel) - int(ok.sum())
+                        sel = sel[ok]
+                        grp_rows = grp_rows[ok]
+                        if not len(sel):
+                            continue
                     group.set_many(grp_rows, values[sel])
                 elif kind in (_K_SET, _K_LOCAL_SET):
                     if member_hashes is None:
@@ -1681,7 +2045,7 @@ class MetricStore:
         """Slow path of the native-batch cache: decode strings, pick the
         scope-class group (worker.go:96-157), intern the row."""
         name = name_b.decode("utf-8", "replace")
-        joined = tags_b.decode("utf-8", "replace")
+        joined = self._truncate_tags(tags_b.decode("utf-8", "replace"))
         tags = joined.split(",") if joined else []
         key = MetricKey(name=name, type=_NATIVE_TYPE_NAMES[t],
                         joined_tags=joined)
@@ -1838,7 +2202,8 @@ class MetricStore:
                         # MISS and the apply phase counts it
                         continue
                     name = name_b.decode("utf-8", "replace")
-                    joined = tags_b.decode("utf-8", "replace")
+                    joined = self._truncate_tags(
+                        tags_b.decode("utf-8", "replace"))
                     tags = joined.split(",") if joined else []
                     key = MetricKey(name=name, type=tname,
                                     joined_tags=joined)
@@ -2154,7 +2519,11 @@ class MetricStore:
             old = getattr(self, attr)
             old._retired = True  # its flush frees state, not reinits it
             setattr(gen, attr, old)
-            setattr(self, attr, old.fresh())
+            fresh = old.fresh()
+            # fresh twins start with the class-default overload attrs;
+            # re-stamp the cap/ledger/breaker plumbing each swap
+            self._apply_overload_attrs(attr, fresh)
+            setattr(self, attr, fresh)
         gen.processed = self.processed
         gen.imported = self.imported
         self.processed = 0
@@ -2196,20 +2565,22 @@ class MetricStore:
             g.histograms, mixed_pcts, aggregates, final, now,
             fwd_list=fwd.histograms if fwd_digests else None,
             col=col, fwd_state=fwd if fwd_digests else None,
-            fwd_attr="histograms_columnar", digest_format=digest_format)
+            fwd_attr="histograms_columnar", digest_format=digest_format,
+            gen_name="histograms")
         self._flush_digest_group(
             g.timers, mixed_pcts, aggregates, final, now,
             fwd_list=fwd.timers if fwd_digests else None,
             col=col, fwd_state=fwd if fwd_digests else None,
-            fwd_attr="timers_columnar", digest_format=digest_format)
+            fwd_attr="timers_columnar", digest_format=digest_format,
+            gen_name="timers")
 
         # local-only histograms/timers: full flush with percentiles
         self._flush_digest_group(g.local_histograms, list(percentiles),
                                  aggregates, final, now, fwd_list=None,
-                                 col=col)
+                                 col=col, gen_name="local_histograms")
         self._flush_digest_group(g.local_timers, list(percentiles),
                                  aggregates, final, now, fwd_list=None,
-                                 col=col)
+                                 col=col, gen_name="local_timers")
 
         # local sets always flush; mixed sets flush only on a global
         # instance (they are forwarded from locals)
@@ -2303,7 +2674,8 @@ class MetricStore:
                             out: List[InterMetric], now: int,
                             fwd_list: Optional[list], col=None,
                             fwd_state=None, fwd_attr: str = "",
-                            digest_format: str = "dense"):
+                            digest_format: str = "dense",
+                            gen_name: str = ""):
         forwarding = fwd_list is not None or fwd_state is not None
         want = forwarding
         if forwarding and digest_format == "packed":
@@ -2327,8 +2699,22 @@ class MetricStore:
             want_stats.add("recip")
         if (agg & Aggregate.MEDIAN) or percentiles:
             want_stats.add("pcts")
-        interner, r = group.flush(percentiles, want_digests=want,
-                                  want_stats=want_stats)
+        try:
+            interner, r = group.flush(percentiles, want_digests=want,
+                                      want_stats=want_stats)
+        except Exception:
+            # the compute ladder's last rung: both the Pallas and the
+            # XLA flush attempts failed (resilience/compute.py). The
+            # group still holds its interval — re-merge it into the
+            # LIVE store with import semantics, so the data emits LATE
+            # next flush (and PR 2's checkpointer persists it on its
+            # normal cadence) instead of being lost with the retired
+            # generation.
+            log.exception("digest flush for %s failed past the fallback "
+                          "ladder; re-merging the interval into the "
+                          "live store", gen_name or "digest group")
+            self._requeue_group(gen_name, group)
+            return
         packed = ("packed_counts" in r) if r else False
         if col is not None and len(interner):
             from veneur_tpu.core import columnar as cb
@@ -2405,6 +2791,34 @@ class MetricStore:
                         w[live].astype(np.float64),
                         float(r["digest_min"][row]),
                         float(r["digest_max"][row])))
+
+    def _requeue_group(self, gen_name: str, group) -> None:
+        """Rung 3 of the flush-kernel ladder: snapshot the retired
+        group (exclusively owned here — the flush swap already replaced
+        it) and merge the snapshot back into the LIVE group with import
+        semantics, exactly like a forwarded sketch or a checkpoint
+        restore. The interval is late, never lost; a total device
+        failure (snapshot raising too) degrades to the checkpoint
+        bound: at most checkpoint_interval of data."""
+        compute = self.compute
+        if not gen_name:
+            compute.count_lost()
+            return
+        try:
+            # retired generation: this thread is the sole owner, the
+            # store lock is not required (cf. _flush_generation)
+            snap = group.snapshot_state()  # lint: ok(unlocked-call)
+            with self._lock:
+                self._restore_group(gen_name, self._GROUP_TYPES[gen_name],
+                                    getattr(self, gen_name), snap)
+            compute.count_requeued()
+            log.warning("re-merged %s into the live store; its interval "
+                        "will emit with the next flush", gen_name)
+        except Exception:
+            compute.count_lost()
+            log.exception("could not re-merge %s after the flush "
+                          "failure; its interval is lost (the last "
+                          "checkpoint bounds the damage)", gen_name)
 
     def _flush_set_group(self, group: SetGroup,
                          out: Optional[List[InterMetric]], now: int,

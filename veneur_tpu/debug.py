@@ -154,6 +154,36 @@ def collect_vars(server) -> dict:
         out["http_import"] = {"queue_depth": pool.qsize(),
                               "merged_batches": pool.merged_batches,
                               "shed_batches": pool.shed}
+    try:
+        # overload / degradation state (the ladder of
+        # docs/resilience.md): admission level + sheds, per-reason
+        # quarantine, per-group spill/scrub tallies, compute breaker
+        ov = getattr(server, "overload", None)
+        store = getattr(server, "store", None)
+        section: dict = {}
+        if ov is not None:
+            section.update(ov.snapshot())
+        if store is not None:
+            q = getattr(store, "quarantine", None)
+            if q is not None:
+                section["quarantined"] = q.snapshot()
+            compute = getattr(store, "compute", None)
+            if compute is not None:
+                section["compute"] = compute.snapshot()
+            spilled = {}
+            for attr in getattr(store, "_GEN_GROUPS", ()):
+                g = getattr(store, attr, None)
+                if g is not None and getattr(g, "spilled", 0):
+                    spilled[attr] = g.spilled
+            if spilled:
+                section["spilled_this_interval"] = spilled
+            section["max_series"] = getattr(store, "max_series", 0)
+        if section:
+            out["overload"] = section
+        if hasattr(server, "degradation"):
+            out["degraded"] = server.degradation()
+    except Exception as e:  # pragma: no cover - diagnostic only
+        out["overload_error"] = repr(e)
     return out
 
 

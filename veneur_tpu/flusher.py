@@ -154,6 +154,7 @@ def _flush_once(server: "Server", span):
                                getattr(server, "flush_overruns", 0))),
             None),
         *_worker_samples(server, ms),
+        *_overload_samples(server, ms),
         *_forward_samples(server),
         *_import_samples(server),
         *_checkpoint_samples(server),
@@ -330,6 +331,67 @@ def _worker_samples(server, ms):
         out.append(ssf_samples.count(
             "veneur.worker.metrics_flushed_total", float(getattr(ms, mtype)),
             {"metric_type": mtype.rstrip("s")}))
+    # per-lane span-queue pressure: the current depth plus the
+    # interval's high watermark (read-and-reset), tagged by sink, so an
+    # operator sees a lane backing up BEFORE ingest_timeout_total drops
+    # begin (each lane sheds only once its bounded queue fills)
+    workers = getattr(server, "_span_workers", None) or ()
+    for w in workers[:1]:  # lanes are shared across workers
+        for lane in getattr(w, "_lanes", ()):
+            hwm, lane.depth_hwm = lane.depth_hwm, 0
+            out.append(ssf_samples.gauge(
+                "veneur.server.span_lane.depth",
+                float(lane.queue.qsize()), {"sink": lane.sink.name}))
+            out.append(ssf_samples.gauge(
+                "veneur.server.span_lane.depth_hwm", float(hwm),
+                {"sink": lane.sink.name}))
+    return out
+
+
+def _overload_samples(server, ms):
+    """The veneur.overload.* set (docs/resilience.md "Degradation
+    ladder"): admission level + per-lane sheds, per-reason quarantine,
+    per-group overflow spills, and the flush-kernel breaker's
+    fallback/requeue tallies. Counters are interval deltas like the
+    worker set; spills/scrubs ride the generation summary (exact for
+    the flushed interval)."""
+    from veneur_tpu.trace import samples as ssf_samples
+
+    out = []
+    ov = getattr(server, "overload", None)
+    if ov is not None:
+        out.append(ssf_samples.gauge("veneur.overload.level",
+                                     float(ov.level()), None))
+        for lane, shed in sorted(ov.shed.items()):
+            out.append(ssf_samples.count(
+                "veneur.overload.shed_total",
+                float(_delta_since(ov, f"_last_shed_{lane}", shed)),
+                {"lane": lane}))
+    quarantine = getattr(getattr(server, "store", None), "quarantine",
+                         None)
+    if quarantine is not None:
+        for reason, total in sorted(quarantine.snapshot().items()):
+            out.append(ssf_samples.count(
+                "veneur.overload.quarantined_total",
+                float(_delta_since(quarantine, f"_last_{reason}", total)),
+                {"reason": reason}))
+    for group, spilled in sorted(getattr(ms, "spilled", {}).items()):
+        out.append(ssf_samples.count(
+            "veneur.overload.samples_spilled_total", float(spilled),
+            {"group": group}))
+    compute = getattr(getattr(server, "store", None), "compute", None)
+    if compute is not None:
+        out.append(ssf_samples.count(
+            "veneur.overload.compute_fallback_total",
+            float(_delta_since(compute, "_last_reported_fallbacks",
+                               compute.fallback_total)), None))
+        out.append(ssf_samples.count(
+            "veneur.overload.compute_requeued_total",
+            float(_delta_since(compute, "_last_reported_requeues",
+                               compute.requeued_total)), None))
+        for kernel, gauge in compute.states():
+            out.append(ssf_samples.gauge(
+                "veneur.breaker.state", gauge, {"destination": kernel}))
     return out
 
 

@@ -311,13 +311,25 @@ class OpsServer:
             # probe: 503 once the last successful flush goes stale
             # (policy lives in Server.readiness), so an orchestrator
             # can stop routing to — without restarting — an instance
-            # that is alive but not draining
+            # that is alive but not draining. Active DEGRADATIONS
+            # (overload shedding, flush on the compute fallback) ride
+            # the body at 200: degraded-but-flushing must keep serving.
             ok, age, limit = server.readiness()
+            degraded = []
+            if hasattr(server, "degradation"):
+                try:
+                    degraded = server.degradation()
+                except Exception:  # telemetry must never fail the probe
+                    degraded = []
             if ok:
-                return 200, "ready", "text/plain"
+                body = "ready" if not degraded else \
+                    "ready (degraded: " + "; ".join(degraded) + ")"
+                return 200, body, "text/plain"
             detail = ("; last flush attempt FAILED"
                       if not getattr(server, "last_flush_ok", True)
                       else "")
+            if degraded:
+                detail += "; degraded: " + "; ".join(degraded)
             return (503,
                     f"last successful flush {age:.1f}s ago "
                     f"(limit {limit:.1f}s){detail}", "text/plain")

@@ -16,11 +16,47 @@ import os
 import socket
 import ssl
 import threading
+import time
 from typing import Callable, List, Optional
 
 from veneur_tpu.protocol.addr import ResolvedAddr, resolve_addr
 
 log = logging.getLogger("veneur.networking")
+
+# read-loop error logging is rate-limited to one warning per flush
+# interval: a persistent socket error (dead NIC, revoked netns) would
+# otherwise log at packet rate — exactly when the GIL is scarcest
+DEFAULT_ERROR_LOG_INTERVAL = 10.0
+
+
+class _LogLimiter:
+    """At most one warning per ``interval`` seconds; interleaving calls
+    fold into a suppressed-count carried on the next emitted line.
+    Thread-safe (one limiter is shared across a listener's readers)."""
+
+    def __init__(self, interval: float = DEFAULT_ERROR_LOG_INTERVAL,
+                 clock: Callable[[], float] = time.monotonic):
+        self.interval = interval
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._last = -interval
+        self.suppressed = 0
+        self.emitted = 0
+
+    def warn(self, fmt: str, *args) -> None:
+        with self._lock:
+            now = self._clock()
+            if now - self._last < self.interval:
+                self.suppressed += 1
+                return
+            self._last = now
+            suppressed, self.suppressed = self.suppressed, 0
+            self.emitted += 1
+        if suppressed:
+            log.warning(fmt + " (%d similar suppressed in the last "
+                        "%.0fs)", *(args + (suppressed, self.interval)))
+        else:
+            log.warning(fmt, *args)
 
 
 def warn_if_port_already_served(family: int, kind: int, host: str,
@@ -122,6 +158,8 @@ def start_statsd(addr_spec: str, num_readers: int, recv_buf: int,
                  stop: threading.Event,
                  handle_tcp_line: Optional[Callable[[bytes], None]] = None,
                  tls_config: Optional[ssl.SSLContext] = None,
+                 admit: Optional[Callable[[], bool]] = None,
+                 error_log_interval: float = DEFAULT_ERROR_LOG_INTERVAL,
                  ):
     """Start DogStatsD listeners for one address spec (networking.go:18-35).
 
@@ -132,10 +170,19 @@ def start_statsd(addr_spec: str, num_readers: int, recv_buf: int,
     Every listener binds with SO_REUSEPORT even when a single reader
     needs no kernel balancing: a SIGUSR2 upgrade (cli/upgrade.py) and a
     rolling restart both briefly run two generations on the same port.
+
+    ``admit`` is the overload governor's watermark gate
+    (veneur_tpu/overload.py): when it returns False the datagram is
+    dropped AT the socket — the governor accounts the shed — instead of
+    costing parse + store work the saturated pipeline cannot spend.
+    Recv-error logging is rate-limited to one warning per
+    ``error_log_interval`` (the flush interval, when the server wires
+    it) with a suppressed-count, shared across this listener's readers.
     """
     addr = resolve_addr(addr_spec)
     threads: List[threading.Thread] = []
     bound: List[tuple] = []
+    limiter = _LogLimiter(error_log_interval)
     if addr.family == "udp":
         warn_if_port_already_served(addr.socket_family, socket.SOCK_DGRAM,
                                     addr.host, addr.port)
@@ -149,7 +196,8 @@ def start_statsd(addr_spec: str, num_readers: int, recv_buf: int,
                                     host=addr.host, port=sock.getsockname()[1])
             t = threading.Thread(
                 target=_udp_read_loop,
-                args=(sock, metric_max_length, handle_packet, stop),
+                args=(sock, metric_max_length, handle_packet, stop,
+                      admit, limiter),
                 name=f"statsd-udp-reader-{i}", daemon=True)
             t.start()
             threads.append(t)
@@ -159,7 +207,8 @@ def start_statsd(addr_spec: str, num_readers: int, recv_buf: int,
         t = threading.Thread(
             target=_tcp_accept_loop,
             args=(listener, metric_max_length,
-                  handle_tcp_line or handle_packet, stop, tls_config),
+                  handle_tcp_line or handle_packet, stop, tls_config,
+                  limiter, admit),
             name="statsd-tcp-listener", daemon=True)
         t.start()
         threads.append(t)
@@ -170,10 +219,14 @@ def start_statsd(addr_spec: str, num_readers: int, recv_buf: int,
 
 def _udp_read_loop(sock: socket.socket, max_len: int,
                    handle_packet: Callable[[bytes], None],
-                   stop: threading.Event):
+                   stop: threading.Event,
+                   admit: Optional[Callable[[], bool]] = None,
+                   limiter: Optional[_LogLimiter] = None):
     """Per-reader receive loop (server.go:795-825). Each datagram may hold
     several newline-separated metrics; oversize datagrams are truncated by
     the OS and the tail line is dropped by the parser."""
+    if limiter is None:
+        limiter = _LogLimiter()
     sock.settimeout(0.5)
     while not stop.is_set():
         try:
@@ -183,9 +236,11 @@ def _udp_read_loop(sock: socket.socket, max_len: int,
         except OSError as e:
             if stop.is_set() or e.errno in (errno.EBADF,):
                 break
-            log.error("UDP recv error: %s", e)
+            limiter.warn("UDP recv error: %s", e)
             continue
         if data:
+            if admit is not None and not admit():
+                continue  # shed at the socket; the governor accounts it
             handle_packet(data)
     sock.close()
 
@@ -193,7 +248,9 @@ def _udp_read_loop(sock: socket.socket, max_len: int,
 def _tcp_accept_loop(listener: socket.socket, max_len: int,
                      handle_line: Callable[[bytes], None],
                      stop: threading.Event,
-                     tls_config: Optional[ssl.SSLContext]):
+                     tls_config: Optional[ssl.SSLContext],
+                     limiter: Optional[_LogLimiter] = None,
+                     admit: Optional[Callable[[], bool]] = None):
     """Accept loop + per-connection readers (server.go:901-1001)."""
     listener.settimeout(0.5)
     while not stop.is_set():
@@ -205,7 +262,7 @@ def _tcp_accept_loop(listener: socket.socket, max_len: int,
             break
         t = threading.Thread(target=_tcp_conn_loop,
                              args=(conn, max_len, handle_line, stop,
-                                   tls_config, peer),
+                                   tls_config, peer, limiter, admit),
                              daemon=True)
         t.start()
     listener.close()
@@ -215,7 +272,8 @@ def _tcp_conn_loop(conn: socket.socket, max_len: int,
                    handle_line: Callable[[bytes], None],
                    stop: threading.Event,
                    tls_config: Optional[ssl.SSLContext] = None,
-                   peer=None):
+                   peer=None, limiter: Optional[_LogLimiter] = None,
+                   admit: Optional[Callable[[], bool]] = None):
     """Newline-scan a TCP connection; a single line longer than max_len
     poisons the connection (server.go:920-983).
 
@@ -224,12 +282,14 @@ def _tcp_conn_loop(conn: socket.socket, max_len: int,
     wedge wrap_socket and with it every other connection (slowloris);
     on this thread it can only wedge itself, and the timeout bounds
     even that. socket.timeout is an OSError."""
+    if limiter is None:
+        limiter = _LogLimiter()
     if tls_config is not None:
         try:
             conn.settimeout(10.0)
             conn = tls_config.wrap_socket(conn, server_side=True)
         except (ssl.SSLError, OSError) as e:
-            log.warning("TLS handshake failed from %s: %s", peer, e)
+            limiter.warn("TLS handshake failed from %s: %s", peer, e)
             conn.close()
             return
     conn.settimeout(0.5)
@@ -239,7 +299,9 @@ def _tcp_conn_loop(conn: socket.socket, max_len: int,
             data = conn.recv(65536)
         except socket.timeout:
             continue
-        except OSError:
+        except OSError as e:
+            if not stop.is_set() and e.errno not in (errno.EBADF,):
+                limiter.warn("TCP recv error from %s: %s", peer, e)
             break
         if not data:
             break
@@ -251,9 +313,13 @@ def _tcp_conn_loop(conn: socket.socket, max_len: int,
             line = bytes(buf[:nl])
             del buf[:nl + 1]
             if line:
+                # the same hard-ceiling admission gate the UDP readers
+                # apply: TCP statsd must not bypass level-3 shedding
+                if admit is not None and not admit():
+                    continue
                 handle_line(line)
         if len(buf) > max_len:
-            log.warning("Line longer than max_length, closing connection")
+            limiter.warn("Line longer than max_length, closing connection")
             break
     conn.close()
 
@@ -274,13 +340,18 @@ def start_ssf(addr_spec: str, num_readers: int, recv_buf: int,
               trace_max_length: int,
               handle_ssf_packet: Callable[[bytes], None],
               handle_ssf_stream: Callable[[socket.socket], None],
-              stop: threading.Event):
+              stop: threading.Event,
+              admit: Optional[Callable[[], bool]] = None,
+              error_log_interval: float = DEFAULT_ERROR_LOG_INTERVAL):
     """Start SSF listeners (networking.go:138-223): UDP datagrams carry one
     bare SSFSpan protobuf each; UNIX/TCP streams carry framed spans.
-    Returns (threads, bound addresses)."""
+    Returns (threads, bound addresses). ``admit``/``error_log_interval``
+    as in :func:`start_statsd` (spans are the governor's second shed
+    tier — they drop before statsd aggregates do)."""
     addr = resolve_addr(addr_spec)
     threads: List[threading.Thread] = []
     bound: List = []
+    limiter = _LogLimiter(error_log_interval)
     if addr.family == "udp":
         warn_if_port_already_served(addr.socket_family, socket.SOCK_DGRAM,
                                     addr.host, addr.port)
@@ -292,7 +363,8 @@ def start_ssf(addr_spec: str, num_readers: int, recv_buf: int,
                                     host=addr.host, port=sock.getsockname()[1])
             t = threading.Thread(
                 target=_udp_read_loop,
-                args=(sock, trace_max_length, handle_ssf_packet, stop),
+                args=(sock, trace_max_length, handle_ssf_packet, stop,
+                      admit, limiter),
                 name=f"ssf-udp-reader-{i}", daemon=True)
             t.start()
             threads.append(t)

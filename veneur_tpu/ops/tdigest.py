@@ -229,14 +229,18 @@ def _compress(mean: jax.Array, weight: jax.Array, compression: float,
 
 def _dispatch_compress_presorted(mean_a, weight_a, mean_b, weight_b,
                                  compression: float, out_size: int,
-                                 sort_b: bool = False):
+                                 sort_b: bool = False,
+                                 use_pallas: bool = True):
     """Compress the union of a row-ASCENDING centroid list with a second
     list (ascending, or any order with sort_b=True and +inf empties):
     the fused Pallas merge kernel on TPU, the sort-based _compress
-    elsewhere (which orders everything itself)."""
+    elsewhere (which orders everything itself). ``use_pallas=False``
+    forces the sort-based path even on TPU — the compute breaker's
+    fallback rung (resilience/compute.py); trace-time static, so each
+    value compiles its own program variant."""
     from veneur_tpu.ops import tdigest_pallas
 
-    if tdigest_pallas.pallas_ok(mean_a):
+    if use_pallas and tdigest_pallas.pallas_ok(mean_a):
         return tdigest_pallas.compress_presorted(
             mean_a, weight_a, mean_b, weight_b, compression, out_size,
             sort_b=sort_b)
@@ -647,20 +651,22 @@ def ingest_chunk_guarded(digest: TDigest, temp: TempCentroids,
                          rows: jax.Array, values: jax.Array,
                          weights: jax.Array,
                          compression: float = DEFAULT_COMPRESSION,
-                         update_stats: bool = True):
+                         update_stats: bool = True,
+                         use_pallas: bool = True):
     """Shift-guarded ingest: ``shift_pred`` -> drain the temp bins into
     the digest (lax.cond, so the drain costs nothing when not taken),
     then ingest the chunk against re-anchored bins. The temp's scalar
     stats (count/vsum/vmin/vmax/recip) survive a mid-interval guard
     drain — they are interval aggregates, only the BINS move into the
-    digest. Returns (digest, temp)."""
+    digest. Returns (digest, temp). ``use_pallas=False`` keeps the
+    guard drain off the Pallas kernel (compute-breaker degradation)."""
     num_series = temp.sum_w.shape[0]
     pred = shift_pred(temp.seg_w, temp.seg_wm, rows, values, weights,
                       num_series)
 
     def do_drain(args):
         d, t = args
-        d2 = drain_temp(d, t, compression)
+        d2 = drain_temp(d, t, compression, use_pallas=use_pallas)
         t2 = t._replace(sum_w=jnp.zeros_like(t.sum_w),
                         sum_wm=jnp.zeros_like(t.sum_wm),
                         seg_w=jnp.zeros_like(t.seg_w),
@@ -674,15 +680,17 @@ def ingest_chunk_guarded(digest: TDigest, temp: TempCentroids,
 
 
 def drain_temp(state: TDigest, temp: TempCentroids,
-               compression: float = DEFAULT_COMPRESSION) -> TDigest:
+               compression: float = DEFAULT_COMPRESSION,
+               use_pallas: bool = True) -> TDigest:
     """Merge the accumulated temp centroids into the digests (one compress
-    per interval — the batched mergeAllTemps)."""
+    per interval — the batched mergeAllTemps). ``use_pallas=False``
+    forces the sort-based XLA path (compute-breaker fallback rung)."""
     from veneur_tpu.ops import tdigest_pallas
 
     t_live = temp.sum_w > 0
     t_mean = jnp.where(t_live, temp.sum_wm / jnp.where(t_live, temp.sum_w, 1.0),
                        jnp.inf)
-    if tdigest_pallas.pallas_ok(state.mean):
+    if use_pallas and tdigest_pallas.pallas_ok(state.mean):
         # bin means are NOT monotone in bin index once several chunks with
         # shifting distributions accumulate, so the temp half needs a real
         # sort. Measured on v5e: lax.sort + presorted kernel beats the
@@ -709,16 +717,19 @@ def drain_temp(state: TDigest, temp: TempCentroids,
 
 def drain_and_quantile(state: TDigest, temp: TempCentroids, dmin, dmax,
                        qs: jax.Array,
-                       compression: float = DEFAULT_COMPRESSION):
+                       compression: float = DEFAULT_COMPRESSION,
+                       use_pallas: bool = True):
     """The whole per-interval digest flush as one op: drain the temp bins
     into the digests, fold in the imported extrema (dmin/dmax), and return
     (drained digests, per-series percentiles). On TPU this is a single
-    fused Pallas program; elsewhere it composes drain_temp + quantile."""
+    fused Pallas program; elsewhere — or with ``use_pallas=False``, the
+    compute breaker's fallback rung — it composes drain_temp +
+    quantile."""
     from veneur_tpu.ops import tdigest_pallas
 
     mn = jnp.minimum(jnp.minimum(state.min, temp.vmin), dmin)
     mx = jnp.maximum(jnp.maximum(state.max, temp.vmax), dmax)
-    if tdigest_pallas.pallas_ok(state.mean):
+    if use_pallas and tdigest_pallas.pallas_ok(state.mean):
         t_live = temp.sum_w > 0
         t_mean = jnp.where(
             t_live, temp.sum_wm / jnp.where(t_live, temp.sum_w, 1.0),
@@ -731,7 +742,7 @@ def drain_and_quantile(state: TDigest, temp: TempCentroids, dmin, dmax,
             state.mean, state.weight, t_mean, t_w, mn, mx,
             jnp.asarray(qs, state.mean.dtype), compression, state.capacity)
         return TDigest(mean=nm, weight=nw, min=mn, max=mx), pcts
-    drained = drain_temp(state, temp, compression)
+    drained = drain_temp(state, temp, compression, use_pallas=use_pallas)
     drained = drained._replace(min=mn, max=mx)
     return drained, quantile(drained, qs)
 

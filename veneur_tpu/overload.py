@@ -1,0 +1,238 @@
+"""Hot-path overload governance: admission watermarks + quarantine ledger.
+
+Production overload systems degrade by priority instead of collapsing
+(DAGOR, "Overload Control for Scaling WeChat Microservices", SoCC'18):
+when the pipeline saturates, the cheapest-to-lose work is shed first and
+every drop is accounted. The ladder here, lowest priority first:
+
+    1. freshly-seen series   (level >= 1: first-sight series spill to the
+                              per-group overflow row; existing series
+                              keep aggregating — their memory is bounded)
+    2. raw spans             (level >= 2: SSF datagrams/spans shed at the
+                              reader loop and the span channel)
+    3. statsd datagrams      (level >= 3, the hard ceiling: even
+                              aggregate traffic sheds at the socket)
+
+Self-metrics (the internal trace client writes the span channel
+directly) and forwarded sketch state (the import servers have their own
+bounded queues and 429 shedding) are never governed here — they outlive
+everything, as the operator's only view INTO the overload.
+
+The pressure signal is the max of the span-channel fill ratio, the
+per-sink ingest-lane fill ratios, and each store group's occupancy
+against its ``max_series`` cap. All reads are lock-free snapshots and
+the level is recomputed at most every ``recompute_interval`` seconds, so
+``admit_*`` costs an attribute read on the packet hot path.
+
+Shed/spill/quarantine tallies surface as ``veneur.overload.*``
+self-metrics (flusher.py) and in ``GET /debug/vars``.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Callable, Dict, Optional
+
+log = logging.getLogger("veneur.overload")
+
+# the single per-group spill row new series collapse into past max_series
+OVERFLOW_NAME = "veneur.overload.overflow"
+
+# numeric bounds the quarantine enforces: values outside these ranges
+# would silently launder into inf (f32 digest staging) or overflow the
+# exact int64 counter lanes
+F32_ABS_MAX = 3.4028235e38
+INT64_ABS_MAX = float(1 << 63)
+# smallest admissible sample rate: below this the float32 reciprocal
+# weight (1/rate) overflows to inf — which would poison digest weights
+# and raise OverflowError on the int64 counter lanes
+MIN_SAMPLE_RATE = 1e-38
+
+LEVEL_NORMAL = 0
+LEVEL_SHED_NEW_SERIES = 1
+LEVEL_SHED_SPANS = 2
+LEVEL_SHED_PACKETS = 3
+
+DEFAULT_LOW_WATERMARK = 0.7
+DEFAULT_HIGH_WATERMARK = 0.85
+DEFAULT_HARD_WATERMARK = 0.97
+DEFAULT_MAX_SERIES = 1 << 20
+DEFAULT_MAX_TAG_LENGTH = 1024
+
+
+class Quarantine:
+    """Per-reason counters for poisoned input that was caught instead of
+    laundered into digest state. Thread-safe; reasons are a small fixed
+    vocabulary so the self-metric tag set stays bounded."""
+
+    REASONS = ("not_finite", "out_of_range", "bad_rate", "oversized_tags")
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counts: Dict[str, int] = {r: 0 for r in self.REASONS}
+
+    def count(self, reason: str, n: int = 1) -> None:
+        with self._lock:
+            self._counts[reason] = self._counts.get(reason, 0) + n
+
+    def total(self) -> int:
+        with self._lock:
+            return sum(self._counts.values())
+
+    def snapshot(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._counts)
+
+
+class OverloadController:
+    """Watermark-based admission ladder over a cheap pressure signal.
+
+    ``attach(server)`` wires the pressure sources (span channel, sink
+    lanes, store groups); until then pressure is 0 and everything is
+    admitted, so stores constructed without a server run ungoverned.
+    """
+
+    def __init__(self, low: float = DEFAULT_LOW_WATERMARK,
+                 high: float = DEFAULT_HIGH_WATERMARK,
+                 hard: float = DEFAULT_HARD_WATERMARK,
+                 clock: Callable[[], float] = time.monotonic,
+                 recompute_interval: float = 0.1):
+        if not 0.0 < low < high < hard <= 1.0:
+            raise ValueError(
+                f"overload watermarks must satisfy 0 < low < high < hard "
+                f"<= 1, got {low}/{high}/{hard}")
+        self.low, self.high, self.hard = low, high, hard
+        self._clock = clock
+        self._recompute_interval = recompute_interval
+        self._lock = threading.Lock()
+        self._level = LEVEL_NORMAL
+        self._pressure = 0.0
+        self._next_recompute = 0.0
+        self._server = None
+        # drops by lane, read as interval deltas by the flusher
+        self.shed: Dict[str, int] = {"statsd": 0, "ssf": 0, "spans": 0}
+        self.level_changes = 0
+
+    def attach(self, server) -> "OverloadController":
+        self._server = server
+        return self
+
+    # -- pressure ----------------------------------------------------------
+
+    def _compute_pressure(self) -> float:
+        srv = self._server
+        if srv is None:
+            return 0.0
+        p = 0.0
+        chan = getattr(srv, "span_chan", None)
+        if chan is not None and chan.maxsize > 0:
+            p = max(p, chan.qsize() / chan.maxsize)
+        workers = getattr(srv, "_span_workers", None) or ()
+        for w in workers[:1]:  # lanes are shared across workers
+            for lane in getattr(w, "_lanes", ()):
+                q = lane.queue
+                if q.maxsize > 0:
+                    p = max(p, q.qsize() / q.maxsize)
+        store = getattr(srv, "store", None)
+        if store is not None:
+            occ = 0.0
+            for name in getattr(store, "_GEN_GROUPS", ()):
+                g = getattr(store, name, None)
+                ms = getattr(g, "max_series", 0)
+                if g is not None and ms:
+                    occ = max(occ, len(g) / ms)
+            # cardinality pressure can only ever reach the FREEZE tier:
+            # the per-group cap already bounds memory (spill), so a
+            # permanently-full group must not shed spans or datagrams —
+            # only queue pressure escalates past level 1
+            p = max(p, min(occ, (self.low + self.high) / 2.0))
+        return min(p, 1.0)
+
+    def pressure(self) -> float:
+        self._maybe_recompute()
+        return self._pressure
+
+    def _maybe_recompute(self) -> None:
+        now = self._clock()
+        if now < self._next_recompute:
+            return
+        with self._lock:
+            if now < self._next_recompute:
+                return
+            self._next_recompute = now + self._recompute_interval
+            self._pressure = p = self._compute_pressure()
+            if p >= self.hard:
+                level = LEVEL_SHED_PACKETS
+            elif p >= self.high:
+                level = LEVEL_SHED_SPANS
+            elif p >= self.low:
+                level = LEVEL_SHED_NEW_SERIES
+            else:
+                level = LEVEL_NORMAL
+            if level != self._level:
+                self.level_changes += 1
+                log.warning(
+                    "overload level %d -> %d (pressure %.2f; watermarks "
+                    "%.2f/%.2f/%.2f)", self._level, level, p, self.low,
+                    self.high, self.hard)
+                self._level = level
+
+    def level(self) -> int:
+        self._maybe_recompute()
+        return self._level
+
+    # -- admission ---------------------------------------------------------
+
+    def freeze_new_series(self) -> bool:
+        """True while first-sight series should spill to the overflow
+        row regardless of the per-group cap (level >= 1)."""
+        return self.level() >= LEVEL_SHED_NEW_SERIES
+
+    def admit_span(self, n: int = 1) -> bool:
+        """Raw external spans (the SSF stream/native lanes)."""
+        if self.level() >= LEVEL_SHED_SPANS:
+            with self._lock:
+                self.shed["spans"] += n
+            return False
+        return True
+
+    def admit_packet(self, lane: str) -> bool:
+        """One datagram on a reader loop; ``lane`` is statsd or ssf.
+        SSF datagrams shed with the spans tier; statsd only at the hard
+        ceiling (aggregate traffic is memory-bounded by the caps)."""
+        level = self.level()
+        threshold = (LEVEL_SHED_SPANS if lane == "ssf"
+                     else LEVEL_SHED_PACKETS)
+        if level >= threshold:
+            with self._lock:
+                self.shed[lane] = self.shed.get(lane, 0) + 1
+            return False
+        return True
+
+    def shed_total(self) -> int:
+        with self._lock:
+            return sum(self.shed.values())
+
+    def snapshot(self) -> dict:
+        """Best-effort state dump for /debug/vars and readiness."""
+        return {"level": self.level(), "pressure": round(self._pressure, 4),
+                "watermarks": [self.low, self.high, self.hard],
+                "shed": dict(self.shed),
+                "level_changes": self.level_changes}
+
+
+def from_config(cfg, clock: Callable[[], float] = time.monotonic
+                ) -> Optional[OverloadController]:
+    """Build the configured controller (None never happens today — the
+    governor always runs; kept Optional-shaped for symmetry with
+    faults.from_config)."""
+    return OverloadController(
+        low=getattr(cfg, "overload_low_watermark", DEFAULT_LOW_WATERMARK)
+        or DEFAULT_LOW_WATERMARK,
+        high=getattr(cfg, "overload_high_watermark",
+                     DEFAULT_HIGH_WATERMARK) or DEFAULT_HIGH_WATERMARK,
+        hard=getattr(cfg, "overload_hard_watermark",
+                     DEFAULT_HARD_WATERMARK) or DEFAULT_HARD_WATERMARK,
+        clock=clock)

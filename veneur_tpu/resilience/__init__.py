@@ -9,6 +9,9 @@ handling. See ``docs/resilience.md`` for the model.
 
 from veneur_tpu.resilience.breaker import (BreakerOpen, BreakerRegistry,
                                            CircuitBreaker)
+from veneur_tpu.resilience.compute import ComputeBreaker
+from veneur_tpu.resilience.compute import \
+    from_config as compute_from_config
 from veneur_tpu.resilience.deadline import Deadline, DeadlineExceeded
 from veneur_tpu.resilience.faults import FaultInjector
 from veneur_tpu.resilience.faults import from_config as faults_from_config
@@ -20,6 +23,8 @@ __all__ = [
     "BreakerOpen",
     "BreakerRegistry",
     "CircuitBreaker",
+    "ComputeBreaker",
+    "compute_from_config",
     "Deadline",
     "DeadlineExceeded",
     "FaultInjector",

@@ -20,7 +20,7 @@ from __future__ import annotations
 import logging
 import random
 import threading
-from typing import Callable, Dict, Optional, Sequence, Tuple
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 log = logging.getLogger("veneur.resilience.faults")
 
@@ -29,6 +29,15 @@ KIND_TIMEOUT = "timeout"
 KIND_HTTP_5XX = "http_5xx"
 KIND_PARTIAL_WRITE = "partial_write"
 ALL_KINDS = (KIND_CONNECT, KIND_TIMEOUT, KIND_HTTP_5XX, KIND_PARTIAL_WRITE)
+# ingest-side faults (mangle_packet): a datagram cut mid-line, and one
+# datagram amplified into a burst — the two shapes a hostile/overloaded
+# UDP path actually produces. Deterministic like the transport kinds,
+# but a SEPARATE vocabulary: adding them to ALL_KINDS would perturb the
+# seeded schedules every existing transport soak reproduces.
+KIND_TRUNCATE = "truncate"
+KIND_BURST = "burst"
+INGEST_KINDS = (KIND_TRUNCATE, KIND_BURST)
+BURST_MAX_COPIES = 8
 
 # the status wrap_post returns for an injected 5xx
 INJECTED_STATUS = 503
@@ -57,10 +66,10 @@ class FaultInjector:
                  kinds: Sequence[str] = ALL_KINDS, scope: str = ""):
         if not 0.0 <= rate <= 1.0:
             raise ValueError(f"fault rate must be in [0, 1], got {rate}")
-        bad = [k for k in kinds if k not in ALL_KINDS]
+        bad = [k for k in kinds if k not in ALL_KINDS + INGEST_KINDS]
         if bad:
-            raise ValueError(f"unknown fault kinds {bad}; "
-                             f"known: {list(ALL_KINDS)}")
+            raise ValueError(f"unknown fault kinds {bad}; known: "
+                             f"{list(ALL_KINDS + INGEST_KINDS)}")
         self.rate = rate
         self.seed = seed
         self.kinds = tuple(kinds) or ALL_KINDS
@@ -90,9 +99,12 @@ class FaultInjector:
     def maybe_fail(self, op: str) -> None:
         """Raise the scheduled fault, if any — the hook for socket-level
         transports, where an injected 5xx surfaces as the peer's NAK
-        (an OSError) like a real one would."""
+        (an OSError) like a real one would. Ingest kinds pass through
+        untouched (like wrap_post): a mixed-kind injector shared with
+        egress hooks must not turn a scheduled packet mangle into a
+        transport error the operator never configured."""
         kind = self.should_fail(op)
-        if kind is None:
+        if kind is None or kind in INGEST_KINDS:
             return
         if kind == KIND_CONNECT:
             raise InjectedConnectError(f"injected connect error ({op})")
@@ -120,6 +132,32 @@ class FaultInjector:
             return post(*args, **kwargs)
 
         return wrapped
+
+    def mangle_packet(self, op: str, data: bytes) -> List[bytes]:
+        """Apply the scheduled INGEST fault to one datagram, returning
+        the datagram(s) the pipeline should actually see:
+
+        * no fault → ``[data]`` untouched;
+        * ``truncate`` → the datagram cut at a seeded offset (mid-line,
+          the OS-truncation shape the parser must survive);
+        * ``burst`` → 2..BURST_MAX_COPIES copies (amplification — the
+          admission/overflow paths must absorb it, not OOM).
+
+        Non-ingest scheduled kinds pass the packet through untouched so
+        a mixed-kind injector can drive transport and ingest faults off
+        one seed. One extra seeded draw per applied fault (the cut
+        point / copy count), taken under the same lock so schedules
+        stay reproducible across thread interleavings."""
+        kind = self.should_fail(op)
+        if kind == KIND_TRUNCATE and len(data) > 1:
+            with self._lock:
+                cut = self._rng.randrange(1, len(data))
+            return [data[:cut]]
+        if kind == KIND_BURST:
+            with self._lock:
+                copies = self._rng.randrange(2, BURST_MAX_COPIES + 1)
+            return [data] * copies
+        return [data]
 
     def schedule(self, n: int) -> Tuple[Optional[str], ...]:
         """The next ``n`` outcomes, consumed — test/debug helper for

@@ -84,6 +84,26 @@ class ParseError(ValueError):
     pass
 
 
+class QuarantineError(ParseError):
+    """A line that parsed but carries a poisoned payload: NaN/Inf or
+    out-of-range values, an absurd sample rate. Subclasses ParseError so
+    every existing rejection path keeps working, but carries a machine
+    ``reason`` the server counts into the per-reason quarantine ledger
+    (``veneur.overload.quarantined_total``) — poison must be visibly
+    quarantined, not silently laundered into percentiles."""
+
+    def __init__(self, reason: str, message: str):
+        super().__init__(message)
+        self.reason = reason
+
+
+# values a digest lane cannot hold: staging is float32, so anything past
+# f32 range becomes inf AFTER parse — catch it here with a reason
+from veneur_tpu.overload import F32_ABS_MAX, MIN_SAMPLE_RATE  # noqa: E402
+# int64 counter lanes overflow (numpy OverflowError) past 2^63
+_COUNTER_ABS_MAX = float(1 << 63)
+
+
 _TYPE_BY_LEAD = {
     ord("c"): "counter",
     ord("g"): "gauge",
@@ -108,11 +128,42 @@ def _extract_scope_tags(tags: List[str], prefix_match: bool) -> tuple[List[str],
     return tags, scope
 
 
-def parse_metric(packet: bytes) -> UDPMetric:
+def _check_numeric(value: float, mtype: str, raw) -> None:
+    """The numerics quarantine's parse-side gate: non-finite values and
+    values the typed store lanes cannot represent (int64 counters, f32
+    digest staging) raise QuarantineError with a reason instead of the
+    bare ParseError — counted, never laundered."""
+    if value != value or value in (float("inf"), float("-inf")):
+        raise QuarantineError(
+            "not_finite", f"Non-finite metric value: {raw!r}")
+    if mtype == "counter" and abs(value) >= _COUNTER_ABS_MAX:
+        raise QuarantineError(
+            "out_of_range", f"Counter value overflows int64: {raw!r}")
+    if mtype in ("histogram", "timer") and abs(value) > F32_ABS_MAX:
+        raise QuarantineError(
+            "out_of_range", f"Value exceeds float32 range: {raw!r}")
+
+
+def truncate_joined_tags(joined: str, limit: int) -> str:
+    """Cut a joined tag string at the last whole tag within ``limit``
+    (the per-series tag-length cap; identities merge past it)."""
+    if not limit or len(joined) <= limit:
+        return joined
+    cut = joined.rfind(",", 0, limit + 1)
+    return joined[:cut] if cut > 0 else joined[:limit]
+
+
+def parse_metric(packet: bytes, max_tag_length: int = 0,
+                 quarantine=None) -> UDPMetric:
     """Parse one DogStatsD metric datagram line (parser.go:232-363).
 
     Grammar: ``name:value|type[|@rate][|#tag1,tag2]`` — sections after the
     type may appear in any order but at most once each.
+
+    ``max_tag_length`` caps the joined tag string (oversized tag sets
+    truncate at a tag boundary, counted into ``quarantine`` under
+    ``oversized_tags``); poisoned values/rates raise
+    :class:`QuarantineError` with a per-reason tag.
     """
     chunks = bytes(packet).split(b"|")
     head = chunks[0]
@@ -144,8 +195,7 @@ def parse_metric(packet: bytes) -> UDPMetric:
             value = float(value_b)
         except ValueError:
             raise ParseError(f"Invalid number for metric value: {value_b!r}")
-        if value != value or value in (float("inf"), float("-inf")):
-            raise ParseError(f"Invalid number for metric value: {value_b!r}")
+        _check_numeric(value, mtype, value_b)
 
     sample_rate = 1.0
     found_rate = False
@@ -163,8 +213,12 @@ def parse_metric(packet: bytes) -> UDPMetric:
                 sample_rate = float(chunk[1:])
             except ValueError:
                 raise ParseError(f"Invalid float for sample rate: {chunk[1:]!r}")
-            if not 0 < sample_rate <= 1:
-                raise ParseError(f"Sample rate {sample_rate} must be >0 and <=1")
+            # the lower bound also rejects denormal-tiny rates whose
+            # float32 reciprocal weight overflows to inf downstream
+            if not MIN_SAMPLE_RATE <= sample_rate <= 1:
+                raise QuarantineError(
+                    "bad_rate",
+                    f"Sample rate {sample_rate} must be >0 and <=1")
             found_rate = True
         elif lead == ord("#"):
             if tags is not None:
@@ -172,6 +226,11 @@ def parse_metric(packet: bytes) -> UDPMetric:
             tags = sorted(chunk[1:].decode("utf-8", "replace").split(","))
             tags, scope = _extract_scope_tags(tags, prefix_match=True)
             joined = ",".join(tags)
+            if max_tag_length and len(joined) > max_tag_length:
+                if quarantine is not None:
+                    quarantine.count("oversized_tags")
+                joined = truncate_joined_tags(joined, max_tag_length)
+                tags = joined.split(",") if joined else []
             h = fnv1a_32(joined, h)
         else:
             raise ParseError(
@@ -210,6 +269,10 @@ def parse_metric_ssf(sample) -> UDPMetric:
         value = int(sample.status)
     else:
         value = float(sample.value)
+        # the SSF lane historically skipped the DogStatsD lane's
+        # non-finite rejection — the straightest NaN path into digest
+        # state (quarantined with a reason now, same as statsd)
+        _check_numeric(value, mtype, sample.value)
 
     scope = MIXED_SCOPE
     tags = []

@@ -74,6 +74,10 @@ class _SinkIngestor:
         self.queue: "queue.Queue" = queue.Queue(capacity)
         self.ingest_errors = 0
         self.ingest_timeouts = 0
+        # per-interval high watermark of the queue depth: queue pressure
+        # must be visible (veneur.server.span_lane.depth) BEFORE
+        # ingest_timeout_total drops begin; read-and-reset by the flusher
+        self.depth_hwm = 0
         # offer() runs on every span-worker thread concurrently
         self._drop_lock = threading.Lock()
         self._flush_thread: Optional[threading.Thread] = None
@@ -84,6 +88,7 @@ class _SinkIngestor:
     def offer(self, span) -> None:
         try:
             self.queue.put_nowait(span)
+            self._note_depth()
         except queue.Full:
             # the lane is wedged (or 9s+ behind): drop, as the reference
             # does after its per-span timeout fires
@@ -96,9 +101,17 @@ class _SinkIngestor:
         C++ decoder's rate."""
         try:
             self.queue.put_nowait(spans)
+            self._note_depth()
         except queue.Full:
             with self._drop_lock:
                 self.ingest_timeouts += len(spans)
+
+    def _note_depth(self) -> None:
+        # racy max is fine: the gauge is advisory and under-reporting by
+        # one sample beats a lock acquisition on every span
+        d = self.queue.qsize()
+        if d > self.depth_hwm:
+            self.depth_hwm = d
 
     def _work(self):
         while True:
@@ -255,6 +268,14 @@ class Server:
             mesh = fleet_mesh(jax.devices(), hosts=hosts)
             log.info("global store sharded over %d devices (%s)", n,
                      dict(mesh.shape))
+        # hot-path overload governance (veneur_tpu/overload.py,
+        # docs/resilience.md "Degradation ladder"): bounded per-group
+        # cardinality, the numerics quarantine ledger, the watermark
+        # admission controller, and the flush-kernel compute breaker
+        from veneur_tpu import overload
+        from veneur_tpu.resilience import compute as rcompute
+
+        self.overload = overload.from_config(config)
         self.store = MetricStore(
             initial_capacity=config.store_initial_capacity,
             chunk=config.store_chunk,
@@ -267,9 +288,35 @@ class Server:
             topk_depth=config.topk_depth,
             topk_width=config.topk_width,
             topk_k=config.topk_k,
+            max_series=config.max_series,
+            max_tag_length=config.max_tag_length,
+            compute=rcompute.from_config(config),
+            overload=self.overload,
         )
+        self.quarantine = self.store.quarantine
         self.event_worker = EventWorker()
         self.span_chan: "queue.Queue" = queue.Queue(config.span_channel_capacity)
+        # pressure sources (span channel, lanes, group occupancy) read
+        # through the server; attach now that the channel exists
+        self.overload.attach(self)
+        # seeded ingest-side fault injection (resilience/faults.py
+        # KIND_TRUNCATE/KIND_BURST): armed only when the configured kind
+        # set includes an ingest kind — transport injectors stay in the
+        # egress layer
+        from veneur_tpu.resilience import faults as rfaults
+
+        self.ingest_injector = None
+        # CSV order preserved: the kind tuple indexes the seeded
+        # schedule, so set ordering would break run-to-run reproduction
+        cfg_kinds = [k.strip() for k in
+                     (config.fault_injection_kinds or "").split(",")
+                     if k.strip()]
+        if config.fault_injection_rate > 0 and \
+                any(k in rfaults.INGEST_KINDS for k in cfg_kinds):
+            self.ingest_injector = rfaults.FaultInjector(
+                rate=config.fault_injection_rate,
+                seed=config.fault_injection_seed,
+                kinds=tuple(cfg_kinds), scope=config.fault_injection_scope)
 
         # config-driven backends (server.go:350-519) plus any injected ones
         from veneur_tpu.sinks.factory import create_sinks
@@ -370,15 +417,24 @@ class Server:
     # -- ingest dispatch ----------------------------------------------------
 
     def handle_metric_packet(self, packet: bytes) -> bool:
-        """Parse one line and route it (server.go:670-720). Returns False on
-        a parse error (counted, logged at debug)."""
+        """Parse one line and route it (server.go:670-720). Returns False
+        on a parse error (counted, logged at debug). Poisoned-but-
+        parseable lines (NaN/Inf, out-of-range, absurd rates) count into
+        the per-reason quarantine ledger instead of packet_errors —
+        they are accounted load, not noise."""
         try:
             if packet.startswith(b"_e{"):
                 self.event_worker.add(p.parse_event(packet))
             elif packet.startswith(b"_sc"):
                 self.store.process_metric(p.parse_service_check(packet))
             else:
-                self.store.process_metric(p.parse_metric(packet))
+                self.store.process_metric(p.parse_metric(
+                    packet, max_tag_length=self.store.max_tag_length,
+                    quarantine=self.quarantine))
+        except p.QuarantineError as e:
+            self.quarantine.count(e.reason)
+            log.debug("quarantined packet %r: %s", packet[:100], e)
+            return False
         except p.ParseError as e:
             with self._counter_lock:
                 self.packet_errors += 1
@@ -388,6 +444,12 @@ class Server:
 
     def handle_packet(self, datagram: bytes):
         """Split a datagram into metric lines (server.go:806-819)."""
+        inj = self.ingest_injector
+        if inj is not None:
+            for mangled in inj.mangle_packet("ingest.statsd", datagram):
+                for line in p.split_lines(mangled):
+                    self.handle_metric_packet(line)
+            return
         for line in p.split_lines(datagram):
             self.handle_metric_packet(line)
 
@@ -420,7 +482,12 @@ class Server:
     def handle_ssf(self, span):
         """Route a span to the span workers (server.go:753-792). Spans that
         aren't valid traces but carry metrics still get their metrics
-        extracted; fully invalid spans are dropped."""
+        extracted; fully invalid spans are dropped. Under overload the
+        governor sheds raw spans BEFORE the channel (priority tier 2:
+        they outlive only freshly-seen series), accounted separately
+        from the queue-full drops."""
+        if not self.overload.admit_span():
+            return
         try:
             self.span_chan.put_nowait(span)
         except queue.Full:
@@ -430,6 +497,8 @@ class Server:
         """Batched form of handle_ssf for the native lane: one channel
         hop per decoded batch, shedding counted per span."""
         if not spans:
+            return
+        if not self.overload.admit_span(len(spans)):
             return
         try:
             self.span_chan.put_nowait(spans)
@@ -537,7 +606,9 @@ class Server:
                 addr, max(1, cfg.num_readers), cfg.read_buffer_size_bytes,
                 cfg.metric_max_length, self.handle_packet, self._stop,
                 handle_tcp_line=self.handle_metric_packet,
-                tls_config=self._tls_context)
+                tls_config=self._tls_context,
+                admit=lambda: self.overload.admit_packet("statsd"),
+                error_log_interval=self.interval)
             self._threads.extend(threads)
             self.statsd_addrs.extend(bound)
         for addr in cfg.ssf_listen_addresses:
@@ -546,7 +617,9 @@ class Server:
             threads, bound = networking.start_ssf(
                 addr, max(1, cfg.num_readers), cfg.read_buffer_size_bytes,
                 cfg.trace_max_length_bytes, self.handle_ssf_packet,
-                self.handle_ssf_stream, self._stop)
+                self.handle_ssf_stream, self._stop,
+                admit=lambda: self.overload.admit_packet("ssf"),
+                error_log_interval=self.interval)
             self._threads.extend(threads)
             self.ssf_addrs.extend(bound)
 
@@ -805,6 +878,10 @@ class Server:
                             m = p.parse_metric_ssf(sample)
                             if p.valid_metric(m):
                                 self.store.process_metric(m)
+                        except p.QuarantineError as e:
+                            # SSF-borne poison is accounted load, not
+                            # noise — same ledger as the statsd lane
+                            self.quarantine.count(e.reason)
                         except Exception:
                             with self._counter_lock:
                                 self.packet_errors += 1
@@ -869,6 +946,26 @@ class Server:
     def is_ready(self) -> bool:
         return self.readiness()[0]
 
+    def degradation(self) -> list:
+        """Human-readable active degradations, [] when fully healthy.
+        Degraded is NOT unready — a shedding-but-flushing instance must
+        keep taking traffic (killing it would dogpile its peers) — so
+        this rides the readiness body and /debug/vars instead of the
+        status code."""
+        out = []
+        level = self.overload.level()
+        if level > 0:
+            out.append(f"overload level {level} "
+                       f"(pressure {self.overload.pressure():.2f})")
+        compute = getattr(self.store, "compute", None)
+        if compute is not None:
+            for kernel, gauge in compute.states():
+                if gauge:
+                    state = "half-open" if gauge == 1.0 else "open"
+                    out.append(f"compute breaker {kernel} {state} "
+                               f"(flush on XLA fallback)")
+        return out
+
     # keys whose change a live reload cannot honor: sockets stay bound
     # (SO_REUSEPORT makes a rolling restart the path for these) and the
     # store's device geometry is allocated once
@@ -885,7 +982,14 @@ class Server:
                       # the checkpointer binds its path/cadence at
                       # construction (its thread is already running)
                       "checkpoint_path", "checkpoint_interval",
-                      "checkpoint_max_age_intervals")
+                      "checkpoint_max_age_intervals",
+                      # overload plumbing is stamped onto live groups and
+                      # the attached controller at construction
+                      "max_series", "max_tag_length",
+                      "overload_low_watermark", "overload_high_watermark",
+                      "overload_hard_watermark",
+                      "compute_breaker_failure_threshold",
+                      "compute_breaker_reset_timeout")
 
     def reload(self, config: "Config"):
         """SIGHUP graceful reload (the reference's HUP path,
