@@ -70,11 +70,14 @@ def fake_clock():
 def tsan_lite():
     """TSan-lite (veneur_tpu/lint/tsan.py): wrap a MetricStore's
     ``@requires_lock`` group mutators and record lock state at each
-    call. Usage::
+    call. v2 also arms the Eraser-style lockset detector
+    (veneur_tpu/lint/lockset.py) over the store and groups, so
+    unannotated-field races surface in ``rec.races`` with both
+    stacks. Usage::
 
         rec = tsan_lite(store)      # arms immediately
         ... drive threads ...
-        rec.assert_clean()
+        rec.assert_clean()          # v1 violations AND lockset races
 
     Everything armed in the test is disarmed at teardown."""
     from veneur_tpu.lint.tsan import LockStateRecorder

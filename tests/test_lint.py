@@ -20,7 +20,8 @@ import pytest
 
 from veneur_tpu.lint import PASSES, Baseline, Project, run_passes
 from veneur_tpu.lint.framework import Finding, SourceFile
-from veneur_tpu.lint import configdrift, deadcode, locks, metricnames, purity
+from veneur_tpu.lint import (configdrift, deadcode, lockorder, locks,
+                             lockset, metricnames, purity, recompile)
 
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
@@ -61,9 +62,41 @@ class TestRealCodebase:
         assert not stale, f"stale baseline entries: {stale}"
 
     def test_every_pass_registered(self):
-        assert set(PASSES) == {"lock-discipline", "jax-purity",
+        assert set(PASSES) == {"lock-discipline", "lock-order", "lockset",
+                               "jax-purity", "recompile-hazard",
                                "config-drift", "metric-registry",
                                "dead-code"}
+
+    def test_full_run_stays_under_wallclock_budget(self):
+        """Runtime-budget guard: the full pass suite over the real
+        package runs inside every tier-1 invocation, so its cost is a
+        direct tax on CI. Baseline is ~16s on the CI container (parse
+        + all 8 passes, fresh project so no memoized analyses); 40s
+        gives ~2.5x headroom for noisy neighbors while still catching
+        an accidentally-quadratic analysis the PR it lands in."""
+        import time
+
+        t0 = time.monotonic()
+        run_passes(Project(REPO_ROOT))
+        elapsed = time.monotonic() - t0
+        assert elapsed < 40.0, (
+            f"lint suite took {elapsed:.1f}s (> 40s budget); a pass "
+            f"has gotten pathologically slower")
+
+    def test_lock_graph_covers_known_edges(self, project):
+        """Non-vacuity: the acquisition graph must contain the edges
+        the architecture is built around, and the acknowledged
+        blocking holds must stay acknowledged."""
+        graph = lockorder.lock_graph(project)
+        edges = {(e["from"], e["to"]) for e in graph["edges"]}
+        assert ("MetricStore._flush_gate", "<store>") in edges
+        assert any(a == "<store>" for a, _ in edges), edges
+        blocking = {(b["lock"], b["op"]): b["acknowledged"]
+                    for b in graph["blocking"]}
+        assert blocking.get(("Checkpointer._io_lock", "os.fsync()")) \
+            is True
+        # the snapshot path must NOT re-grow a held device fetch
+        assert ("<store>", "jax.device_get()") not in blocking
 
     def test_lock_registry_covers_store_contract(self, project):
         reg = locks._build_registry(project)
@@ -103,14 +136,25 @@ class TestRealCodebase:
         assert all(n.startswith("veneur.") for n in names)
 
     def test_runner_cli_clean_json(self):
-        """`python -m veneur_tpu.lint --json` is the CI entry point."""
+        """`python -m veneur_tpu.lint --json` is the CI entry point;
+        the payload now carries the diffable lock-acquisition graph."""
         proc = subprocess.run(
             [sys.executable, "-m", "veneur_tpu.lint", "--json"],
-            cwd=REPO_ROOT, capture_output=True, text=True, timeout=120)
+            cwd=REPO_ROOT, capture_output=True, text=True, timeout=180)
         assert proc.returncode == 0, proc.stdout + proc.stderr
         data = json.loads(proc.stdout)
         assert data["findings"] == []
         assert data["stale_baseline"] == []
+        edges = {(e["from"], e["to"]) for e in data["lock_graph"]["edges"]}
+        assert ("MetricStore._flush_gate", "<store>") in edges
+
+    def test_runner_cli_programs_table(self):
+        proc = subprocess.run(
+            [sys.executable, "-m", "veneur_tpu.lint", "--programs-table"],
+            cwd=REPO_ROOT, capture_output=True, text=True, timeout=120)
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        assert "| program | static arg |" in proc.stdout
+        assert "core/slab.py::_gather_pack" in proc.stdout
 
 
 # ---------------------------------------------------------------------------
@@ -298,6 +342,397 @@ class TestJaxPurity:
     def test_pragma_suppresses(self, purity_findings):
         assert not any("suppressed_sync" in f.anchor
                        for f in purity_findings)
+
+
+# ---------------------------------------------------------------------------
+# lock-order
+# ---------------------------------------------------------------------------
+
+
+LOCKORDER_FIXTURE = '''
+import os
+import threading
+
+import jax
+
+
+class OrderPairA:
+    def __init__(self, b):
+        self._lock = threading.Lock()
+        self.b = b
+        self.x = 0
+
+    def hold_then_b(self):
+        with self._lock:
+            self.b.mutate_pair_b()          # edge A -> B
+
+    def mutate_pair_a(self):
+        with self._lock:
+            self.x += 1
+
+    def benign_reacquire(self):
+        with self._lock:
+            with self._lock:                # same lock: must NOT flag
+                self.x += 1
+
+
+class OrderPairB:
+    def __init__(self, a):
+        self._lock = threading.Lock()
+        self.a = a
+        self.y = 0
+
+    def hold_then_a(self):
+        with self._lock:
+            self.a.mutate_pair_a()          # edge B -> A: cycle!
+
+    def mutate_pair_b(self):
+        with self._lock:
+            self.y += 1
+
+
+class FsyncHolder:
+    def __init__(self, fd):
+        self._io_lock = threading.Lock()
+        self.fd = fd
+
+    def locked_fsync(self):
+        with self._io_lock:
+            os.fsync(self.fd)               # MUST flag
+
+    def fsync_outside(self):
+        with self._io_lock:
+            fd = self.fd
+        os.fsync(fd)                        # must NOT flag
+
+    def acknowledged_fsync(self):
+        with self._io_lock:  # lint: ok(lock-across-blocking) serializer
+            os.fsync(self.fd)               # suppressed
+
+
+class DeviceHolder:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.plane = None
+
+    def locked_fetch(self):
+        with self._lock:
+            return jax.device_get(self.plane)   # MUST flag
+
+    def dispatch_under_fetch_outside(self):
+        with self._lock:
+            ref = self.plane[:4]            # async dispatch: fine
+        return jax.device_get(ref)          # must NOT flag
+'''
+
+
+class TestLockOrder:
+    REL = "veneur_tpu/_fixture_lockorder.py"
+
+    @pytest.fixture(scope="class")
+    def order_findings(self, project):
+        clone = synthetic(project, self.REL, LOCKORDER_FIXTURE)
+        return findings_in(lockorder.run(clone), self.REL)
+
+    def test_opposite_order_cycle_flagged(self, order_findings):
+        cycles = [f for f in order_findings if f.code == "lock-cycle"]
+        assert len(cycles) == 1, [f.render() for f in order_findings]
+        assert "OrderPairA._lock" in cycles[0].message
+        assert "OrderPairB._lock" in cycles[0].message
+
+    def test_lock_across_fsync_and_device_get_flagged(self,
+                                                      order_findings):
+        anchors = {f.anchor for f in order_findings
+                   if f.code == "lock-across-blocking"}
+        assert any("locked_fsync" in a and "os.fsync" in a
+                   for a in anchors), anchors
+        assert any("locked_fetch" in a and "device_get" in a
+                   for a in anchors), anchors
+
+    def test_benign_shapes_not_flagged(self, order_findings):
+        anchors = {f.anchor for f in order_findings}
+        assert not any("benign_reacquire" in a for a in anchors)
+        assert not any("fsync_outside" in a for a in anchors)
+        assert not any("dispatch_under_fetch_outside" in a
+                       for a in anchors)
+
+    def test_pragma_suppresses_blocking(self, order_findings):
+        assert not any("acknowledged_fsync" in f.anchor
+                       for f in order_findings)
+
+    def test_graph_includes_fixture_edges(self, project):
+        clone = synthetic(project, self.REL, LOCKORDER_FIXTURE)
+        graph = lockorder.lock_graph(clone)
+        edges = {(e["from"], e["to"]) for e in graph["edges"]}
+        assert ("OrderPairA._lock", "OrderPairB._lock") in edges
+        assert ("OrderPairB._lock", "OrderPairA._lock") in edges
+
+
+# ---------------------------------------------------------------------------
+# lockset (static pass)
+# ---------------------------------------------------------------------------
+
+
+LOCKSET_FIXTURE = '''
+import threading
+
+
+class Governed:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.mixed = 0
+        self.consistent = 0
+        self.confined = 0
+        self.acked = 0
+
+    def locked_bumps(self):
+        with self._lock:
+            self.mixed += 1
+            self.consistent += 1
+            self.acked += 1
+
+    def unlocked_bumps(self):
+        self.mixed += 1                     # MUST flag: empty lockset
+        self.confined += 1                  # must NOT flag: never locked
+
+    def justified_bump(self):
+        self.acked += 1  # lint: ok(inconsistent-lockset) startup only
+
+
+class Unlocked:
+    """No lock attr at all: never monitored."""
+
+    def bump(self):
+        self.n = 1
+'''
+
+
+class TestLocksetStatic:
+    REL = "veneur_tpu/_fixture_lockset.py"
+
+    @pytest.fixture(scope="class")
+    def set_findings(self, project):
+        clone = synthetic(project, self.REL, LOCKSET_FIXTURE)
+        return findings_in(lockset.run(clone), self.REL)
+
+    def test_mixed_locked_unlocked_field_flagged(self, set_findings):
+        anchors = {f.anchor for f in set_findings}
+        assert "Governed.mixed" in anchors
+        assert any("unlocked_bumps" in f.message for f in set_findings
+                   if f.anchor == "Governed.mixed")
+
+    def test_consistent_confined_and_suppressed_not_flagged(
+            self, set_findings):
+        anchors = {f.anchor for f in set_findings}
+        assert "Governed.consistent" not in anchors   # always locked
+        assert "Governed.confined" not in anchors     # never locked
+        assert "Governed.acked" not in anchors        # pragma'd site
+        assert "Unlocked.n" not in anchors            # lockless class
+        assert len(set_findings) == 1
+
+
+# ---------------------------------------------------------------------------
+# lockset (runtime Eraser detector)
+# ---------------------------------------------------------------------------
+
+
+class TestEraserLockset:
+    @pytest.fixture
+    def store(self):
+        from veneur_tpu.core.store import MetricStore
+
+        return MetricStore(initial_capacity=64, chunk=64)
+
+    def _drive(self, store, rec):
+        """Thread 1 quarantines under the store lock (the ingest path);
+        thread 2 bumps the same telemetry field through the UNANNOTATED
+        mutator with no lock — the seeded race."""
+        from veneur_tpu.core.store import MetricKey
+
+        key = MetricKey(name="tsan.ctr", type="counter", joined_tags="")
+
+        def locked():
+            for _ in range(20):
+                with store._lock:
+                    store.counters.sample(key, [], 1.0, 1e-40)  # bad rate
+
+        def unlocked():
+            for _ in range(20):
+                store.counters._quarantine_samples("bad_rate")
+
+        t1 = threading.Thread(target=locked, name="ingest")
+        t1.start()
+        t1.join()
+        t2 = threading.Thread(target=unlocked, name="rogue")
+        t2.start()
+        t2.join()
+
+    def test_seeded_race_caught_with_both_stacks(self, store, tsan_lite):
+        rec = tsan_lite(store)
+        self._drive(store, rec)
+        races = [r for r in rec.races if r.field == "scrubbed"]
+        assert races, "lockset detector missed the seeded race"
+        r = races[0]
+        assert r.first_thread != r.second_thread
+        assert any("_quarantine_samples" in line for line in
+                   r.first_stack + r.second_stack)
+        assert r.first_stack and r.second_stack  # BOTH stacks present
+        with pytest.raises(AssertionError, match="data race"):
+            rec.assert_clean()
+
+    def test_tsan_lite_v1_provably_missed_it(self, store, tsan_lite):
+        """The same workload under the v1 detector alone: zero
+        violations — _quarantine_samples is not an annotated mutator,
+        which is exactly the blind spot the lockset upgrade closes."""
+        from veneur_tpu.lint.tsan import LockStateRecorder
+
+        rec = LockStateRecorder(store, eraser=False)
+        rec.arm()
+        try:
+            self._drive(store, rec)
+            assert rec.violations == []   # v1: blind
+            assert rec.races == []        # eraser off: nothing recorded
+        finally:
+            rec.disarm()
+
+    def test_locked_workload_stays_clean(self, store, tsan_lite):
+        from veneur_tpu.core.store import MetricKey
+
+        rec = tsan_lite(store)
+        key = MetricKey(name="tsan.ctr", type="counter", joined_tags="")
+
+        def worker():
+            for _ in range(30):
+                with store._lock:
+                    store.counters.sample(key, [], 1.0, 1.0)
+                    store.counters._quarantine_samples("bad_rate")
+
+        threads = [threading.Thread(target=worker) for _ in range(3)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        rec.assert_clean()
+
+    def test_retired_generation_exempt(self, store, tsan_lite):
+        """Off-lock field mutation on a retired twin is the flush
+        design, not a race — mirrors TSan-lite's exemption."""
+        rec = tsan_lite(store)
+        g = store.counters
+        with store._lock:
+            g.spilled += 1                      # main thread, locked
+        g._retired = True
+        t = threading.Thread(
+            target=lambda: setattr(g, "spilled", g.spilled + 5))
+        t.start()
+        t.join()
+        assert not rec.races
+        rec.assert_clean()
+
+
+# ---------------------------------------------------------------------------
+# recompile-hazard
+# ---------------------------------------------------------------------------
+
+
+RECOMPILE_FIXTURE = '''
+import jax
+
+from veneur_tpu.core.bucketing import bucketed, next_pow2
+
+
+@bucketed("rungs")
+def fallback_rung(n):
+    return 1 if n < 8 else 64
+
+
+def _kernel(x, n):
+    return x[:n].sum()
+
+
+_prog = jax.jit(_kernel, static_argnums=(1,))
+
+
+def bad_len(x, items):
+    return _prog(x, len(items))             # MUST flag
+
+def good_bucketed(x, items):
+    return _prog(x, next_pow2(len(items)))  # must NOT flag: pow2 ladder
+
+def good_custom_rung(x, items):
+    return _prog(x, fallback_rung(len(items)))  # must NOT flag
+
+def good_const(x):
+    return _prog(x, 16)                     # must NOT flag
+
+def suppressed(x, items):
+    return _prog(x, len(items))  # lint: ok(unbounded-static-arg) bench
+
+def bad_sliced_shape(x, k):
+    return _prog(x[:len(k)], 4)             # MUST flag: unbounded-shape
+
+
+class Holder:
+    def __init__(self, cap):
+        self.cap = cap
+        self._p = jax.jit(_kernel, static_argnums=(1,))
+
+    def good_config(self, x):
+        return self._p(x, self.cap)         # must NOT flag
+
+    def bad_method(self, x, items):
+        return self._p(x, len(items))       # MUST flag
+
+
+@jax.jit
+def traced_user(x):
+    return _kernel(x, x.shape[0] // 2)      # must NOT flag: traced shape
+'''
+
+
+class TestRecompileHazard:
+    REL = "veneur_tpu/_fixture_recompile.py"
+
+    @pytest.fixture(scope="class")
+    def rc_findings(self, project):
+        clone = synthetic(project, self.REL, RECOMPILE_FIXTURE)
+        return findings_in(recompile.run(clone), self.REL)
+
+    def test_unbounded_static_args_flagged(self, rc_findings):
+        anchors = {f.anchor for f in rc_findings
+                   if f.code == "unbounded-static-arg"}
+        assert any(a.startswith("bad_len->") for a in anchors), anchors
+        assert any(a.startswith("Holder.bad_method->") for a in anchors)
+        assert len(anchors) == 2
+
+    def test_unbounded_slice_shape_flagged(self, rc_findings):
+        shapes = [f for f in rc_findings if f.code == "unbounded-shape"]
+        assert [f.anchor.split("->")[0] for f in shapes] == \
+            ["bad_sliced_shape"]
+
+    def test_bucketed_config_const_and_traced_not_flagged(
+            self, rc_findings):
+        anchors = {f.anchor for f in rc_findings}
+        for benign in ("good_bucketed", "good_custom_rung", "good_const",
+                       "good_config", "traced_user", "suppressed"):
+            assert not any(a.startswith(benign) for a in anchors), (
+                benign, anchors)
+
+    def test_inventory_table_lists_fixture_program(self, project):
+        clone = synthetic(project, self.REL, RECOMPILE_FIXTURE)
+        table = recompile.programs_table(clone)
+        assert "_fixture_recompile.py::_kernel" in table
+        assert "UNBOUNDED" in table
+        assert "bucketed" in table
+
+    def test_real_inventory_matches_docs(self, project):
+        """The docs table is generated; drift is a finding. The real
+        package must also contain zero UNBOUNDED classifications —
+        every live static arg is const/config/bucketed/opaque."""
+        table = recompile.programs_table(project)
+        assert "UNBOUNDED" not in table
+        docs = project.read("docs/static-analysis.md")
+        assert table.strip() in docs
 
 
 # ---------------------------------------------------------------------------
@@ -523,6 +958,56 @@ class TestBaseline:
         bl = Baseline.load(path)
         new, old, stale = bl.split([])
         assert stale == ["dead-code:unused-import:veneur_tpu/x.py:json"]
+
+    def _renamed(self, file):
+        return Finding(pass_name="dead-code", code="unused-import",
+                       file=file, line=7, anchor="json",
+                       message="unused")
+
+    def test_rename_reanchors_justified_entries(self, tmp_path):
+        """A renamed-but-unchanged file must carry its justified
+        baseline entries along: same pass/code/anchor in a new file
+        while the old file is gone is neither a new finding nor a
+        stale entry."""
+        bl = Baseline(path=str(tmp_path / "b.json"))
+        old_f = self._finding()                   # veneur_tpu/x.py
+        bl.entries[old_f.key()] = "grandfathered: generated shim"
+        moved = self._renamed("veneur_tpu/y.py")
+        new, old, stale = bl.split([moved],
+                                   live_files={"veneur_tpu/y.py"})
+        assert not new and not stale
+        assert [f.file for f in old] == ["veneur_tpu/y.py"]
+
+    def test_rename_requires_old_file_gone(self, tmp_path):
+        """If the old file still exists, the same-anchor finding in a
+        second file is genuinely NEW (a copy, not a rename)."""
+        bl = Baseline(path=str(tmp_path / "b.json"))
+        bl.entries[self._finding().key()] = "grandfathered: shim"
+        moved = self._renamed("veneur_tpu/y.py")
+        new, old, stale = bl.split(
+            [moved], live_files={"veneur_tpu/x.py", "veneur_tpu/y.py"})
+        assert len(new) == 1 and not old
+        assert stale == [self._finding().key()]
+
+    def test_rename_ambiguous_candidates_fall_through(self, tmp_path):
+        """Two same-anchor findings in two new files cannot both be
+        the rename — strict behavior wins."""
+        bl = Baseline(path=str(tmp_path / "b.json"))
+        bl.entries[self._finding().key()] = "grandfathered: shim"
+        a = self._renamed("veneur_tpu/y.py")
+        b = self._renamed("veneur_tpu/z.py")
+        new, old, stale = bl.split(
+            [a, b], live_files={"veneur_tpu/y.py", "veneur_tpu/z.py"})
+        assert len(new) == 2 and not old and len(stale) == 1
+
+    def test_rename_of_unjustified_entry_does_not_reanchor(
+            self, tmp_path):
+        bl = Baseline(path=str(tmp_path / "b.json"))
+        bl.entries[self._finding().key()] = "TODO: justify"
+        moved = self._renamed("veneur_tpu/y.py")
+        new, old, stale = bl.split([moved],
+                                   live_files={"veneur_tpu/y.py"})
+        assert len(new) == 1 and not old
 
     def test_cli_nonzero_on_synthetic_violation(self, tmp_path):
         """End-to-end: a repo with a violation makes the runner exit 1."""

@@ -502,3 +502,53 @@ class TestConfigValidation:
         server, _ = make_server(interval="20s", checkpoint_path=path)
         assert server.checkpointer.interval_s == pytest.approx(5.0)
         assert server.checkpointer.max_age_s == pytest.approx(40.0)
+
+
+class TestSnapshotLockNarrowing:
+    """PR 5 lock-order fix: the checkpoint snapshot DISPATCHES device
+    reads under each group's lock hold (async slices of immutable
+    buffers) and runs every blocking ``jax.device_get`` OFF-lock —
+    ingest never stalls behind a checkpoint's device→host transfer.
+    The lock-order pass flags the old hold-across-fetch shape
+    statically; this pins the runtime behavior."""
+
+    @pytest.mark.parametrize("storage", ["dense", "slab"])
+    def test_device_fetch_runs_off_lock(self, monkeypatch, storage):
+        import jax
+
+        kw = {"digest_storage": storage}
+        if storage == "slab":
+            kw["slab_rows"] = 32
+        store = make_store(**kw)
+        populate(store)
+        held_at_fetch = []
+        real = jax.device_get
+
+        def spying(x):
+            held_at_fetch.append(store._lock._is_owned())
+            return real(x)
+
+        monkeypatch.setattr(jax, "device_get", spying)
+        groups, epoch = store.snapshot_state()
+        assert held_at_fetch, "snapshot performed no device fetch"
+        assert not any(held_at_fetch), (
+            "a blocking device_get ran while the store lock was held")
+        # and the two-phase snapshot is still complete + restorable
+        assert groups["histograms"]["names"]
+        assert "means" in groups["histograms"]
+        assert "registers" in groups["sets"]
+        assert "table" in groups["heavy_hitters"]
+        fresh = make_store(**kw)
+        merged = fresh.restore_state(groups)
+        assert merged > 0
+
+    def test_one_shot_snapshot_state_unchanged_for_exclusive_owners(
+            self):
+        """The re-merge rung / tests call group.snapshot_state()
+        directly on an exclusively-owned group: begin+finish in one
+        call, same payload as before the split."""
+        store = make_store()
+        populate(store)
+        with store._lock:
+            snap = store.histograms.snapshot_state()
+        assert snap["names"] and "means" in snap and "count" in snap

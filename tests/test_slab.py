@@ -476,3 +476,96 @@ class TestSelectiveStatFetch:
                        "h.50percentile"):
             assert absent in m_all
             assert absent not in m_mmc
+
+
+class TestRetiredRelease:
+    """Release-order audit (PR 5): a RETIRED twin frees its device
+    planes first and its host staging immediately after the flush —
+    it outlives the flush by the whole sink fan-out and must not pin
+    chunk-sized buffers (or allocate fresh ones) for that window."""
+
+    def _group(self):
+        from veneur_tpu.core.slab import SlabDigestGroup
+
+        g = SlabDigestGroup(slab_rows=8, chunk=32)
+        from veneur_tpu.samplers.parser import MetricKey
+
+        for i in range(12):
+            g.sample(MetricKey(name=f"h{i}", type="histogram",
+                               joined_tags=""), [], float(i + 1), 1.0)
+        return g
+
+    def test_retired_slab_twin_frees_planes_and_staging(self):
+        g = self._group()
+        g._retired = True
+        interner, out = g.flush([0.5])
+        assert len(interner) == 12 and "percentiles" in out
+        assert g.digests == [] and g.temps == []
+        assert g._rows is None and g._vals is None and g._wts is None
+        assert g._imp_rows is None and g._imp_stat_rows is None
+
+    def test_retired_empty_twin_allocates_nothing(self):
+        """The n==0 path used to hand a dead twin six fresh
+        chunk-sized buffers; now it drops the ones it has."""
+        from veneur_tpu.core.slab import SlabDigestGroup
+
+        g = SlabDigestGroup(slab_rows=8, chunk=32)
+        g._retired = True
+        interner, out = g.flush([0.5])
+        assert out == {}
+        assert g.digests == [] and g.temps == []
+        assert g._rows is None and g._imp_rows is None
+
+    def test_live_group_keeps_staging(self):
+        g = self._group()
+        interner, out = g.flush([0.5])
+        assert g._rows is not None and len(g.digests) >= 1
+        # and it still aggregates the next interval
+        from veneur_tpu.samplers.parser import MetricKey
+
+        g.sample(MetricKey(name="h0", type="histogram",
+                           joined_tags=""), [], 5.0, 1.0)
+        assert len(g.interner) == 1
+
+    def test_dense_retired_twin_frees_staging_too(self):
+        from veneur_tpu.core.store import DigestGroup
+        from veneur_tpu.samplers.parser import MetricKey
+
+        g = DigestGroup(capacity=16, chunk=32)
+        for i in range(5):
+            g.sample(MetricKey(name=f"h{i}", type="histogram",
+                               joined_tags=""), [], float(i + 1), 1.0)
+        g._retired = True
+        interner, out = g.flush([0.5])
+        assert g.digest is None and g.temp is None
+        assert g._rows is None and g._imp_rows is None
+
+    def test_store_flush_releases_the_retired_generation(self):
+        """End to end through the swap: after MetricStore.flush the
+        retired groups (exclusively owned by the flush) are drained
+        AND stripped of device planes + staging."""
+        from veneur_tpu.core.store import MetricStore
+        from veneur_tpu.samplers.intermetric import HistogramAggregates
+        from veneur_tpu.samplers.parser import parse_metric
+
+        store = MetricStore(initial_capacity=16, chunk=32,
+                            digest_storage="slab", slab_rows=16)
+        for v in range(1, 20):
+            store.process_metric(parse_metric(f"h1:{v}|h".encode()))
+        gen = {}
+        orig = MetricStore._swap_generation
+
+        def spy(self):
+            g = orig(self)
+            gen["histograms"] = g.histograms
+            return g
+
+        MetricStore._swap_generation = spy
+        try:
+            store.flush([0.5], HistogramAggregates(), is_local=False,
+                        now=0, forward=False)
+        finally:
+            MetricStore._swap_generation = orig
+        retired = gen["histograms"]
+        assert retired._retired
+        assert retired.digests == [] and retired._rows is None

@@ -1,0 +1,51 @@
+"""Shape-bucketing registry for the recompile-hazard pass.
+
+Every distinct static argument (or input shape) handed to a
+``jax.jit``/``pmap``/Pallas program compiles its own XLA executable —
+~20s each on TPU — so any static value derived from *unbounded* runtime
+data (a batch length, a queue depth, a live-row count) is a trace-cache
+explosion waiting for production traffic to trigger it. The codebase's
+defense is a small set of **bucketing ladders**: functions that collapse
+an unbounded integer into a log-bounded set of values (pow2 rounding,
+the fallback rungs). ``veneur_tpu.lint``'s ``recompile-hazard`` pass
+(``lint/recompile.py``, docs/static-analysis.md) statically checks that
+every hazardous static arg flows through one of them.
+
+``@bucketed("pow2")`` marks such a ladder. Like ``core/locking.py`` it
+is a zero-cost attribute stamp — the drain hot path must not pay a
+wrapper frame — and the decorator argument names the bucketing scheme
+for the generated compiled-program inventory table.
+"""
+
+from __future__ import annotations
+
+BUCKETED_ATTR = "__shape_bucketed__"
+
+
+def bucketed(scheme: str):
+    """The function maps unbounded runtime integers onto a bounded
+    (typically log-sized) value set; the recompile-hazard pass treats
+    its results as safe static args / slice bounds."""
+
+    def deco(fn):
+        setattr(fn, BUCKETED_ATTR, scheme)
+        return fn
+
+    return deco
+
+
+@bucketed("pow2")
+def next_pow2(n: int) -> int:
+    """Smallest power of two >= n (n >= 1; n == 0 rounds to 2 to match
+    the historical ``ops/tdigest_pallas.py`` edge behavior)."""
+    return 1 << (n - 1).bit_length()
+
+
+@bucketed("pow2")
+def pow2_cap(n: int) -> int:
+    """Power-of-two bucket for a staged-prefix length: smallest pow2
+    >= n, with 0 -> 1 (an empty drain still slices one sentinel row).
+    Exactly the inline ``1 << max(n - 1, 0).bit_length()`` idiom this
+    helper replaced — kept bit-identical so drain padding (and thus the
+    compiled-variant set) does not change."""
+    return max(1 << max(n - 1, 0).bit_length(), 1)

@@ -880,6 +880,24 @@ class SlabDigestGroup(OverloadLimited):
                       for _ in range(nslabs)]
         self._device_dirty = False
 
+    def _drop_staging(self):
+        """Release a RETIRED twin's host staging buffers at the
+        earliest point — the round-5 release-order audit: the retired
+        generation object outlives its flush by the whole sink fan-out,
+        and before this the dead twin kept ~6 chunk-sized numpy buffers
+        (plus, on the n==0 path, allocated FRESH ones) pinned for that
+        entire window. Device planes free first (donated slab by slab
+        or dropped by the caller), host staging immediately after;
+        fills reset so a stray drain on the dead twin is a no-op
+        instead of a crash."""
+        self._rows = self._vals = self._wts = None
+        self._imp_rows = self._imp_means = self._imp_wts = None
+        self._imp_stat_rows = self._imp_stat_mins = None
+        self._imp_stat_maxs = None
+        self._fill = 0
+        self._imp_fill = 0
+        self._imp_stat_fill = 0
+
     def flush(self, percentiles: List[float], want_digests=True,
               want_stats=None):
         """Drain + percentile every slab; identical contract to
@@ -911,10 +929,14 @@ class SlabDigestGroup(OverloadLimited):
         if n == 0:
             interner, self.interner = self.interner, self._interner_cls()
             if self._retired:
+                # release order: device planes first, then host staging;
+                # a dead twin must not allocate fresh buffers
                 self.digests = []
                 self.temps = []
                 self._device_dirty = False
-            elif self._device_dirty:
+                self._drop_staging()
+                return interner, {}
+            if self._device_dirty:
                 self._reset_device()
             self._new_sample_buffers()
             self._new_import_buffers()
@@ -928,8 +950,11 @@ class SlabDigestGroup(OverloadLimited):
         interner, self.interner = self.interner, self._interner_cls()
         self._device_dirty = False
         if self._retired:
+            # release order: drained device planes first (their donated
+            # buffers already freed slab by slab), host staging second
             self.digests = []
             self.temps = []
+            self._drop_staging()
         else:
             self._new_sample_buffers()
             self._new_import_buffers()
@@ -1006,56 +1031,73 @@ class SlabDigestGroup(OverloadLimited):
     # -- checkpoint snapshot / restore (veneur_tpu/persist/) --------------
 
     @requires_lock("store")
-    def snapshot_state(self) -> dict:
-        """Slab twin of ``DigestGroup.snapshot_state``: each slab's
-        interned prefix flattens (digest planes + pending temp bins)
-        into the same per-row centroid-run layout, WITHOUT resetting
-        any device state. Caller holds the store lock."""
-        from veneur_tpu.core.store import flatten_digest_state
-
+    def snapshot_begin(self):
+        """Slab twin of ``DigestGroup.snapshot_begin``: phase 1 under
+        the store lock drains staging and dispatches per-slab plane
+        slices (fresh buffers, async); the returned ``finish`` runs the
+        blocking fetches OFF-lock and flattens each slab's interned
+        prefix into the shared per-row centroid-run layout."""
         self._drain_staging()
         n = len(self.interner)
         snap = {"kind": "digest", "names": list(self.interner.names),
                 "joined": list(self.interner.joined)}
         if n == 0:
-            return snap
+            return snap, None
         k = self.k
-        rows_p, means_p, weights_p, scalars_p = [], [], [], []
+        slab_refs = []
         for i, d in enumerate(self.digests):
             need = min(n - i * self.slab_rows, self.slab_rows)
             if need <= 0:
                 break
             t = self.temps[i]
-            (mean, weight, bin_w, bin_wm, dmn, dmx, cnt, vsum, vmin,
-             vmax, recip) = jax.device_get(
-                (d.mean.reshape(self.slab_rows, k)[:need],
-                 d.weight.reshape(self.slab_rows, k)[:need],
-                 t.sum_w.reshape(self.slab_rows, k)[:need],
-                 t.sum_wm.reshape(self.slab_rows, k)[:need],
-                 d.dmin[:need], d.dmax[:need], t.count[:need],
-                 t.vsum[:need], t.vmin[:need], t.vmax[:need],
-                 t.recip[:need]))
-            flat = flatten_digest_state(
-                np.asarray(mean, np.float32),
-                np.asarray(weight, np.float32),
-                np.asarray(bin_w, np.float32),
-                np.asarray(bin_wm, np.float32))
-            rows_p.append(flat["rows"] + np.int32(i * self.slab_rows))
-            means_p.append(flat["means"])
-            weights_p.append(flat["weights"])
-            scalars_p.append((np.asarray(dmn, np.float32),
-                              np.asarray(dmx, np.float32),
-                              np.asarray(cnt, np.float32),
-                              np.asarray(vsum, np.float32),
-                              np.asarray(vmin, np.float32),
-                              np.asarray(vmax, np.float32),
-                              np.asarray(recip, np.float32)))
-        snap["rows"] = np.concatenate(rows_p)
-        snap["means"] = np.concatenate(means_p)
-        snap["weights"] = np.concatenate(weights_p)
-        for j, nm in enumerate(("mins", "maxs", "count", "vsum", "vmin",
-                                "vmax", "recip")):
-            snap[nm] = np.concatenate([s[j] for s in scalars_p])
+            slab_refs.append((i, (
+                d.mean.reshape(self.slab_rows, k)[:need],
+                d.weight.reshape(self.slab_rows, k)[:need],
+                t.sum_w.reshape(self.slab_rows, k)[:need],
+                t.sum_wm.reshape(self.slab_rows, k)[:need],
+                d.dmin[:need], d.dmax[:need], t.count[:need],
+                t.vsum[:need], t.vmin[:need], t.vmax[:need],
+                t.recip[:need])))
+
+        def finish():
+            from veneur_tpu.core.store import flatten_digest_state
+
+            rows_p, means_p, weights_p, scalars_p = [], [], [], []
+            for i, refs in slab_refs:
+                (mean, weight, bin_w, bin_wm, dmn, dmx, cnt, vsum, vmin,
+                 vmax, recip) = jax.device_get(refs)
+                flat = flatten_digest_state(
+                    np.asarray(mean, np.float32),
+                    np.asarray(weight, np.float32),
+                    np.asarray(bin_w, np.float32),
+                    np.asarray(bin_wm, np.float32))
+                rows_p.append(flat["rows"] + np.int32(i * self.slab_rows))
+                means_p.append(flat["means"])
+                weights_p.append(flat["weights"])
+                scalars_p.append((np.asarray(dmn, np.float32),
+                                  np.asarray(dmx, np.float32),
+                                  np.asarray(cnt, np.float32),
+                                  np.asarray(vsum, np.float32),
+                                  np.asarray(vmin, np.float32),
+                                  np.asarray(vmax, np.float32),
+                                  np.asarray(recip, np.float32)))
+            snap["rows"] = np.concatenate(rows_p)
+            snap["means"] = np.concatenate(means_p)
+            snap["weights"] = np.concatenate(weights_p)
+            for j, nm in enumerate(("mins", "maxs", "count", "vsum",
+                                    "vmin", "vmax", "recip")):
+                snap[nm] = np.concatenate([s[j] for s in scalars_p])
+
+        return snap, finish
+
+    @requires_lock("store")
+    def snapshot_state(self) -> dict:
+        """Slab twin of ``DigestGroup.snapshot_state``: flattened host
+        snapshot WITHOUT resetting device state. One-shot begin+finish
+        for callers that exclusively own the group."""
+        snap, finish = self.snapshot_begin()
+        if finish is not None:
+            finish()
         return snap
 
     @requires_lock("store")

@@ -56,6 +56,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from veneur_tpu.ops import hll as hll_ops
+from veneur_tpu.core.bucketing import pow2_cap
 from veneur_tpu.core.locking import acquires_lock, requires_lock
 from veneur_tpu.ops import tdigest as td_ops
 from veneur_tpu.overload import (F32_ABS_MAX, MIN_SAMPLE_RATE,
@@ -432,10 +433,11 @@ class ScalarGroup(OverloadLimited):
         return interner, values, messages, hostnames
 
     @requires_lock("store")
-    def snapshot_state(self) -> dict:
-        """Host copy of the live group WITHOUT resetting it (the
-        checkpoint path, veneur_tpu/persist/): the caller holds the
-        store lock, so the copies are interval-coherent."""
+    def snapshot_begin(self):
+        """Phase 1 of the two-phase checkpoint snapshot (the caller
+        holds the store lock): scalar state is host numpy, so the copy
+        itself is the whole snapshot — no off-lock fetch phase. Returns
+        ``(snap, None)`` matching the device groups' contract."""
         n = len(self.interner)
         snap = {"kind": "scalar", "names": list(self.interner.names),
                 "joined": list(self.interner.joined),
@@ -443,7 +445,14 @@ class ScalarGroup(OverloadLimited):
         if self.messages is not None:
             snap["messages"] = list(self.messages[:n])
             snap["hostnames"] = list(self.hostnames[:n])
-        return snap
+        return snap, None
+
+    @requires_lock("store")
+    def snapshot_state(self) -> dict:
+        """Host copy of the live group WITHOUT resetting it (the
+        checkpoint path, veneur_tpu/persist/): the caller holds the
+        store lock, so the copies are interval-coherent."""
+        return self.snapshot_begin()[0]
 
     def fresh(self) -> "ScalarGroup":
         """Empty same-config twin (swap-on-flush generation swap)."""
@@ -839,7 +848,7 @@ class DigestGroup(OverloadLimited):
         # ns per batch phase. The staged buffers are pre-filled with
         # identity sentinels (row=capacity, +inf/-inf), so a pow2 prefix
         # slice IS the padded array.
-        cap = max(1 << max(ns - 1, 0).bit_length(), 1)
+        cap = pow2_cap(ns)
         stat_rows = self._imp_stat_rows[:cap]
         stat_mins = self._imp_stat_mins[:cap]
         stat_maxs = self._imp_stat_maxs[:cap]
@@ -962,9 +971,65 @@ class DigestGroup(OverloadLimited):
 
     def _drop_device(self):
         """Free a retired generation's device state at the earliest
-        point (it is never read again)."""
+        point (it is never read again), then the host staging buffers —
+        same release order as ``SlabDigestGroup._drop_staging``: the
+        generation object outlives its flush by the sink fan-out and
+        must not pin chunk-sized buffers for that window."""
         self.digest = self.temp = self.dmin = self.dmax = None
         self._device_dirty = False
+        self._rows = self._vals = self._wts = None
+        self._imp_rows = self._imp_means = self._imp_wts = None
+        self._imp_stat_rows = self._imp_stat_mins = None
+        self._imp_stat_maxs = None
+        self._fill = 0
+        self._imp_fill = 0
+        self._imp_stat_fill = 0
+
+    @requires_lock("store")
+    def snapshot_begin(self):
+        """Phase 1 of the two-phase checkpoint snapshot (the caller
+        holds the store lock): drain staging, then DISPATCH device
+        slices of every live plane. Op-by-op slicing enqueues
+        asynchronously and yields fresh buffers, so the returned
+        ``finish`` closure can run the blocking ``jax.device_get``
+        OFF-lock — a later drain donating the originals cannot touch
+        the captured slices, and ingest never stalls behind the fetch
+        (the lock-order pass flags the old hold-across-device_get
+        shape). ``finish(…)`` completes ``snap`` in place."""
+        self._drain_staging()
+        n = len(self.interner)
+        snap = {"kind": "digest", "names": list(self.interner.names),
+                "joined": list(self.interner.joined)}
+        if n == 0:
+            return snap, None
+        refs = (self.digest.mean[:n], self.digest.weight[:n],
+                self.temp.sum_w[:n], self.temp.sum_wm[:n],
+                self.dmin[:n], self.dmax[:n],
+                self.digest.min[:n], self.digest.max[:n],
+                self.temp.count[:n], self.temp.vsum[:n],
+                self.temp.vmin[:n], self.temp.vmax[:n],
+                self.temp.recip[:n])
+
+        def finish():
+            (mean, weight, bin_w, bin_wm, imp_min, imp_max, dmn, dmx,
+             cnt, vsum, vmin, vmax, recip) = jax.device_get(refs)
+            snap.update(flatten_digest_state(
+                np.asarray(mean, np.float32),
+                np.asarray(weight, np.float32),
+                np.asarray(bin_w, np.float32),
+                np.asarray(bin_wm, np.float32)))
+            # digest-bound extrema (import path stat args); the
+            # interval's observed extrema travel separately as temp stats
+            snap["mins"] = np.minimum(np.asarray(imp_min, np.float32),
+                                      np.asarray(dmn, np.float32))
+            snap["maxs"] = np.maximum(np.asarray(imp_max, np.float32),
+                                      np.asarray(dmx, np.float32))
+            for nm, arr in (("count", cnt), ("vsum", vsum),
+                            ("vmin", vmin), ("vmax", vmax),
+                            ("recip", recip)):
+                snap[nm] = np.asarray(arr, np.float32)
+
+        return snap, finish
 
     @requires_lock("store")
     def snapshot_state(self) -> dict:
@@ -973,33 +1038,11 @@ class DigestGroup(OverloadLimited):
         plus pending temp-bin centroids flatten to per-row runs, and the
         interval's scalar stats ride alongside so a restore rebuilds
         both the mergeable sketch and the local-aggregate emissions.
-        Caller holds the store lock."""
-        self._drain_staging()
-        n = len(self.interner)
-        snap = {"kind": "digest", "names": list(self.interner.names),
-                "joined": list(self.interner.joined)}
-        if n == 0:
-            return snap
-        (mean, weight, bin_w, bin_wm, imp_min, imp_max, dmn, dmx,
-         cnt, vsum, vmin, vmax, recip) = jax.device_get(
-            (self.digest.mean[:n], self.digest.weight[:n],
-             self.temp.sum_w[:n], self.temp.sum_wm[:n],
-             self.dmin[:n], self.dmax[:n],
-             self.digest.min[:n], self.digest.max[:n],
-             self.temp.count[:n], self.temp.vsum[:n], self.temp.vmin[:n],
-             self.temp.vmax[:n], self.temp.recip[:n]))
-        snap.update(flatten_digest_state(
-            np.asarray(mean, np.float32), np.asarray(weight, np.float32),
-            np.asarray(bin_w, np.float32), np.asarray(bin_wm, np.float32)))
-        # digest-bound extrema (import path stat args); the interval's
-        # observed extrema travel separately as temp stats
-        snap["mins"] = np.minimum(np.asarray(imp_min, np.float32),
-                                  np.asarray(dmn, np.float32))
-        snap["maxs"] = np.maximum(np.asarray(imp_max, np.float32),
-                                  np.asarray(dmx, np.float32))
-        for nm, arr in (("count", cnt), ("vsum", vsum), ("vmin", vmin),
-                        ("vmax", vmax), ("recip", recip)):
-            snap[nm] = np.asarray(arr, np.float32)
+        One-shot begin+finish for callers that exclusively own the
+        group (the re-merge rung, tests)."""
+        snap, finish = self.snapshot_begin()
+        if finish is not None:
+            finish()
         return snap
 
     @requires_lock("store")
@@ -1237,18 +1280,33 @@ class SetGroup(OverloadLimited):
         self._device_dirty = False
 
     @requires_lock("store")
-    def snapshot_state(self) -> dict:
-        """Host copy of the live registers WITHOUT resetting (the
-        checkpoint path, veneur_tpu/persist/). Caller holds the store
-        lock."""
+    def snapshot_begin(self):
+        """Phase 1 of the two-phase checkpoint snapshot: drain staging
+        and dispatch the register-plane slice under the store lock; the
+        returned ``finish`` fetches it off-lock (see
+        ``DigestGroup.snapshot_begin``)."""
         self._drain_staging()
         n = len(self.interner)
         snap = {"kind": "set", "precision": self.precision,
                 "names": list(self.interner.names),
                 "joined": list(self.interner.joined)}
-        if n:
-            snap["registers"] = np.asarray(
-                jax.device_get(self.registers[:n]), np.uint8)
+        if n == 0:
+            return snap, None
+        refs = self.registers[:n]
+
+        def finish():
+            snap["registers"] = np.asarray(jax.device_get(refs), np.uint8)
+
+        return snap, finish
+
+    @requires_lock("store")
+    def snapshot_state(self) -> dict:
+        """Host copy of the live registers WITHOUT resetting (the
+        checkpoint path, veneur_tpu/persist/). One-shot begin+finish
+        for callers that exclusively own the group."""
+        snap, finish = self.snapshot_begin()
+        if finish is not None:
+            finish()
         return snap
 
 
@@ -1507,33 +1565,50 @@ class HeavyHitterGroup(OverloadLimited):
         return interner, out, fwd
 
     @requires_lock("store")
-    def snapshot_state(self) -> dict:
-        """Host copy of the live sketch WITHOUT resetting (the
-        checkpoint path, veneur_tpu/persist/): the count-min table plus
-        each series' top-k candidates in the import_sketch layout.
-        Caller holds the store lock."""
+    def snapshot_begin(self):
+        """Phase 1 of the two-phase checkpoint snapshot: dispatch the
+        top-k plane slices and a device-side table copy (the count-min
+        update program donates the table, so the captured handle must
+        be a fresh buffer), and copy the host member memo — all under
+        the store lock. The returned ``finish`` fetches and assembles
+        off-lock (see ``DigestGroup.snapshot_begin``)."""
         self._drain_samples()
         n = len(self.interner)
         snap = {"kind": "topk", "depth": self.depth, "width": self.width,
                 "names": list(self.interner.names),
                 "joined": list(self.interner.joined)}
         if n == 0:
-            return snap
-        hi, lo, ct, table = jax.device_get(
-            (self.sketch.topk_hi[:n], self.sketch.topk_lo[:n],
-             self.sketch.topk_counts[:n], self.sketch.table))
-        snap["table"] = np.asarray(table, np.float32)
-        # vectorized live-slot extraction: this runs under the store
-        # lock every checkpoint_interval, so no O(n*k) Python loop
-        live_r, live_c = np.nonzero(np.asarray(ct) > 0)
-        series = [{"keys": [], "members": []} for _ in range(n)]
-        for r, c in zip(live_r.tolist(), live_c.tolist()):
-            pair = (int(hi[r, c]), int(lo[r, c]))
-            s = series[r]
-            s["keys"].append(pair)
-            s["members"].append(
-                self._members.get((pair[0] << 32) | pair[1]))
-        snap["series"] = series
+            return snap, None
+        refs = (self.sketch.topk_hi[:n], self.sketch.topk_lo[:n],
+                self.sketch.topk_counts[:n], jnp.copy(self.sketch.table))
+        members = dict(self._members)
+
+        def finish():
+            hi, lo, ct, table = jax.device_get(refs)
+            snap["table"] = np.asarray(table, np.float32)
+            # vectorized live-slot extraction: no O(n*k) Python loop
+            live_r, live_c = np.nonzero(np.asarray(ct) > 0)
+            series = [{"keys": [], "members": []} for _ in range(n)]
+            for r, c in zip(live_r.tolist(), live_c.tolist()):
+                pair = (int(hi[r, c]), int(lo[r, c]))
+                s = series[r]
+                s["keys"].append(pair)
+                s["members"].append(
+                    members.get((pair[0] << 32) | pair[1]))
+            snap["series"] = series
+
+        return snap, finish
+
+    @requires_lock("store")
+    def snapshot_state(self) -> dict:
+        """Host copy of the live sketch WITHOUT resetting (the
+        checkpoint path, veneur_tpu/persist/): the count-min table plus
+        each series' top-k candidates in the import_sketch layout.
+        One-shot begin+finish for callers that exclusively own the
+        group."""
+        snap, finish = self.snapshot_begin()
+        if finish is not None:
+            finish()
         return snap
 
 
@@ -2030,6 +2105,7 @@ class MetricStore:
                         (1.0 / rates[sel]).astype(np.float32))
         return raws
 
+    @requires_lock("store")
     def _group_for_kind(self, kind: int):
         if self._kind_groups is None:
             self._kind_groups = (
@@ -2332,21 +2408,31 @@ class MetricStore:
     @acquires_lock("store")
     def snapshot_state(self) -> Tuple[Dict[str, dict], int]:
         """Host-side snapshot of every group WITHOUT resetting
-        anything. Each group snapshots under its own lock hold, so
-        ingest interleaves between groups and the stall is bounded by
-        the largest single group's device fetch, not the whole store's;
-        disk IO is the caller's job, off-lock entirely. Returns
-        ``(groups, flush_epoch)``: the writer must discard the snapshot
-        if the epoch moved before it commits — which also covers a
-        flush swap landing BETWEEN group holds (the mixed snapshot's
-        epoch no longer matches, so it is dropped and the next cadence
-        retries)."""
+        anything, in two phases: under each group's own lock hold only
+        host copies are taken and device reads are DISPATCHED
+        (``snapshot_begin`` — async slices of immutable buffers); the
+        blocking ``jax.device_get`` fetches then run entirely OFF-lock
+        (``finish``), so ingest never stalls behind a checkpoint's
+        device→host transfer (the lock-order pass flags the held-fetch
+        shape) and disk IO stays the caller's job. Returns ``(groups,
+        flush_epoch)``: the writer must discard the snapshot if the
+        epoch moved before it commits — which also covers a flush swap
+        landing BETWEEN group holds (the mixed snapshot's epoch no
+        longer matches, so it is dropped and the next cadence
+        retries; the swapped-out groups' captured slices stay valid —
+        they are fresh buffers the retired flush cannot donate)."""
         with self._lock:
             epoch = self.flush_epoch
         groups = {}
+        fetches = []
         for name in self._GEN_GROUPS:
             with self._lock:
-                groups[name] = getattr(self, name).snapshot_state()
+                snap, finish = getattr(self, name).snapshot_begin()
+            groups[name] = snap
+            if finish is not None:
+                fetches.append(finish)
+        for finish in fetches:  # blocking device reads, no lock held
+            finish()
         return groups, epoch
 
     @acquires_lock("store")
@@ -2496,7 +2582,10 @@ class MetricStore:
         ``_flush_gate`` serializes overlapping flush() calls so retired
         generations drain in order.
         """
-        with self._flush_gate:
+        # the gate's entire job is to hold across the retired drain:
+        # it serializes overlapping flush() calls (only the flusher and
+        # shutdown ever contend) while ingest proceeds on _lock
+        with self._flush_gate:  # lint: ok(lock-across-blocking)
             with self._lock:
                 gen = self._swap_generation()
             return self._flush_generation(

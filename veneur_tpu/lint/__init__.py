@@ -1,13 +1,22 @@
 """veneur_tpu.lint — project-native static analysis.
 
 The Python/JAX substitute for the toolchain the reference leans on
-(``go vet``, the race detector, "imported and not used"). Five passes,
+(``go vet``, the race detector, "imported and not used"). Eight passes,
 all AST-based, no third-party lint dependency:
 
 - ``lock-discipline``  — ``@requires_lock`` call sites hold the store
   lock (``lint/locks.py``; runtime twin in ``lint/tsan.py``)
+- ``lock-order``       — deadlock cycles in the lock-acquisition graph
+  and locks held across blocking ops (``lint/lockorder.py``; the graph
+  rides ``--json`` for per-PR diffing)
+- ``lockset``          — Eraser-style candidate-lockset check on every
+  shared field of lock-owning classes (``lint/lockset.py``; the same
+  module's runtime detector arms inside TSan-lite)
 - ``jax-purity``       — no host syncs / Python branching inside
   jit-traced hot paths (``lint/purity.py``)
+- ``recompile-hazard`` — static args / slice shapes of compiled
+  programs must come from bounded value sets (``lint/recompile.py``;
+  generates the compiled-program inventory, ``--programs-table``)
 - ``config-drift``     — Config/ProxyConfig ↔ example yamls ↔ docs,
   bidirectionally (``lint/configdrift.py``)
 - ``metric-registry``  — one ``veneur.*`` name, one tag schema, all
@@ -24,7 +33,10 @@ from veneur_tpu.lint.framework import (Baseline, Finding, Project, PASSES,
                                        run_passes)
 # importing the pass modules registers them in PASSES
 from veneur_tpu.lint import locks as _locks            # noqa: F401
+from veneur_tpu.lint import lockorder as _lockorder    # noqa: F401
+from veneur_tpu.lint import lockset as _lockset        # noqa: F401
 from veneur_tpu.lint import purity as _purity          # noqa: F401
+from veneur_tpu.lint import recompile as _recompile    # noqa: F401
 from veneur_tpu.lint import configdrift as _configdrift  # noqa: F401
 from veneur_tpu.lint import metricnames as _metricnames  # noqa: F401
 from veneur_tpu.lint import deadcode as _deadcode      # noqa: F401

@@ -5,11 +5,14 @@ baseline entry is stale), 1 when new findings (or stale baseline
 entries) exist, 2 on usage errors.
 
     python -m veneur_tpu.lint                    # human output
-    python -m veneur_tpu.lint --json             # machine output
-    python -m veneur_tpu.lint --passes jax-purity,dead-code
+    python -m veneur_tpu.lint --json             # machine output (incl.
+                                                 # the lock-order graph)
+    python -m veneur_tpu.lint --passes lock-order,recompile-hazard
     python -m veneur_tpu.lint --update-baseline  # grandfather current set
     python -m veneur_tpu.lint --metrics-table    # self-metrics registry md
     python -m veneur_tpu.lint --config-table     # config-key reference md
+    python -m veneur_tpu.lint --programs-table   # compiled-program
+                                                 # inventory md
 """
 
 from __future__ import annotations
@@ -21,7 +24,9 @@ import sys
 
 from veneur_tpu.lint import PASSES, Baseline, Project, run_passes
 from veneur_tpu.lint.configdrift import config_table
+from veneur_tpu.lint.lockorder import lock_graph
 from veneur_tpu.lint.metricnames import metrics_table
+from veneur_tpu.lint.recompile import programs_table
 
 
 def _default_root() -> str:
@@ -49,6 +54,9 @@ def main(argv=None) -> int:
                     help="print the self-metrics registry markdown and exit")
     ap.add_argument("--config-table", action="store_true",
                     help="print the config-key reference markdown and exit")
+    ap.add_argument("--programs-table", action="store_true",
+                    help="print the compiled-program inventory markdown "
+                         "(docs/static-analysis.md section) and exit")
     args = ap.parse_args(argv)
 
     project = Project(args.root)
@@ -57,6 +65,9 @@ def main(argv=None) -> int:
         return 0
     if args.config_table:
         print(config_table(project))
+        return 0
+    if args.programs_table:
+        print(programs_table(project))
         return 0
 
     only = [p.strip() for p in args.passes.split(",") if p.strip()] or None
@@ -75,14 +86,20 @@ def main(argv=None) -> int:
               f"fill in every 'reason'")
         return 0
 
-    new, grandfathered, stale = baseline.split(findings)
+    new, grandfathered, stale = baseline.split(
+        findings, live_files=set(project.files))
 
     if args.as_json:
-        print(json.dumps({
+        payload = {
             "findings": [f.as_json() for f in new],
             "grandfathered": [f.as_json() for f in grandfathered],
             "stale_baseline": stale,
-        }, indent=2))
+        }
+        if only is None or "lock-order" in only:
+            # the acquisition graph rides along so tooling can diff the
+            # lock order per PR (docs/static-analysis.md)
+            payload["lock_graph"] = lock_graph(project)
+        print(json.dumps(payload, indent=2))
     else:
         for f in new:
             print(f.render())

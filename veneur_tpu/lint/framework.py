@@ -190,19 +190,63 @@ class Baseline:
             json.dump(data, f, indent=2, sort_keys=False)
             f.write("\n")
 
-    def split(self, findings: List[Finding]):
+    def split(self, findings: List[Finding],
+              live_files: Optional[set] = None):
         """(new, grandfathered, stale_baseline_keys). An entry whose
         reason is empty or still the "TODO" placeholder does NOT
-        grandfather anything — justification is the price of entry."""
+        grandfather anything — justification is the price of entry.
+
+        ``live_files`` (the analyzed relpaths) enables **rename
+        re-anchoring**: when a justified entry's file no longer exists
+        and exactly one otherwise-identical finding (same pass, code,
+        and anchor) appears in some other file, the entry follows the
+        file — a pure rename must not resurface a grandfathered finding
+        as new, nor report the old entry as stale. Ambiguous matches
+        (two candidate findings, or the old file still present) fall
+        through to the strict behavior."""
         keys = {f.key() for f in findings}
+        # key -> reason, for entries eligible to re-anchor
+        moved: Dict[str, str] = {}
+        if live_files is not None:
+            orphans: Dict[str, List[str]] = {}  # pass:code:anchor -> keys
+            for k in self.entries:
+                if k in keys:
+                    continue
+                # key layout pass:code:file:anchor — only the anchor can
+                # itself contain ':', so a bounded split is exact
+                try:
+                    p, code, file, anchor = k.split(":", 3)
+                except ValueError:  # pragma: no cover - malformed entry
+                    continue
+                reason = self.entries[k].strip()
+                if file not in live_files and reason \
+                        and not reason.startswith("TODO"):
+                    orphans.setdefault(f"{p}:{code}:{anchor}", []).append(k)
+            claims: Dict[str, int] = {}
+            for f in findings:
+                if f.key() not in self.entries:
+                    sig = f"{f.pass_name}:{f.code}:{f.anchor}"
+                    claims[sig] = claims.get(sig, 0) + 1
+            for f in findings:
+                if f.key() in self.entries:
+                    continue
+                sig = f"{f.pass_name}:{f.code}:{f.anchor}"
+                cands = orphans.get(sig, [])
+                # 1:1 only — two same-anchor findings (a copy) or two
+                # orphaned entries cannot be disambiguated as a rename
+                if len(cands) == 1 and claims.get(sig) == 1:
+                    moved[f.key()] = self.entries[cands[0]]
+                    moved[cands[0]] = ""  # consumed: not stale
         new, old = [], []
         for f in findings:
-            reason = self.entries.get(f.key(), "").strip()
+            reason = self.entries.get(f.key(), moved.get(f.key(), "")) \
+                .strip()
             if reason and not reason.startswith("TODO"):
                 old.append(f)
             else:
                 new.append(f)
-        stale = sorted(k for k in self.entries if k not in keys)
+        stale = sorted(k for k in self.entries
+                       if k not in keys and k not in moved)
         return new, old, stale
 
 

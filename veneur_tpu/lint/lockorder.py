@@ -1,0 +1,511 @@
+"""Lock-order pass: deadlock cycles and locks held across blocking ops.
+
+The lock-discipline pass (``lint/locks.py``) checks that annotated
+mutators are *called* under the right lock; it says nothing about what
+happens *while* a lock is held. This pass builds the whole-program
+lock-acquisition graph and checks the two properties Go's toolchain
+would have caught dynamically (``go test -race`` plus the runtime's
+deadlock detector):
+
+1. **``lock-cycle``** — two locks acquired in opposite orders on
+   different code paths (A→B somewhere, B→A elsewhere) can deadlock the
+   moment the two paths run concurrently. Edges come from ``with``
+   acquisitions (and ``.acquire()`` calls) reached — directly or through
+   the call graph — while another lock is lexically held, plus
+   ``@acquires_lock`` annotations. Re-acquiring the *same* lock is not
+   an edge: the store lock is an RLock and ``with``-scoped reacquire is
+   a supported idiom.
+
+2. **``lock-across-blocking``** — a lock held across a blocking
+   operation (``jax.device_get`` / ``.block_until_ready()`` — a full
+   device sync, multi-second on a busy chip —, ``os.fsync``, socket
+   send/recv verbs, ``time.sleep``) turns every waiter on that lock
+   into a waiter on the slow operation. The flush/ingest SLO rides on
+   the store lock being held only for host-memory work, so any
+   annotated region that transitively reaches a blocking op is flagged.
+
+Lock identity: the ``@requires_lock``/``@acquires_lock`` registry names
+the store lock ``"store"`` (rendered ``<store>``); any other ``with
+self.<attr>`` on a lock-shaped attribute is identified as
+``ClassName.<attr>`` (falling back to a site-unique id when the
+receiver cannot be resolved, so unrelated locks never alias into a
+false cycle). Call-graph reach reuses the purity pass's resolver plus
+the lock pass's light receiver inference; an unresolvable *method*
+call unions the summaries of every same-named method in the package
+when that set is small and unambiguous (bounded fan-out keeps this
+from flagging generic names).
+
+Suppress a deliberate hold with ``# lint: ok(lock-across-blocking)``
+on the ``with`` line (e.g. the checkpoint IO lock, whose entire job is
+to serialize a multi-second write+fsync behind a non-blocking probe),
+or a known-safe ordering with ``# lint: ok(lock-cycle)`` on one of the
+cycle's acquisition sites.
+
+``lock_graph(project)`` exposes the edges (and the lock→blocking-op
+reach) for ``python -m veneur_tpu.lint --json`` so future tooling can
+diff the graph per PR.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from veneur_tpu.lint.framework import (Finding, Project, SourceFile, dotted,
+                                       qualname, register)
+from veneur_tpu.lint import locks as locks_pass
+from veneur_tpu.lint import purity
+from veneur_tpu.lint.purity import walk_shallow
+
+# attribute-name shapes treated as locks even without a visible ctor
+import re
+
+_LOCK_ATTR_RE = re.compile(r"(^|_)(lock|gate|mutex)$")
+
+# method names too generic to union across classes when the receiver
+# cannot be resolved (unioning `.flush()` would drag every sink and
+# group flush into every region)
+_UNION_STOPLIST = {"flush", "run", "close", "start", "stop", "write",
+                   "read", "send", "get", "put", "add", "reset", "clear",
+                   "update", "append", "acquire", "release", "items",
+                   "values", "keys", "pop", "join", "wait", "count"}
+_UNION_MAX_DEFS = 8
+
+# socket verbs that block on the peer / kernel buffers ('.send' itself
+# is excluded: too many non-socket objects expose it)
+_SOCKET_VERBS = {"sendall", "sendto", "recvfrom", "recv_into", "recv",
+                 "accept", "connect"}
+
+FnKey = Tuple[str, str]
+
+
+def _blocking_op(node: ast.Call, jax_names: Set[str]) -> Optional[str]:
+    """Human-readable op name if this call blocks, else None."""
+    if not isinstance(node.func, ast.Attribute):
+        return None
+    attr = node.func.attr
+    name = dotted(node.func)
+    prefix = name.split(".")[0] if name else None
+    if attr == "block_until_ready":
+        return ".block_until_ready()"
+    if attr == "device_get" and (prefix in jax_names or prefix == "jax"):
+        return "jax.device_get()"
+    if attr == "fsync" and prefix == "os":
+        return "os.fsync()"
+    if attr == "sleep" and prefix == "time":
+        return "time.sleep()"
+    if attr in _SOCKET_VERBS:
+        return f"socket .{attr}()"
+    return None
+
+
+class _FnSummary:
+    __slots__ = ("acquires", "blocking", "callees")
+
+    def __init__(self):
+        # lock id -> (file, line) witness of the acquisition
+        self.acquires: Dict[str, Tuple[str, int]] = {}
+        # op name -> (file, line) witness
+        self.blocking: Dict[str, Tuple[str, int]] = {}
+        self.callees: Set[FnKey] = set()
+
+
+class _Analysis:
+    """One full lock-order analysis over a project."""
+
+    def __init__(self, project: Project):
+        self.project = project
+        self.fns = purity._collect_functions(project)
+        self.resolver = purity._Resolver(project, self.fns)
+        # class name -> annotation lock name ("store") when any method
+        # carries a locking decorator naming it
+        self.ann_lock: Dict[str, str] = {}
+        # class name -> known lock attribute names
+        self.lock_attrs: Dict[str, Set[str]] = {}
+        # method name -> FnKeys of class methods bearing it (union fallback)
+        self.method_defs: Dict[str, List[FnKey]] = {}
+        # plain (non-method) defs sharing a name make a union unsafe
+        self.plain_defs: Set[str] = set()
+        self._attr_types_cache: Dict[str, Dict] = {}
+        self._local_env_cache: Dict[ast.FunctionDef, Dict] = {}
+        self._jax_cache: Dict[str, Set[str]] = {}
+        self._collect_classes()
+        self.summaries: Dict[FnKey, _FnSummary] = {}
+        self._build_summaries()
+        self._close_summaries()
+
+    def _jax_names(self, sf: SourceFile) -> Set[str]:
+        """Per-file jax import aliases (import_aliases re-walks the
+        whole module AST — far too hot to call once per function)."""
+        if sf.relpath not in self._jax_cache:
+            self._jax_cache[sf.relpath] = purity._jax_aliases(sf)
+        return self._jax_cache[sf.relpath]
+
+    # -- class / lock discovery -------------------------------------------
+
+    def _collect_classes(self):
+        for sf in self.project.files.values():
+            parents = sf.parents
+            for node in ast.walk(sf.tree):
+                if isinstance(node, ast.FunctionDef):
+                    owner = parents.get(node)
+                    if isinstance(owner, ast.ClassDef):
+                        self.method_defs.setdefault(node.name, []).append(
+                            (sf.relpath, qualname(node, parents)))
+                        deco = locks_pass._lock_decoration(node)
+                        if deco:
+                            self.ann_lock.setdefault(owner.name, deco[1])
+                    else:
+                        self.plain_defs.add(node.name)
+                if not isinstance(node, ast.ClassDef):
+                    continue
+                self.lock_attrs.setdefault(node.name, set()).update(
+                    locks_pass.class_lock_attrs(node))
+
+    def lock_id(self, cls: Optional[str], attr: str, sf: SourceFile,
+                line: int) -> str:
+        """Stable identity for a lock acquisition site."""
+        if cls is not None:
+            ann = self.ann_lock.get(cls)
+            if ann and attr == "_lock":
+                return f"<{ann}>"
+            return f"{cls}.{attr}"
+        # unresolved receiver: site-unique id; never aliases two
+        # different locks into a fake cycle
+        return f"?{sf.relpath}:{line}.{attr}"
+
+    def _is_lock_expr(self, expr: ast.AST, cls: Optional[str]) -> bool:
+        name = dotted(expr)
+        if name is None:
+            return False
+        attr = name.split(".")[-1]
+        if _LOCK_ATTR_RE.search(attr):
+            return True
+        return cls is not None and attr in self.lock_attrs.get(cls, ())
+
+    def _with_locks(self, node: ast.With, cls: Optional[str],
+                    sf: SourceFile) -> List[str]:
+        out = []
+        for item in node.items:
+            expr = item.context_expr
+            if not self._is_lock_expr(expr, cls):
+                continue
+            name = dotted(expr)
+            attr = name.split(".")[-1]
+            parts = name.split(".")
+            owner = cls if (len(parts) == 2 and parts[0] == "self") else None
+            out.append(self.lock_id(owner, attr, sf, node.lineno))
+        return out
+
+    # -- call resolution ---------------------------------------------------
+
+    def _receiver_classes(self, call: ast.Call, sf: SourceFile,
+                          encl: Optional[ast.FunctionDef],
+                          cls: Optional[str]) -> Set[str]:
+        """Light receiver type inference (borrowed from lint/locks.py)."""
+        if not isinstance(call.func, ast.Attribute):
+            return set()
+        recv = call.func.value
+        if sf.relpath not in self._attr_types_cache:
+            self._attr_types_cache[sf.relpath] = \
+                locks_pass._class_attr_types(sf)
+        self_attrs = self._attr_types_cache[sf.relpath].get(cls, {}) \
+            if cls else {}
+        if isinstance(recv, ast.Attribute) \
+                and isinstance(recv.value, ast.Name) \
+                and recv.value.id == "self":
+            return set(self_attrs.get(recv.attr, set()))
+        if isinstance(recv, ast.Name) and encl is not None:
+            if encl not in self._local_env_cache:
+                all_classes = set(self.lock_attrs) | {
+                    k[1].split(".")[0] for k in self.fns if "." in k[1]}
+                self._local_env_cache[encl] = locks_pass._infer_locals(
+                    encl, self_attrs, all_classes)
+            return set(self._local_env_cache[encl].get(recv.id, set()))
+        return set()
+
+    def _callees(self, call: ast.Call, sf: SourceFile,
+                 encl: Optional[ast.FunctionDef], cls: Optional[str],
+                 scope: Optional[str]) -> List[FnKey]:
+        key = self.resolver.resolve(call.func, sf, cls, scope=scope)
+        if key is not None:
+            return [key]
+        if not isinstance(call.func, ast.Attribute):
+            return []
+        method = call.func.attr
+        rtypes = self._receiver_classes(call, sf, encl, cls)
+        if rtypes:
+            found = []
+            for t in rtypes:
+                for k in self.method_defs.get(method, ()):
+                    if k[1].split(".")[0] == t \
+                            and k[1].endswith("." + method):
+                        found.append(k)
+            if found:
+                return found
+            return []  # resolved to classes that don't define it
+        # unresolvable receiver: bounded union of same-named methods
+        if method in _UNION_STOPLIST or method in self.plain_defs:
+            return []
+        defs = self.method_defs.get(method, ())
+        if 0 < len(defs) <= _UNION_MAX_DEFS:
+            return list(defs)
+        return []
+
+    # -- summaries ---------------------------------------------------------
+
+    def _build_summaries(self):
+        for key, info in self.fns.items():
+            sf = info.sf
+            jax_names = self._jax_names(sf)
+            s = _FnSummary()
+            deco = locks_pass._lock_decoration(info.node)
+            if deco and deco[0] == "acquires":
+                s.acquires.setdefault(f"<{deco[1]}>",
+                                      (sf.relpath, info.node.lineno))
+            for node in walk_shallow(info.node):
+                if isinstance(node, ast.With):
+                    for lock in self._with_locks(node, info.cls, sf):
+                        s.acquires.setdefault(lock,
+                                              (sf.relpath, node.lineno))
+                elif isinstance(node, ast.Call):
+                    if isinstance(node.func, ast.Attribute) \
+                            and node.func.attr == "acquire" \
+                            and self._is_lock_expr(node.func.value,
+                                                   info.cls):
+                        name = dotted(node.func.value)
+                        parts = name.split(".")
+                        owner = info.cls if (len(parts) == 2
+                                             and parts[0] == "self") \
+                            else None
+                        lock = self.lock_id(owner, parts[-1], sf,
+                                            node.lineno)
+                        s.acquires.setdefault(lock,
+                                              (sf.relpath, node.lineno))
+                        continue
+                    op = _blocking_op(node, jax_names)
+                    if op:
+                        s.blocking.setdefault(op, (sf.relpath, node.lineno))
+                    else:
+                        encl = info.node
+                        for c in self._callees(node, sf, encl, info.cls,
+                                               info.qual):
+                            if c != key:
+                                s.callees.add(c)
+            self.summaries[key] = s
+
+    def _close_summaries(self):
+        """Propagate acquires/blocking up the call graph to a fixed
+        point (reverse-edge worklist)."""
+        callers: Dict[FnKey, Set[FnKey]] = {}
+        for key, s in self.summaries.items():
+            for c in s.callees:
+                if c in self.summaries:
+                    callers.setdefault(c, set()).add(key)
+        work = list(self.summaries)
+        pending = set(work)
+        while work:
+            key = work.pop()
+            pending.discard(key)
+            s = self.summaries[key]
+            changed = False
+            for c in s.callees:
+                cs = self.summaries.get(c)
+                if cs is None:
+                    continue
+                for lock, wit in cs.acquires.items():
+                    if lock not in s.acquires:
+                        s.acquires[lock] = wit
+                        changed = True
+                for op, wit in cs.blocking.items():
+                    if op not in s.blocking:
+                        s.blocking[op] = wit
+                        changed = True
+            if changed:
+                for caller in callers.get(key, ()):
+                    if caller not in pending:
+                        pending.add(caller)
+                        work.append(caller)
+
+    # -- regions -----------------------------------------------------------
+
+    def regions(self):
+        """Yield (held_lock_id, region_stmts, sf, cls, fn_info,
+        with_line_or_None) for every lexical lock-holding region."""
+        for key, info in self.fns.items():
+            sf = info.sf
+            deco = locks_pass._lock_decoration(info.node)
+            if deco and deco[0] == "requires":
+                yield (f"<{deco[1]}>", list(info.node.body), sf, info,
+                       info.node.lineno)
+            for node in walk_shallow(info.node):
+                if not isinstance(node, ast.With):
+                    continue
+                for lock in self._with_locks(node, info.cls, sf):
+                    yield lock, list(node.body), sf, info, node.lineno
+
+    def region_reach(self, held: str, body: List[ast.stmt],
+                     sf: SourceFile, info) -> Tuple[
+                         Dict[str, Tuple[str, int]],
+                         Dict[str, Tuple[str, int]]]:
+        """(acquired_locks, blocking_ops) reached from a held region,
+        each mapped to a (file, line) witness AT the region."""
+        acquired: Dict[str, Tuple[str, int]] = {}
+        blocking: Dict[str, Tuple[str, int]] = {}
+        jax_names = self._jax_names(sf)
+
+        def visit(stmts):
+            stack = list(stmts)
+            while stack:
+                node = stack.pop()
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                     ast.Lambda)):
+                    continue  # deferred execution: not under this hold
+                if isinstance(node, ast.With):
+                    for lock in self._with_locks(node, info.cls, sf):
+                        if lock != held:
+                            acquired.setdefault(
+                                lock, (sf.relpath, node.lineno))
+                if isinstance(node, ast.Call):
+                    op = _blocking_op(node, jax_names)
+                    if op:
+                        blocking.setdefault(op, (sf.relpath, node.lineno))
+                    elif isinstance(node.func, ast.Attribute) \
+                            and node.func.attr == "acquire" \
+                            and self._is_lock_expr(node.func.value,
+                                                   info.cls):
+                        name = dotted(node.func.value)
+                        parts = name.split(".")
+                        owner = info.cls if (len(parts) == 2
+                                             and parts[0] == "self") \
+                            else None
+                        lock = self.lock_id(owner, parts[-1], sf,
+                                            node.lineno)
+                        if lock != held:
+                            acquired.setdefault(
+                                lock, (sf.relpath, node.lineno))
+                    else:
+                        for c in self._callees(node, sf, info.node,
+                                               info.cls, info.qual):
+                            cs = self.summaries.get(c)
+                            if cs is None:
+                                continue
+                            for lock in cs.acquires:
+                                if lock != held:
+                                    acquired.setdefault(
+                                        lock, (sf.relpath, node.lineno))
+                            for op in cs.blocking:
+                                blocking.setdefault(
+                                    op, (sf.relpath, node.lineno))
+                stack.extend(ast.iter_child_nodes(node))
+
+        visit(body)
+        return acquired, blocking
+
+
+def _analyze(project: Project):
+    """(findings, graph) for one project; graph is the --json payload.
+    Memoized on the project instance: the runner needs both the
+    findings (the pass) and the graph (--json) from one traversal."""
+    cached = getattr(project, "_lockorder_result", None)
+    if cached is not None:
+        return cached
+    an = _Analysis(project)
+    findings: List[Finding] = []
+    # edge (A, B) -> witness dict
+    edges: Dict[Tuple[str, str], dict] = {}
+    blocked: Dict[Tuple[str, str, str], dict] = {}
+    suppressed_edges: Set[Tuple[str, str]] = set()
+
+    for held, body, sf, info, line in an.regions():
+        acquired, blocking = an.region_reach(held, body, sf, info)
+        for lock, (wfile, wline) in sorted(acquired.items()):
+            edge = (held, lock)
+            if edge not in edges:
+                edges[edge] = {"from": held, "to": lock, "file": wfile,
+                               "line": wline, "via": info.qual}
+            if sf.suppressed(line, "lock-cycle") \
+                    or sf.suppressed(wline, "lock-cycle"):
+                suppressed_edges.add(edge)
+        for op, (wfile, wline) in sorted(blocking.items()):
+            key = (held, info.qual, op)
+            if key in blocked:
+                continue
+            acknowledged = sf.suppressed(line, "lock-across-blocking") \
+                or sf.suppressed(wline, "lock-across-blocking")
+            # acknowledged holds stay in the diffable graph — they are
+            # real, just justified — but raise no finding
+            blocked[key] = {"lock": held, "op": op, "file": wfile,
+                            "line": wline, "via": info.qual,
+                            "acknowledged": acknowledged}
+            if acknowledged:
+                continue
+            findings.append(Finding(
+                pass_name="lock-order", code="lock-across-blocking",
+                file=sf.relpath, line=wline,
+                anchor=f"{info.qual}:{held}->{op}",
+                message=(f"{held} is held across {op} (reached from "
+                         f"{info.qual}); every waiter on the lock now "
+                         f"waits on the blocking op — move it outside "
+                         f"the hold or justify with "
+                         f"`# lint: ok(lock-across-blocking)`")))
+
+    # cycle detection over the lock edges (unique locks only; the
+    # site-unique '?' ids can never complete a cycle by construction)
+    adj: Dict[str, Set[str]] = {}
+    for (a, b) in edges:
+        adj.setdefault(a, set()).add(b)
+    seen_cycles: Set[frozenset] = set()
+    for start in sorted(adj):
+        # DFS bounded by the tiny lock alphabet
+        stack = [(start, [start])]
+        while stack:
+            node, path = stack.pop()
+            for nxt in sorted(adj.get(node, ())):
+                if nxt == start and len(path) > 1:
+                    cyc_edges = [(path[i], path[(i + 1) % len(path)])
+                                 for i in range(len(path))]
+                    # dedup on the EDGE set: A->B->C->A and its reverse
+                    # are distinct cycles over the same locks, and a
+                    # suppressed cycle must not shadow an unsuppressed
+                    # twin
+                    cyc = frozenset(cyc_edges)
+                    if cyc in seen_cycles:
+                        continue
+                    seen_cycles.add(cyc)
+                    if any(e in suppressed_edges for e in cyc_edges):
+                        continue
+                    w = edges[cyc_edges[0]]
+                    order = " -> ".join(path + [start])
+                    locks_in_cycle = sorted({a for a, _ in cyc_edges})
+                    findings.append(Finding(
+                        pass_name="lock-order", code="lock-cycle",
+                        file=w["file"], line=w["line"],
+                        anchor=f"cycle:{'->'.join(locks_in_cycle)}",
+                        message=(f"lock acquisition cycle {order}: these "
+                                 f"locks are taken in conflicting orders "
+                                 f"on different paths "
+                                 + "; ".join(
+                                     f"{a}->{b} at {edges[(a, b)]['file']}:"
+                                     f"{edges[(a, b)]['line']}"
+                                     for a, b in cyc_edges))))
+                elif nxt not in path:
+                    stack.append((nxt, path + [nxt]))
+
+    graph = {"edges": sorted(edges.values(),
+                             key=lambda e: (e["from"], e["to"])),
+             "blocking": sorted(blocked.values(),
+                                key=lambda e: (e["lock"], e["op"],
+                                               e["via"]))}
+    project._lockorder_result = (findings, graph)
+    return findings, graph
+
+
+def lock_graph(project: Project) -> dict:
+    """The acquisition graph for --json output / future diff tooling."""
+    return _analyze(project)[1]
+
+
+@register("lock-order")
+def run(project: Project) -> List[Finding]:
+    return _analyze(project)[0]

@@ -38,6 +38,26 @@ from veneur_tpu.lint.framework import (Finding, Project, SourceFile, dotted,
 
 _DECOS = {"requires_lock": "requires", "acquires_lock": "acquires"}
 
+# constructors that make a self-attribute a lock; shared by the
+# lock-order and lockset passes so they can never disagree about which
+# classes own locks
+LOCK_CTORS = {"Lock", "RLock", "Condition", "Semaphore", "BoundedSemaphore"}
+
+
+def class_lock_attrs(cls: ast.ClassDef) -> Set[str]:
+    """Lock attributes ``cls`` assigns to self anywhere in its body."""
+    out: Set[str] = set()
+    for node in ast.walk(cls):
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            ctor = dotted(node.value.func)
+            if ctor and ctor.split(".")[-1] in LOCK_CTORS:
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Attribute) \
+                            and isinstance(tgt.value, ast.Name) \
+                            and tgt.value.id == "self":
+                        out.add(tgt.attr)
+    return out
+
 
 def _lock_decoration(fn: ast.FunctionDef) -> Optional[Tuple[str, str]]:
     """('requires'|'acquires', lock_name) if the def carries one."""

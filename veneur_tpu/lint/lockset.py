@@ -1,0 +1,469 @@
+"""Eraser-style lockset analysis: one static pass, one runtime detector.
+
+The lock-discipline pass checks *annotated* mutators; TSan-lite v1
+checked the same contract at runtime. Neither could see a race on a
+field that has no annotated accessor at all — a telemetry counter
+bumped from two threads, a controller watermark rewritten from a reader
+loop. This module closes that hole with the classic lockset algorithm
+(Savage et al., "Eraser: A Dynamic Data Race Detector for Multithreaded
+Programs", TOCS 1997): every shared field has a *candidate lockset*,
+refined to the intersection of the locks held at each access; an empty
+lockset on a shared, written field means no lock consistently protects
+it.
+
+**Static half** (the ``lockset`` pass): for every class that owns a
+lock (a ``threading.Lock``/``RLock``/... assigned to ``self`` in the
+class body), every ``self.<field>`` write site outside ``__init__`` is
+collected with the set of the class's locks *lexically* held there
+(``with self._lock:`` blocks; ``@requires_lock`` bodies count as
+holding the annotated lock). The candidate lockset of a field is the
+intersection across its write sites; a field whose lockset is empty
+even though SOME site holds a lock is flagged ``inconsistent-lockset``
+— the classic "mostly locked" bug shape. Fields written only unlocked
+are presumed thread-confined (flagging them would bury the signal);
+establishing writes in ``__init__`` are ignored, as Eraser's
+initialization state machine prescribes. Suppress a deliberate
+off-lock write with ``# lint: ok(inconsistent-lockset) <why>``.
+
+**Runtime half** (:class:`FieldRaceRecorder`): instruments live
+objects (store groups, ``OverloadController``, ``ComputeBreaker``,
+``Checkpointer`` — anything handed to :meth:`instrument`) by swapping
+in a subclass whose ``__getattribute__``/``__setattr__`` feed every
+tracked-field access into the per-field Eraser state machine
+(virgin → exclusive → shared → shared-modified), with lock ownership
+observed through :class:`TrackedLock` proxies. A write to a shared
+field with an empty candidate lockset is reported with BOTH stacks —
+the remembered prior access and the racing write. Reporting is
+write-biased: a lone unlocked *read* only refines the lockset (under
+the GIL a single attribute read cannot tear, and flagging the
+read-after-join idiom would drown real races). Mutations on retired
+flush generations are exempt (``_retired``), mirroring TSan-lite.
+``lint/tsan.py``'s :class:`LockStateRecorder` arms one of these over
+the store automatically, so the tier-1 TSan tests run genuine data-race
+detection across the generation-swap and requeue paths.
+"""
+
+from __future__ import annotations
+
+import ast
+import sys
+import threading
+from dataclasses import dataclass, field as dc_field
+from typing import Dict, FrozenSet, List, Optional, Set, Tuple
+
+from veneur_tpu.lint.framework import (Finding, Project, dotted, qualname,
+                                       register)
+from veneur_tpu.lint import locks as locks_pass
+from veneur_tpu.lint.locks import class_lock_attrs as _class_locks
+
+
+# ---------------------------------------------------------------------------
+# static pass
+# ---------------------------------------------------------------------------
+
+
+def _held_at(node: ast.AST, parents, lock_attrs: Set[str],
+             ann_lock_attr: Optional[str]) -> FrozenSet[str]:
+    """The class's locks lexically held at ``node``."""
+    held: Set[str] = set()
+    cur = parents.get(node)
+    while cur is not None:
+        if isinstance(cur, ast.With):
+            for item in cur.items:
+                name = dotted(item.context_expr)
+                if name and name.startswith("self.") \
+                        and name.split(".")[-1] in lock_attrs:
+                    held.add(name.split(".")[-1])
+        if isinstance(cur, ast.FunctionDef):
+            deco = locks_pass._lock_decoration(cur)
+            if deco and deco[0] == "requires" and ann_lock_attr:
+                held.add(ann_lock_attr)
+        cur = parents.get(cur)
+    return frozenset(held)
+
+
+def _self_field_writes(fn: ast.FunctionDef):
+    """(field, node) pairs for every ``self.X`` write (incl. augmented
+    and subscript/content writes) inside ``fn``."""
+    for node in ast.walk(fn):
+        targets = []
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+        elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+            targets = [node.target]
+        for tgt in targets:
+            base = tgt
+            while isinstance(base, ast.Subscript):
+                base = base.value
+            if isinstance(base, ast.Attribute) \
+                    and isinstance(base.value, ast.Name) \
+                    and base.value.id == "self":
+                yield base.attr, node
+
+
+@register("lockset")
+def run(project: Project) -> List[Finding]:
+    findings: List[Finding] = []
+    for sf in project.files.values():
+        parents = sf.parents
+        for cls in ast.walk(sf.tree):
+            if not isinstance(cls, ast.ClassDef):
+                continue
+            lock_attrs = _class_locks(cls)
+            if not lock_attrs:
+                continue
+            # a @requires_lock/@acquires_lock class maps its annotation
+            # onto "_lock" by convention (see lint/locks.py)
+            ann_attr = None
+            for m in cls.body:
+                if isinstance(m, ast.FunctionDef) \
+                        and locks_pass._lock_decoration(m) \
+                        and "_lock" in lock_attrs:
+                    ann_attr = "_lock"
+                    break
+            # field -> [(held, line, qual, suppressed)]
+            sites: Dict[str, List[Tuple[FrozenSet[str], int, str, bool]]] = {}
+            for m in cls.body:
+                if not isinstance(m, ast.FunctionDef):
+                    continue
+                if m.name in ("__init__", "__new__", "__post_init__"):
+                    continue  # establishing writes (Eraser's init state)
+                for fieldname, node in _self_field_writes(m):
+                    if fieldname in lock_attrs:
+                        continue  # rebinding a lock is plumbing, not data
+                    held = _held_at(node, parents, lock_attrs, ann_attr)
+                    supp = sf.suppressed(node.lineno, "inconsistent-lockset")
+                    sites.setdefault(fieldname, []).append(
+                        (held, node.lineno, qualname(node, parents), supp))
+            for fieldname, accesses in sorted(sites.items()):
+                live = [a for a in accesses if not a[3]]
+                if not live:
+                    continue
+                lockset = frozenset.intersection(*[a[0] for a in live])
+                ever_locked = any(a[0] for a in live)
+                if lockset or not ever_locked:
+                    continue
+                unlocked = [a for a in live if not a[0]] or live
+                lines = ", ".join(f"{a[2]}:{a[1]}" for a in unlocked[:4])
+                findings.append(Finding(
+                    pass_name="lockset", code="inconsistent-lockset",
+                    file=sf.relpath, line=unlocked[0][1],
+                    anchor=f"{cls.name}.{fieldname}",
+                    message=(
+                        f"{cls.name}.{fieldname} has an empty candidate "
+                        f"lockset: written under {sorted(lock_attrs)} at "
+                        f"some sites but with no common lock at {lines} — "
+                        f"hold the lock there or justify with "
+                        f"`# lint: ok(inconsistent-lockset)`")))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# runtime detector
+# ---------------------------------------------------------------------------
+
+
+class TrackedLock:
+    """Delegating lock proxy that records per-thread ownership so the
+    recorder can compute the lockset at each field access. Supports the
+    full Lock/RLock surface the codebase uses (``with``, ``acquire``
+    with blocking/timeout, ``_is_owned`` for TSan-lite)."""
+
+    def __init__(self, inner, name: str, recorder: "FieldRaceRecorder"):
+        self._inner = inner
+        self._name = name
+        self._rec = recorder
+
+    def acquire(self, *args, **kwargs):
+        got = self._inner.acquire(*args, **kwargs)
+        if got:
+            self._rec._note_acquire(self._name)
+        return got
+
+    def release(self):
+        self._inner.release()
+        self._rec._note_release(self._name)
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    def locked(self):
+        return self._inner.locked()
+
+    def _is_owned(self):
+        is_owned = getattr(self._inner, "_is_owned", None)
+        if is_owned is not None:
+            return is_owned()
+        return self._inner.locked()
+
+
+@dataclass
+class RaceReport:
+    """One racy pair, with both sides' stacks (Eraser's report shape)."""
+
+    owner: str          # instrumented object label
+    field: str
+    first_thread: str
+    first_op: str       # "read" | "write"
+    first_stack: List[str]
+    second_thread: str
+    second_stack: List[str]
+    locks_held: FrozenSet[str] = dc_field(default_factory=frozenset)
+
+    def __str__(self):
+        a = "\n      ".join(self.first_stack[-4:])
+        b = "\n      ".join(self.second_stack[-4:])
+        return (f"race on {self.owner}.{self.field}: no common lock "
+                f"protects it\n  first:  {self.first_op} on thread "
+                f"{self.first_thread}\n      {a}\n  second: write on "
+                f"thread {self.second_thread} (locks held: "
+                f"{sorted(self.locks_held) or 'none'})\n      {b}")
+
+
+_VIRGIN, _EXCLUSIVE, _SHARED, _SHARED_MOD = range(4)
+
+
+class _FieldState:
+    __slots__ = ("state", "owner", "lockset", "last_thread", "last_stack",
+                 "last_was_write", "other_thread", "other_stack",
+                 "other_was_write", "reported")
+
+    def __init__(self):
+        self.state = _VIRGIN
+        self.owner = None
+        self.lockset: Optional[FrozenSet[str]] = None  # None == top (all)
+        self.last_thread = ""
+        self.last_stack: List[str] = []
+        self.last_was_write = False
+        # most recent access by a thread OTHER than the current one —
+        # the "first" side of a reported racy pair
+        self.other_thread = ""
+        self.other_stack: List[str] = []
+        self.other_was_write = False
+        self.reported = False
+
+
+def _stack(skip: int = 3) -> List[str]:
+    """Innermost-last caller stack, cheap enough for per-access capture
+    (sys._getframe walk, no linecache / traceback machinery)."""
+    out: List[str] = []
+    try:
+        f = sys._getframe(skip)
+    except ValueError:  # pragma: no cover - shallow stack
+        return out
+    while f is not None and len(out) < 8:
+        code = f.f_code
+        out.append(f"{code.co_filename.rsplit('/', 1)[-1]}:{f.f_lineno} "
+                   f"in {code.co_name}")
+        f = f.f_back
+    out.reverse()
+    return out
+
+
+class FieldRaceRecorder:
+    """Eraser-style per-field lockset refinement over live objects."""
+
+    def __init__(self):
+        self._slock = threading.Lock()
+        self._tls = threading.local()
+        self._state: Dict[Tuple[int, str], _FieldState] = {}
+        self._labels: Dict[int, str] = {}
+        self.races: List[RaceReport] = []
+        self._instrumented: List[tuple] = []   # (obj, original class)
+        self._locks: List[tuple] = []          # (owner, attr, original)
+
+    # -- lock tracking -----------------------------------------------------
+
+    def track_lock(self, owner, attr: str, name: str) -> TrackedLock:
+        """Replace ``owner.<attr>`` with a TrackedLock proxy named
+        ``name``; restored by :meth:`restore`."""
+        inner = object.__getattribute__(owner, "__dict__").get(attr) \
+            if hasattr(owner, "__dict__") else getattr(owner, attr)
+        if isinstance(inner, TrackedLock):
+            return inner
+        proxy = TrackedLock(inner, name, self)
+        object.__setattr__(owner, attr, proxy)
+        self._locks.append((owner, attr, inner))
+        return proxy
+
+    def _held_map(self) -> Dict[str, int]:
+        m = getattr(self._tls, "held", None)
+        if m is None:
+            m = self._tls.held = {}
+        return m
+
+    def _note_acquire(self, name: str):
+        m = self._held_map()
+        m[name] = m.get(name, 0) + 1
+
+    def _note_release(self, name: str):
+        m = self._held_map()
+        depth = m.get(name, 0) - 1
+        if depth <= 0:
+            m.pop(name, None)
+        else:
+            m[name] = depth
+
+    def held(self) -> FrozenSet[str]:
+        return frozenset(self._held_map())
+
+    # -- instrumentation ---------------------------------------------------
+
+    def instrument(self, obj, label: Optional[str] = None,
+                   fields: Optional[Set[str]] = None):
+        """Track ``obj``'s data fields. Default: every non-callable,
+        non-lock entry in its ``__dict__`` right now, plus simple-data
+        class-attribute defaults (the ``spilled = 0`` lazy-counter
+        idiom) — those materialize as instance fields on first write.
+        ``_retired`` is never tracked: it is the exemption flag the
+        state machine itself consults."""
+        if fields is None:
+            fields = set()
+            candidates: Dict[str, object] = {}
+            for klass in reversed(type(obj).__mro__):
+                candidates.update(vars(klass))
+            candidates.update(vars(obj))
+            for k, v in candidates.items():
+                if k.startswith("_eraser") or k.startswith("__") \
+                        or k == "_retired":
+                    continue
+                if callable(v) or isinstance(v, (property, classmethod,
+                                                 staticmethod)):
+                    continue
+                if hasattr(v, "acquire") and hasattr(v, "release"):
+                    continue  # locks are the instruments, not the data
+                if k in vars(obj) or isinstance(
+                        v, (int, float, bool, str, bytes, type(None))):
+                    fields.add(k)
+        cls = type(obj)
+        if getattr(cls, "_eraser_shim_", False):
+            cls = cls.__mro__[1]
+        shim = _shim_class(cls)
+        self._labels[id(obj)] = label or cls.__name__
+        object.__setattr__(obj, "_eraser_fields_", frozenset(fields))
+        object.__setattr__(obj, "_eraser_rec_", self)
+        object.__setattr__(obj, "__class__", shim)
+        self._instrumented.append((obj, cls))
+
+    def restore(self):
+        for obj, cls in self._instrumented:
+            object.__setattr__(obj, "__class__", cls)
+            for k in ("_eraser_fields_", "_eraser_rec_"):
+                try:
+                    object.__delattr__(obj, k)
+                except AttributeError:
+                    pass
+        self._instrumented.clear()
+        for owner, attr, inner in self._locks:
+            object.__setattr__(owner, attr, inner)
+        self._locks.clear()
+
+    # -- the Eraser state machine -----------------------------------------
+
+    def _on_access(self, obj, fieldname: str, is_write: bool):
+        if getattr(self._tls, "busy", False):
+            return  # re-entrant access from our own bookkeeping
+        self._tls.busy = True
+        try:
+            self._record(obj, fieldname, is_write)
+        finally:
+            self._tls.busy = False
+
+    def _record(self, obj, fieldname: str, is_write: bool):
+        if getattr(obj, "_retired", False):
+            return  # retired generations are exclusively owned by design
+        thread = threading.current_thread().name
+        held = self.held()
+        key = (id(obj), fieldname)
+        with self._slock:
+            st = self._state.get(key)
+            if st is None:
+                st = self._state[key] = _FieldState()
+            if st.state == _VIRGIN:
+                st.state = _EXCLUSIVE
+                st.owner = thread
+            if st.last_thread and st.last_thread != thread:
+                st.other_thread = st.last_thread
+                st.other_stack = st.last_stack
+                st.other_was_write = st.last_was_write
+            if st.state == _EXCLUSIVE:
+                if thread == st.owner:
+                    st.last_thread = thread
+                    st.last_stack = _stack()
+                    st.last_was_write = is_write
+                    return
+                # second thread: leave the initialization state and
+                # start refining from this access's lockset
+                st.state = _SHARED_MOD if (is_write or st.last_was_write) \
+                    else _SHARED
+                st.lockset = held
+            else:
+                st.lockset = (st.lockset & held
+                              if st.lockset is not None else held)
+                if is_write:
+                    st.state = _SHARED_MOD
+            race = (is_write and st.state == _SHARED_MOD
+                    and st.lockset is not None and not st.lockset
+                    and not st.reported)
+            if race:
+                st.reported = True
+                self.races.append(RaceReport(
+                    owner=self._labels.get(id(obj), type(obj).__name__),
+                    field=fieldname,
+                    first_thread=st.other_thread,
+                    first_op="write" if st.other_was_write else "read",
+                    first_stack=list(st.other_stack),
+                    second_thread=thread,
+                    second_stack=_stack(),
+                    locks_held=held))
+            st.last_thread = thread
+            st.last_stack = _stack()
+            st.last_was_write = is_write
+
+    def assert_no_races(self):
+        if self.races:
+            lines = "\n".join(str(r) for r in self.races[:10])
+            raise AssertionError(
+                f"lockset detector: {len(self.races)} data race(s):\n"
+                f"{lines}")
+
+
+_SHIM_CACHE: Dict[type, type] = {}
+
+
+def _shim_class(cls: type) -> type:
+    """Subclass of ``cls`` routing tracked-field access through the
+    instance's recorder (stored via object.__setattr__, so the shim
+    itself never recurses)."""
+    shim = _SHIM_CACHE.get(cls)
+    if shim is not None:
+        return shim
+
+    def __getattribute__(self, name):
+        if not name.startswith("_eraser"):
+            d = object.__getattribute__(self, "__dict__")
+            rec = d.get("_eraser_rec_")
+            if rec is not None and name in d.get("_eraser_fields_", ()):
+                rec._on_access(self, name, False)
+        return object.__getattribute__(self, name)
+
+    def __setattr__(self, name, value):
+        if not name.startswith("_eraser"):
+            d = object.__getattribute__(self, "__dict__")
+            rec = d.get("_eraser_rec_")
+            if rec is not None and name in d.get("_eraser_fields_", ()):
+                rec._on_access(self, name, True)
+        object.__setattr__(self, name, value)
+
+    shim = type(f"Eraser{cls.__name__}", (cls,), {
+        "__getattribute__": __getattribute__,
+        "__setattr__": __setattr__,
+        "_eraser_shim_": True,
+    })
+    _SHIM_CACHE[cls] = shim
+    return shim

@@ -1,0 +1,596 @@
+"""Recompile-hazard pass: static args must come from bounded value sets.
+
+Every distinct static-argument tuple (and every distinct input shape)
+handed to a ``jax.jit``/``pmap``/Pallas program compiles a fresh XLA
+executable — ~20-40s on TPU — and lives in the trace cache forever.
+A static arg derived from an *unbounded* runtime value (a batch length,
+a queue depth, a live-row count) therefore turns production traffic
+into a compile storm: the classic trace-cache-explosion failure mode of
+JAX serving stacks. The codebase's defense is the **bucketing ladder**
+(``veneur_tpu/core/bucketing.py``): pow2 rounding collapses any integer
+into a log-bounded set, so the compiled-variant count stays ~log2 of
+the largest value ever seen.
+
+This pass walks every call site of every compiled program — functions
+decorated ``@jax.jit`` / ``@partial(jax.jit, ...)`` and programs bound
+via ``name = jax.jit(fn, static_argnums=...)`` (module-level,
+function-local, and ``self._prog = jax.jit(...)`` bindings) — and
+classifies each expression flowing into a ``static_argnums``/
+``static_argnames`` position:
+
+====================  ==================================================
+``const``             literals; module-level constants
+``bool``              ``bool()``, ``not``, comparisons — two values
+``config``            ``self.<attr>`` reads (set at construction, pow2-
+                      grown capacities included: the growers are
+                      bucketed)
+``bucketed``          flows through an ``@bucketed`` ladder function or
+                      ``.bit_length()`` (log-bounded by construction)
+``opaque``            can't be traced further (unresolvable call,
+                      foreign param) — NOT flagged; listed in the
+                      inventory so reviewers see the blind spot
+``UNBOUNDED``         derived from ``len()`` / ``.shape`` / ``.size`` /
+                      ``.sum()`` / ``.qsize()`` … with no bucketing
+                      ladder on the path — **flagged**
+====================  ==================================================
+
+Findings: ``unbounded-static-arg`` for a hazardous static arg, and
+``unbounded-shape`` for a *traced* argument sliced to a hazardous
+length at the call site (``prog(x[:n])`` retraces per distinct ``n``;
+slice staging buffers to a pow2 prefix instead, as the drains do).
+Parameters of ordinary functions classify by joining their own call
+sites, so a helper threading a bucketed length through to the program
+does not flag. Suppress a deliberate edge with
+``# lint: ok(unbounded-static-arg)`` / ``# lint: ok(unbounded-shape)``.
+
+The pass also renders the **compiled-program inventory** — program ×
+static-arg × observed source classes — and checks it into
+``docs/static-analysis.md`` between the ``programs-inventory`` markers
+(``python -m veneur_tpu.lint --programs-table`` regenerates it), so
+trace-cache growth is reviewable per PR; drift is the
+``inventory-drift`` finding.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from veneur_tpu.lint.framework import (Finding, Project, SourceFile, dotted,
+                                       qualname, register)
+from veneur_tpu.lint import purity
+from veneur_tpu.lint.purity import walk_shallow
+
+FnKey = Tuple[str, str]
+
+# severity-ordered classification lattice
+CONST, BOOL, CONFIG, BUCKETED, OPAQUE, UNBOUNDED = (
+    "const", "bool", "config", "bucketed", "opaque", "UNBOUNDED")
+_RANK = {CONST: 0, BOOL: 0, CONFIG: 1, BUCKETED: 1, OPAQUE: 2, UNBOUNDED: 3}
+
+# attribute reads that yield runtime-data-dependent integers
+_HAZARD_ATTRS = {"shape", "size", "nbytes"}
+# method calls on arbitrary receivers that yield data-dependent values
+_HAZARD_METHODS = {"sum", "max", "min", "qsize", "item", "tolist",
+                   "__len__"}
+# builtins whose result is data-sized
+_HAZARD_BUILTINS = {"len"}
+# bounded regardless of argument (rank / dtype / log-bounded)
+_BOUNDED_ATTRS = {"ndim", "dtype"}
+
+_MARKER_BEGIN = "<!-- generated: programs-inventory begin -->"
+_MARKER_END = "<!-- generated: programs-inventory end -->"
+
+
+def _is_jit_call(node: ast.Call, jax_names: Set[str]) -> bool:
+    fname = dotted(node.func)
+    if fname is None:
+        return False
+    parts = fname.split(".")
+    return parts[-1] in ("jit", "pmap") and (
+        len(parts) == 1 or parts[0] in jax_names or parts[0] == "jax")
+
+
+class _Program:
+    """One compiled program: the target function + its static params."""
+
+    def __init__(self, key: FnKey, static: Set[str], via: str):
+        self.key = key
+        self.static = static          # static parameter NAMES
+        self.via = via                # how it compiles (decorator/binding)
+        # param name -> {classification labels observed at call sites}
+        self.observed: Dict[str, Set[str]] = {p: set() for p in
+                                              sorted(static)}
+        self.call_sites = 0
+
+
+class _Pass:
+    def __init__(self, project: Project):
+        self.project = project
+        self.fns = purity._collect_functions(project)
+        self.resolver = purity._Resolver(project, self.fns)
+        self._jax_cache: Dict[str, Set[str]] = {}
+        self._mconst_cache: Dict[str, Set[str]] = {}
+        self.programs: Dict[FnKey, _Program] = {}
+        # (relpath, scope_qual_or_None, name) -> program key for
+        # name-bound programs;  (relpath, class, attr) for self-bindings
+        self.name_bindings: Dict[Tuple[str, Optional[str], str], FnKey] = {}
+        self.attr_bindings: Dict[Tuple[str, str, str], FnKey] = {}
+        # bucketed ladder functions: FnKey -> scheme
+        self.bucketed: Dict[FnKey, str] = {}
+        self.bucketed_names: Set[str] = set()
+        # reverse call index for param classification
+        self._callers: Dict[FnKey, List[Tuple[ast.Call, "_Ctx"]]] = {}
+        self._param_memo: Dict[Tuple[FnKey, str], str] = {}
+        self._param_stack: Set[Tuple[FnKey, str]] = set()
+        self.findings: List[Finding] = []
+        self._collect()
+        # functions that execute under a trace (jit roots + everything
+        # they call with traced args, per the purity pass): inside them
+        # `.shape` & friends are trace-time CONSTANTS — the enclosing
+        # program's own trace key already bounds them — not new hazards
+        summaries = purity._Summaries(self.fns, self.resolver)
+        hot = purity._find_hot_roots(self.project, self.fns, self.resolver)
+        purity._propagate(self.fns, hot, self.resolver, summaries)
+        self.traced_fns: Set[FnKey] = set(hot) | set(self.programs)
+
+    def _jax_names(self, sf: SourceFile) -> Set[str]:
+        if sf.relpath not in self._jax_cache:
+            self._jax_cache[sf.relpath] = purity._jax_aliases(sf)
+        return self._jax_cache[sf.relpath]
+
+    # -- collection --------------------------------------------------------
+
+    def _collect(self):
+        for sf in self.project.files.values():
+            parents = sf.parents
+            jax_names = self._jax_names(sf)
+            for node in ast.walk(sf.tree):
+                if isinstance(node, ast.FunctionDef):
+                    for dec in node.decorator_list:
+                        name = dotted(dec) if not isinstance(dec, ast.Call) \
+                            else dotted(dec.func)
+                        if name and name.split(".")[-1] == "bucketed":
+                            key = (sf.relpath, qualname(node, parents))
+                            scheme = "custom"
+                            if isinstance(dec, ast.Call) and dec.args and \
+                                    isinstance(dec.args[0], ast.Constant):
+                                scheme = str(dec.args[0].value)
+                            self.bucketed[key] = scheme
+                            self.bucketed_names.add(node.name)
+                    kwargs = purity._jit_decoration(node)
+                    if kwargs is not None:
+                        key = (sf.relpath, qualname(node, parents))
+                        info = self.fns[key]
+                        static = purity._static_params(
+                            kwargs, info.params + info.kwonly)
+                        if static:
+                            self.programs.setdefault(key, _Program(
+                                key, static, via="decorator"))
+                elif isinstance(node, ast.Assign) \
+                        and isinstance(node.value, ast.Call) \
+                        and _is_jit_call(node.value, jax_names) \
+                        and node.value.args:
+                    self._bind(node, sf, parents)
+
+    def _bind(self, node: ast.Assign, sf: SourceFile, parents):
+        call = node.value
+        encl_cls = None
+        cur = parents.get(node)
+        while cur is not None:
+            if isinstance(cur, ast.ClassDef):
+                encl_cls = cur.name
+                break
+            cur = parents.get(cur)
+        scope = qualname(node, parents)
+        target_key = None
+        for ref in purity._fn_refs(call.args[0]):
+            target_key = self.resolver.resolve(
+                ref, sf, encl_cls, scope=scope or None)
+            if target_key is not None:
+                break
+        if target_key is None:
+            return
+        info = self.fns[target_key]
+        static = purity._static_params(call.keywords,
+                                       info.params + info.kwonly)
+        if not static:
+            return
+        prog = self.programs.setdefault(
+            target_key, _Program(target_key, static, via="binding"))
+        prog.static |= static
+        for p in sorted(static):
+            prog.observed.setdefault(p, set())
+        for tgt in node.targets:
+            if isinstance(tgt, ast.Name):
+                self.name_bindings[(sf.relpath,
+                                    scope if scope != "<module>" else None,
+                                    tgt.id)] = target_key
+            elif isinstance(tgt, ast.Attribute) \
+                    and isinstance(tgt.value, ast.Name) \
+                    and tgt.value.id == "self" and encl_cls:
+                self.attr_bindings[(sf.relpath, encl_cls,
+                                    tgt.attr)] = target_key
+
+    # -- classification ----------------------------------------------------
+
+    def _module_consts(self, sf: SourceFile) -> Set[str]:
+        cached = self._mconst_cache.get(sf.relpath)
+        if cached is not None:
+            return cached
+        out = set()
+        for stmt in sf.tree.body:
+            if isinstance(stmt, ast.Assign) \
+                    and isinstance(stmt.value, ast.Constant):
+                for tgt in stmt.targets:
+                    if isinstance(tgt, ast.Name):
+                        out.add(tgt.id)
+        self._mconst_cache[sf.relpath] = out
+        return out
+
+    def classify(self, expr: ast.AST, ctx: "_Ctx", depth: int = 0) -> str:
+        if depth > 12:
+            return OPAQUE
+        c = lambda e: self.classify(e, ctx, depth + 1)
+        if isinstance(expr, ast.Constant):
+            return CONST
+        if isinstance(expr, ast.Attribute):
+            if expr.attr in _HAZARD_ATTRS:
+                return CONST if ctx.key in self.traced_fns else UNBOUNDED
+            if expr.attr in _BOUNDED_ATTRS:
+                return CONST
+            if isinstance(expr.value, ast.Name) \
+                    and expr.value.id == "self":
+                return CONFIG
+            return OPAQUE
+        if isinstance(expr, ast.Name):
+            return self._classify_name(expr.id, ctx, depth)
+        if isinstance(expr, ast.Subscript):
+            # cfg["key"] / shape[0]: the container's class carries over
+            return c(expr.value)
+        if isinstance(expr, (ast.Compare, ast.BoolOp)):
+            return BOOL
+        if isinstance(expr, ast.UnaryOp):
+            if isinstance(expr.op, ast.Not):
+                return BOOL
+            return c(expr.operand)
+        if isinstance(expr, ast.IfExp):
+            return _join(c(expr.body), c(expr.orelse))
+        if isinstance(expr, ast.BinOp):
+            return _join(c(expr.left), c(expr.right))
+        if isinstance(expr, (ast.Tuple, ast.List)):
+            return _join(*[c(e) for e in expr.elts]) if expr.elts else CONST
+        if isinstance(expr, ast.Call):
+            return self._classify_call(expr, ctx, depth)
+        return OPAQUE
+
+    def _classify_name(self, name: str, ctx: "_Ctx", depth: int) -> str:
+        bound = ctx._param_classes.get(name)
+        if bound is not None:
+            # a one-level callee analysis bound this param to the class
+            # of the actual argument at the call site
+            return bound
+        assigns = ctx.assignments().get(name)
+        if assigns:
+            return _join(*[self.classify(v, ctx, depth + 1)
+                           for v in assigns])
+        if name in ctx.fn_params():
+            return self._classify_param(ctx.key, name)
+        if name in self._module_consts(ctx.sf):
+            return CONST
+        if name in ("True", "False", "None"):
+            return CONST
+        return OPAQUE
+
+    def _classify_call(self, call: ast.Call, ctx: "_Ctx",
+                       depth: int) -> str:
+        fname = dotted(call.func)
+        base = fname.split(".")[-1] if fname else None
+        if base == "bool":
+            return BOOL
+        if base in _HAZARD_BUILTINS:
+            return UNBOUNDED
+        if isinstance(call.func, ast.Attribute):
+            if call.func.attr == "bit_length":
+                return BUCKETED
+            if call.func.attr in _HAZARD_METHODS:
+                return UNBOUNDED
+        if base == "int" and call.args:
+            return self.classify(call.args[0], ctx, depth + 1)
+        args = [self.classify(a, ctx, depth + 1) for a in call.args]
+        if base == "min" and len(call.args) > 1:
+            # min(unbounded, bounded) is bounded by the smaller set
+            if any(_RANK[a] <= _RANK[CONFIG] for a in args):
+                return BUCKETED if BUCKETED in args else \
+                    min(args, key=lambda a: _RANK[a])
+            return _join(*args)
+        if base == "max" and len(call.args) > 1:
+            return _join(*args)
+        key = self.resolver.resolve(call.func, ctx.sf, ctx.cls,
+                                    scope=ctx.qual)
+        if key is None:
+            if base in self.bucketed_names:
+                return BUCKETED
+            return OPAQUE
+        if key in self.bucketed:
+            return BUCKETED
+        info = self.fns.get(key)
+        if info is None:
+            return OPAQUE
+        # one-level return-expression classification in the callee,
+        # with the callee's params bound to this call's arg classes
+        bound = {}
+        for i, a in enumerate(call.args):
+            if i < len(info.params):
+                bound[info.params[i]] = (
+                    args[i] if i < len(args)
+                    else self.classify(a, ctx, depth + 1))
+        for kw in call.keywords:
+            if kw.arg:
+                bound[kw.arg] = self.classify(kw.value, ctx, depth + 1)
+        callee_ctx = _Ctx(self, info, param_classes=bound)
+        results = []
+        for node in walk_shallow(info.node):
+            if isinstance(node, ast.Return) and node.value is not None:
+                results.append(self.classify(node.value, callee_ctx,
+                                             depth + 1))
+        return _join(*results) if results else OPAQUE
+
+    def _classify_param(self, key: FnKey, param: str) -> str:
+        memo_key = (key, param)
+        if memo_key in self._param_memo:
+            return self._param_memo[memo_key]
+        if memo_key in self._param_stack:
+            return OPAQUE
+        self._param_stack.add(memo_key)
+        try:
+            info = self.fns.get(key)
+            sites = self._callers.get(key, ())
+            results = []
+            for call, ctx in sites:
+                idx = None
+                for i, p in enumerate(info.params):
+                    if p == param:
+                        idx = i
+                        break
+                expr = None
+                if idx is not None and idx < len(call.args) \
+                        and not isinstance(call.args[idx], ast.Starred):
+                    expr = call.args[idx]
+                else:
+                    for kw in call.keywords:
+                        if kw.arg == param:
+                            expr = kw.value
+                if expr is not None:
+                    results.append(self.classify(expr, ctx, 1))
+            out = _join(*results) if results else OPAQUE
+        finally:
+            self._param_stack.discard(memo_key)
+        self._param_memo[memo_key] = out
+        return out
+
+    # -- call-site walk ----------------------------------------------------
+
+    def _program_for_call(self, call: ast.Call, sf: SourceFile,
+                          cls: Optional[str],
+                          scope: Optional[str]) -> Optional[_Program]:
+        key = self.resolver.resolve(call.func, sf, cls, scope=scope)
+        if key is not None and key in self.programs:
+            return self.programs[key]
+        if isinstance(call.func, ast.Name):
+            # innermost binding scope first, then module level
+            prefix = scope.split(".") if scope else []
+            while prefix:
+                b = self.name_bindings.get(
+                    (sf.relpath, ".".join(prefix), call.func.id))
+                if b is not None:
+                    return self.programs.get(b)
+                prefix.pop()
+            b = self.name_bindings.get((sf.relpath, None, call.func.id))
+            if b is not None:
+                return self.programs.get(b)
+        if isinstance(call.func, ast.Attribute) \
+                and isinstance(call.func.value, ast.Name) \
+                and call.func.value.id == "self" and cls:
+            b = self.attr_bindings.get((sf.relpath, cls, call.func.attr))
+            if b is not None:
+                return self.programs.get(b)
+        return None
+
+    def analyze(self):
+        # reverse call index first (param classification needs it)
+        contexts: List[Tuple[ast.Call, _Ctx, _Program]] = []
+        for key, info in self.fns.items():
+            ctx = _Ctx(self, info)
+            for node in walk_shallow(info.node):
+                if not isinstance(node, ast.Call):
+                    continue
+                callee = self.resolver.resolve(node.func, info.sf,
+                                               info.cls, scope=info.qual)
+                if callee is not None and callee in self.fns:
+                    self._callers.setdefault(callee, []).append((node, ctx))
+                prog = self._program_for_call(node, info.sf, info.cls,
+                                              info.qual)
+                if prog is not None:
+                    contexts.append((node, ctx, prog))
+
+        for call, ctx, prog in contexts:
+            prog.call_sites += 1
+            info = self.fns[prog.key]
+            sf = ctx.sf
+            for i, arg in enumerate(call.args):
+                if isinstance(arg, ast.Starred) or i >= len(info.params):
+                    continue
+                p = info.params[i]
+                if p in prog.static:
+                    label = self.classify(arg, ctx)
+                    prog.observed.setdefault(p, set()).add(label)
+                    if label == UNBOUNDED \
+                            and not sf.suppressed(call.lineno,
+                                                  "unbounded-static-arg"):
+                        self.findings.append(Finding(
+                            pass_name="recompile-hazard",
+                            code="unbounded-static-arg",
+                            file=sf.relpath, line=call.lineno,
+                            anchor=f"{ctx.qual}->{info.qual}:{p}",
+                            message=(
+                                f"static arg {p!r} of compiled program "
+                                f"{info.qual}() derives from an unbounded "
+                                f"runtime value — every distinct value "
+                                f"compiles a new XLA executable; route it "
+                                f"through a registered bucketing ladder "
+                                f"(core/bucketing.py)")))
+                else:
+                    self._check_shape(arg, call, ctx, info, p)
+            for kw in call.keywords:
+                if kw.arg and kw.arg in prog.static:
+                    label = self.classify(kw.value, ctx)
+                    prog.observed.setdefault(kw.arg, set()).add(label)
+                    if label == UNBOUNDED \
+                            and not sf.suppressed(call.lineno,
+                                                  "unbounded-static-arg"):
+                        self.findings.append(Finding(
+                            pass_name="recompile-hazard",
+                            code="unbounded-static-arg",
+                            file=sf.relpath, line=call.lineno,
+                            anchor=f"{ctx.qual}->{info.qual}:{kw.arg}",
+                            message=(
+                                f"static arg {kw.arg!r} of compiled "
+                                f"program {info.qual}() derives from an "
+                                f"unbounded runtime value — route it "
+                                f"through a registered bucketing ladder "
+                                f"(core/bucketing.py)")))
+                elif kw.arg:
+                    # traced args pass by keyword too: prog(x=buf[:n])
+                    self._check_shape(kw.value, call, ctx, info, kw.arg)
+
+    def _check_shape(self, arg: ast.AST, call: ast.Call, ctx: "_Ctx",
+                     info, param: str):
+        """A traced arg sliced to a hazardous length retraces per
+        distinct length: prog(x[:n]) with runtime n."""
+        sf = ctx.sf
+        exprs = [arg]
+        if isinstance(arg, ast.Name):
+            exprs.extend(ctx.assignments().get(arg.id, ()))
+        for e in exprs:
+            for node in ast.walk(e if isinstance(e, ast.AST) else arg):
+                if not (isinstance(node, ast.Subscript)
+                        and isinstance(node.slice, ast.Slice)
+                        and node.slice.upper is not None):
+                    continue
+                if self.classify(node.slice.upper, ctx) != UNBOUNDED:
+                    continue
+                if sf.suppressed(call.lineno, "unbounded-shape") or \
+                        sf.suppressed(node.lineno, "unbounded-shape"):
+                    continue
+                self.findings.append(Finding(
+                    pass_name="recompile-hazard", code="unbounded-shape",
+                    file=sf.relpath, line=call.lineno,
+                    anchor=f"{ctx.qual}->{info.qual}:{param}",
+                    message=(
+                        f"traced arg {param!r} of compiled program "
+                        f"{info.qual}() is sliced to an unbounded runtime "
+                        f"length — each distinct length retraces; pad to "
+                        f"a pow2 bucket (core/bucketing.py) like the "
+                        f"staging drains do")))
+                return
+
+
+def _join(*labels: str) -> str:
+    if not labels:
+        return OPAQUE
+    return max(labels, key=lambda l: _RANK[l])
+
+
+class _Ctx:
+    """Classification context: one function body."""
+
+    def __init__(self, p: _Pass, info, param_classes=None):
+        self.p = p
+        self.sf = info.sf
+        self.cls = info.cls
+        self.qual = info.qual
+        self.key = (info.sf.relpath, info.qual)
+        self.info = info
+        self._assigns: Optional[Dict[str, List[ast.AST]]] = None
+        self._param_classes = param_classes or {}
+
+    def fn_params(self) -> Set[str]:
+        return set(self.info.params) | set(self.info.kwonly)
+
+    def assignments(self) -> Dict[str, List[ast.AST]]:
+        if self._assigns is None:
+            out: Dict[str, List[ast.AST]] = {}
+            for node in walk_shallow(self.info.node):
+                if isinstance(node, ast.Assign):
+                    for tgt in node.targets:
+                        if isinstance(tgt, ast.Name):
+                            out.setdefault(tgt.id, []).append(node.value)
+                        elif isinstance(tgt, ast.Tuple) \
+                                and isinstance(node.value, ast.Tuple) \
+                                and len(tgt.elts) == len(node.value.elts):
+                            for t, v in zip(tgt.elts, node.value.elts):
+                                if isinstance(t, ast.Name):
+                                    out.setdefault(t.id, []).append(v)
+                elif isinstance(node, ast.AnnAssign) \
+                        and node.value is not None \
+                        and isinstance(node.target, ast.Name):
+                    out.setdefault(node.target.id, []).append(node.value)
+            self._assigns = out
+        return self._assigns
+
+
+# ---------------------------------------------------------------------------
+# inventory table + drift check
+# ---------------------------------------------------------------------------
+
+
+def _build(project: Project) -> _Pass:
+    p = _Pass(project)
+    p.analyze()
+    return p
+
+
+def programs_table(project: Project, prebuilt: Optional[_Pass] = None
+                   ) -> str:
+    """Markdown inventory: compiled program × static arg × observed
+    source classes (regen with --programs-table)."""
+    p = prebuilt if prebuilt is not None else _build(project)
+    lines = ["| program | static arg | call sites | sources |",
+             "|---|---|---|---|"]
+    for key in sorted(p.programs):
+        prog = p.programs[key]
+        name = f"`{key[0]}::{key[1]}`"
+        for param in sorted(prog.static):
+            seen = prog.observed.get(param) or {OPAQUE}
+            lines.append(
+                f"| {name} | `{param}` | {prog.call_sites} | "
+                f"{', '.join(sorted(seen, key=lambda l: _RANK[l]))} |")
+            name = ""  # group rows visually per program
+    return "\n".join(lines)
+
+
+@register("recompile-hazard")
+def run(project: Project) -> List[Finding]:
+    p = _build(project)
+    findings = list(p.findings)
+
+    # inventory drift: the docs table must match the generated one
+    docs_rel = "docs/static-analysis.md"
+    docs = project.read(docs_rel)
+    table = programs_table(project, prebuilt=p)
+    current = None
+    if docs and _MARKER_BEGIN in docs and _MARKER_END in docs:
+        current = docs.split(_MARKER_BEGIN, 1)[1] \
+            .split(_MARKER_END, 1)[0].strip()
+    if current is None or current != table.strip():
+        findings.append(Finding(
+            pass_name="recompile-hazard", code="inventory-drift",
+            file=docs_rel, line=1, anchor="programs-inventory",
+            message=(
+                f"the compiled-program inventory in {docs_rel} is "
+                f"{'missing' if current is None else 'stale'}: regenerate "
+                f"with `python -m veneur_tpu.lint --programs-table` and "
+                f"paste between the programs-inventory markers")))
+    findings.sort(key=lambda f: (f.file, f.line, f.code))
+    return findings

@@ -17,6 +17,15 @@ the calling thread actually holds ``store._lock`` at that moment
 exempt by design (swap-on-flush hands the flusher exclusive ownership)
 — the wrapper honors the ``_retired`` flag the store already sets.
 
+v2 additionally arms an Eraser-style lockset detector
+(``lint/lockset.py``) over the store object and every group: the store
+lock is proxied through a :class:`~veneur_tpu.lint.lockset.TrackedLock`
+and every tracked *field* access — not just annotated method calls —
+refines a per-field candidate lockset, so an unannotated mutator racing
+the generation swap or the requeue path is reported as a genuine data
+race with both stacks (``rec.races``). ``assert_clean()`` covers both
+detectors.
+
 Wrapping is per-instance (bound attributes on the group objects), so
 parallel tests and the ingest fast path outside the context manager pay
 nothing. The pytest fixture ``tsan_lite`` (tests/conftest.py) wires
@@ -31,6 +40,7 @@ from dataclasses import dataclass
 from typing import List
 
 from veneur_tpu.core.locking import REQUIRES_LOCK_ATTR
+from veneur_tpu.lint.lockset import FieldRaceRecorder
 
 
 @dataclass
@@ -47,7 +57,7 @@ class UnlockedMutation:
 class LockStateRecorder:
     """Wraps a MetricStore's group mutators; records unlocked calls."""
 
-    def __init__(self, store):
+    def __init__(self, store, eraser: bool = True):
         self.store = store
         self.violations: List[UnlockedMutation] = []
         self._vlock = threading.Lock()
@@ -55,6 +65,9 @@ class LockStateRecorder:
         # one violation per outermost annotated call: sample() calling
         # _row() unlocked is ONE mutation, not two
         self._tls = threading.local()
+        # the lockset detector rides along by default (eraser=False
+        # opts a test out, e.g. to demonstrate exactly what v1 caught)
+        self.eraser = FieldRaceRecorder() if eraser else None
 
     # -- arm / disarm ------------------------------------------------------
 
@@ -71,10 +84,15 @@ class LockStateRecorder:
 
         gen_groups = getattr(type(self.store), "_GEN_GROUPS",
                              MetricStore._GEN_GROUPS)
+        if self.eraser is not None:
+            self.eraser.track_lock(self.store, "_lock", "store")
+            self.eraser.instrument(self.store, "store")
         for attr in gen_groups:
             group = getattr(self.store, attr, None)
             if group is not None:
                 self._wrap_group(attr, group)
+                if self.eraser is not None:
+                    self.eraser.instrument(group, attr)
         # a flush swaps every group for a fresh (unwrapped) twin; hook
         # the swap so coverage survives flushes instead of silently
         # ending at the first one
@@ -88,6 +106,8 @@ class LockStateRecorder:
                 group = getattr(rec.store, attr, None)
                 if group is not None:
                     rec._wrap_group(attr, group)
+                    if rec.eraser is not None:
+                        rec.eraser.instrument(group, attr)
             return gen
 
         self.store._swap_generation = swap_and_rearm
@@ -101,6 +121,8 @@ class LockStateRecorder:
             except AttributeError:
                 pass
         self._wrapped.clear()
+        if self.eraser is not None:
+            self.eraser.restore()
 
     def _wrap_group(self, group_name: str, group):
         for name in dir(type(group)):
@@ -144,9 +166,17 @@ class LockStateRecorder:
 
     # -- assertions --------------------------------------------------------
 
+    @property
+    def races(self):
+        """Field-level data races from the lockset detector (empty when
+        armed with eraser=False)."""
+        return self.eraser.races if self.eraser is not None else []
+
     def assert_clean(self):
         if self.violations:
             lines = "\n  ".join(str(v) for v in self.violations[:20])
             raise AssertionError(
                 f"TSan-lite: {len(self.violations)} unlocked group "
                 f"mutation(s):\n  {lines}")
+        if self.eraser is not None:
+            self.eraser.assert_no_races()

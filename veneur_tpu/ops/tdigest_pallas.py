@@ -31,6 +31,8 @@ import jax.numpy as jnp
 from jax import lax
 from jax.experimental import pallas as pl
 
+from veneur_tpu.core.bucketing import bucketed
+
 _ROWS = 128          # series rows per kernel block
 _KCHUNK = 16         # output bins reduced per inner step
 # Mosaic addresses kernel operands with 32-bit byte offsets, so any single
@@ -50,6 +52,7 @@ def _row_slabs(total: int):
         start += size
 
 
+@bucketed("pow2")
 def _next_pow2(n: int) -> int:
     return 1 << (n - 1).bit_length()
 

@@ -78,7 +78,10 @@ class Checkpointer:
         blob = ckpt_format.serialize(
             groups, created_at=time.time(), interval=self.interval_s,
             meta={"hostname": self.hostname})
-        with self._io_lock:
+        # the IO lock's entire job is to serialize this write+fsync
+        # against truncation; the flush path never waits behind it
+        # (truncate(blocking=False)) and the store lock is not held
+        with self._io_lock:  # lint: ok(lock-across-blocking)
             if self.store.flush_epoch != epoch:
                 self.discarded_writes += 1
                 return False

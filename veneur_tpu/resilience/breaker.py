@@ -17,6 +17,8 @@ import threading
 import time
 from typing import Callable, Dict, List, Tuple
 
+from veneur_tpu.core.locking import requires_lock
+
 log = logging.getLogger("veneur.resilience.breaker")
 
 CLOSED = "closed"
@@ -68,15 +70,15 @@ class CircuitBreaker:
         """0=closed, 1=half-open, 2=open (veneur.breaker.state)."""
         return _STATE_GAUGE[self.state]
 
+    @requires_lock("breaker")
     def _maybe_half_open(self) -> None:
-        # caller holds the lock
         if (self._state == OPEN
                 and self._clock() - self._opened_at >= self.reset_timeout):
             self._state = HALF_OPEN
             self._probes = 0
 
+    @requires_lock("breaker")
     def _trip(self) -> None:
-        # caller holds the lock
         self._state = OPEN
         self._opened_at = self._clock()
         self._probes = 0

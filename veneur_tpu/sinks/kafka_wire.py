@@ -242,7 +242,10 @@ class WireProducer:
 
     def produce(self, topic: str, value: bytes,
                 key: Optional[str] = None) -> None:
-        with self._lock:
+        # one socket, one in-flight produce: the lock IS the wire
+        # serializer. Only the kafka sink's flush thread contends, and
+        # the egress deadline bounds the hold
+        with self._lock:  # lint: ok(lock-across-blocking)
             err: Optional[Exception] = None
             for attempt in range(self.retry_max + 1):
                 try:
