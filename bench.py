@@ -36,6 +36,7 @@ Prints exactly one JSON line on stdout.
 """
 
 import ctypes
+import fnmatch
 import json
 import os
 import re
@@ -175,6 +176,190 @@ def bench_histo_flush(num_series: int, digest_dtype: str = "float32",
     if stalls:
         out["transport_stalls_discarded"] = stalls
     return out
+
+
+class _RangeInterner:
+    """Interner stand-in for the tiered bench: 10M real MetricKeys are
+    GBs of Python objects, but the flush path only needs __len__ plus
+    name/joined lookups for the HOT rows (_end_interval)."""
+
+    class _Names:
+        def __getitem__(self, i):
+            return f"s{i}"
+
+    class _Joined:
+        def __getitem__(self, i):
+            return ""
+
+    def __init__(self, n: int):
+        self._n = n
+        self.rows = {}
+        self.names = self._Names()
+        self.joined = self._Joined()
+
+    def __len__(self):
+        return self._n
+
+
+def bench_tiered_10m(num_series: int = 10 * (1 << 20),
+                     hot_rows: int = 10000, cold_samples: int = 4,
+                     iters: int = 5, oracle_rows: int = 2048):
+    """Config 2g: realistic-density flush on the TIERED store
+    (core/tiered.py). Bench 2d measured the fleet-realistic workload at
+    ~3.9 live centroids against the dense-48 plane; here every series
+    gets ``cold_samples`` samples per interval (the realistic density)
+    except ``hot_rows`` hot ones, which cross the promotion bar and land
+    in dense full-K slots. Reports flush p50 directly comparable to
+    ``2b_histo_10m_bf16``'s dense-shape flush, resident bytes (the >= 5x
+    reduction claim), and ``merged_ok``: quantile agreement with a dense
+    DigestGroup oracle over a sampled row subset, within the pool
+    compression's t-digest error envelope, plus exact count equality."""
+    import warnings
+
+    warnings.filterwarnings("ignore", message="Some donated buffers")
+    import jax.numpy as jnp  # noqa: F401  (ensures backend init here)
+    from veneur_tpu.core.store import DigestGroup
+    from veneur_tpu.core.tiered import TieredDigestGroup
+    from veneur_tpu.samplers.parser import MetricKey
+
+    rng = np.random.default_rng(0)
+    chunk = 1 << 16
+    g = TieredDigestGroup(slab_rows=1 << 18, chunk=chunk,
+                          promote_samples=32, promote_intervals=1)
+    g.ensure_capacity(num_series - 1)
+    g.interner = _RangeInterner(num_series)
+    hot = rng.choice(num_series, size=min(hot_rows, num_series),
+                     replace=False).astype(np.int64)
+    # the sampled oracle subset: cold rows + a few hot ones
+    osel = np.concatenate([
+        rng.choice(num_series, size=oracle_rows - 64, replace=False),
+        hot[:64]]).astype(np.int64)
+    osel = np.unique(osel)
+    omap = {int(r): i for i, r in enumerate(osel)}
+    oracle_vals = {i: [] for i in range(len(osel))}
+
+    def stage(record_oracle=False):
+        # cold pass: every series, cold_samples rounds of one sample
+        for _ in range(cold_samples):
+            start = 0
+            while start < num_series:
+                n = min(chunk, num_series - start)
+                rows = np.arange(start, start + n, dtype=np.int64)
+                vals = rng.gamma(2.0, 50.0, n).astype(np.float32)
+                g.sample_many(rows, vals, np.ones(n, np.float32))
+                if record_oracle:
+                    for r in rows[np.isin(rows, osel)]:
+                        oracle_vals[omap[int(r)]].append(
+                            float(vals[int(r) - start]))
+                start += n
+        # hot pass: promotion-bar volume on the hot subset
+        for _ in range(40):
+            vals = rng.gamma(2.0, 50.0, len(hot)).astype(np.float32)
+            g.sample_many(hot, vals, np.ones(len(hot), np.float32))
+            if record_oracle:
+                for j, r in enumerate(hot):
+                    i = omap.get(int(r))
+                    if i is not None:
+                        oracle_vals[i].append(float(vals[j]))
+
+    def flush():
+        _, r = g.flush(list(QS), want_digests=False,
+                       want_stats=("pcts", "count"))
+        ni = _RangeInterner(num_series)
+        g.interner = ni
+        # production re-enters each series through _row(), which gives
+        # directory-resident keys their dense slot back at first sight
+        # in the new generation; the range interner bypasses _row, so
+        # re-stamp here — without this the timed intervals run 100%
+        # pool-tier and the p50 omits the dense bank's flush cost
+        for row in hot:
+            if g.directory.is_dense((ni.names[int(row)],
+                                     ni.joined[int(row)])):
+                g._assign_dense(int(row))
+        return r
+
+    stage(record_oracle=True)
+    r0 = flush()  # warmup: compile + first run, and the oracle interval
+    # merged_ok: dense oracle over the sampled subset, fed identically
+    oracle = DigestGroup(capacity=1 << (len(osel) - 1).bit_length(),
+                         chunk=chunk)
+    for i in range(len(osel)):
+        key = MetricKey(name=f"s{osel[i]}", type="histogram",
+                        joined_tags="")
+        for v in oracle_vals[i]:
+            oracle.sample(key, [], v, 1.0)
+    _, ro = oracle.flush(list(QS), want_digests=False,
+                         want_stats=("pcts", "count"))
+    tp = np.asarray(r0["percentiles"])[osel]
+    tc = np.asarray(r0["count"])[osel]
+    oc = np.asarray(ro["count"])
+    # the acceptance criterion is "identical to the DENSE PATH within
+    # the t-digest error bound", so the gate is per-cell EXCESS rank
+    # error over the dense oracle: both paths share the reference's
+    # quantile interpolation (merging_digest.go:297-327 walks min ->
+    # first-centroid upper bound), so p01 on a 4-sample row sits an
+    # epsilon above the row minimum and costs a full 1/n under exact
+    # searchsorted bracketing — on the ORACLE TOO (measured 0.24 on
+    # both, identically). Excess cancels the shared convention and
+    # leaves only what the tiered representation adds: the pool's PK-2
+    # k-scale envelope caps mid-q cluster mass at ~2/C (C=14 -> ~0.14
+    # worst-case), and a splice/merge/promotion bug lands far past it
+    # (the pre-fix promotion clump measured 0.27 where the oracle was
+    # exact).
+    op = np.asarray(ro["percentiles"])
+    rank_err = 0.0
+    excess_err = 0.0
+    for m in range(len(osel)):
+        t_sorted = np.sort(np.asarray(oracle_vals[m], np.float64))
+        nroww = len(t_sorted)
+        if nroww == 0:
+            continue
+
+        def _bracket(v):
+            lo = np.searchsorted(t_sorted, v, "left") / nroww
+            hi = np.searchsorted(t_sorted, v, "right") / nroww
+            return lo, hi
+
+        for qi, q in enumerate(QS):
+            lo, hi = _bracket(float(tp[m, qi]))
+            e_t = float(max(0.0, lo - q, q - hi))
+            lo, hi = _bracket(float(op[m, qi]))
+            e_o = float(max(0.0, lo - q, q - hi))
+            rank_err = max(rank_err, e_t)
+            excess_err = max(excess_err, e_t - e_o)
+    counts_ok = bool(np.allclose(tc, oc))
+    merged_ok = counts_ok and bool(excess_err <= 0.15)
+    times = []
+    for _ in range(iters):
+        stage()
+        t0 = time.perf_counter()
+        flush()
+        times.append(time.perf_counter() - t0)
+    plan = g.hbm_bytes()
+    # the dense-shape comparison footprint: what 2b's bf16 slab plan
+    # would hold resident at the same series count (core/slab.py)
+    from veneur_tpu.core.slab import SlabDigestBank
+
+    dense_plan = SlabDigestBank(num_series, slab_rows=1 << 18,
+                                digest_dtype="bfloat16").hbm_bytes()
+    # per-ROW ratio: the pool allocates pow2 slabs, so at small probe
+    # sizes the allocated-bytes ratio would be padding, not plan
+    dense_per_row = dense_plan["total_bytes"] / num_series
+    tier_per_row = plan["total_bytes"] / plan["pool_rows"]
+    return {"p50_ms": round(float(np.median(times)) * 1e3, 3),
+            "series": num_series,
+            "hot_rows": int(len(hot)),
+            "live_centroids_per_row": cold_samples,
+            "resident_gb": round(plan["total_bytes"] / 2**30, 3),
+            "dense_bf16_resident_gb": round(
+                dense_plan["total_bytes"] / 2**30, 3),
+            "resident_reduction_x": round(dense_per_row / tier_per_row,
+                                          2),
+            "merged_ok": merged_ok,
+            "counts_exact": counts_ok,
+            "quantile_rank_err": round(rank_err, 4),
+            "quantile_excess_err": round(excess_err, 4),
+            "promotions": g.directory.promotions}
 
 
 def bench_import_throughput(num_series: int = 20000, duration: float = 4.0):
@@ -2001,6 +2186,12 @@ def run_isolated(fn_name: str, timeout: float = 560.0):
                            capture_output=True, timeout=timeout,
                            text=True, cwd=_HERE)
         return json.loads(r.stdout.strip().splitlines()[-1])
+    except subprocess.TimeoutExpired:
+        # the lane-budget contract: a lane that blows its budget is
+        # recorded as skipped-with-reason, never an rc=124 for the run
+        print(f"{fn_name} exceeded its {timeout:.0f}s budget; skipped",
+              file=sys.stderr)
+        return {"skipped": f"lane budget exceeded ({timeout:.0f}s)"}
     except Exception as e:  # pragma: no cover
         print(f"{fn_name} subprocess failed: {e}", file=sys.stderr)
         return {"error": str(e)[:160]}
@@ -2031,7 +2222,99 @@ def run_tpu_smoke(timeout: float = 560.0) -> dict:
         return {"ok": False, "result": f"smoke run failed: {e}"[:160]}
 
 
-def _run_all(result):
+# Per-lane wall-clock budgets (seconds). BENCH_r05 died rc=124 at the
+# driver's GLOBAL timeout mid-lane, leaving 2f/5b/7/9 unmeasured; with
+# budgets, a lane that cannot fit the remaining deadline is recorded as
+# skipped-with-reason and the run keeps emitting. Subprocess lanes
+# enforce their budget hard (subprocess timeout); in-process lanes
+# cannot be preempted safely (they share the parent's TPU runtime), so
+# an overrun is recorded on the lane and eats into the deadline the
+# later lanes check against.
+_DEADLINE_DEFAULT = 3300.0
+
+
+def _lane_plan(result, guarded):
+    """The lane registry: (name, thunk(budget_s) -> config dict,
+    budget_s) in run order; ``guarded`` wraps in-process callables."""
+
+    def headline_histo():
+        num_series = 1 << 22
+        histo = None
+        while num_series >= 1 << 16:
+            try:
+                histo = bench_histo_flush(num_series)
+                break
+            except Exception as e:
+                print(f"histo bench at {num_series} failed "
+                      f"({type(e).__name__}); retrying at "
+                      f"{num_series // 2}", file=sys.stderr)
+                num_series //= 2
+        if histo is None:
+            raise SystemExit("histo bench failed at all sizes")
+        # the headline is valid from this point on
+        base_us = result["baseline_us_per_series"]
+        result["metric"] = f"flush_p99_{num_series // 1000}k_histo_series"
+        result["value"] = histo["p99_ms"]
+        result["vs_baseline"] = round(
+            num_series * base_us / 1e3 / histo["p99_ms"], 2)
+        # p99 of N iters rides the max sample, so one tunnel hiccup
+        # moves it run-to-run; the p50 ratio is the steady number
+        result["vs_baseline_p50"] = round(
+            num_series * base_us / 1e3 / histo["p50_ms"], 2)
+        return dict(histo, series=num_series)
+
+    return [
+        ("0_ingest_udp", guarded(bench_ingest_pps), 180),
+        ("1_scalar_10k", guarded(bench_scalar_flush), 120),
+        ("2_histo_4m", guarded(headline_histo), 900),
+        # north-star scale: 10M series on the one chip — bf16 resident
+        # digests (~13.2 GB local incl. the round-5 anchor-summary
+        # planes; see core/slab.py). 256k-row slabs keep the per-slab
+        # flush transients inside the free HBM.
+        ("2b_histo_10m_bf16",
+         guarded(bench_histo_flush, 10 * (1 << 20), "bfloat16", 5, 4,
+                 1 << 18), 600),
+        ("2c_merge_global_10m",
+         guarded(bench_merge_global, 10 * (1 << 20)), 420),
+        # gRPC import path (wire decode + bulk staging + device
+        # scatter); isolated so it does not inherit the 10M configs'
+        # HBM fragmentation (inline it measured ~100k/s lower)
+        ("2d_import_grpc",
+         lambda t: run_isolated("bench_import_throughput", timeout=t),
+         300),
+        # the server's own egress: flush -> columnar emission -> native
+        # Datadog serialization; isolated subprocesses keep the multi-GB
+        # configs off the parent's fragmented HBM
+        ("6_egress_1m",
+         lambda t: run_isolated("bench_egress_1m", timeout=t), 560),
+        ("2e_forward_1m",
+         lambda t: run_isolated("bench_forward_1m", timeout=t), 560),
+        # the flagship: 10M-series packed forward, with sampled merge
+        # oracle — staging 40M+ samples and fetching ~500 MB over the
+        # harness tunnel takes minutes, hence the wide budget
+        ("2f_forward_10m",
+         lambda t: run_isolated("bench_forward_10m", timeout=t), 900),
+        # tiered residency at realistic density (core/tiered.py):
+        # flush p50 at ~4 live centroids/row, resident-bytes reduction
+        # vs the dense-shape 2b plan, merged_ok oracle agreement
+        ("2g_tiered_10m",
+         lambda t: run_isolated("bench_tiered_10m", timeout=t), 900),
+        ("3_hll", guarded(bench_hll), 240),
+        ("3b_hll_1m_p12", guarded(bench_hll, 1 << 20, 1 << 17, 12), 240),
+        ("3c_sets_1m_p14",
+         lambda t: run_isolated("bench_sets_1m_p14", timeout=t), 560),
+        ("4_mesh_global", guarded(bench_mesh_subprocess), 300),
+        ("5_heavy_hitters", guarded(bench_heavy_hitters), 240),
+        ("5b_heavy_hitters_100m",
+         lambda t: run_isolated("bench_heavy_hitters_100m", timeout=t),
+         560),
+        ("7_tls_handshakes", guarded(bench_tls_handshakes), 240),
+        ("8_ssf_spans", guarded(bench_ssf_spans), 240),
+        ("9_proxy_fanout", guarded(bench_proxy_fanout), 300),
+    ]
+
+
+def _run_all(result, lanes_filter=None, deadline=None):
     # record machine contention alongside the numbers: every lane here
     # (and the C++ baseline) shares the host cores with whatever else is
     # running, so a loaded box shifts host-bound rates and the baseline
@@ -2041,6 +2324,10 @@ def _run_all(result):
                           "loadavg_at_start": round(os.getloadavg()[0], 2)}
     except OSError:  # pragma: no cover
         pass
+    t_start = time.monotonic()
+    if deadline is None:
+        deadline = float(os.environ.get("BENCH_DEADLINE",
+                                        _DEADLINE_DEFAULT))
     base_us, base_src = measure_scalar_baseline_us()
     result["baseline_us_per_series"] = round(base_us, 2)
     result["baseline_source"] = base_src
@@ -2050,75 +2337,35 @@ def _run_all(result):
 
     def guarded(fn, *args):
         # the headline line must print even if one config dies
-        try:
-            return fn(*args)
-        except Exception as e:
-            print(f"{fn.__name__} failed: {e}", file=sys.stderr)
-            return {"error": f"{type(e).__name__}: {e}"[:160]}
+        def thunk(_budget):
+            try:
+                return fn(*args)
+            except Exception as e:
+                print(f"{fn.__name__} failed: {e}", file=sys.stderr)
+                return {"error": f"{type(e).__name__}: {e}"[:160]}
+
+        return thunk
 
     configs = result["configs"]
-    configs["0_ingest_udp"] = guarded(bench_ingest_pps)
-    configs["1_scalar_10k"] = guarded(bench_scalar_flush)
-
-    num_series = 1 << 22
-    histo = None
-    while num_series >= 1 << 16:
-        try:
-            histo = bench_histo_flush(num_series)
-            break
-        except Exception as e:
-            print(f"histo bench at {num_series} failed "
-                  f"({type(e).__name__}); retrying at {num_series // 2}",
-                  file=sys.stderr)
-            num_series //= 2
-    if histo is None:
-        raise SystemExit("histo bench failed at all sizes")
-    configs["2_histo_4m"] = dict(histo, series=num_series)
-    # the headline is valid from this point on, whatever else completes
-    result["metric"] = f"flush_p99_{num_series // 1000}k_histo_series"
-    result["value"] = histo["p99_ms"]
-    result["vs_baseline"] = round(
-        num_series * base_us / 1e3 / histo["p99_ms"], 2)
-    # p99 of 20 iters is the max sample, so one tunnel hiccup moves it
-    # by hundreds of ms run-to-run; the p50 ratio is the steady number
-    result["vs_baseline_p50"] = round(
-        num_series * base_us / 1e3 / histo["p50_ms"], 2)
-    # north-star scale: 10M series on the one chip — bf16 resident
-    # digests (~13.2 GB local incl. the round-5 anchor-summary planes /
-    # 4.2 GB merge-mode; see core/slab.py). 256k-row slabs keep the
-    # per-slab flush transients inside the ~2.3 GB of HBM the resident
-    # planes now leave free (512k slabs fit before the summary planes;
-    # their transients no longer do).
-    configs["2b_histo_10m_bf16"] = guarded(
-        bench_histo_flush, 10 * (1 << 20), "bfloat16", 5, 4, 1 << 18)
-    configs["2c_merge_global_10m"] = guarded(
-        bench_merge_global, 10 * (1 << 20))
-    # the OTHER north-star metric: metrics/sec merged through the whole
-    # gRPC import path (wire decode + bulk staging + device scatter);
-    # isolated so it does not inherit the 10M configs' HBM fragmentation
-    # (inline it measured ~100k/s lower than standalone)
-    configs["2d_import_grpc"] = run_isolated("bench_import_throughput")
-    # the server's own egress: flush -> columnar emission -> native
-    # Datadog serialization (round-3: "make the SERVER as fast as the
-    # kernels"); isolated subprocesses keep the multi-GB configs off the
-    # parent's fragmented HBM
-    configs["6_egress_1m"] = run_isolated("bench_egress_1m")
-    configs["2e_forward_1m"] = run_isolated("bench_forward_1m")
-    # the flagship: 10M-series packed forward, with sampled merge
-    # oracle — staging 40M+ samples and fetching ~500 MB over the
-    # harness tunnel takes minutes, hence the wider timeout
-    configs["2f_forward_10m"] = run_isolated("bench_forward_10m",
-                                             timeout=900.0)
-    configs["3_hll"] = guarded(bench_hll)
-    configs["3b_hll_1m_p12"] = guarded(bench_hll, 1 << 20, 1 << 17, 12)
-    configs["3c_sets_1m_p14"] = run_isolated("bench_sets_1m_p14")
-    configs["4_mesh_global"] = guarded(bench_mesh_subprocess)
-    configs["5_heavy_hitters"] = guarded(bench_heavy_hitters)
-    configs["5b_heavy_hitters_100m"] = run_isolated(
-        "bench_heavy_hitters_100m")
-    configs["7_tls_handshakes"] = guarded(bench_tls_handshakes)
-    configs["8_ssf_spans"] = guarded(bench_ssf_spans)
-    configs["9_proxy_fanout"] = guarded(bench_proxy_fanout)
+    for name, thunk, budget in _lane_plan(result, guarded):
+        if lanes_filter is not None and not any(
+                fnmatch.fnmatchcase(name, pat) for pat in lanes_filter):
+            continue
+        elapsed = time.monotonic() - t_start
+        remaining = deadline - elapsed
+        if remaining < min(budget, 60):
+            # never die rc=124 mid-lane again: record WHY the lane went
+            # unmeasured and keep emitting the lanes that still fit
+            configs[name] = {"skipped":
+                             f"deadline: {elapsed:.0f}s elapsed of "
+                             f"{deadline:.0f}s, lane budget {budget}s"}
+            continue
+        t0 = time.monotonic()
+        out = thunk(min(budget, remaining))
+        took = time.monotonic() - t0
+        if isinstance(out, dict) and took > budget:
+            out["over_budget_s"] = round(took - budget, 1)
+        configs[name] = out
 
 
 def _headline(result) -> dict:
@@ -2131,8 +2378,12 @@ def _headline(result) -> dict:
 
     def pick(cfg, *keys):
         d = c.get(cfg) or {}
-        return {k: d[k] for k in keys if k in d} or \
-            ({"error": d["error"][:60]} if "error" in d else {})
+        out = {k: d[k] for k in keys if k in d}
+        if not out and "error" in d:
+            return {"error": d["error"][:60]}
+        if not out and "skipped" in d:
+            return {"skipped": d["skipped"][:60]}
+        return out
 
     head = {
         "metric": result.get("metric"),
@@ -2159,6 +2410,9 @@ def _headline(result) -> dict:
                                    "est_total_s_on_pcie_host",
                                    "within_interval_on_pcie_host",
                                    "merged_ok"),
+            "2g_tiered_10m": pick("2g_tiered_10m", "p50_ms",
+                                  "resident_gb", "resident_reduction_x",
+                                  "merged_ok", "promotions"),
             "5b_topk_100m": pick("5b_heavy_hitters_100m",
                                  "updates_per_s", "recall_at_64"),
             "6_egress_1m": pick("6_egress_1m", "total_s"),
@@ -2188,8 +2442,24 @@ def _emit(result):
 
 
 def main():
+    import argparse
     import signal
     import threading
+
+    ap = argparse.ArgumentParser(
+        description="veneur-tpu bench suite (one JSON line on stdout)")
+    ap.add_argument(
+        "--lanes", default="",
+        help="comma-separated lane names to run (globs ok, e.g. "
+             "'2*,3_hll'); default: every lane")
+    ap.add_argument(
+        "--deadline", type=float, default=None,
+        help=f"global wall-clock budget in seconds (default "
+             f"$BENCH_DEADLINE or {_DEADLINE_DEFAULT:.0f}); lanes that "
+             f"no longer fit are recorded skipped-with-reason")
+    args = ap.parse_args()
+    lanes_filter = [p.strip() for p in args.lanes.split(",")
+                    if p.strip()] or None
 
     # The full suite runs tens of minutes; if the harness times us out
     # mid-run, emit the one-line result with every config completed so
@@ -2204,6 +2474,8 @@ def main():
         "unit": "ms",
         "configs": {},
     }
+    if lanes_filter:
+        result["lanes_filter"] = lanes_filter
 
     def emit_and_exit(signum, frame):  # pragma: no cover - timeout path
         result.setdefault("truncated_by_signal", signum)
@@ -2213,7 +2485,9 @@ def main():
     signal.signal(signal.SIGTERM, emit_and_exit)
     signal.signal(signal.SIGINT, emit_and_exit)
 
-    worker = threading.Thread(target=_run_all, args=(result,), daemon=True)
+    worker = threading.Thread(target=_run_all,
+                              args=(result, lanes_filter, args.deadline),
+                              daemon=True)
     worker.start()
     while worker.is_alive():
         worker.join(0.2)

@@ -217,3 +217,59 @@ class TestMeshGlobalServerE2E:
                     assert abs(got - exact) / span < 0.10, (i, q, got, exact)
         finally:
             gserver.shutdown()
+
+
+@pytest.mark.multidevice
+class TestFleetSoak:
+    """The opt-in fleet lane (VENEUR_MULTIDEVICE_TESTS=1): multi-interval
+    mesh soaks that need more wall-clock than the tier-1 budget allows.
+    Runs on the same conftest-forced 8-device virtual mesh; the marker
+    only gates TIME, not devices, so tier-1 stays flat."""
+
+    def test_multi_interval_mesh_soak_matches_oracle(self, mesh):
+        """5 flush intervals of sustained mixed traffic with mid-soak
+        capacity growth: the mesh store's per-interval emissions track a
+        single-device oracle fed identically, every interval."""
+        mstore = MetricStore(initial_capacity=32, chunk=128, mesh=mesh)
+        sstore = MetricStore(initial_capacity=32, chunk=128)
+        rng_m = np.random.default_rng(77)
+        rng_s = np.random.default_rng(77)
+        for interval in range(5):
+            # growth mid-soak: interval k adds series beyond interval
+            # k-1's capacity, exercising grow-under-traffic on the mesh
+            n_hist = 24 + 16 * interval
+            _fill_store(mstore, rng_m, n_hist=n_hist, n_samples=64)
+            _fill_store(sstore, rng_s, n_hist=n_hist, n_samples=64)
+            now = int(time.time()) + interval
+            mby = {m.name: m.value
+                   for m in mstore.flush(QS, AGG, is_local=False,
+                                         now=now)[0]}
+            sby = {m.name: m.value
+                   for m in sstore.flush(QS, AGG, is_local=False,
+                                         now=now)[0]}
+            assert set(mby) == set(sby), f"interval {interval}"
+            for name, want in sby.items():
+                assert mby[name] == pytest.approx(
+                    want, rel=1e-4, abs=1e-4), (interval, name)
+
+    def test_sharded_store_conserves_counts_across_intervals(self, mesh):
+        """Exact count conservation through 4 intervals of ingest +
+        flush on the sharded store (the mesh form of the swap-on-flush
+        conservation invariant)."""
+        store = MetricStore(initial_capacity=16, chunk=64, mesh=mesh)
+        total = 0
+        rng = np.random.default_rng(13)
+        for interval in range(4):
+            n = int(rng.integers(100, 400))
+            for j in range(n):
+                store.process_metric(p.parse_metric(
+                    b"soak.h%d:%.3f|h" % (j % 37, rng.normal(50, 5))))
+            total += n
+            final, _, _ = store.flush(QS, AGG, is_local=False,
+                                      now=interval + 1)
+            got = sum(m.value for m in final
+                      if m.name.startswith("soak.")
+                      and m.name.endswith(".count"))
+            # per-interval totals: every ingested sample lands in
+            # exactly one row's count
+            assert got == float(n), interval

@@ -1,0 +1,639 @@
+"""Tiered packed↔dense digest residency (veneur_tpu/core/tiered.py).
+
+The ISSUE-6 acceptance surface: quantized-pool round-trip bounds, flush
+parity against a dense DigestGroup oracle (exact counts, quantiles
+inside the pool compression's t-digest envelope), promotion/demotion
+hysteresis through the TierDirectory, the packed forward splice,
+checkpoint round-trips that cross tier assignments (tiered→dense,
+dense→tiered, tiered→tiered), a promotion landing mid-snapshot, the
+flush-epoch guard, the compute ladder's requeue rung, and the
+OverloadLimited cardinality cap — all with exact count conservation.
+
+Everything here is tier-1 fast (pool slabs of 256 rows).
+"""
+
+import numpy as np
+import pytest
+
+import veneur_tpu.core.tiered as tiered_mod
+from veneur_tpu.core.store import DigestGroup, MetricStore
+from veneur_tpu.core.tiered import (TierDirectory, TieredDigestGroup,
+                                    pool_bytes_per_row)
+from veneur_tpu.ops import tdigest as td_ops
+from veneur_tpu.resilience.compute import ComputeBreaker
+from veneur_tpu.samplers.intermetric import HistogramAggregates
+from veneur_tpu.samplers.parser import MetricKey, parse_metric
+
+AGG = HistogramAggregates.from_names(["min", "max", "count", "sum"])
+QS = [0.5, 0.9, 0.99]
+
+
+def _flush(store, now=1):
+    return store.flush(QS, AGG, is_local=False, now=now)
+
+
+def make_store(**kw):
+    kw.setdefault("initial_capacity", 32)
+    kw.setdefault("chunk", 128)
+    kw.setdefault("digest_storage", "tiered")
+    if kw["digest_storage"] in ("tiered", "slab"):
+        kw.setdefault("slab_rows", 256)
+    return MetricStore(**kw)
+
+
+def make_group(**kw):
+    kw.setdefault("slab_rows", 256)
+    kw.setdefault("chunk", 64)
+    return TieredDigestGroup(**kw)
+
+
+def _key(i):
+    return MetricKey(name=f"s{i}", type="histogram", joined_tags="")
+
+
+def _feed(group, per_row, rng):
+    """per_row: {row_index: sample_count}; returns {i: values}."""
+    vals = {}
+    for i, n in per_row.items():
+        v = rng.gamma(2.0, 50.0, n).astype(np.float32)
+        vals[i] = v
+        for x in v:
+            group.sample(_key(i), [], float(x), 1.0)
+    return vals
+
+
+class TestQuantization:
+    def test_round_trip_error_bounds(self):
+        rng = np.random.default_rng(5)
+        import jax.numpy as jnp
+
+        mean = np.sort(rng.normal(0, 1000, (16, 8)).astype(np.float32),
+                       axis=-1)
+        weight = rng.uniform(1, 300, (16, 8)).astype(np.float32)
+        weight[3] = 0.0          # a fully-empty row
+        weight[7, 5:] = 0.0      # a partially-live row
+        mq, wb, fmin, fmax = td_ops.quantize_centroids(
+            jnp.asarray(mean), jnp.asarray(weight))
+        m2, w2 = (np.asarray(a) for a in
+                  td_ops.dequantize_centroids(mq, wb, fmin, fmax))
+        live = weight > 0
+        span = np.where(np.isfinite(np.asarray(fmax)),
+                        np.asarray(fmax) - np.asarray(fmin), 0.0)
+        tol = np.broadcast_to(span[:, None] / 65535.0 + 1e-6,
+                              mean.shape)
+        assert np.all(np.abs(m2[live] - mean[live]) <= tol[live])
+        # bf16 weight rounding: <= 2^-8 relative
+        assert np.all(np.abs(w2[live] - weight[live])
+                      <= weight[live] * 2.0**-8)
+        # empties stay empty (weight-liveness contract) and both empty
+        # shapes decode to the +inf empty-mean sentinel
+        assert np.all(w2[~live] == 0.0)
+        assert np.all(np.isinf(m2[~live]))
+
+    def test_pool_bytes_per_row_is_the_documented_plan(self):
+        # docs/tiered.md quotes ~228 B/row at PK=16
+        assert pool_bytes_per_row(16) == 228
+
+
+class TestTieredGroupParity:
+    def test_flush_matches_dense_oracle(self):
+        rng = np.random.default_rng(1)
+        g = make_group(promote_samples=32, promote_intervals=1)
+        d = DigestGroup(64, chunk=64)
+        per_row = {i: (100 if i < 3 else 4) for i in range(24)}
+        for i, n in per_row.items():
+            v = rng.gamma(2.0, 50.0, n).astype(np.float32)
+            for x in v:
+                g.sample(_key(i), [], float(x), 1.0)
+                d.sample(_key(i), [], float(x), 1.0)
+        assert g.directory.promotions == 3  # the 3 hot rows promoted
+        _, rt = g.flush(QS)
+        _, rd = d.flush(QS)
+        n = len(per_row)
+        assert np.array_equal(rt["count"][:n], rd["count"][:n])
+        assert np.array_equal(rt["min"][:n], rd["min"][:n])
+        assert np.array_equal(rt["max"][:n], rd["max"][:n])
+        assert np.allclose(rt["sum"][:n], rd["sum"][:n], rtol=1e-5)
+        spread = np.maximum(rd["max"][:n] - rd["min"][:n], 1e-6)
+        err = np.abs(rt["percentiles"][:n] - rd["percentiles"][:n]) \
+            / spread[:, None]
+        # pool rows carry PK=16 slots (compression 14): rank error is
+        # bounded well under 10% of the row's value spread; promoted
+        # rows carry the full dense digest
+        assert float(np.nanmax(err)) < 0.10
+
+    def test_one_sample_per_drain_stays_value_coherent(self):
+        """Regression: the realistic fleet arrival shape — ONE sample
+        per row per staged chunk — must not alias value-distant samples
+        into the same pool bin. Arrival-time quantile-estimate binning
+        did exactly that (consecutive order statistics arrive with
+        nearly the same estimated quantile): 4-sample rows flushed with
+        rank errors up to 0.75. The value-bracketed placement
+        (ops/tdigest.py bin_pool_samples) keeps them singleton."""
+        rng = np.random.default_rng(7)
+        rows_n = 64
+        g = make_group(slab_rows=rows_n, chunk=rows_n)
+        vals = (np.abs(rng.lognormal(3.0, 1.2, (4, rows_n)))
+                .astype(np.float32) + 1.0)
+        for s in range(4):
+            for i in range(rows_n):
+                g.sample(_key(i), [], float(vals[s, i]), 1.0)
+            g._drain_samples()  # exactly one sample per row per drain
+        _, r = g.flush([0.25, 0.75])
+        worst = 0.0
+        for i in range(rows_n):
+            t_sorted = np.sort(vals[:, i].astype(np.float64))
+            for q, est in ((0.25, r["percentiles"][i, 0]),
+                           (0.75, r["percentiles"][i, 1]),
+                           (0.5, r["median"][i])):
+                lo = np.searchsorted(t_sorted, est, "left") / 4
+                hi = np.searchsorted(t_sorted, est, "right") / 4
+                worst = max(worst, max(0.0, lo - q, q - hi))
+        # pre-fix this measured 0.75; boundary interpolation between
+        # singleton bins costs 0 under the bracket-rank formula
+        assert worst <= 0.15
+
+    def test_bin_pool_samples_spreads_sequential_arrivals(self):
+        """Direct contract of the value-bracketed binning: distinct
+        values arriving in separate single-sample chunks land in
+        distinct, value-ordered bins while free bins remain."""
+        import jax.numpy as jnp
+
+        pk = 16
+        seq = [60.5, 44.9, 36.8, 42.4, 90.0, 10.0]
+        bw = jnp.zeros((pk,), jnp.float32)
+        bwm = jnp.zeros((pk,), jnp.float32)
+        placed = {}
+        for v in seq:
+            r, vv, w, b = td_ops.bin_pool_samples(
+                jnp.zeros(1, jnp.int32), jnp.asarray([v], jnp.float32),
+                jnp.ones(1, jnp.float32), 1, pk, float(pk - 2), bw, bwm)
+            bi = int(b[0])
+            assert bi not in placed, f"{v} aliased with {placed.get(bi)}"
+            placed[bi] = v
+            bw = bw.at[bi].add(1.0)
+            bwm = bwm.at[bi].add(v)
+        # bins must be value-ordered: sort by bin id == sort by value
+        by_bin = [placed[k] for k in sorted(placed)]
+        assert by_bin == sorted(seq)
+
+    def test_chunk_dominant_run_spreads_by_rank(self):
+        """Regression (2g bench, promoted-row clump): a ramping row
+        whose staged chunk carries MORE mass than everything it
+        accumulated so far — the shape of a series about to cross the
+        promotion bar, after staging coalesced its samples — must not
+        collapse the run into one bin. Pre-fix, every sample of the
+        run bracketed against the same pre-chunk bin state, so a run
+        of new maxima all bisected onto the same bin: 12 of 16
+        one-chunk samples landed in a single bin (43% of row mass vs
+        the ~11% mid-q k-scale envelope), flushing with 0.27 rank
+        error at the median. Chunk-dominant rows now spread by exact
+        within-chunk rank (merged with the accumulated below-mass), and
+        the guard drain compacts the accumulated bins into the packed
+        planes FIRST: bracket-era bin ids encode insertion order, not
+        k-scale position, so leaving them live would merge the run's
+        mid-rank mass into whatever history happened to sit at mid ids
+        (the 2g probe measured a cold 463-extreme at id 7 absorbing the
+        ramp chunk's median samples — 0.16 rank error at p50)."""
+        rng = np.random.default_rng(11)
+        g = make_group(slab_rows=64, chunk=64)
+        vals = []
+        for _ in range(4):  # sparse phase: one sample per drain
+            v = float(rng.gamma(2.0, 50.0))
+            vals.append(v)
+            g.sample(_key(0), [], v, 1.0)
+            g._drain_samples()
+        burst = rng.gamma(2.0, 50.0, 16).astype(np.float32)
+        for v in burst:  # ramp phase: 16 samples in ONE drained chunk
+            vals.append(float(v))
+            g.sample(_key(0), [], float(v), 1.0)
+        g._drain_samples()
+        pool = g.pools[0]
+        bw = np.asarray(pool.bw).reshape(-1, g.pk)[0]
+        _, pw = td_ops.dequantize_centroids(
+            pool.mq.reshape(-1, g.pk)[:1], pool.wb.reshape(-1, g.pk)[:1],
+            pool.fmin[:1], pool.fmax[:1])
+        pw = np.asarray(pw)[0]
+        # the sparse-phase history compacted into the packed planes (the
+        # dominance drain), the burst alone landed on fresh k-scale bins
+        assert pw.sum() == pytest.approx(4.0)
+        assert bw.sum() == pytest.approx(16.0)
+        # pre-fix the largest bin held 12+ of the 20 samples
+        assert bw.max() <= 6.0, f"clumped bins: {bw}"
+        _, r = g.flush([0.25, 0.5, 0.75])
+        t_sorted = np.sort(np.asarray(vals, np.float64))
+        worst = 0.0
+        for q, est in zip((0.25, 0.5, 0.75), r["percentiles"][0]):
+            lo = np.searchsorted(t_sorted, est, "left") / 20
+            hi = np.searchsorted(t_sorted, est, "right") / 20
+            worst = max(worst, max(0.0, lo - q, q - hi))
+        assert worst <= 0.15  # pre-fix: 0.27+
+
+    def test_chunk_solo_clumps_bounded_by_guard(self):
+        """Regression (2g bench, hot-row incremental clump): a row
+        receiving one sample per drained chunk far past PK samples.
+        Value-bracketed sharing has no per-bin mass cap and the
+        ID-bisection for new extremes leaves some bin ids unreachable,
+        so pre-guard a mode-concentrated stream piled up to 9 of 44
+        samples onto one shared bin (the k-scale envelope is ~6.3) —
+        0.09+ rank error at the median. The over-cap guard trigger now
+        compacts the bins before a clump crosses its envelope."""
+        rng = np.random.default_rng(5)
+        g = make_group(slab_rows=64, chunk=64)
+        vals = []
+        for _ in range(44):
+            v = float(rng.gamma(2.0, 50.0))
+            vals.append(v)
+            g.sample(_key(0), [], v, 1.0)
+            g._drain_samples()  # chunk-solo arrival, like the fleet shape
+        pool = g.pools[0]
+        bw = np.asarray(pool.bw).reshape(-1, g.pk)[0]
+        _, pw = td_ops.dequantize_centroids(
+            pool.mq.reshape(-1, g.pk)[:1], pool.wb.reshape(-1, g.pk)[:1],
+            pool.fmin[:1], pool.fmax[:1])
+        pw = np.asarray(pw)[0]
+        assert bw.sum() + pw.sum() == pytest.approx(44.0)
+        envelope = 2.0 * 44.0 / g.pcomp
+        assert max(bw.max(), pw.max()) <= envelope + 1.0, \
+            f"clumped: bins {bw}, packed {pw}"
+        _, r = g.flush([0.25, 0.5, 0.75])
+        t_sorted = np.sort(np.asarray(vals, np.float64))
+        worst = 0.0
+        for q, est in zip((0.25, 0.5, 0.75), r["percentiles"][0]):
+            lo = np.searchsorted(t_sorted, est, "left") / 44
+            hi = np.searchsorted(t_sorted, est, "right") / 44
+            worst = max(worst, max(0.0, lo - q, q - hi))
+        assert worst <= 0.1, f"mid-q rank error {worst}"
+
+    def test_binning_sees_packed_mass_after_guard_drain(self):
+        """Regression: after a guard drain compacts the bins into the
+        packed planes, a chunk-solo arrival used to bin as though the
+        row were EMPTY (chunk-relative mid bin, blind to the row's
+        whole history). The quantile anchor now includes the packed
+        planes' mass, so a value above everything compacted lands in a
+        high bin and a value below it lands in a low bin."""
+        import jax.numpy as jnp
+
+        pk = 16
+        means = jnp.asarray(
+            np.linspace(10.0, 40.0, pk, dtype=np.float32)[None])
+        wts = jnp.ones((1, pk), jnp.float32)
+        mq, wb, fmin, fmax = td_ops.quantize_centroids(means, wts)
+        empty = jnp.zeros((pk,), jnp.float32)
+
+        def place(v):
+            _, _, _, b = td_ops.bin_pool_samples(
+                jnp.zeros(1, jnp.int32), jnp.asarray([v], jnp.float32),
+                jnp.ones(1, jnp.float32), 1, pk, float(pk - 2),
+                empty, empty, mq.reshape(-1), wb.reshape(-1), fmin, fmax)
+            return int(b[0])
+
+        hi_bin, lo_bin = place(100.0), place(1.0)
+        # blind chunk-relative placement put BOTH on the mid bin (7)
+        assert hi_bin >= 10, f"new max placed at bin {hi_bin}"
+        assert lo_bin <= 3, f"new min placed at bin {lo_bin}"
+
+    def test_multi_slab_rows_flush_in_global_order(self):
+        # rows straddling pool slab 0 and slab 1
+        g = make_group(slab_rows=8, chunk=16)
+        rng = np.random.default_rng(2)
+        per_row = {i: 3 for i in range(20)}
+        vals = _feed(g, per_row, rng)
+        assert len(g.pools) >= 3
+        _, r = g.flush(QS)
+        for i in range(20):
+            assert r["count"][i] == 3.0
+            assert r["min"][i] == pytest.approx(vals[i].min())
+            assert r["max"][i] == pytest.approx(vals[i].max())
+
+    def test_packed_flush_splices_tiers(self):
+        rng = np.random.default_rng(3)
+        g = make_group(promote_samples=16, promote_intervals=1,
+                       pool_centroids=8)
+        per_row = {i: (200 if i == 5 else 3) for i in range(12)}
+        _feed(g, per_row, rng)
+        assert g.directory.dense_count() == 1
+        _, r = g.flush(QS, want_digests="packed",
+                       want_stats=("count",))
+        counts = np.asarray(r["packed_counts"], np.int64)
+        assert counts.shape == (12,)
+        # cold rows: <= PK live centroids; the hot row came from the
+        # dense tier and may carry more than the pool ever could
+        assert np.all(counts[np.arange(12) != 5] <= 8)
+        assert counts[5] > 8
+        # the splice is wire-exact: per-row centroid runs decode to the
+        # per-row sample mass (weights are bf16-rounded)
+        w = (np.asarray(r["packed_weights"], np.uint16)
+             .astype(np.uint32) << 16).view(np.float32)
+        ends = np.cumsum(counts)
+        starts = ends - counts
+        for i in range(12):
+            run_w = w[starts[i]:ends[i]]
+            assert np.all(run_w > 0)
+            assert float(run_w.sum()) == pytest.approx(
+                float(r["count"][i]), rel=2.0**-7)
+        assert int(np.asarray(r["packed_means"]).size) == int(ends[-1])
+
+    def test_promotion_hysteresis_needs_streak(self):
+        rng = np.random.default_rng(4)
+        g = make_group(promote_samples=16, promote_intervals=2,
+                       chunk=16)
+        _feed(g, {0: 40}, rng)  # chunk=16: drains (and the promotion
+        # check) run mid-interval. Interval 1: hot, streak 1 < 2 —
+        # stays pooled
+        assert g.directory.promotions == 0
+        g.flush(QS)
+        g = g.fresh()
+        _feed(g, {0: 40}, rng)
+        # interval 2: streak reached — promoted MID-interval, before
+        # any flush
+        assert g.directory.promotions == 1
+        assert g.directory.dense_count() == 1
+        assert len(g._dense_rows) == 1
+
+    def test_demotion_after_idle_intervals(self):
+        rng = np.random.default_rng(6)
+        g = make_group(promote_samples=8, promote_intervals=1,
+                       demote_intervals=2)
+        _feed(g, {0: 20}, rng)
+        g.flush(QS)  # staging drains -> promotion, then end_interval
+        assert g.directory.dense_count() == 1
+        g = g.fresh()
+        _feed(g, {0: 2}, rng)
+        for _ in range(1):  # second idle (sub-bar) interval
+            g.flush(QS)
+            g = g.fresh()
+            _feed(g, {0: 2}, rng)
+        g.flush(QS)
+        assert g.directory.demotions == 1
+        assert g.directory.dense_count() == 0
+        # ...and the series keeps aggregating correctly from the pool
+        g = g.fresh()
+        _feed(g, {0: 4}, rng)
+        _, r = g.flush(QS)
+        assert r["count"][0] == 4.0
+
+    def test_oscillating_series_does_not_ping_pong(self):
+        rng = np.random.default_rng(8)
+        g = make_group(promote_samples=16, promote_intervals=2,
+                       demote_intervals=3)
+        # alternates hot/cold every interval: never builds the streak
+        for k in range(6):
+            _feed(g, {0: 40 if k % 2 == 0 else 2}, rng)
+            g.flush(QS)
+            g = g.fresh()
+        assert g.directory.promotions == 0
+        assert g.directory.demotions == 0
+
+    def test_fresh_twin_shares_directory(self):
+        g = make_group(promote_samples=8, promote_intervals=1)
+        rng = np.random.default_rng(9)
+        _feed(g, {0: 20}, rng)
+        g.flush(QS)  # drain -> promote; the directory remembers s0
+        t = g.fresh()
+        assert t.directory is g.directory
+        # the twin interns the promoted series straight into dense
+        _feed(t, {0: 1}, rng)
+        assert len(t._dense_rows) == 1
+
+    def test_import_centroids_lands_in_both_tiers(self):
+        g = make_group(promote_samples=8, promote_intervals=1)
+        rng = np.random.default_rng(10)
+        _feed(g, {0: 20}, rng)  # row 0 promotes
+        for i in (0, 1):
+            means = np.array([10.0, 20.0, 30.0], np.float32)
+            weights = np.array([2.0, 3.0, 5.0], np.float32)
+            g.import_centroids(_key(i), [], means, weights, 5.0, 35.0)
+        _, r = g.flush(QS, want_stats=("count", "min", "max"))
+        # imported extrema bound the digest, not the scalar stats
+        # (samplers.go:473-480); pooled and dense rows agree
+        assert r["digest_min"][1] == pytest.approx(5.0)
+        assert r["digest_max"][1] == pytest.approx(35.0)
+        assert r["digest_min"][0] <= 5.0
+        assert r["count"][1] == 0.0
+
+
+class TestCheckpointRoundTrip:
+    def _emissions(self, store):
+        final, _, _ = _flush(store, now=100)
+        return {(m.name, tuple(m.tags)): m.value for m in final}
+
+    def _populate(self, store, rng):
+        for i in range(10):
+            n = 60 if i < 2 else 5  # 2 promotion-worthy, 8 cold
+            for v in rng.gamma(2.0, 50.0, n):
+                store.process_metric(parse_metric(
+                    f"h{i}:{v:.4f}|h|#env:dev".encode()))
+        for _ in range(4):
+            store.process_metric(parse_metric(b"c1:2|c"))
+
+    @pytest.mark.parametrize("src,dst", [("tiered", "tiered"),
+                                         ("tiered", "dense"),
+                                         ("dense", "tiered"),
+                                         ("tiered", "slab")])
+    def test_roundtrip_across_tier_assignments(self, src, dst):
+        """A snapshot flattens BOTH tiers into the shared centroid-run
+        layout, so it restores into any digest store — including one
+        whose tier assignment differs (the dst tiered store has an
+        empty TierDirectory: everything re-enters via the pool)."""
+        rng = np.random.default_rng(20)
+        store = make_store(digest_storage=src,
+                           tier_promote_samples=16,
+                           tier_promote_intervals=1)
+        self._populate(store, rng)
+        if src == "tiered":
+            assert store.histograms.directory.promotions >= 2
+        groups, _ = store.snapshot_state()
+
+        restored = make_store(digest_storage=dst)
+        assert restored.restore_state(groups) > 0
+        want = self._emissions(store)
+        got = self._emissions(restored)
+        assert set(want) == set(got)
+        spread = {}
+        for (name, tags), v in want.items():
+            if name.endswith(".max"):
+                base = name[:-4]
+                spread[(base, tags)] = v - want[(base + ".min", tags)]
+        for (name, tags), v in want.items():
+            if "percentile" in name:
+                # quantiles re-enter the dst's binning (a pool row is
+                # 16 slots): within 10% of the row's value spread — the
+                # same envelope the group-parity test asserts
+                base = name.rsplit(".", 1)[0]
+                tol = max(0.10 * spread.get((base, tags), 0.0), 1e-3)
+                assert abs(got[(name, tags)] - v) <= tol, name
+            else:  # counts/min/max/sum are exact through the layout
+                assert got[(name, tags)] == pytest.approx(
+                    v, rel=1e-5), name
+
+    def test_promotion_landing_mid_snapshot(self):
+        """snapshot_begin dispatches async slices under the lock; a
+        promotion that lands before finish() (donating and clearing
+        pool planes) must not corrupt the fetched snapshot — it reads
+        the state as of begin, counts conserved."""
+        rng = np.random.default_rng(21)
+        g = make_group(promote_samples=16, promote_intervals=1,
+                       chunk=16)
+        vals = _feed(g, {0: 8, 1: 4}, rng)
+        snap, finish = g.snapshot_begin()
+        # row 0 crosses the bar while the fetch is still pending
+        _feed(g, {0: 30}, rng)
+        assert g.directory.promotions == 1
+        finish()
+        restored = DigestGroup(32, chunk=64)
+        from veneur_tpu.core.store import bulk_stage_import_centroids
+
+        row_map = np.array([restored._row(_key(i), []) for i in
+                            range(len(snap["names"]))], np.int32)
+        rows = row_map[np.asarray(snap["rows"], np.int64)]
+        finite = np.isfinite(snap["mins"])
+        bulk_stage_import_centroids(
+            restored, rows, snap["means"], snap["weights"],
+            row_map[finite], snap["mins"][finite], snap["maxs"][finite])
+        restored.restore_stats(row_map, snap["count"], snap["vsum"],
+                               snap["vmin"], snap["vmax"], snap["recip"])
+        _, r = restored.flush(QS)
+        assert r["count"][0] == 8.0  # pre-promotion state, exactly
+        assert r["count"][1] == 4.0
+        assert r["min"][0] == pytest.approx(vals[0].min())
+        # and the live group still holds the full interval
+        _, live = g.flush(QS)
+        assert live["count"][0] == 38.0
+
+    def test_flush_epoch_guard_still_moves(self):
+        store = make_store(tier_promote_samples=8,
+                           tier_promote_intervals=1)
+        self._populate(store, np.random.default_rng(22))
+        _, epoch = store.snapshot_state()
+        _flush(store)
+        # the PR 2 contract the checkpointer keys on: a snapshot taken
+        # before the flush must not commit after it
+        assert store.flush_epoch != epoch
+
+
+class TestLadderAndCaps:
+    def _ingest(self, store, n=64, name=b"lat"):
+        rng = np.random.default_rng(7)
+        for v in rng.normal(100.0, 15.0, n):
+            store.process_metric(parse_metric(b"%s:%f|h" % (name, v)))
+
+    def test_requeue_rung_conserves_counts(self, fake_clock,
+                                           monkeypatch):
+        """Both ladder rungs fail -> the retired tiered generation
+        re-merges into the live store: late, never lost, exact."""
+        store = make_store(tier_promote_samples=16,
+                           tier_promote_intervals=1,
+                           compute=ComputeBreaker(
+                               failure_threshold=1, reset_timeout=30.0,
+                               clock=fake_clock))
+        self._ingest(store, 32)
+
+        def raiser(self, *a, **kw):
+            raise RuntimeError("injected tiered kernel failure")
+
+        monkeypatch.setattr(TieredDigestGroup, "_flush_fetch", raiser)
+        final, _, _ = _flush(store, 1)
+        assert not any(m.name.startswith("lat.") for m in final)
+        assert store.compute.requeued_total == 1
+        assert store.compute.lost_total == 0
+        monkeypatch.undo()
+        fake_clock.advance(60.0)
+        final, _, _ = _flush(store, 2)
+        by = {m.name: m.value for m in final}
+        assert by["lat.count"] == 32.0
+
+    def test_xla_rung_matches_pallas_rung(self, fake_clock):
+        """An open breaker routes the tiered flush (pool programs AND
+        the embedded dense bank) onto use_pallas=False; results match
+        the healthy path within digest tolerance."""
+        mk = dict(tier_promote_samples=16, tier_promote_intervals=1)
+        healthy = make_store(**mk)
+        degraded = make_store(compute=ComputeBreaker(
+            failure_threshold=1, reset_timeout=1e9, clock=fake_clock),
+            **mk)
+        degraded.compute.record_failure()
+        assert degraded.compute.degraded()
+        self._ingest(healthy, 48)
+        self._ingest(degraded, 48)
+        assert degraded.histograms._pallas_allowed() is False
+        want = {m.name: m.value for m in _flush(healthy)[0]}
+        got = {m.name: m.value for m in _flush(degraded)[0]}
+        assert set(want) == set(got)
+        for name, v in want.items():
+            assert got[name] == pytest.approx(v, rel=1e-5), name
+        assert degraded.compute.fallback_total >= 1
+
+    def test_cardinality_cap_balances_exactly(self):
+        store = make_store(max_series=8, tier_promote_samples=4,
+                           tier_promote_intervals=1)
+        total = 0
+        for i in range(50):
+            reps = 6 if i < 2 else 1  # hot rows promote under the cap
+            for _ in range(reps):
+                store.process_metric(parse_metric(b"h%02d:5|h" % i))
+                total += 1
+        g = store.histograms
+        assert len(g) <= 8
+        # 7 real rows + the overflow row; the other 43 series spilled
+        # one sample each
+        assert g.spilled == 43
+        final, _, _ = _flush(store)
+        counts = {m.name: m.value for m in final
+                  if m.name.endswith(".count")}
+        # conservation: every admitted sample is in SOME row's count
+        assert sum(counts.values()) == float(total)
+        assert counts["veneur.overload.overflow.count"] == float(
+            g.spilled)
+
+    def test_quarantine_applies_to_pool_path(self):
+        g = make_group()
+        g.sample(_key(0), [], float("nan"), 1.0)
+        g.sample(_key(0), [], 1e39, 1.0)
+        g.sample(_key(0), [], 5.0, 0.0)
+        g.sample(_key(0), [], 5.0, 1.0)
+        _, r = g.flush(QS)
+        assert r["count"][0] == 1.0
+
+
+class TestConfigSurface:
+    def _cfg(self, **kw):
+        from veneur_tpu.config import Config
+
+        cfg = Config(**kw)
+        cfg.apply_defaults()
+        cfg.validate()
+        return cfg
+
+    def test_tier_defaults_applied(self):
+        cfg = self._cfg(digest_storage="tiered")
+        assert cfg.tier_pool_centroids == 16
+        assert (cfg.tier_promote_samples, cfg.tier_promote_intervals,
+                cfg.tier_demote_intervals) == (64, 2, 3)
+
+    @pytest.mark.parametrize("kw", [
+        {"tier_pool_centroids": 12},   # not a pow2
+        {"tier_pool_centroids": 4},    # below the floor
+        {"tier_promote_samples": -1},
+        {"tier_demote_intervals": -2},
+        {"digest_storage": "tiered", "mesh_enabled": True},
+        {"digest_storage": "ragged"},
+    ])
+    def test_invalid_rejected(self, kw):
+        with pytest.raises(ValueError):
+            self._cfg(**kw)
+
+    def test_server_threads_tier_knobs(self):
+        from veneur_tpu.config import Config
+        from veneur_tpu.server import Server
+        from veneur_tpu.sinks import ChannelMetricSink
+
+        cfg = Config(statsd_listen_addresses=[], interval="86400s",
+                     store_initial_capacity=32, store_chunk=128,
+                     slab_rows=256, digest_storage="tiered",
+                     tier_promote_samples=8, tier_promote_intervals=1)
+        server = Server(cfg, metric_sinks=[ChannelMetricSink()])
+        assert isinstance(server.store.histograms, TieredDigestGroup)
+        assert isinstance(server.store.timers, TieredDigestGroup)
+        assert server.store.histograms.promote_samples == 8
+        assert server.store.histograms.directory.promote_intervals == 1

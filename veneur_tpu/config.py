@@ -135,9 +135,29 @@ class Config:
     # initial dense-series capacity per scope-class (grows by doubling)
     store_initial_capacity: int = 4096
     # histogram/timer digest backing store: "dense" (one [S,K] plane per
-    # group, default) or "slab" (flat per-slab planes, the multi-million-
-    # series capacity plan of core/slab.py; grows one slab at a time)
+    # group, default), "slab" (flat per-slab planes, the multi-million-
+    # series capacity plan of core/slab.py; grows one slab at a time), or
+    # "tiered" (core/tiered.py: cold series in a packed u16/bf16 quantized
+    # pool at ~228 B/row, promotion to dense full-K slots on sustained
+    # activity — the 5-10x series-capacity plan at realistic density)
     digest_storage: str = "dense"
+    # tiered store: packed-pool centroid slots per series (power of two
+    # >= 8; more slots = finer cold-row quantiles, more resident bytes)
+    tier_pool_centroids: int = 16
+    # tiered store: interval sample count at/above which a series counts
+    # as HOT (0 = default 64); a HOT pool series is promoted to a dense
+    # slot mid-interval once its hot streak meets tier_promote_intervals
+    tier_promote_samples: int = 0
+    # tiered store: consecutive HOT intervals a pool series needs before
+    # it takes a dense slot (0 = default 2) — promotion-side hysteresis
+    # so a series oscillating around the activity bar doesn't grab a
+    # dense slot on one spike
+    tier_promote_intervals: int = 0
+    # tiered store: consecutive idle (below-bar) intervals after which a
+    # dense series demotes back to the packed pool at the next flush
+    # boundary (0 = default 3) — demotion-side hysteresis against dense
+    # slot ping-ponging
+    tier_demote_intervals: int = 0
     # resident digest dtype for the slab store: "float32" or "bfloat16"
     # (bf16 halves HBM — the 10M-series-per-chip plan; kernel math and
     # counts stay f32, quantile storage rounding <= 2^-8 relative)
@@ -269,10 +289,21 @@ class Config:
             from veneur_tpu.crash import SentryReporter
 
             SentryReporter(self.sentry_dsn)  # raises on malformed DSN
-        if self.digest_storage not in ("dense", "slab"):
+        if self.digest_storage not in ("dense", "slab", "tiered"):
             raise ValueError(
-                f"digest_storage must be 'dense' or 'slab', got "
-                f"{self.digest_storage!r}")
+                f"digest_storage must be 'dense', 'slab' or 'tiered', "
+                f"got {self.digest_storage!r}")
+        pk = self.tier_pool_centroids
+        if pk < 8 or pk & (pk - 1):
+            raise ValueError(
+                f"tier_pool_centroids must be a power of two >= 8 (the "
+                f"packed pool's per-row centroid budget), got {pk}")
+        for knob in ("tier_promote_samples", "tier_promote_intervals",
+                     "tier_demote_intervals"):
+            if getattr(self, knob) < 0:
+                raise ValueError(
+                    f"{knob} must be >= 0 (0 = use the default), "
+                    f"got {getattr(self, knob)}")
         if self.digest_dtype not in ("float32", "bfloat16"):
             raise ValueError(
                 f"digest_dtype must be 'float32' or 'bfloat16', got "
@@ -284,11 +315,11 @@ class Config:
         if self.slab_rows <= 0:
             raise ValueError(f"slab_rows must be positive, got "
                              f"{self.slab_rows}")
-        if self.digest_storage == "slab" and self.mesh_enabled:
+        if self.digest_storage != "dense" and self.mesh_enabled:
             raise ValueError(
-                "digest_storage: slab and mesh_enabled are mutually "
-                "exclusive — the mesh store is its own capacity plan "
-                "(series sharded across chips); pick one")
+                f"digest_storage: {self.digest_storage} and mesh_enabled "
+                f"are mutually exclusive — the mesh store is its own "
+                f"capacity plan (series sharded across chips); pick one")
         if self.breaker_failure_threshold < 0:
             raise ValueError(
                 f"breaker_failure_threshold must be >= 0 (0 = use the "
@@ -412,6 +443,13 @@ class Config:
             self.compute_breaker_failure_threshold = 2
         if not self.compute_breaker_reset_timeout:
             self.compute_breaker_reset_timeout = "60s"
+        # tiered-residency hysteresis defaults (core/tiered.py)
+        if not self.tier_promote_samples:
+            self.tier_promote_samples = 64
+        if not self.tier_promote_intervals:
+            self.tier_promote_intervals = 2
+        if not self.tier_demote_intervals:
+            self.tier_demote_intervals = 3
         self.compute_breaker_reset_timeout_seconds = parse_duration(
             self.compute_breaker_reset_timeout)
         # parse-once (round-1 audit policy): 0.0 = unset, the server

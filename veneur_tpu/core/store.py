@@ -1828,16 +1828,21 @@ class MetricStore:
                  digest_dtype: str = "float32", slab_rows: int = 1 << 20,
                  topk_depth: int = 4, topk_width: int = 1 << 16,
                  topk_k: int = 32, max_series: int = 0,
-                 max_tag_length: int = 0, compute=None, overload=None):
+                 max_tag_length: int = 0, compute=None, overload=None,
+                 tier_pool_centroids: int = 16,
+                 tier_promote_samples: int = 64,
+                 tier_promote_intervals: int = 2,
+                 tier_demote_intervals: int = 3):
         self._lock = threading.RLock()
         # serializes whole flush() calls (the store lock itself is held
         # only for the generation swap — see flush())
         self._flush_gate = threading.Lock()
         self.mesh = mesh
-        if mesh is not None and digest_storage == "slab":
+        if mesh is not None and digest_storage in ("slab", "tiered"):
             raise ValueError(
-                "digest_storage='slab' cannot combine with a device mesh "
-                "(the mesh store shards series across chips instead)")
+                f"digest_storage={digest_storage!r} cannot combine with a "
+                f"device mesh (the mesh store shards series across chips "
+                f"instead)")
 
         def _slab_group():
             # the multi-million-series capacity plan (core/slab.py): flat
@@ -1847,6 +1852,21 @@ class MetricStore:
             return SlabDigestGroup(slab_rows=slab_rows, chunk=chunk,
                                    compression=compression,
                                    digest_dtype=digest_dtype)
+
+        def _tiered_group():
+            # the ragged-residency capacity plan (core/tiered.py):
+            # packed pool + activity-promoted dense slots; each group
+            # owns ONE TierDirectory shared by its generation twins
+            from veneur_tpu.core.tiered import TieredDigestGroup
+
+            return TieredDigestGroup(
+                slab_rows=min(slab_rows, 1 << 18), chunk=chunk,
+                compression=compression,
+                pool_centroids=tier_pool_centroids,
+                promote_samples=tier_promote_samples,
+                promote_intervals=tier_promote_intervals,
+                demote_intervals=tier_demote_intervals,
+                dense_capacity=initial_capacity)
 
         self._slab_group = _slab_group
         self.counters = ScalarGroup("counter", initial_capacity)
@@ -1870,6 +1890,10 @@ class MetricStore:
             self.histograms = self._slab_group()
             self.timers = self._slab_group()
             self.sets = SetGroup(initial_capacity, chunk, hll_precision)
+        elif digest_storage == "tiered":
+            self.histograms = _tiered_group()
+            self.timers = _tiered_group()
+            self.sets = SetGroup(initial_capacity, chunk, hll_precision)
         else:
             self.histograms = DigestGroup(initial_capacity, chunk, compression)
             self.timers = DigestGroup(initial_capacity, chunk, compression)
@@ -1877,6 +1901,9 @@ class MetricStore:
         if digest_storage == "slab":
             self.local_histograms = self._slab_group()
             self.local_timers = self._slab_group()
+        elif digest_storage == "tiered":
+            self.local_histograms = _tiered_group()
+            self.local_timers = _tiered_group()
         else:
             self.local_histograms = DigestGroup(initial_capacity, chunk,
                                                 compression)

@@ -381,7 +381,8 @@ def bin_flat_samples(rows: jax.Array, values: jax.Array, weights: jax.Array,
                      num_series: int, capacity: int,
                      compression: float = DEFAULT_COMPRESSION,
                      acc_seg_w: jax.Array | None = None,
-                     acc_seg_wm: jax.Array | None = None):
+                     acc_seg_wm: jax.Array | None = None,
+                     acc_anchors: int = BELOW_MASS_ANCHORS):
     """Pre-cluster a flat batch of (row, value, weight) samples into k-bins.
 
     The streaming-ingest half of the TPU t-digest: instead of a per-digest
@@ -429,7 +430,7 @@ def bin_flat_samples(rows: jax.Array, values: jax.Array, weights: jax.Array,
     tot = jnp.maximum(totals[jnp.minimum(r, num_series)], jnp.finfo(w.dtype).tiny)
     if acc_seg_w is not None:
         below, acc_tot = _acc_below_mass(
-            r, v, acc_seg_w, acc_seg_wm, num_series)
+            r, v, acc_seg_w, acc_seg_wm, num_series, acc_anchors)
         q_mid = (below + q_excl + 0.5 * w) / jnp.maximum(
             tot + acc_tot, jnp.finfo(w.dtype).tiny)
     else:
@@ -439,10 +440,192 @@ def bin_flat_samples(rows: jax.Array, values: jax.Array, weights: jax.Array,
     return r, v, w, bins
 
 
+def _packed_below_mass(r: jax.Array, v: jax.Array, mq: jax.Array,
+                       wb: jax.Array, fmin: jax.Array, fmax: jax.Array,
+                       num_series: int, capacity: int):
+    """Per-sample accumulated mass below its value from the PACKED
+    centroid planes (step attribution at centroid granularity — the
+    pool compression keeps centroid mass within the k-scale envelope,
+    so the half-centroid error is the same bound a t-digest admits).
+    Gathers only the chunk's rows before dequantizing: [N, PK] work,
+    the same cost class as the bracket compares."""
+    rc = jnp.minimum(r, num_series - 1)
+    pm, pw = dequantize_centroids(
+        mq.reshape(num_series, capacity)[rc],
+        wb.reshape(num_series, capacity)[rc], fmin[rc], fmax[rc])
+    live = pw > 0
+    below = (jnp.sum(jnp.where(live & (pm < v[:, None]), pw, 0.0), axis=1)
+             + 0.5 * jnp.sum(jnp.where(live & (pm == v[:, None]), pw, 0.0),
+                             axis=1))
+    ptot = jnp.sum(jnp.where(live, pw, 0.0), axis=1)
+    return below, ptot
+
+
+def bin_pool_samples(rows: jax.Array, values: jax.Array,
+                     weights: jax.Array, num_series: int, capacity: int,
+                     compression: float, acc_w: jax.Array,
+                     acc_wm: jax.Array, mq: jax.Array | None = None,
+                     wb: jax.Array | None = None,
+                     fmin: jax.Array | None = None,
+                     fmax: jax.Array | None = None):
+    """Pool-tier binning: value-bracketed against the row's LIVE bin
+    means for sparse arrival, merged-rank quantile-anchored when the
+    chunk itself dominates the row's accumulated mass.
+
+    The dense/slab temps bin by estimated global quantile against an
+    [S, A] anchor *summary* (``bin_flat_samples``) — fine at K=48,
+    where the k-scale leaves slack between consecutive order
+    statistics. The tiered pool's PK (16) bins are too coarse for
+    that: under one-sample-per-row chunks (the realistic fleet
+    arrival shape) consecutive samples arrive with nearly the same
+    *estimated* quantile, so value-distant samples alias into the same
+    bin — measured up to 0.75 rank error on 4-sample rows. But in the
+    pool the bins ARE the anchors (A == PK == capacity), so each
+    sample can be placed directly against the live bin means instead:
+    find the bracketing live bins (lo, hi), then
+
+      * room in between -> value-interpolated bin inside the open gap
+        (rows with <= PK spread-out samples get exact singleton bins),
+      * no room -> the nearer-by-value neighbor (local smearing only,
+        the same bound a t-digest centroid admits),
+      * outside the envelope (a new row min/max) -> BISECT the open
+        side's bin range: the quantile estimate would place every new
+        extreme hard against the last-placed bin (estimated quantiles
+        of consecutive order statistics nearly coincide), exhausting
+        the side after two arrivals; halving the remaining range
+        instead supports log2 more distinct extremes before any
+        sharing, and keeps interior room for in-between arrivals,
+      * empty summary -> the quantile-anchored bin (the first chunk
+        degrades to exactly the uncorrected behavior, where the
+        within-chunk ranks are exact).
+
+    Value-bracketing exists to compensate for the MISSING relative-rank
+    information of chunk-solo samples; when one chunk carries more of a
+    row's mass than everything accumulated so far (a ramping series
+    about to cross the promotion bar, the refill after a guard drain, a
+    demotion re-import of a whole centroid run), the exact within-chunk
+    ranks ARE that information, and the bracket scheme fails in the
+    opposite direction: every sample of the run brackets against the
+    same PRE-chunk state, so a run of new maxima all bisect onto the
+    same bin (measured as a 43%-of-row-mass clump on promoted rows in
+    the 2g bench shape). Such rows use the merged-rank estimate
+    (accumulated below-mass + exact within-chunk rank) instead.
+
+    The accumulated mass feeding both the estimate and the dominance
+    test includes the PACKED planes (mq/wb/fmin/fmax, when given):
+    after a guard drain compacts the bins the row's history lives
+    there, and binning as though the row were empty re-anchored every
+    post-drain arrival chunk-relative — the blindness that made the
+    drain's "re-anchor" hurt the rows it meant to help.
+
+    Bin ids track the k-scale position only approximately under the
+    mixed scheme; the below-mass summary tolerates transient
+    non-monotonicity (cummax) and the flush compact re-sorts bins by
+    value, so correctness never depends on id order. All extra work is
+    [N, PK] compares + reductions, the same cost class as the
+    below-mass correction itself.
+
+    Returns (rows, values, weights, bins) sorted by row, like
+    ``bin_flat_samples``.
+    """
+    values = values.astype(jnp.float32)
+    weights = weights.astype(jnp.float32)
+    r, v, w = lax.sort((rows, values, weights), dimension=-1, num_keys=2,
+                       is_stable=False)
+    cw = _cumsum(w)
+    excl = cw - w
+    seg_start = jnp.concatenate([jnp.ones((1,), bool), r[1:] != r[:-1]])
+    base = _cummax(jnp.where(seg_start, excl, -jnp.inf))
+    q_excl = excl - base
+    totals = jnp.zeros((num_series + 1,), w.dtype).at[r].add(w, mode="drop")
+    tot = totals[jnp.minimum(r, num_series)]
+    below, acc_tot = _acc_below_mass(r, v, acc_w, acc_wm, num_series,
+                                     capacity)
+    if mq is not None:
+        pbelow, ptot = _packed_below_mass(r, v, mq, wb, fmin, fmax,
+                                          num_series, capacity)
+        below = below + pbelow
+        acc_tot = acc_tot + ptot
+    q_mid = (below + q_excl + 0.5 * w) / jnp.maximum(
+        tot + acc_tot, jnp.finfo(w.dtype).tiny)
+    kk = compression * (jnp.arcsin(jnp.clip(2.0 * q_mid - 1.0, -1.0, 1.0))
+                        / jnp.pi + 0.5)
+    qb = jnp.clip(jnp.floor(kk), 0, capacity - 1).astype(jnp.int32)
+    a_w = acc_w.reshape(num_series, capacity)
+    a_wm = acc_wm.reshape(num_series, capacity)
+    live = a_w > 0
+    means = jnp.where(live, a_wm / jnp.where(live, a_w, 1.0), jnp.nan)
+    rc = jnp.minimum(r, num_series - 1)
+    m_r = means[rc]                                   # [N, PK]
+    live_r = live[rc]
+    idx = jnp.arange(capacity, dtype=jnp.int32)
+    below = live_r & (m_r < v[:, None])
+    above = live_r & (m_r > v[:, None])
+    lo = jnp.max(jnp.where(below, idx, -1), axis=1)
+    hi = jnp.min(jnp.where(above, idx, capacity), axis=1)
+    m_lo = jnp.max(jnp.where(below, m_r, -jnp.inf), axis=1)
+    m_hi = jnp.min(jnp.where(above, m_r, jnp.inf), axis=1)
+    gap = hi - lo - 1                                 # free/equal bins
+    span = m_hi - m_lo
+    interp_ok = jnp.isfinite(span) & (span > 0)
+    frac = jnp.clip((v - m_lo) / jnp.where(interp_ok, span, 1.0),
+                    0.0, 1.0)
+    # frac 0 -> first free bin, 1 -> last; while >= 3 free bins remain,
+    # keep off the bins ADJACENT to the brackets — placing v flush
+    # against a bracket forecloses the whole value range between them
+    # for later arrivals (the repeated two-samples-merge failures the
+    # 4-sample rank-error sweep caught all reduce to this)
+    off = jnp.round(frac * (gap - 1).astype(v.dtype)).astype(jnp.int32)
+    off = jnp.clip(off, jnp.where(gap >= 3, 1, 0),
+                   jnp.where(gap >= 3, gap - 2, gap - 1))
+    b_interp = lo + 1 + off
+    low_open = (lo < 0) & (hi < capacity)     # new row minimum
+    high_open = (lo >= 0) & (hi >= capacity)  # new row maximum
+    b_onesided = jnp.where(low_open, (hi - 1) // 2, (lo + capacity) // 2)
+    b_room = jnp.where(interp_ok, b_interp,
+                       jnp.where(low_open | high_open, b_onesided, qb))
+    b_room = jnp.clip(b_room, lo + 1, hi - 1)
+    # gap == 0: share with the nearer-by-value neighbor — UNLESS that
+    # bin already holds more than the k-scale mid-q envelope
+    # (~2*total/C) and the other bracket is lighter, in which case the
+    # lighter bracket takes it. Nearest-only sharing has no mass cap:
+    # under chunk-solo arrival a mode-concentrated distribution piles
+    # every mid sample onto the single bin nearest the mode (measured
+    # 7/44 of a promoted row's mass on one bin = 0.16 rank error at the
+    # median, past the envelope the flush compact maintains). Both
+    # brackets span the same value interval, so the switch stays the
+    # local smearing a t-digest centroid admits; singleton/balanced
+    # bins never switch (strict <), and the -inf/inf sentinels push a
+    # value outside a one-sided envelope onto the single live
+    # bracketing bin (never onto a dead side).
+    wt_r = a_w[rc]
+    w_lo = jnp.take_along_axis(wt_r, jnp.clip(lo, 0, capacity - 1)[:, None],
+                               1)[:, 0]
+    w_hi = jnp.take_along_axis(wt_r, jnp.clip(hi, 0, capacity - 1)[:, None],
+                               1)[:, 0]
+    nearer_lo = (v - m_lo) <= (m_hi - v)
+    w_near = jnp.where(nearer_lo, w_lo, w_hi)
+    w_far = jnp.where(nearer_lo, w_hi, w_lo)
+    cap_w = 2.0 * (tot + acc_tot) / compression
+    switch = ((lo >= 0) & (hi < capacity) & (w_near + w > cap_w)
+              & (w_far < w_near))
+    b_full = jnp.where(nearer_lo ^ switch, lo, hi)
+    b = jnp.where(gap >= 1, b_room, b_full)
+    # chunk-dominant rows: the exact within-chunk ranks carry more
+    # information than the bracket state every run member shares
+    # (tot/acc_tot are row-level, so the whole run switches together);
+    # strict > keeps a second chunk-solo sample on the bracket path
+    b = jnp.where(tot > acc_tot, qb, b)
+    return r, v, w, jnp.clip(b, 0, capacity - 1).astype(jnp.int32)
+
+
 def _acc_below_mass(r: jax.Array, v: jax.Array, acc_seg_w: jax.Array,
-                    acc_seg_wm: jax.Array, num_series: int):
+                    acc_seg_wm: jax.Array, num_series: int,
+                    anchors: int = BELOW_MASS_ANCHORS):
     """Per-sample accumulated mass below its value, from the temp's
-    incremental BELOW_MASS_ANCHORS-segment summary.
+    incremental ``anchors``-segment summary (BELOW_MASS_ANCHORS for the
+    dense/slab temps; the tiered pool passes its own bin planes, whose
+    per-bin means are quantile-ordered by the same construction).
 
     Segments are quantile-ordered by construction (every previous
     chunk was binned by estimated global quantile and its mass
@@ -456,8 +639,8 @@ def _acc_below_mass(r: jax.Array, v: jax.Array, acc_seg_w: jax.Array,
     Returns (below [N], acc_total [N]) with zeros for rows that have
     accumulated nothing (first chunk == uncorrected behavior).
     """
-    a_w = acc_seg_w.reshape(num_series, BELOW_MASS_ANCHORS)
-    a_wm = acc_seg_wm.reshape(num_series, BELOW_MASS_ANCHORS)
+    a_w = acc_seg_w.reshape(num_series, anchors)
+    a_wm = acc_seg_wm.reshape(num_series, anchors)
     live = a_w > 0
     means = jnp.where(live, a_wm / jnp.where(live, a_w, 1.0), -jnp.inf)
     mono = jax.lax.cummax(means, axis=1)              # [S, A] envelope
@@ -594,7 +777,7 @@ SHIFT_GUARD_MIN_CHUNK_MASS = 4.0
 
 def shift_masses(acc_seg_w: jax.Array, acc_seg_wm: jax.Array,
                  rows: jax.Array, values: jax.Array, weights: jax.Array,
-                 num_series: int):
+                 num_series: int, anchors: int = BELOW_MASS_ANCHORS):
     """(shifted_mass, total_mass) of a chunk against the accumulated
     anchor summary — the raw inputs of ``shift_pred``, exposed
     separately so the mesh store can psum them over its axes before
@@ -603,8 +786,8 @@ def shift_masses(acc_seg_w: jax.Array, acc_seg_wm: jax.Array,
 
     rows may carry the padding sentinel (== num_series); padding and
     zero weights are excluded everywhere."""
-    acc_w2 = acc_seg_w.reshape(num_series, BELOW_MASS_ANCHORS)
-    acc_m2 = acc_seg_wm.reshape(num_series, BELOW_MASS_ANCHORS)
+    acc_w2 = acc_seg_w.reshape(num_series, anchors)
+    acc_m2 = acc_seg_wm.reshape(num_series, anchors)
     live_b = acc_w2 > 0
     means = jnp.where(live_b, acc_m2 / jnp.where(live_b, acc_w2, 1.0),
                       jnp.nan)
@@ -633,7 +816,8 @@ def shift_masses(acc_seg_w: jax.Array, acc_seg_wm: jax.Array,
 def shift_pred(acc_seg_w: jax.Array, acc_seg_wm: jax.Array,
                rows: jax.Array, values: jax.Array, weights: jax.Array,
                num_series: int,
-               frac: float = SHIFT_GUARD_FRAC) -> jax.Array:
+               frac: float = SHIFT_GUARD_FRAC,
+               anchors: int = BELOW_MASS_ANCHORS) -> jax.Array:
     """True when >= ``frac`` of the chunk's mass lands in rows whose
     value range is DISJOINT from what those rows' accumulated bins
     cover — a distribution step/shift that per-bin accumulation cannot
@@ -642,7 +826,7 @@ def shift_pred(acc_seg_w: jax.Array, acc_seg_wm: jax.Array,
     guard with lax.cond: drain the temp into the digest first, then
     ingest against fresh bins. Stationary traffic never triggers."""
     shifted, total = shift_masses(acc_seg_w, acc_seg_wm, rows, values,
-                                  weights, num_series)
+                                  weights, num_series, anchors)
     return shifted > frac * jnp.maximum(total,
                                         jnp.finfo(jnp.float32).tiny)
 
@@ -759,3 +943,48 @@ def from_centroids(mean: jax.Array, weight: jax.Array, mins: jax.Array,
     new_mean, new_weight = _compress(mean, weight, compression, k)
     return TDigest(mean=new_mean, weight=new_weight,
                    min=jnp.asarray(mins, mean.dtype), max=jnp.asarray(maxs, mean.dtype))
+
+
+# ---------------------------------------------------------------------------
+# Quantized (packed) centroid storage — the tiered pool's resident format
+# ---------------------------------------------------------------------------
+#
+# The packed wire format of core/slab.py:_pack_slab, promoted into a
+# RESIDENT representation (core/tiered.py): per row, means quantize to
+# uint16 against the row's own [fmin, fmax] frame (absolute error <=
+# span/65535) and weights round to bfloat16 bit patterns (relative
+# error <= 2^-9; exact counts ride separate f32 stats). Liveness is
+# weight > 0 exactly as in TDigest — a wb of 0 is the empty slot.
+
+
+def quantize_centroids(mean: jax.Array, weight: jax.Array):
+    """Quantize sorted, front-compacted [..., P] f32 centroid planes into
+    (means_q u16, weights_bf u16, fmin f32, fmax f32): the row frame is
+    the live-mean span, so quantization never clips. Rows with no live
+    centroids get an empty frame (+inf/-inf) and all-zero planes."""
+    live = weight > 0
+    fmin = jnp.min(jnp.where(live, mean, jnp.inf), axis=-1)
+    fmax = jnp.max(jnp.where(live, mean, -jnp.inf), axis=-1)
+    span = fmax - fmin
+    scale = jnp.where(span > 0, 65535.0 / span, 0.0)
+    mq = jnp.clip(jnp.round((jnp.where(live, mean, 0.0) - jnp.where(
+        jnp.isfinite(fmin), fmin, 0.0)[..., None]) * scale[..., None]),
+        0.0, 65535.0).astype(jnp.uint16)
+    mq = jnp.where(live, mq, 0)
+    wb = lax.bitcast_convert_type(
+        jnp.where(live, weight, 0.0).astype(jnp.bfloat16), jnp.uint16)
+    return mq, wb, fmin, fmax
+
+
+def dequantize_centroids(mq: jax.Array, wb: jax.Array, fmin: jax.Array,
+                         fmax: jax.Array):
+    """Inverse of :func:`quantize_centroids`: (mean f32 [..., P] with
+    +inf empties, weight f32). The one in-kernel place the packed
+    residency contract is decoded (host consumers go through
+    core.store.PackedDigestPlanes)."""
+    weight = lax.bitcast_convert_type(wb, jnp.bfloat16).astype(jnp.float32)
+    live = weight > 0
+    base = jnp.where(jnp.isfinite(fmin), fmin, 0.0)[..., None]
+    span = jnp.where(jnp.isfinite(fmax - fmin), fmax - fmin, 0.0)
+    mean = base + mq.astype(jnp.float32) * (span[..., None] / 65535.0)
+    return jnp.where(live, mean, jnp.inf), weight

@@ -28,10 +28,8 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-try:  # JAX >= 0.4.35 exports shard_map at top level
-    from jax import shard_map  # type: ignore
-except ImportError:  # pragma: no cover
-    from jax.experimental.shard_map import shard_map  # type: ignore
+# version-compat shard_map wrapper (check_vma/check_rep rename)
+from veneur_tpu.parallel.mesh import shard_map
 
 from veneur_tpu.ops import hll as hll_ops
 from veneur_tpu.ops import tdigest as td_ops

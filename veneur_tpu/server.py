@@ -285,6 +285,10 @@ class Server:
             digest_storage=config.digest_storage,
             digest_dtype=config.digest_dtype,
             slab_rows=config.slab_rows,
+            tier_pool_centroids=config.tier_pool_centroids,
+            tier_promote_samples=config.tier_promote_samples,
+            tier_promote_intervals=config.tier_promote_intervals,
+            tier_demote_intervals=config.tier_demote_intervals,
             topk_depth=config.topk_depth,
             topk_width=config.topk_width,
             topk_k=config.topk_k,
@@ -974,6 +978,8 @@ class Server:
                       "native_import_address", "tls_certificate",
                       "tls_key", "tls_authority_certificate",
                       "digest_storage", "digest_dtype", "slab_rows",
+                      "tier_pool_centroids", "tier_promote_samples",
+                      "tier_promote_intervals", "tier_demote_intervals",
                       "tdigest_compression", "hll_precision",
                       "mesh_enabled", "mesh_hosts",
                       "store_initial_capacity", "store_chunk",
