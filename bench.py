@@ -1212,8 +1212,12 @@ def bench_ingest_pps(duration: float = 3.0, senders: int = 3):
     from veneur_tpu.config import Config
     from veneur_tpu.server import Server
 
+    # ingest_lanes: -1 pins the LEGACY C++ reader-pool path — this lane
+    # is the single-pipeline baseline the 0b_ingest_fleet lane scales
+    # against (the default 0 would route UDP through the lane fleet)
     cfg = Config(statsd_listen_addresses=["udp://127.0.0.1:0"],
-                 interval="86400s", aggregates=["count"], num_readers=4)
+                 interval="86400s", aggregates=["count"], num_readers=4,
+                 ingest_lanes=-1)
     srv = Server(cfg, metric_sinks=[])
     srv.start()
     procs = []
@@ -1272,6 +1276,161 @@ def bench_ingest_pps(duration: float = 3.0, senders: int = 3):
         for p in procs:
             p.wait(timeout=30)
         srv.shutdown()
+
+
+_FLEET_BLAST = r'''
+import os, socket, sys, time
+# recvmmsg.py is stdlib-only: import it by file so the sender skips the
+# package __init__ (and with it the multi-second jax import)
+sys.path.insert(0, os.path.join(os.getcwd(), "veneur_tpu", "ingest"))
+from recvmmsg import BatchSender
+port, dur, burst, gap = (int(sys.argv[1]), float(sys.argv[2]),
+                         int(sys.argv[3]), float(sys.argv[4]))
+msgs = [("svc.req.latency:%d|ms|@0.5|#route:r%d,env:prod"
+         % (i % 497, i % 7)).encode() for i in range(64)]
+senders = []
+for i in range(16):
+    s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    s.connect(("127.0.0.1", port))
+    senders.append(BatchSender(s, msgs[(i % 2) * 32:(i % 2) * 32 + 32]))
+end = time.time() + dur
+i = 0
+while time.time() < end:
+    for _ in range(burst):
+        senders[i % 16].send_cycle()
+        i += 1
+    if gap:
+        time.sleep(gap)
+'''
+
+
+def bench_ingest_fleet(duration: float = 3.0, lane_counts=(1, 2, 4, 8),
+                       senders: int = 2):
+    """Ingest-lane fleet scaling (veneur_tpu/ingest/): packets/s over
+    real loopback UDP vs ``ingest_lanes``, plus the share-nothing
+    decode+stage capacity of one lane in isolation.
+
+    The fleet is driven directly (MetricStore + IngestFleet, no server
+    shell) by subprocess load generators that batch with ``sendmmsg``
+    across 16 source ports each — one ``send()`` syscall per datagram
+    would saturate the sender core long before any lane, and 16 flows
+    per sender keep SO_REUSEPORT's 4-tuple hash spreading datagrams
+    over every lane. ``linearity_ratio_4x`` is the 4-lane/1-lane
+    packets/s ratio; on hosts with fewer cores than
+    lanes + senders + merger the wire ratio measures the scheduler,
+    not the subsystem — ``core_limited`` flags that, and the
+    ``lane_decode_rps`` section (in-process spans, no sockets) shows
+    the per-lane staging capacity and its thread-scaling ceiling."""
+    import os as _os
+    import socket as _socket
+    import threading
+
+    from veneur_tpu.core.store import MetricStore
+    from veneur_tpu.ingest import IngestFleet, recvmmsg_available
+    from veneur_tpu.ingest.lanes import IngestLane
+    from veneur_tpu.protocol.addr import resolve_addr
+
+    chunk = 1 << 14
+    configs = {}
+    for lanes in lane_counts:
+        store = MetricStore(initial_capacity=1 << 14, chunk=chunk)
+        fleet = IngestFleet(store, resolve_addr("udp://127.0.0.1:0"),
+                            lanes, 1 << 21, 4096)
+        fleet.start()
+        port = fleet.bound[0][1]
+        procs = [subprocess.Popen(
+            [sys.executable, "-c", _FLEET_BLAST, str(port), "600",
+             "3", "0.001"], cwd=_HERE) for _ in range(senders)]
+        entry = {"lanes": lanes}
+        try:
+            # warm until the store has drained several full staging
+            # chunks: the first drain compiles the device scatter, and
+            # a compile inside the timed window measures XLA, not
+            # ingest (same contract as 0_ingest_udp's warmup)
+            deadline = time.time() + 60
+            while (fleet.totals()["merged"] < 4 * chunk
+                   and time.time() < deadline):
+                time.sleep(0.25)
+            if fleet.totals()["merged"] < 4 * chunk:
+                entry["error"] = "fleet did not warm up"
+                continue
+            t0 = time.perf_counter()
+            p0 = fleet.totals()["packets"]
+            time.sleep(duration)
+            p1 = fleet.totals()["packets"]
+            dt = time.perf_counter() - t0
+            entry["packets_per_s"] = int((p1 - p0) / dt)
+        finally:
+            for p in procs:
+                p.terminate()
+            for p in procs:
+                p.wait(timeout=30)
+            fleet.shutdown()
+            t = fleet.totals()
+            bal = fleet.balance()
+            entry.update({
+                "syscalls_per_packet": t["syscalls_per_packet"],
+                "merged": t["merged"], "shed": t["shed_records"],
+                "quarantined": t["quarantined"],
+                "balance_ok": bal["ok"]})
+            configs[str(lanes)] = entry
+
+    # lane decode+stage capacity in isolation: prebuilt datagram spans
+    # through the real native parse + columnar staging, no sockets —
+    # the per-lane ceiling the wire number approaches as cores allow,
+    # and (at 2/4 threads) how far the GIL lets lanes overlap
+    msgs = [("svc.req.latency:%d|ms|@0.5|#route:r%d,env:prod"
+             % (i % 497, i % 7)).encode() for i in range(64)]
+    span = [msgs[i % 64] for i in range(2048)]
+
+    def lane_only():
+        s = _socket.socket(_socket.AF_INET, _socket.SOCK_DGRAM)
+        s.bind(("127.0.0.1", 0))
+        return IngestLane(0, s, 4096, chunk, threading.Event())
+
+    def stage_for(lane, dur, out):
+        stage = (lane._stage_native if lane.using_native
+                 else lane._stage_python)
+        n = 0
+        t0 = time.perf_counter()
+        while time.perf_counter() - t0 < dur:
+            stage(span)
+            lane.sealed.clear()
+            n += len(span)
+        out.append(int(n / (time.perf_counter() - t0)))
+
+    decode_rps = {}
+    native_decode = None
+    for nthreads in (1, 2, 4):
+        pool = [lane_only() for _ in range(nthreads)]
+        if native_decode is None:
+            native_decode = pool[0].using_native
+        for lane in pool:
+            stage_for(lane, 0.2, [])  # warm
+        out = []
+        threads = [threading.Thread(target=stage_for, args=(lane, 1.5, out))
+                   for lane in pool]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join()
+        decode_rps[str(nthreads)] = sum(out)
+
+    pps1 = configs.get("1", {}).get("packets_per_s")
+    pps4 = configs.get("4", {}).get("packets_per_s")
+    cpus = _os.cpu_count() or 1
+    out = {"configs": configs,
+           "lane_decode_rps": decode_rps,
+           "cpu_count": cpus,
+           # senders + merger + lanes all need a core for the wire
+           # ratio to measure the fleet rather than the scheduler
+           "core_limited": cpus < 4 + senders + 1,
+           "recvmmsg": recvmmsg_available(),
+           "native_decode": native_decode,
+           "duration_s": duration}
+    if pps1 and pps4:
+        out["linearity_ratio_4x"] = round(pps4 / pps1, 2)
+    return out
 
 
 def bench_scalar_flush():
@@ -2265,6 +2424,10 @@ def _lane_plan(result, guarded):
 
     return [
         ("0_ingest_udp", guarded(bench_ingest_pps), 180),
+        # lane-fleet scaling: packets/s vs ingest_lanes in {1,2,4,8}
+        # with the linearity ratio in the record; 0_ingest_udp above
+        # stays the single-pipeline (legacy reader-pool) baseline
+        ("0b_ingest_fleet", guarded(bench_ingest_fleet), 420),
         ("1_scalar_10k", guarded(bench_scalar_flush), 120),
         ("2_histo_4m", guarded(headline_histo), 900),
         # north-star scale: 10M series on the one chip — bf16 resident

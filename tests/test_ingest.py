@@ -1,0 +1,559 @@
+"""Ingest-lane fleet (veneur_tpu/ingest/): lock-free lanes, group-
+boundary merge.
+
+The contracts under test are the ones the subsystem's design hangs on:
+seal/merge is exactly-once even when several threads drain concurrently
+(counts conserved per lane: ingested == merged + quarantined + shed +
+pending), lane-local intern rows never collide across lanes or across
+intern generations, overload sheds AT the lane socket with the tally
+rolled up off the hot path, and sealed-but-unmerged chunks reach a
+checkpoint snapshot through the store's ingest drain hook.
+"""
+
+import socket
+import threading
+import time
+
+import pytest
+
+from veneur_tpu.core import MetricStore
+from veneur_tpu.ingest import (BatchReceiver, BatchSender, IngestFleet,
+                               LaneLedger, ShardedCounter)
+from veneur_tpu.overload import LEVEL_SHED_PACKETS
+from veneur_tpu.protocol.addr import resolve_addr
+from veneur_tpu.samplers import HistogramAggregates
+
+DEFAULT_AGGS = HistogramAggregates()
+
+
+def make_store(**kw):
+    kw.setdefault("initial_capacity", 32)
+    kw.setdefault("chunk", 128)
+    return MetricStore(**kw)
+
+
+def make_fleet(store, lanes=1, **kw):
+    kw.setdefault("chunk_records", 256)
+    return IngestFleet(store, resolve_addr("udp://127.0.0.1:0"), lanes,
+                       1 << 20, 4096, **kw)
+
+
+def flush_map(store):
+    final, _, _ = store.flush([], DEFAULT_AGGS, is_local=True, now=1)
+    return {m.name: m for m in final}
+
+
+# ---------------------------------------------------------------------------
+# sharded counters
+# ---------------------------------------------------------------------------
+
+
+class TestShardedCounter:
+    def test_concurrent_adds_exact(self):
+        c = ShardedCounter()
+        n_threads, per = 8, 5000
+
+        def work():
+            for _ in range(per):
+                c.add(1)
+
+        threads = [threading.Thread(target=work) for _ in range(n_threads)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert c.total() == n_threads * per
+
+    def test_overflow_cell_after_thread_churn(self):
+        from veneur_tpu.ingest import counters as mod
+
+        c = ShardedCounter()
+        old = mod._MAX_CELLS
+        mod._MAX_CELLS = 2
+        try:
+            for _ in range(4):
+                t = threading.Thread(target=c.add, args=(3,))
+                t.start()
+                t.join()
+        finally:
+            mod._MAX_CELLS = old
+        assert c.total() == 12
+
+    def test_ledger_deltas(self):
+        led = LaneLedger()
+        led.count("nan", 2)
+        led.count("bad_rate")
+        assert led.take_deltas() == {"nan": 2, "bad_rate": 1}
+        led.count("nan")
+        assert led.take_deltas() == {"nan": 1}
+        assert led.take_deltas() == {}
+        assert led.total() == 4
+
+
+# ---------------------------------------------------------------------------
+# batched receive / send
+# ---------------------------------------------------------------------------
+
+
+class TestBatchedSyscalls:
+    @pytest.mark.parametrize("force_fallback", [False, True])
+    def test_round_trip(self, force_fallback):
+        r = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        r.bind(("127.0.0.1", 0))
+        recv = BatchReceiver(r, 4096, batch=8,
+                             force_fallback=force_fallback)
+        s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        s.connect(r.getsockname())
+        payloads = [b"a:%d|c" % i for i in range(12)]
+        sender = BatchSender(s, payloads)
+        assert sender.send_cycle() == 12
+        got = []
+        deadline = time.monotonic() + 5
+        while len(got) < 12 and time.monotonic() < deadline:
+            got.extend(recv.recv_batch(0.2))
+        assert sorted(got) == sorted(payloads)
+        assert recv.packets == 12
+        if recv.using_recvmmsg:
+            # 12 datagrams in batches of <= 8: at most 3 syscalls, not 12
+            assert recv.syscalls <= 3
+        s.close()
+        r.close()
+
+    def test_timeout_returns_empty(self):
+        r = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        r.bind(("127.0.0.1", 0))
+        recv = BatchReceiver(r, 4096)
+        assert recv.recv_batch(0.01) == []
+        r.close()
+
+
+# ---------------------------------------------------------------------------
+# seal / merge exactly-once
+# ---------------------------------------------------------------------------
+
+
+class TestSealMergeExactlyOnce:
+    def _stage(self, lane, lines):
+        if lane.using_native:
+            lane._stage_native(lines)
+        else:
+            lane._stage_python(lines)
+
+    @pytest.mark.parametrize("use_native", [None, False])
+    def test_counts_conserved_under_concurrent_drain(self, use_native):
+        store = make_store()
+        fleet = make_fleet(store, lanes=1, use_native=use_native)
+        lane = fleet.lanes[0]
+        total = 4000  # many chunks at chunk_records=256
+        stop = threading.Event()
+        errors = []
+
+        def drain():
+            while not stop.is_set():
+                try:
+                    fleet.merge_sealed()
+                except Exception as e:  # pragma: no cover
+                    errors.append(e)
+                    return
+
+        drainers = [threading.Thread(target=drain) for _ in range(4)]
+        for t in drainers:
+            t.start()
+        for i in range(total):
+            self._stage(lane, [b"x:1|c", b"lat.%d:%d|ms" % (i % 7, i)])
+        lane._seal()
+        # let the drainers race over the tail, then stop and do the
+        # final authoritative drain
+        time.sleep(0.05)
+        stop.set()
+        for t in drainers:
+            t.join()
+        fleet.merge_sealed()
+        assert not errors
+        bal = fleet.balance()
+        assert bal["ok"], bal
+        row = bal["lanes"][0]
+        assert row["ingested"] == 2 * total
+        assert row["merged"] == 2 * total
+        assert row["pending"] == 0 and row["shed"] == 0
+        # the store saw each sample exactly once: x accumulated 1 per
+        # staged line, never double-merged by a racing drainer
+        assert flush_map(store)["x"].value == total
+        fleet.shutdown()
+
+    def test_backlog_cap_sheds_payload_not_interns(self):
+        store = make_store()
+        fleet = make_fleet(store, lanes=1, max_backlog=2)
+        lane = fleet.lanes[0]
+        for i in range(5):
+            self._stage(lane, [b"series.%d:1|c" % i])
+            lane._seal()
+        # chunks 3..5 exceeded the backlog: payload shed, entry shipped
+        assert lane.shed_chunks == 3 and lane.shed_records == 3
+        fleet.merge_sealed()
+        bal = fleet.balance()
+        assert bal["ok"], bal
+        assert bal["lanes"][0]["merged"] == 2
+        assert bal["lanes"][0]["shed"] == 3
+        # shed chunks still taught the resolver their intern entries, so
+        # a LATER chunk referencing an earlier-minted row merges right
+        self._stage(lane, [b"series.4:7|c"])
+        lane._seal()
+        fleet.merge_sealed()
+        assert flush_map(store)["series.4"].value == 7
+        fleet.shutdown()
+
+    def test_raw_lines_routed_outside_store(self):
+        store = make_store()
+        raws = []
+        fleet = make_fleet(store, lanes=1, raw_handler=raws.append)
+        lane = fleet.lanes[0]
+        self._stage(lane, [b"_e{5,2}:hello|hi", b"ok:1|c"])
+        lane._seal()
+        fleet.merge_sealed()
+        assert raws and raws[0].startswith(b"_e{")
+        assert flush_map(store)["ok"].value == 1
+        fleet.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# lane-intern remap
+# ---------------------------------------------------------------------------
+
+
+class TestInternRemap:
+    def _stage(self, lane, lines):
+        if lane.using_native:
+            lane._stage_native(lines)
+        else:
+            lane._stage_python(lines)
+
+    def test_cross_lane_row_collisions_resolve_by_name(self):
+        # both lanes assign row 0/1 in OPPOSITE order for the same two
+        # series: the per-lane resolvers must keep them apart
+        store = make_store()
+        fleet = make_fleet(store, lanes=2)
+        a, b = fleet.lanes
+        self._stage(a, [b"first:1|c", b"second:10|c"])
+        self._stage(b, [b"second:100|c", b"first:1000|c"])
+        a._seal()
+        b._seal()
+        fleet.merge_sealed()
+        fm = flush_map(store)
+        assert fm["first"].value == 1001
+        assert fm["second"].value == 110
+        fleet.shutdown()
+
+    def test_gen_rollover_never_aliases_rows(self):
+        store = make_store()
+        fleet = make_fleet(store, lanes=1, intern_limit=1024)
+        lane = fleet.lanes[0]
+        self._stage(lane, [b"old:5|c"])
+        lane._seal()
+        # force the bounded-memory rollover: row 0 is re-minted for a
+        # DIFFERENT series under a new generation
+        lane._intern_total = lane._intern_limit
+        if lane._table is not None:
+            self._stage(lane, [b"fresh:7|c"])
+        else:
+            self._stage(lane, [b"fresh:7|c"])
+        lane._seal()
+        fleet.merge_sealed()
+        fm = flush_map(store)
+        assert fm["old"].value == 5
+        assert fm["fresh"].value == 7
+        assert lane.gen == 1
+        fleet.shutdown()
+
+    def test_flush_epoch_bump_rebuilds_remap(self):
+        store = make_store()
+        fleet = make_fleet(store, lanes=1)
+        lane = fleet.lanes[0]
+        self._stage(lane, [b"x:1|c"])
+        lane._seal()
+        fleet.merge_sealed()
+        assert flush_map(store)["x"].value == 1  # flush bumps the epoch
+        # same lane rows, new store generation: the stale remap must be
+        # dropped and rebuilt by re-interning the registry
+        self._stage(lane, [b"x:2|c"])
+        lane._seal()
+        fleet.merge_sealed()
+        assert flush_map(store)["x"].value == 2
+        fleet.shutdown()
+
+    def test_idle_series_not_resurrected_after_flush(self):
+        # the lane's lifetime registry must NOT be re-interned whole
+        # into every fresh store generation: a series that stops
+        # arriving stops being emitted (it would otherwise flush as
+        # zero forever, and the rebuild would hold the store lock for
+        # the registry size, not the chunk size)
+        store = make_store()
+        fleet = make_fleet(store, lanes=1)
+        lane = fleet.lanes[0]
+        self._stage(lane, [b"once:1|c", b"steady:1|c"])
+        lane._seal()
+        fleet.merge_sealed()
+        assert set(flush_map(store)) >= {"once", "steady"}
+        self._stage(lane, [b"steady:2|c"])
+        lane._seal()
+        fleet.merge_sealed()
+        fm = flush_map(store)
+        assert fm["steady"].value == 2
+        assert "once" not in fm
+        # ...but the row is still resolvable if the series comes back
+        self._stage(lane, [b"once:5|c"])
+        lane._seal()
+        fleet.merge_sealed()
+        assert flush_map(store)["once"].value == 5
+        fleet.shutdown()
+
+    def test_all_kinds_flow_through_merge(self):
+        store = make_store()
+        fleet = make_fleet(store, lanes=1)
+        lane = fleet.lanes[0]
+        self._stage(lane, [
+            b"c:3|c", b"g:2.5|g", b"h:1.5|h", b"t:12|ms",
+            b"s:member|s|#veneurlocalonly",
+            b"gc:4|c|#veneurglobalonly",
+        ])
+        lane._seal()
+        fleet.merge_sealed()
+        final, fwd, _ = store.flush([0.5], DEFAULT_AGGS, is_local=True,
+                                    now=1)
+        fm = {m.name: m for m in final}
+        assert fm["c"].value == 3
+        assert fm["g"].value == 2.5
+        assert fm["s"].value == pytest.approx(1, rel=0.01)  # set card.
+        assert any(m.name.startswith("h.") for m in final)
+        assert any(m.name.startswith("t.") for m in final)
+        assert fwd.counters == [("gc", [], 4)]
+        fleet.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# overload shed at the lane
+# ---------------------------------------------------------------------------
+
+
+class _ShedCtl:
+    """OverloadController stand-in pinned at the statsd-shed tier."""
+
+    def __init__(self, level=LEVEL_SHED_PACKETS):
+        self._level = level
+        self.shed = {}
+
+    def level_nowait(self):
+        return self._level
+
+    def level(self):
+        return self._level
+
+    def account_shed(self, lane, n):
+        self.shed[lane] = self.shed.get(lane, 0) + n
+
+
+class TestLaneOverloadShed:
+    def test_shed_at_socket_counted_and_rolled_up(self):
+        store = make_store()
+        ctl = _ShedCtl()
+        fleet = make_fleet(store, lanes=1, overload=ctl)
+        lane = fleet.lanes[0]
+        s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        s.connect(lane.sock.getsockname())
+        for _ in range(5):
+            s.send(b"x:1|c")
+        deadline = time.monotonic() + 5
+        got = 0
+        while got < 5 and time.monotonic() < deadline:
+            got += lane._ingest_once()
+        assert lane.shed_packets == 5
+        assert lane.staged == 0 and lane.parsed == 0
+        # the merger's rollup moves the lane-local tally to the ladder
+        fleet._rollup_sheds(ctl)
+        assert ctl.shed == {"statsd": 5}
+        fleet._rollup_sheds(ctl)  # idempotent: only deltas ship
+        assert ctl.shed == {"statsd": 5}
+        s.close()
+        fleet.shutdown()
+
+    def test_sustained_shed_still_seals_aged_residue(self):
+        # samples accepted BEFORE an overload shed began must not sit
+        # in staging for the whole episode: the aged-residue seal runs
+        # even on the shed path, so flushes/checkpoints see them
+        store = make_store()
+        ctl = _ShedCtl(level=0)
+        fleet = make_fleet(store, lanes=1, overload=ctl)
+        lane = fleet.lanes[0]
+        if lane.using_native:
+            lane._stage_native([b"pre.shed:4|c"])
+        else:
+            lane._stage_python([b"pre.shed:4|c"])
+        lane._first_stage_t = time.monotonic() - 10.0  # long aged
+        ctl._level = LEVEL_SHED_PACKETS
+        s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        s.connect(lane.sock.getsockname())
+        s.send(b"shed.me:1|c")
+        deadline = time.monotonic() + 5
+        got = 0
+        while got < 1 and time.monotonic() < deadline:
+            got += lane._ingest_once()
+        assert lane.shed_packets == 1
+        assert lane._staged_total == 0  # residue sealed, not stranded
+        fleet.merge_sealed()
+        assert flush_map(store)["pre.shed"].value == 4
+        assert fleet.balance()["ok"]
+        s.close()
+        fleet.shutdown()
+
+    def test_full_backlog_sheds_packets_before_decode(self):
+        # a wedged merger must cost bounded memory: once the sealed
+        # deque hits the cap, whole packets shed at the socket — no
+        # decode, no new intern entries, no new chunks
+        store = make_store()
+        fleet = make_fleet(store, lanes=1, max_backlog=2)
+        lane = fleet.lanes[0]
+        for i in range(2):
+            if lane.using_native:
+                lane._stage_native([b"fill.%d:1|c" % i])
+            else:
+                lane._stage_python([b"fill.%d:1|c" % i])
+            lane._seal()
+        s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        s.connect(lane.sock.getsockname())
+        for _ in range(3):
+            s.send(b"late:1|c")
+        deadline = time.monotonic() + 5
+        got = 0
+        while got < 3 and time.monotonic() < deadline:
+            got += lane._ingest_once()
+        assert lane.shed_packets == 3
+        assert len(lane.sealed) == 2  # deque did not grow
+        assert lane.parsed == 2       # nothing decoded past the cap
+        fleet.merge_sealed()
+        assert fleet.balance()["ok"]
+        s.close()
+        fleet.shutdown()
+
+    def test_quarantine_folds_to_store_ledger(self):
+        store = make_store()
+        fleet = make_fleet(store, lanes=1)
+        lane = fleet.lanes[0]
+        before = store.quarantine.total()
+        # 1e40 parses as a double but exceeds the f32 digest range: it
+        # must land in the lane ledger as out_of_range, not crash the
+        # lane and not reach the store (NaN/Inf die earlier, at parse)
+        if lane.using_native:
+            lane._stage_native([b"bad:1e40|ms", b"ok:1|c"])
+        else:
+            lane._stage_python([b"bad:1e40|ms", b"ok:1|c"])
+        lane._seal()
+        fleet.merge_sealed()
+        assert lane.quarantined == 1
+        assert store.quarantine.total() == before + 1
+        assert store.quarantine.snapshot()["out_of_range"] >= 1
+        bal = fleet.balance()
+        assert bal["ok"], bal
+        fleet.shutdown()
+
+    def test_fleet_pressure_tracks_backlog(self):
+        store = make_store()
+        fleet = make_fleet(store, lanes=1, max_backlog=4)
+        lane = fleet.lanes[0]
+        assert fleet.pressure() == 0.0
+        for i in range(2):
+            if lane.using_native:
+                lane._stage_native([b"p.%d:1|c" % i])
+            else:
+                lane._stage_python([b"p.%d:1|c" % i])
+            lane._seal()
+        assert fleet.pressure() == pytest.approx(0.5)
+        fleet.merge_sealed()
+        assert fleet.pressure() == 0.0
+        fleet.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# checkpoint composition
+# ---------------------------------------------------------------------------
+
+
+class TestCheckpointMidSeal:
+    def test_sealed_unmerged_chunks_reach_snapshot(self):
+        store = make_store()
+        fleet = make_fleet(store, lanes=1)
+        lane = fleet.lanes[0]
+        if lane.using_native:
+            lane._stage_native([b"ckpt:9|c"])
+        else:
+            lane._stage_python([b"ckpt:9|c"])
+        lane._seal()  # sealed, NOT merged — mid-flight at snapshot time
+        store.set_ingest_drain(fleet.merge_sealed)
+        groups, _epoch = store.snapshot_state()
+        assert fleet.totals()["merged"] == 1
+        # the snapshot itself carries the drained sample: restoring it
+        # into a fresh store reproduces the counter
+        fresh = make_store()
+        fresh.restore_state(groups)
+        assert flush_map(fresh)["ckpt"].value == 9
+        fleet.shutdown()
+
+    def test_snapshot_without_fleet_unaffected(self):
+        # no fleet registered: the drain hook stays None and snapshots
+        # behave exactly as before the subsystem existed
+        store = make_store()
+        groups, _ = store.snapshot_state()
+        assert isinstance(groups, dict)
+
+
+# ---------------------------------------------------------------------------
+# wire-level fleet (threads + sockets, the real lifecycle)
+# ---------------------------------------------------------------------------
+
+
+class TestFleetWire:
+    def test_end_to_end_counts_conserved(self):
+        store = make_store()
+        fleet = make_fleet(store, lanes=2, drain_tick=0.005)
+        fleet.start()
+        port = fleet.bound[0][1]
+        socks = []
+        # distinct source ports so SO_REUSEPORT spreads across lanes
+        for _ in range(8):
+            s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+            s.connect(("127.0.0.1", port))
+            socks.append(s)
+        sent = 0
+        for i in range(400):
+            socks[i % 8].send(b"wire.%d:1|c" % (i % 5))
+            sent += 1
+        deadline = time.monotonic() + 10
+        while (fleet.totals()["merged"] < sent
+               and time.monotonic() < deadline):
+            time.sleep(0.02)
+        fleet.shutdown()
+        t = fleet.totals()
+        # loopback UDP may drop under pressure; everything RECEIVED
+        # must be conserved and nothing may be double-merged
+        assert t["merged"] == t["parsed"] > 0
+        assert fleet.balance()["ok"], fleet.balance()
+        total = sum(m.value for m in flush_map(store).values()
+                    if m.name.startswith("wire."))
+        assert total == t["merged"]
+        for s in socks:
+            s.close()
+
+    def test_shutdown_flushes_staged_residue(self):
+        store = make_store()
+        fleet = make_fleet(store, lanes=1, drain_tick=0.005)
+        fleet.start()
+        s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        s.connect(("127.0.0.1", fleet.bound[0][1]))
+        s.send(b"residue:3|c")
+        deadline = time.monotonic() + 10
+        while (fleet.totals()["packets"] < 1
+               and time.monotonic() < deadline):
+            time.sleep(0.02)
+        fleet.shutdown()  # lane seals residue; final merge collects it
+        assert flush_map(store)["residue"].value == 3
+        assert fleet.balance()["ok"]
+        s.close()

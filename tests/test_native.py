@@ -290,9 +290,11 @@ class TestNativeUDPReader:
         from veneur_tpu.server import Server
         from veneur_tpu.sinks import ChannelMetricSink
 
+        # ingest_lanes: -1 pins the legacy C++ reader pool this test
+        # asserts on (the default 0 routes UDP through the lane fleet)
         cfg = Config(statsd_listen_addresses=["udp://127.0.0.1:0"],
                      interval="86400s", aggregates=["count"],
-                     num_readers=2)
+                     num_readers=2, ingest_lanes=-1)
         sink = ChannelMetricSink()
         server = Server(cfg, metric_sinks=[sink])
         server.start()

@@ -168,6 +168,14 @@ class Config:
     # drain plain-IPv4 UDP statsd listeners with the C++ recvmmsg reader
     # pool + batch parser when the native library is available
     native_ingest: bool = True
+    # sharded ingest-lane fleet for UDP statsd listeners
+    # (veneur_tpu/ingest/): each reader thread owns a lock-free lane
+    # (SO_REUSEPORT socket, recvmmsg batches, native parse, lane-local
+    # interner + columnar staging) merged into the store one chunk at a
+    # time at the group boundary. 0 = auto (one lane per reader,
+    # num_readers); N > 0 = explicit lane count; -1 = disabled (legacy
+    # readers: the C++ reader pool, else the Python read loops)
+    ingest_lanes: int = 0
     # gRPC forward writes the reference's repeated-Centroid schema IN
     # ADDITION to the packed arrays, so a Go global — or any importer
     # predating the packed extension — can read this local's digests.
@@ -317,9 +325,19 @@ class Config:
                              f"{self.slab_rows}")
         if self.digest_storage != "dense" and self.mesh_enabled:
             raise ValueError(
-                f"digest_storage: {self.digest_storage} and mesh_enabled "
-                f"are mutually exclusive — the mesh store is its own "
-                f"capacity plan (series sharded across chips); pick one")
+                f"digest_storage: {self.digest_storage} cannot combine "
+                f"with mesh_enabled yet: the mesh store shards DENSE "
+                f"[S,K] planes across chips and does not speak the slab "
+                f"layout or the tiered packed-pool residency "
+                f"(core/tiered.py). Run the mesh dense, or drop "
+                f"mesh_enabled — sharding the tiered store across the "
+                f"device mesh is the ROADMAP fleet-mode item (open "
+                f"item 1)")
+        if self.ingest_lanes < -1:
+            raise ValueError(
+                f"ingest_lanes must be -1 (disabled), 0 (auto: one lane "
+                f"per reader) or a positive lane count, got "
+                f"{self.ingest_lanes}")
         if self.breaker_failure_threshold < 0:
             raise ValueError(
                 f"breaker_failure_threshold must be >= 0 (0 = use the "

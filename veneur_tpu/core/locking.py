@@ -23,6 +23,7 @@ from __future__ import annotations
 
 REQUIRES_LOCK_ATTR = "__requires_lock__"
 ACQUIRES_LOCK_ATTR = "__acquires_lock__"
+LOCKFREE_HOT_PATH_ATTR = "__lockfree_hot_path__"
 
 
 def requires_lock(name: str):
@@ -40,6 +41,28 @@ def acquires_lock(name: str):
 
     def deco(fn):
         setattr(fn, ACQUIRES_LOCK_ATTR, name)
+        return fn
+
+    return deco
+
+
+def lockfree_hot_path(region: str):
+    """Assert this function's WHOLE call graph acquires no lock.
+
+    The inverse contract of the two annotations above: instead of
+    naming the lock a region needs, it declares the region must reach
+    none at all — neither an annotated ``@acquires_lock`` callee nor
+    any ``with <lock>:`` / ``.acquire()`` site, however deep. The
+    lock-order lint pass closes the call graph and fails the build
+    with ``hot-path-lock`` on a regression (docs/static-analysis.md).
+
+    ``region`` names the hot path in reports (e.g. ``"ingest"`` for
+    the reader-lane recv->decode->stage loop, whose design point is
+    zero shared locks per packet). Runtime no-op beyond the stamp.
+    """
+
+    def deco(fn):
+        setattr(fn, LOCKFREE_HOT_PATH_ATTR, region)
         return fn
 
     return deco

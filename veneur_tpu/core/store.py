@@ -1939,6 +1939,10 @@ class MetricStore:
         self._native_table = None
         self._mlist_table = None
         self._kind_groups = None
+        # set by the ingest-lane fleet (veneur_tpu/ingest/): invoked by
+        # snapshot_state so sealed-but-unmerged lane chunks reach the
+        # checkpoint
+        self._ingest_drain = None
 
     # -- overload plumbing (veneur_tpu/overload.py) ------------------------
 
@@ -2407,6 +2411,100 @@ class MetricStore:
             self.imported += n_ok
             return n_ok, n_err
 
+    # -- ingest-lane merge (veneur_tpu/ingest/) ----------------------------
+
+    # lane kind -> (native record type, scope) for re-interning lane
+    # entries through _intern_native: the inverse of kind_of() in
+    # native/veneur_ingest.cpp (MIXED_SCOPE falls through to the
+    # non-global/non-local branch for every type)
+    _KIND_NATIVE = {
+        _K_COUNTER: (0, 0), _K_GLOBAL_COUNTER: (0, GLOBAL_ONLY),
+        _K_GAUGE: (1, 0), _K_GLOBAL_GAUGE: (1, GLOBAL_ONLY),
+        _K_HISTO: (2, 0), _K_LOCAL_HISTO: (2, LOCAL_ONLY),
+        _K_TIMER: (3, 0), _K_LOCAL_TIMER: (3, LOCAL_ONLY),
+        _K_SET: (4, 0), _K_LOCAL_SET: (4, LOCAL_ONLY),
+        _K_TOPK: (4, _TOPK_SCOPE)}
+
+    def set_ingest_drain(self, drain) -> None:
+        """Register the ingest fleet's sealed-chunk drain; the
+        checkpoint snapshot calls it so mid-flight lane chunks are
+        captured (veneur_tpu/ingest/IngestFleet.merge_sealed)."""
+        self._ingest_drain = drain
+
+    @acquires_lock("store")
+    def import_lane_chunk(self, chunk, resolver) -> List[bytes]:
+        """Merge one sealed ingest-lane chunk under ONE store-lock hold
+        — the group-boundary half of the reader-lane design
+        (veneur_tpu/ingest/lanes.py): readers stage lock-free against
+        lane-local rows; this is the only place their samples meet
+        shared state, one lock acquisition per CHUNK instead of per
+        metric.
+
+        ``resolver`` is the merger's per-lane LaneResolver: its
+        accumulated (name, tags) registry remaps lane rows onto the
+        store interners. The remap invalidates whole when the flush
+        epoch moved (fresh generation twins restart their interners)
+        and rebuilds lazily from the registry. Values arrive already
+        scrubbed and in Go semantics (contribs truncated, weights as
+        f32 reciprocals) — the same bits process_batch would stage.
+
+        Returns the chunk's raw event/service-check lines for the
+        caller to route through the Python parser OUTSIDE the lock."""
+        with self._lock:
+            if resolver.epoch != self.flush_epoch:
+                resolver.remap = [None] * len(resolver.remap)
+                resolver.epoch = self.flush_epoch
+            for kind, new in chunk.new_entries.items():
+                resolver.entries[kind].extend(new)
+            for kind, span in chunk.spans.items():
+                rows = span[0]
+                remap = self._lane_remap(kind, resolver, rows)
+                grp_rows = remap[rows]
+                group = self._group_for_kind(kind)
+                group.ensure_capacity(int(grp_rows.max()))
+                if kind in (_K_COUNTER, _K_GLOBAL_COUNTER):
+                    group.add_many(grp_rows, span[1])
+                elif kind in (_K_GAUGE, _K_GLOBAL_GAUGE):
+                    group.set_many(grp_rows, span[1])
+                elif kind in (_K_SET, _K_LOCAL_SET):
+                    group.sample_many(grp_rows.astype(np.int32), span[1])
+                elif kind == _K_TOPK:
+                    group.sample_many(grp_rows.astype(np.int32), span[1],
+                                      span[3])
+                else:
+                    group.sample_many(grp_rows.astype(np.int32), span[1],
+                                      span[2])
+            self.processed += chunk.records
+        return chunk.raws
+
+    @requires_lock("store")
+    def _lane_remap(self, kind: int, resolver, rows) -> np.ndarray:
+        """Lane-row -> store-row array for one kind, resolved LAZILY
+        per referenced row (-1 = unresolved): only rows the incoming
+        chunk actually carries re-intern after a flush-epoch bump, so
+        an idle series the lane once saw is NOT resurrected into every
+        fresh store generation (it would emit as zero forever), and
+        the under-lock work is bounded by the chunk's live rows, not
+        the lane's lifetime registry. Interning goes through
+        _intern_native, so the tag-length cap and the overload
+        spill/freeze semantics apply to lane-merged series exactly as
+        to every other ingest path."""
+        entries = resolver.entries[kind]
+        remap = resolver.remap[kind]
+        if remap is None or len(remap) < len(entries):
+            grown = np.full(len(entries), -1, np.int64)
+            if remap is not None and len(remap):
+                grown[:len(remap)] = remap
+            remap = resolver.remap[kind] = grown
+        needed = np.unique(rows)
+        todo = needed[remap[needed] < 0]
+        if len(todo):
+            t, sc = self._KIND_NATIVE[kind]
+            for r in todo:
+                name_b, tags_b = entries[int(r)]
+                remap[r] = self._intern_native(t, sc, name_b, tags_b)[2]
+        return remap
+
     @acquires_lock("store")
     def import_topk(self, table: np.ndarray, series: List[tuple]):
         """Merge a forwarded heavy-hitter sketch (see
@@ -2448,6 +2546,16 @@ class MetricStore:
         longer matches, so it is dropped and the next cadence
         retries; the swapped-out groups' captured slices stay valid —
         they are fresh buffers the retired flush cannot donate)."""
+        # ingest lanes first: sealed-but-unmerged chunks carry real
+        # samples — fold them in (off-lock; the drain takes the store
+        # lock per chunk itself) so the snapshot's coverage matches
+        # what the lanes have already accepted
+        drain = self._ingest_drain
+        if drain is not None:
+            try:
+                drain()
+            except Exception:
+                log.exception("pre-snapshot ingest drain failed")
         with self._lock:
             epoch = self.flush_epoch
         groups = {}

@@ -122,10 +122,28 @@ def collect_vars(server) -> dict:
             }
     except Exception as e:  # pragma: no cover - diagnostic only
         out["store_error"] = repr(e)
-    for counter in ("packet_errors", "packet_drops"):
+    for counter in ("packet_errors", "packet_drops", "spans_dropped"):
+        # packet_errors/spans_dropped are read-side sums over sharded
+        # per-thread cells + per-lane tallies (veneur_tpu/ingest/):
+        # reading here never takes a lock the hot path could contend on
         v = getattr(server, counter, None)
         if v is not None:
             out[counter] = v
+    try:
+        fleets = getattr(server, "_ingest_fleets", None) or ()
+        if fleets:
+            out["ingest_fleet"] = [f.snapshot() for f in fleets]
+        receivers = getattr(server, "_udp_receivers", None) or ()
+        if receivers:
+            pkts = sum(r.packets for r in receivers)
+            calls = sum(r.syscalls for r in receivers)
+            out["udp_readers"] = {
+                "packets": pkts, "syscalls": calls,
+                "recvmmsg": all(r.using_recvmmsg for r in receivers),
+                "syscalls_per_packet": (round(calls / pkts, 4)
+                                        if pkts else None)}
+    except Exception as e:  # pragma: no cover - diagnostic only
+        out["ingest_fleet_error"] = repr(e)
     try:
         workers = getattr(server, "_span_workers", None) or ()
         lanes = []

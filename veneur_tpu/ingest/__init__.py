@@ -1,0 +1,47 @@
+"""Multi-core ingest fleet: lock-free per-reader lanes, merged at the
+group boundary.
+
+The reference scales ingest with SO_REUSEPORT per-core readers
+(``socket_linux.go:12-76``) feeding hash-partitioned workers that share
+*nothing* on the hot path (``worker.go:54-91``). This package is that
+design rebuilt for the TPU store: each reader thread owns a **lane** —
+its SO_REUSEPORT socket, a reusable recv buffer drained with
+``recvmmsg`` where the platform has it, a reusable native parse batch
+(``veneur_tpu.native`` releases the GIL during the parse), a lane-local
+intern table, lane-local columnar staging arrays per metric kind (the
+same rows/vals/wts layout the store groups stage in), and lane-local
+counters — zero shared locks and zero shared dict writes per packet.
+
+Lanes hand off at the **group boundary only**: a full (or idle-sealed)
+staging chunk is published to a lock-free per-lane deque, and the
+fleet's merger thread folds sealed chunks into the store under ONE
+store-lock hold per chunk (``MetricStore.import_lane_chunk``), remapping
+lane-local intern rows onto the store interners through a batched,
+flush-epoch-aware resolver.
+
+The lane hot path is *verified* lock-free: ``IngestLane._ingest_once``
+carries ``@lockfree_hot_path`` (``core/locking.py``) and the lock-order
+lint pass fails the build if its call graph ever reaches a registered
+lock (``hot-path-lock``, docs/static-analysis.md).
+
+See docs/internals.md ("Life of a datagram") for the lane lifecycle:
+recv -> decode -> stage -> seal -> merge.
+"""
+
+from veneur_tpu.ingest.counters import LaneLedger, ShardedCounter
+from veneur_tpu.ingest.lanes import (DRAIN_TICK, IngestFleet, IngestLane,
+                                     SealedChunk)
+from veneur_tpu.ingest.recvmmsg import (BatchReceiver, BatchSender,
+                                        recvmmsg_available)
+
+__all__ = [
+    "BatchReceiver",
+    "BatchSender",
+    "DRAIN_TICK",
+    "IngestFleet",
+    "IngestLane",
+    "LaneLedger",
+    "SealedChunk",
+    "ShardedCounter",
+    "recvmmsg_available",
+]

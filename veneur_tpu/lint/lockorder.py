@@ -24,6 +24,17 @@ deadlock detector):
    the store lock being held only for host-memory work, so any
    annotated region that transitively reaches a blocking op is flagged.
 
+3. **``hot-path-lock``** — the inverse assertion: a function declared
+   ``@lockfree_hot_path`` (core/locking.py) must reach NO lock through
+   its whole closed call graph — not an ``@acquires_lock`` callee, not
+   a ``with self.<lock>``, not an ``.acquire()``. The ingest reader
+   lanes (``veneur_tpu/ingest/lanes.py``) declare their
+   recv->decode->stage loop this way: the design point is zero shared
+   locks per packet, hand-off at the group boundary only, and a
+   regression — someone "just" adding a counter under
+   ``Server._counter_lock`` to the lane loop — fails lint instead of
+   silently re-serializing every reader core.
+
 Lock identity: the ``@requires_lock``/``@acquires_lock`` registry names
 the store lock ``"store"`` (rendered ``<store>``); any other ``with
 self.<attr>`` on a lock-shaped attribute is identified as
@@ -79,6 +90,23 @@ _SOCKET_VERBS = {"sendall", "sendto", "recvfrom", "recv_into", "recv",
 FnKey = Tuple[str, str]
 
 
+def _hot_path_decoration(node: ast.FunctionDef
+                         ) -> Optional[Tuple[str, int]]:
+    """(region, decorator line) if ``node`` carries
+    ``@lockfree_hot_path("...")``. The decorator's own line is where a
+    ``# lint: ok(hot-path-lock)`` pragma lives (node.lineno is the
+    ``def`` line, which a reader would not annotate)."""
+    for deco in node.decorator_list:
+        target = deco.func if isinstance(deco, ast.Call) else deco
+        name = dotted(target)
+        if name and name.split(".")[-1] == "lockfree_hot_path":
+            if isinstance(deco, ast.Call) and deco.args and \
+                    isinstance(deco.args[0], ast.Constant):
+                return str(deco.args[0].value), deco.lineno
+            return "", deco.lineno
+    return None
+
+
 def _blocking_op(node: ast.Call, jax_names: Set[str]) -> Optional[str]:
     """Human-readable op name if this call blocks, else None."""
     if not isinstance(node.func, ast.Attribute):
@@ -130,6 +158,8 @@ class _Analysis:
         self._local_env_cache: Dict[ast.FunctionDef, Dict] = {}
         self._jax_cache: Dict[str, Set[str]] = {}
         self._collect_classes()
+        # (key, region, decorator line) of every @lockfree_hot_path fn
+        self.hot_paths: List[Tuple[FnKey, str, int]] = []
         self.summaries: Dict[FnKey, _FnSummary] = {}
         self._build_summaries()
         self._close_summaries()
@@ -259,6 +289,9 @@ class _Analysis:
             sf = info.sf
             jax_names = self._jax_names(sf)
             s = _FnSummary()
+            hot = _hot_path_decoration(info.node)
+            if hot is not None:
+                self.hot_paths.append((key, hot[0], hot[1]))
             deco = locks_pass._lock_decoration(info.node)
             if deco and deco[0] == "acquires":
                 s.acquires.setdefault(f"<{deco[1]}>",
@@ -450,6 +483,43 @@ def _analyze(project: Project):
                          f"the hold or justify with "
                          f"`# lint: ok(lock-across-blocking)`")))
 
+    # hot-path lock-freedom: a @lockfree_hot_path function whose CLOSED
+    # summary reaches any lock acquisition breaks the share-nothing
+    # ingest contract (lanes hand off at the group boundary only)
+    hot_report = []
+    for key, region, deco_line in sorted(an.hot_paths,
+                                         key=lambda h: h[0]):
+        s = an.summaries.get(key)
+        if s is None:
+            continue
+        qual = an.fns[key].qual
+        sf = an.fns[key].sf
+        reached = []
+        for lock, (wfile, wline) in sorted(s.acquires.items()):
+            reached.append(lock)
+            # the acquisition witness may live in ANOTHER module than
+            # the decorated function: anchor the finding at the
+            # decorator (this file, stable line) and honor a pragma at
+            # either the decorator or the actual acquisition site
+            wsf = project.files.get(wfile)
+            if sf.suppressed(deco_line, "hot-path-lock") \
+                    or (wsf is not None
+                        and wsf.suppressed(wline, "hot-path-lock")):
+                continue
+            findings.append(Finding(
+                pass_name="lock-order", code="hot-path-lock",
+                file=sf.relpath, line=deco_line,
+                anchor=f"{qual}:{region or 'hot'}->{lock}",
+                message=(f"{qual} is declared @lockfree_hot_path"
+                         f"({region!r}) but its call graph reaches "
+                         f"lock {lock} (acquired at {wfile}:{wline}); "
+                         f"the hot path must stay lock-free — stage "
+                         f"into lane-local state and hand off at the "
+                         f"group boundary instead")))
+        hot_report.append({"fn": qual, "region": region,
+                           "file": sf.relpath, "line": deco_line,
+                           "locks": reached})
+
     # cycle detection over the lock edges (unique locks only; the
     # site-unique '?' ids can never complete a cycle by construction)
     adj: Dict[str, Set[str]] = {}
@@ -496,7 +566,10 @@ def _analyze(project: Project):
                              key=lambda e: (e["from"], e["to"])),
              "blocking": sorted(blocked.values(),
                                 key=lambda e: (e["lock"], e["op"],
-                                               e["via"]))}
+                                               e["via"])),
+             # every asserted-lock-free hot path and what (if anything)
+             # it reaches — diffable per PR like the edges
+             "hot_paths": hot_report}
     project._lockorder_result = (findings, graph)
     return findings, graph
 

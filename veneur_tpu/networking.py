@@ -160,6 +160,7 @@ def start_statsd(addr_spec: str, num_readers: int, recv_buf: int,
                  tls_config: Optional[ssl.SSLContext] = None,
                  admit: Optional[Callable[[], bool]] = None,
                  error_log_interval: float = DEFAULT_ERROR_LOG_INTERVAL,
+                 receivers: Optional[list] = None,
                  ):
     """Start DogStatsD listeners for one address spec (networking.go:18-35).
 
@@ -197,7 +198,7 @@ def start_statsd(addr_spec: str, num_readers: int, recv_buf: int,
             t = threading.Thread(
                 target=_udp_read_loop,
                 args=(sock, metric_max_length, handle_packet, stop,
-                      admit, limiter),
+                      admit, limiter, receivers),
                 name=f"statsd-udp-reader-{i}", daemon=True)
             t.start()
             threads.append(t)
@@ -221,24 +222,35 @@ def _udp_read_loop(sock: socket.socket, max_len: int,
                    handle_packet: Callable[[bytes], None],
                    stop: threading.Event,
                    admit: Optional[Callable[[], bool]] = None,
-                   limiter: Optional[_LogLimiter] = None):
+                   limiter: Optional[_LogLimiter] = None,
+                   receivers: Optional[list] = None):
     """Per-reader receive loop (server.go:795-825). Each datagram may hold
     several newline-separated metrics; oversize datagrams are truncated by
-    the OS and the tail line is dropped by the parser."""
+    the OS and the tail line is dropped by the parser.
+
+    Datagrams arrive in ``recvmmsg`` batches where the platform has it
+    (veneur_tpu/ingest/recvmmsg.py — one syscall for up to a batch of
+    datagrams instead of one each; portable ``recv`` fallback
+    otherwise). ``receivers``, when given, collects the BatchReceiver
+    so the caller can read syscalls-per-packet telemetry."""
+    from veneur_tpu.ingest.recvmmsg import BatchReceiver
+
     if limiter is None:
         limiter = _LogLimiter()
-    sock.settimeout(0.5)
+    receiver = BatchReceiver(sock, max_len)
+    if receivers is not None:
+        receivers.append(receiver)
     while not stop.is_set():
         try:
-            data = sock.recv(max_len)
-        except socket.timeout:
-            continue
+            datagrams = receiver.recv_batch(timeout=0.5)
         except OSError as e:
             if stop.is_set() or e.errno in (errno.EBADF,):
                 break
             limiter.warn("UDP recv error: %s", e)
             continue
-        if data:
+        for data in datagrams:
+            if not data:
+                continue  # zero-length datagrams are valid UDP; ignore
             if admit is not None and not admit():
                 continue  # shed at the socket; the governor accounts it
             handle_packet(data)
@@ -342,7 +354,8 @@ def start_ssf(addr_spec: str, num_readers: int, recv_buf: int,
               handle_ssf_stream: Callable[[socket.socket], None],
               stop: threading.Event,
               admit: Optional[Callable[[], bool]] = None,
-              error_log_interval: float = DEFAULT_ERROR_LOG_INTERVAL):
+              error_log_interval: float = DEFAULT_ERROR_LOG_INTERVAL,
+              receivers: Optional[list] = None):
     """Start SSF listeners (networking.go:138-223): UDP datagrams carry one
     bare SSFSpan protobuf each; UNIX/TCP streams carry framed spans.
     Returns (threads, bound addresses). ``admit``/``error_log_interval``
@@ -364,7 +377,7 @@ def start_ssf(addr_spec: str, num_readers: int, recv_buf: int,
             t = threading.Thread(
                 target=_udp_read_loop,
                 args=(sock, trace_max_length, handle_ssf_packet, stop,
-                      admit, limiter),
+                      admit, limiter, receivers),
                 name=f"ssf-udp-reader-{i}", daemon=True)
             t.start()
             threads.append(t)

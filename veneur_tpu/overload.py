@@ -135,6 +135,16 @@ class OverloadController:
                 q = lane.queue
                 if q.maxsize > 0:
                     p = max(p, q.qsize() / q.maxsize)
+        fleets = getattr(srv, "_ingest_fleets", None)
+        if not fleets:
+            fleet = getattr(srv, "ingest_fleet", None)
+            fleets = [fleet] if fleet is not None else []
+        for fleet in fleets:
+            # per-lane fill: sealed chunks backing up against the
+            # merger read as pipeline pressure exactly like a full
+            # span channel does — EVERY fleet counts, not just the
+            # first listener's
+            p = max(p, fleet.pressure())
         store = getattr(srv, "store", None)
         if store is not None:
             occ = 0.0
@@ -182,6 +192,20 @@ class OverloadController:
     def level(self) -> int:
         self._maybe_recompute()
         return self._level
+
+    def level_nowait(self) -> int:
+        """Lock-free level snapshot for the ingest-lane hot path: no
+        recompute, no lock — the fleet merger drives ``level()`` on its
+        tick, so this stays at most one tick stale. The lane loop's
+        lock-freedom assertion (``@lockfree_hot_path``) depends on this
+        read never touching ``_lock``."""
+        return self._level
+
+    def account_shed(self, lane: str, n: int) -> None:
+        """Fold lane-local shed tallies into the shared ledger (the
+        merger's roll-up; lanes count their own sheds lock-free)."""
+        with self._lock:
+            self.shed[lane] = self.shed.get(lane, 0) + n
 
     # -- admission ---------------------------------------------------------
 

@@ -398,19 +398,59 @@ class Server:
                            * self.interval),
                 hostname=self.hostname)
 
-        # ingest error/telemetry counters
-        self.packet_errors = 0
+        # ingest error/telemetry counters. packet_errors/spans_dropped
+        # are SHARDED (veneur_tpu/ingest/counters.py): the hot paths —
+        # every reader thread on every bad packet, every span shed —
+        # write a per-thread cell lock-free and the totals sum
+        # read-side at flush //debug/vars (the old _counter_lock
+        # serialized all readers exactly during poison bursts)
+        from veneur_tpu.ingest.counters import ShardedCounter
+
+        self._packet_errors = ShardedCounter()
+        self._spans_dropped = ShardedCounter()
+        self._packet_errors_adjust = 0  # property-setter shim (tests)
+        self._spans_dropped_adjust = 0
         self.packet_drops = 0
-        self.spans_dropped = 0
         self._last_spans_dropped = 0
-        self._counter_lock = threading.Lock()  # all ingest counters
+        self._counter_lock = threading.Lock()  # cold-path counters
         self._last_span_drop_log = 0.0
         self._last_packet_errors = 0
         self._last_packet_drops = 0
         self._warned_no_forward = False
+        # sharded ingest-lane fleets, one per UDP statsd address
+        # (veneur_tpu/ingest/); the first one feeds overload pressure
+        self.ingest_fleet = None
+        self._ingest_fleets: List = []
+        self._udp_receivers: List = []  # BatchReceivers of Python readers
         # bound listener addresses (useful when configured with port 0)
         self.statsd_addrs: List = []
         self.ssf_addrs: List = []
+
+    # -- sharded ingest counters --------------------------------------------
+
+    @property
+    def packet_errors(self) -> int:
+        """Bad-packet total: sharded reader cells + per-lane parse
+        errors, summed read-side (no lock on the increment path)."""
+        lanes = sum(f.parse_errors() for f in self._ingest_fleets)
+        return (self._packet_errors.total() + lanes
+                + self._packet_errors_adjust)
+
+    @packet_errors.setter
+    def packet_errors(self, value: int) -> None:
+        # test/tooling shim: absolute assignment adjusts the offset; the
+        # server itself only ever adds through the sharded counter
+        self._packet_errors_adjust = 0
+        self._packet_errors_adjust = value - self.packet_errors
+
+    @property
+    def spans_dropped(self) -> int:
+        return self._spans_dropped.total() + self._spans_dropped_adjust
+
+    @spans_dropped.setter
+    def spans_dropped(self, value: int) -> None:
+        self._spans_dropped_adjust = 0
+        self._spans_dropped_adjust = value - self.spans_dropped
 
     # -- role ---------------------------------------------------------------
 
@@ -440,8 +480,7 @@ class Server:
             log.debug("quarantined packet %r: %s", packet[:100], e)
             return False
         except p.ParseError as e:
-            with self._counter_lock:
-                self.packet_errors += 1
+            self._packet_errors.add(1)
             log.debug("rejected packet %r: %s", packet[:100], e)
             return False
         return True
@@ -462,8 +501,7 @@ class Server:
         try:
             span = wire.parse_ssf(datagram)
         except Exception as e:
-            with self._counter_lock:
-                self.packet_errors += 1
+            self._packet_errors.add(1)
             log.debug("rejected SSF packet: %s", e)
             return
         self.handle_ssf(span)
@@ -471,12 +509,13 @@ class Server:
     def _shed_spans(self, count: int):
         """Shedding is the designed overload behavior; one warning per
         drop would flood the log (and the GIL) at exactly the moment
-        the pipeline is saturated — count every drop (locked: many
-        reader/stream threads shed at once, and an unlocked += loses
-        counts exactly when drops spike), log at most once a second."""
-        with self._counter_lock:
-            self.spans_dropped += count
-            dropped = self.spans_dropped
+        the pipeline is saturated — count every drop (sharded: many
+        reader/stream threads shed at once, and each writes its OWN
+        cell, so no count is lost and no lock serializes the spike),
+        log at most once a second (the timestamp race can at worst
+        double-log; the old lock bought nothing more)."""
+        self._spans_dropped.add(count)
+        dropped = self.spans_dropped
         now = time.monotonic()
         if now - self._last_span_drop_log >= 1.0:
             self._last_span_drop_log = now
@@ -523,8 +562,7 @@ class Server:
                 except Exception as e:
                     # a whole frame was consumed, so the stream is at a clean
                     # boundary — keep reading (server.go:888-895)
-                    with self._counter_lock:
-                        self.packet_errors += 1
+                    self._packet_errors.add(1)
                     log.debug("bad SSF message: %s", e)
                     continue
                 if span is None:
@@ -602,6 +640,8 @@ class Server:
             sink.start(self.trace_client)
 
         for addr in cfg.statsd_listen_addresses:
+            if self._try_ingest_lanes(addr):
+                continue
             if self._try_native_statsd(addr):
                 continue
             if self._try_native_tcp(addr):
@@ -612,7 +652,8 @@ class Server:
                 handle_tcp_line=self.handle_metric_packet,
                 tls_config=self._tls_context,
                 admit=lambda: self.overload.admit_packet("statsd"),
-                error_log_interval=self.interval)
+                error_log_interval=self.interval,
+                receivers=self._udp_receivers)
             self._threads.extend(threads)
             self.statsd_addrs.extend(bound)
         for addr in cfg.ssf_listen_addresses:
@@ -623,7 +664,8 @@ class Server:
                 cfg.trace_max_length_bytes, self.handle_ssf_packet,
                 self.handle_ssf_stream, self._stop,
                 admit=lambda: self.overload.admit_packet("ssf"),
-                error_log_interval=self.interval)
+                error_log_interval=self.interval,
+                receivers=self._udp_receivers)
             self._threads.extend(threads)
             self.ssf_addrs.extend(bound)
 
@@ -689,6 +731,62 @@ class Server:
             if flush_took > self.interval:
                 log.warning("flush took %.2fs, %.2fs longer than the interval",
                             flush_took, flush_took - self.interval)
+
+    def _try_ingest_lanes(self, addr_spec: str) -> bool:
+        """Bring up the sharded ingest-lane fleet for a UDP statsd
+        listener (veneur_tpu/ingest/): per-reader lock-free lanes —
+        SO_REUSEPORT socket, recvmmsg batches, native parse, lane-local
+        intern + columnar staging — merged into the store one chunk at
+        a time at the group boundary. The DEFAULT UDP ingest path
+        (``ingest_lanes: 0`` = one lane per reader); ``-1`` disables
+        and falls through to the legacy readers."""
+        cfg = self.config
+        if cfg.ingest_lanes < 0:
+            return False
+        from veneur_tpu.protocol.addr import resolve_addr
+
+        try:
+            resolved = resolve_addr(addr_spec)
+        except ValueError:
+            return False
+        if resolved.family != "udp":
+            return False
+        num_lanes = cfg.ingest_lanes or max(1, cfg.num_readers)
+        from veneur_tpu.ingest import IngestFleet
+
+        networking.warn_if_port_already_served(
+            resolved.socket_family, socket.SOCK_DGRAM,
+            resolved.host, resolved.port)
+        try:
+            fleet = IngestFleet(
+                self.store, resolved, num_lanes,
+                cfg.read_buffer_size_bytes, cfg.metric_max_length,
+                chunk_records=cfg.store_chunk, stop=self._stop,
+                overload=self.overload,
+                raw_handler=self.handle_metric_packet,
+                thread_wrap=self._guard,
+                limiter=networking._LogLimiter(self.interval))
+        except OSError as e:
+            log.warning("ingest lanes failed to bind (%s); falling back "
+                        "to the legacy readers", e)
+            return False
+        fleet.start()
+        self._ingest_fleets.append(fleet)
+        if self.ingest_fleet is None:
+            self.ingest_fleet = fleet
+        # sealed-but-unmerged chunks must reach checkpoints: every
+        # fleet drains before a snapshot
+        fleets = list(self._ingest_fleets)
+        self.store.set_ingest_drain(
+            lambda: [f.merge_sealed() for f in fleets])
+        # one entry per LISTENER (every lane REUSEPORTs the same
+        # address), matching the legacy paths' bookkeeping
+        self.statsd_addrs.append(fleet.bound[0])
+        log.info("ingest fleet on udp port %s: %d lanes (native "
+                 "decode=%s, recvmmsg=%s)", fleet.bound[0][1], num_lanes,
+                 fleet.lanes[0].using_native,
+                 fleet.lanes[0]._receiver.using_recvmmsg)
+        return True
 
     def _try_native_statsd(self, addr_spec: str) -> bool:
         """Bring up the C++ SO_REUSEPORT reader pool for a plain IPv4 UDP
@@ -869,9 +967,8 @@ class Server:
                     continue
                 for b in batches:
                     if b.decode_errors or b.invalid_samples:
-                        with self._counter_lock:
-                            self.packet_errors += int(b.decode_errors)
-                            self.packet_errors += int(b.invalid_samples)
+                        self._packet_errors.add(int(b.decode_errors)
+                                                + int(b.invalid_samples))
                     if b.metrics.count:
                         for line in self.store.process_batch(b.metrics):
                             self.handle_metric_packet(line)
@@ -887,8 +984,7 @@ class Server:
                             # noise — same ledger as the statsd lane
                             self.quarantine.count(e.reason)
                         except Exception:
-                            with self._counter_lock:
-                                self.packet_errors += 1
+                            self._packet_errors.add(1)
                     self.handle_ssf_batch(b.spans())
             except Exception:
                 log.exception("native SSF pump iteration failed")
@@ -912,8 +1008,7 @@ class Server:
                     self._stop.wait(0.005)
                     continue
                 for b in batches:
-                    with self._counter_lock:
-                        self.packet_errors += int(b.parse_errors)
+                    self._packet_errors.add(int(b.parse_errors))
                     for line in self.store.process_batch(b):
                         self.handle_metric_packet(line)
             except Exception:
@@ -974,7 +1069,7 @@ class Server:
     # (SO_REUSEPORT makes a rolling restart the path for these) and the
     # store's device geometry is allocated once
     _RELOAD_FROZEN = ("statsd_listen_addresses", "ssf_listen_addresses",
-                      "http_address", "grpc_address",
+                      "ingest_lanes", "http_address", "grpc_address",
                       "native_import_address", "tls_certificate",
                       "tls_key", "tls_authority_certificate",
                       "digest_storage", "digest_dtype", "slab_rows",
@@ -1120,6 +1215,15 @@ class Server:
             log.warning("leaving native reader pool allocated (pump alive)")
             for reader in self._native_readers:
                 reader.leak()
+        # ingest lanes quiesce before the final flush: lane threads
+        # seal their staged residue on exit and the fleet's final merge
+        # folds every sealed chunk into the store — accepted samples
+        # ride the last interval out instead of dying in staging
+        for fleet in self._ingest_fleets:
+            try:
+                fleet.shutdown()
+            except Exception:
+                log.exception("ingest fleet shutdown failed")
         # the ticker must finish any in-flight flush before the final
         # drain runs, or two passes would drain the store concurrently
         if self._flush_thread is not None:
