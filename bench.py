@@ -1464,6 +1464,74 @@ def bench_scalar_flush():
     return out
 
 
+def bench_obs_overhead(iters: int = 20, num_series: int = 8192,
+                       samples_per_series: int = 6):
+    """Lane 10: the observability tax. Full server flush p50/p99 with
+    stage instrumentation ON (obs_enabled, the default) vs OFF, same
+    workload — the acceptance gate (instrumented p50 <= 3% over
+    baseline) becomes a measured number instead of a claim. The
+    workload mixes digests (device programs, where the per-stage hooks
+    nest deepest) with scalars.
+
+    Honesty note on scale: the instrumentation cost is FIXED per
+    interval (one extra small digest-group flush for the self-telemetry
+    rows, ~20 deque appends, ~17 child spans), not proportional to
+    cardinality — so the percentage gate only means something at a
+    flush large enough to represent production (the tax against a toy
+    512-series flush reads ~10x worse). The record carries the absolute
+    ms delta alongside the percentage so both readings are visible."""
+    from veneur_tpu.config import Config
+    from veneur_tpu.samplers import parser as p
+    from veneur_tpu.server import Server
+    from veneur_tpu.sinks import ChannelMetricSink
+
+    metrics = []
+    for i in range(num_series):
+        for j in range(samples_per_series):
+            metrics.append(p.parse_metric(
+                f"obs.h{i}:{(i * 7 + j) % 100}|h".encode()))
+        metrics.append(p.parse_metric(f"obs.c{i}:1|c".encode()))
+
+    def run(obs_enabled: bool):
+        cfg = Config(statsd_listen_addresses=[], interval="86400s",
+                     percentiles=[0.5, 0.99], obs_enabled=obs_enabled,
+                     store_initial_capacity=max(1024, num_series),
+                     store_chunk=1 << 13)
+        sink = ChannelMetricSink()
+        srv = Server(cfg, metric_sinks=[sink])
+        srv.start()
+        times = []
+        try:
+            for it in range(iters + 2):
+                for m in metrics:
+                    srv.store.process_metric(m)
+                t0 = time.perf_counter()
+                srv.flush()
+                took = time.perf_counter() - t0
+                sink.get_flush()
+                if it >= 2:  # first two intervals pay compiles
+                    times.append(took)
+        finally:
+            srv.shutdown()
+        arr = np.asarray(times)
+        return (round(float(np.percentile(arr, 50)) * 1e3, 3),
+                round(float(np.percentile(arr, 99)) * 1e3, 3))
+
+    base_p50, base_p99 = run(False)
+    inst_p50, inst_p99 = run(True)
+    overhead_pct = round((inst_p50 - base_p50) / base_p50 * 100.0, 2) \
+        if base_p50 else 0.0
+    return {"series": num_series, "iters": iters,
+            "p50_ms_baseline": base_p50, "p99_ms_baseline": base_p99,
+            "p50_ms_instrumented": inst_p50,
+            "p99_ms_instrumented": inst_p99,
+            "overhead_abs_ms_p50": round(inst_p50 - base_p50, 3),
+            "overhead_pct_p50": overhead_pct,
+            # the acceptance gate: instrumented flush p50 within 3% of
+            # obs_enabled: false (negative overhead = noise floor)
+            "within_3pct_gate": overhead_pct <= 3.0}
+
+
 def bench_egress_1m(num_series: int = 1 << 20):
     """Config #6: the SERVER's flush — store flush + columnar emission +
     native Datadog JSON serialization (deflate level 1), end-to-end to
@@ -2474,6 +2542,9 @@ def _lane_plan(result, guarded):
         ("7_tls_handshakes", guarded(bench_tls_handshakes), 240),
         ("8_ssf_spans", guarded(bench_ssf_spans), 240),
         ("9_proxy_fanout", guarded(bench_proxy_fanout), 300),
+        # the observability tax: flush p50/p99 with stage tracing on vs
+        # obs_enabled: false — the <=3% acceptance gate, measured
+        ("10_obs_overhead", guarded(bench_obs_overhead), 300),
     ]
 
 

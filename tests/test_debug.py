@@ -66,6 +66,26 @@ class TestDebugPrimitives:
         debug.sample_profile(0.0)  # clamps to 0.1, not 0 or negative
         assert time.perf_counter() - t0 < 2.0
 
+    def test_profile_excludes_other_samplers(self):
+        """A second /debug/profile request waits up to 1s on the
+        profile lock INSIDE sample_profile; the winner must not report
+        that waiter as a hot stack (nor any of its own frames)."""
+        from veneur_tpu import debug
+
+        out = []
+
+        def winner():
+            out.append(debug.sample_profile(0.6, hz=100))
+
+        t = threading.Thread(target=winner, name="winner", daemon=True)
+        t.start()
+        time.sleep(0.1)
+        # this call loses the lock race and blocks INSIDE
+        # sample_profile while the winner is sampling this very thread
+        debug.sample_profile(0.1)
+        t.join(timeout=10)
+        assert out and "sample_profile" not in out[0]
+
 
 class TestServerDebugRoutes:
     @pytest.fixture()
@@ -104,6 +124,17 @@ class TestServerDebugRoutes:
         assert status == 200
         assert "sampling rounds" in body
         assert time.perf_counter() - t0 < 5.0
+
+    def test_debug_profile_content_disposition(self, server):
+        """The collapsed-stack output downloads as a .collapsed file —
+        straight into flamegraph.pl / speedscope."""
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{server.ops_server.port}"
+                f"/debug/profile?seconds=0.2", timeout=10) as r:
+            assert r.status == 200
+            disp = r.headers.get("Content-Disposition", "")
+        assert disp.startswith("attachment")
+        assert disp.endswith('.collapsed"')
 
     def test_debug_profile_bad_param_is_400(self, server):
         import urllib.error

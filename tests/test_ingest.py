@@ -221,6 +221,55 @@ class TestSealMergeExactlyOnce:
 # ---------------------------------------------------------------------------
 
 
+class TestSealToMergeLatency:
+    """Seal->merge latency observability (veneur_tpu/obs/): every
+    SealedChunk is stamped at seal; the merger measures the gap and the
+    flusher drains it into the self-telemetry group per interval."""
+
+    def test_latencies_visible_and_drained(self):
+        store = make_store()
+        fleet = make_fleet(store, lanes=1, use_native=False)
+        lane = fleet.lanes[0]
+        lane._stage_python([b"x:1|c"])
+        lane._seal()
+        time.sleep(0.002)  # a measurable seal->merge gap
+        fleet.merge_sealed()
+        snap = fleet.merge_latency_snapshot()
+        assert snap["count"] == 1
+        assert snap["max_ns"] >= 2_000_000  # >= the 2ms we slept
+        assert snap["avg_ns"] > 0
+        lats = fleet.take_merge_latencies()
+        assert len(lats) == 1 and lats[0] == snap["max_ns"]
+        # drained once per interval: a second take is empty, the
+        # running aggregates stay for /debug/vars
+        assert fleet.take_merge_latencies() == []
+        assert fleet.merge_latency_snapshot()["count"] == 1
+        assert fleet.snapshot()["seal_to_merge"]["count"] == 1
+
+    def test_flusher_samples_latencies_into_self_telemetry(self):
+        from veneur_tpu.flusher import _drain_ingest_latencies
+
+        store = make_store()
+        fleet = make_fleet(store, lanes=1, use_native=False)
+        lane = fleet.lanes[0]
+        for i in range(3):
+            lane._stage_python([b"y:%d|ms" % i])
+            lane._seal()
+        fleet.merge_sealed()
+
+        class FakeServer:
+            _ingest_fleets = [fleet]
+
+        lats = _drain_ingest_latencies(FakeServer())
+        assert len(lats) == 3
+        for ns in lats:
+            store.sample_self_timing("ingest.seal_to_merge", float(ns))
+        final, _, _ = store.flush([], DEFAULT_AGGS, is_local=True, now=1)
+        by = {(m.name, tuple(m.tags)): m.value for m in final}
+        assert by[("veneur.obs.stage_duration_ns.count",
+                   ("stage:ingest.seal_to_merge",))] == 3
+
+
 class TestInternRemap:
     def _stage(self, lane, lines):
         if lane.using_native:

@@ -953,17 +953,26 @@ class TestMetricRegistry:
                        for f in metric_findings)
 
     def test_prefix_of_documented_name_is_still_undocumented(self, project):
-        """`veneur.flush` must not count as documented just because
-        `veneur.flush.age_seconds` is (dot is a name separator)."""
-        clone = synthetic(project, self.REL, '''
+        """`veneur.worker` must not count as documented just because
+        `veneur.worker.spans_dropped_total` is (dot is a name
+        separator). The probe name must be one the prose never writes
+        bare — `veneur.flush` stopped qualifying once the obs docs
+        named the flush root SPAN, which legitimately is the bare
+        string ``veneur.flush``."""
+        bare_name = "veneur.worker"
+        docs = project.docs_text()
+        assert not metricnames._name_in_docs(bare_name, docs), \
+            f"probe name {bare_name} is now written bare in the docs; " \
+            f"pick another documented-metric prefix for this test"
+        clone = synthetic(project, self.REL, f'''
 from veneur_tpu.trace import samples as ssf_samples
 
 def emit():
-    ssf_samples.count("veneur.flush", 1.0, None)
+    ssf_samples.count("{bare_name}", 1.0, None)
 ''')
         undoc = {f.anchor for f in metricnames.run(clone)
                  if f.code == "undocumented"}
-        assert "veneur.flush" in undoc
+        assert bare_name in undoc
 
     def test_fstring_names_normalize(self, project):
         clone = synthetic(project, self.REL, '''

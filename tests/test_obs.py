@@ -1,0 +1,471 @@
+"""Flush-interval observability (veneur_tpu/obs/): the StageRecorder,
+the /debug/flush-timeline ring, dogfooded self-telemetry through the
+dedicated digest group, and the kernel-scope coverage of the compiled-
+program inventory.
+
+The load-bearing contracts: every interval's stage durations account
+for >= 90% of its wall-clock (the coverage tripwire), the ring stays
+bounded, self-telemetry percentiles are exact and survive an overload
+freeze, and PROGRAM_SCOPES cannot drift from the recompile pass's
+inventory (same contract as the generated docs table).
+"""
+
+import json
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from veneur_tpu.obs import FlushTimeline, StageRecorder, activate
+from veneur_tpu.obs import kernels as obs_kernels
+from veneur_tpu.obs import recorder as obs_rec
+from veneur_tpu.samplers import HistogramAggregates
+
+AGGS = HistogramAggregates.from_names(["min", "max", "count"])
+
+
+def get(port, path):
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=10) as r:
+        return r.status, r.read().decode(), dict(r.headers)
+
+
+# ---------------------------------------------------------------------------
+# StageRecorder
+# ---------------------------------------------------------------------------
+
+
+class TestStageRecorder:
+    def test_nested_paths_and_tree(self):
+        clock = iter(range(0, 10000, 10))
+        rec = StageRecorder(clock_ns=lambda: next(clock) * 1000)
+        with rec.stage("store"):
+            with rec.stage("histograms", series=7):
+                with rec.stage("fetch"):
+                    pass
+        entry = rec.finish()
+        names = [s["name"] for s in entry["stages"]]
+        assert names == ["store", "store.histograms",
+                         "store.histograms.fetch"]
+        histo = entry["stages"][1]
+        assert histo["series"] == 7
+        # tree nests by dotted path
+        root = entry["tree"][0]
+        assert root["name"] == "store"
+        assert root["children"][0]["name"] == "store.histograms"
+        assert root["children"][0]["children"][0]["name"] == \
+            "store.histograms.fetch"
+
+    def test_note_attaches_to_innermost_open_stage(self):
+        rec = StageRecorder()
+        with rec.stage("store"):
+            with rec.stage("timers"):
+                rec.note(rung="xla")
+        stages = {s["name"]: s for s in rec.finish()["stages"]}
+        assert stages["store.timers"]["rung"] == "xla"
+        assert "rung" not in stages["store"]
+
+    def test_module_hooks_are_noops_without_recorder(self):
+        # deep call sites run these on every flush with obs off
+        assert obs_rec.current() is None
+        with obs_rec.maybe_stage("anything") as frame:
+            assert frame is None
+        obs_rec.note(rung="pallas")  # must not raise
+
+    def test_activate_scopes_current(self):
+        rec = StageRecorder()
+        with activate(rec):
+            assert obs_rec.current() is rec
+            with obs_rec.maybe_stage("s"):
+                obs_rec.note(k="v")
+        assert obs_rec.current() is None
+        stages = rec.finish()["stages"]
+        assert stages[0]["name"] == "s" and stages[0]["k"] == "v"
+
+    def test_record_abs_and_amend(self):
+        rec = StageRecorder()
+        t0 = rec.t0_ns
+        rec.record_abs("post.datadog", t0 + 10, t0 + 510)
+        rec.amend("post.datadog", bytes=42)
+        stages = {s["name"]: s for s in rec.finish()["stages"]}
+        assert stages["post.datadog"]["duration_ns"] == 500
+        assert stages["post.datadog"]["bytes"] == 42
+
+    def test_coverage_counts_top_level_only(self):
+        clock = iter([0, 0, 0, 900, 1000, 1000])
+        rec = StageRecorder(clock_ns=lambda: next(clock))
+        with rec.stage("a"):          # 0 -> 1000
+            with rec.stage("b"):      # 0 -> 900 (child; not re-counted)
+                pass
+        entry = rec.finish(total_ns=1000)
+        assert entry["coverage_ratio"] == 1.0
+
+    def test_record_late_before_finish_stays_off_path(self):
+        """A forward that completes BEFORE finish() lands via the
+        event-stream fallback but keeps the off-path marker, so the
+        concurrently-running forward never inflates coverage past 1.0
+        or double-counts against the post stage it overlapped."""
+        clock = iter([0, 0, 1000, 1000])
+        rec = StageRecorder(clock_ns=lambda: next(clock))
+        with rec.stage("post"):      # 0 -> 1000
+            pass
+        rec.record_late("forward", 0, 900)  # overlaps post; pre-finish
+        entry = rec.finish(total_ns=1000)
+        fwd = next(s for s in entry["stages"] if s["name"] == "forward")
+        assert fwd["off_path"]
+        assert entry["coverage_ratio"] == 1.0  # post only, not 1.9
+
+    def test_record_late_lands_in_published_entry(self):
+        rec = StageRecorder()
+        entry = rec.finish()
+        n = len(entry["stages"])
+        rec.record_late("forward", rec.t0_ns, rec.t0_ns + 5000, series=3)
+        assert len(entry["stages"]) == n + 1
+        late = entry["stages"][-1]
+        assert late["name"] == "forward" and late["off_path"]
+        assert late["duration_ns"] == 5000 and late["series"] == 3
+
+    def test_recorder_is_single_writer_per_thread(self):
+        """Stages recorded from several threads at once all land (the
+        deque append hand-off, like the ingest lanes)."""
+        rec = StageRecorder()
+
+        def work(i):
+            rec.record_abs(f"post.sink{i}", rec.t0_ns, rec.t0_ns + i)
+
+        threads = [threading.Thread(target=work, args=(i,))
+                   for i in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert len(rec.finish()["stages"]) == 8
+
+
+class TestFlushTimeline:
+    def test_ring_is_bounded(self):
+        tl = FlushTimeline(intervals=3)
+        for i in range(7):
+            tl.publish({"total_duration_ns": i, "coverage_ratio": 1.0,
+                        "stages": [], "tree": []})
+        entries = tl.entries()
+        assert len(entries) == 3
+        assert [e["interval"] for e in entries] == [4, 5, 6]
+        assert tl.published_total == 7
+
+    def test_handler_limits_and_rejects_bad_n(self):
+        tl = FlushTimeline(intervals=8)
+        for i in range(5):
+            tl.publish({"total_duration_ns": i, "coverage_ratio": 1.0,
+                        "stages": [], "tree": []})
+        status, body, _ = tl.handler({"n": "2"})
+        assert status == 200
+        data = json.loads(body)
+        assert [e["interval"] for e in data["intervals"]] == [3, 4]
+        status, _, _ = tl.handler({"n": "nope"})
+        assert status == 400
+
+
+# ---------------------------------------------------------------------------
+# the server end-to-end: timeline entries, coverage, endpoints
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture()
+def obs_server():
+    from veneur_tpu.config import Config
+    from veneur_tpu.server import Server
+    from veneur_tpu.sinks import ChannelMetricSink
+
+    cfg = Config(statsd_listen_addresses=[], interval="86400s",
+                 http_address="127.0.0.1:0", percentiles=[0.5, 0.99],
+                 obs_timeline_intervals=4,
+                 store_initial_capacity=32, store_chunk=128)
+    sink = ChannelMetricSink()
+    srv = Server(cfg, metric_sinks=[sink])
+    srv.start()
+    yield srv, sink
+    srv.shutdown()
+
+
+class TestServerTimeline:
+    def flush(self, srv, sink, packets=(b"to:3.5|h", b"tc:1|c")):
+        for pkt in packets:
+            srv.handle_metric_packet(pkt)
+        srv.flush()
+        sink.get_flush()
+
+    def test_every_interval_yields_an_entry_with_coverage(self, obs_server):
+        srv, sink = obs_server
+        for _ in range(2):
+            self.flush(srv, sink)
+        entries = srv.obs_timeline.entries()
+        assert len(entries) == 2
+        for e in entries:
+            assert e["total_duration_ns"] > 0
+            # the acceptance tripwire: stage durations account for
+            # >= 90% of the interval's wall-clock
+            assert e["coverage_ratio"] >= 0.9, e
+            top = sum(s["duration_ns"] for s in e["stages"]
+                      if "." not in s["name"])
+            assert top >= 0.9 * e["total_duration_ns"]
+
+    def test_stage_tree_shape(self, obs_server):
+        srv, sink = obs_server
+        self.flush(srv, sink)
+        e = srv.obs_timeline.entries()[-1]
+        names = {s["name"] for s in e["stages"]}
+        for expected in ("events", "store", "store.swap",
+                         "store.histograms", "store.histograms.compute",
+                         "store.histograms.fetch", "store.self_timers",
+                         "post", "post.channel", "span_join"):
+            assert expected in names, (expected, sorted(names))
+        histo = next(s for s in e["stages"]
+                     if s["name"] == "store.histograms")
+        assert histo["series"] == 1
+        assert histo["rung"] in ("pallas", "xla")
+        # stages nest in the tree exactly like their dotted paths
+        store = next(t for t in e["tree"] if t["name"] == "store")
+        child_names = {c["name"] for c in store["children"]}
+        assert "store.histograms" in child_names
+
+    def test_flush_timeline_endpoint_schema_and_bound(self, obs_server):
+        srv, sink = obs_server
+        for _ in range(6):  # ring holds 4 (obs_timeline_intervals)
+            self.flush(srv, sink)
+        status, body, _ = get(srv.ops_server.port,
+                              "/debug/flush-timeline?n=10")
+        assert status == 200
+        data = json.loads(body)
+        assert data["ring_capacity"] == 4
+        assert data["published_total"] == 6
+        assert len(data["intervals"]) == 4
+        assert [e["interval"] for e in data["intervals"]] == [2, 3, 4, 5]
+        for e in data["intervals"]:
+            for s in e["stages"]:
+                assert {"name", "start_ns", "duration_ns"} <= set(s)
+
+    def test_debug_vars_obs_section(self, obs_server):
+        srv, sink = obs_server
+        self.flush(srv, sink)
+        status, body, _ = get(srv.ops_server.port, "/debug/vars")
+        data = json.loads(body)
+        assert data["obs"]["timeline"]["published_total"] == 1
+        assert "flush.digest.dense" in data["obs"]["kernels"]["dispatches"]
+
+    def test_self_telemetry_reenters_the_pipeline(self, obs_server):
+        """Stage durations sampled in interval N emit exact digest
+        percentiles in interval N+1 — through the same sketches the
+        server sells."""
+        srv, sink = obs_server
+        self.flush(srv, sink)
+        srv.flush()
+        batch = sink.get_flush()
+        by_name = {}
+        for m in batch:
+            by_name.setdefault(m.name, []).append(m)
+        assert "veneur.obs.stage_duration_ns.50percentile" in by_name
+        counts = by_name["veneur.obs.stage_duration_ns.count"]
+        tags = {t for m in counts for t in m.tags}
+        assert "stage:store" in tags
+        # every sampled duration is one observation per stage name
+        assert all(m.value == 1 for m in counts)
+
+    def test_xprof_endpoint_captures(self, obs_server, tmp_path):
+        srv, _sink = obs_server
+        status, body, _ = get(srv.ops_server.port,
+                              "/debug/xprof?seconds=0.05")
+        assert status == 200, body
+        data = json.loads(body)
+        assert data["trace_dir"]
+        assert data["files"], "capture produced no trace files"
+        assert "flush.digest.dense" in data["scopes"]
+
+    def test_xprof_bad_param_is_400(self, obs_server):
+        import urllib.error
+
+        srv, _sink = obs_server
+        with pytest.raises(urllib.error.HTTPError) as e:
+            get(srv.ops_server.port, "/debug/xprof?seconds=nope")
+        assert e.value.code == 400
+
+
+class TestObsDisabled:
+    def test_disabled_means_no_recorder_and_404(self):
+        from veneur_tpu.config import Config
+        from veneur_tpu.server import Server
+        from veneur_tpu.sinks import ChannelMetricSink
+
+        cfg = Config(statsd_listen_addresses=[], interval="86400s",
+                     http_address="127.0.0.1:0", obs_enabled=False,
+                     store_initial_capacity=32, store_chunk=128)
+        sink = ChannelMetricSink()
+        srv = Server(cfg, metric_sinks=[sink])
+        srv.start()
+        try:
+            assert srv.obs_timeline is None
+            srv.handle_metric_packet(b"x:1|c")
+            srv.flush()
+            sink.get_flush()
+            # no self-telemetry rows accrue with obs off
+            assert len(srv.store.self_timers) == 0
+            import urllib.error
+
+            with pytest.raises(urllib.error.HTTPError) as e:
+                get(srv.ops_server.port, "/debug/flush-timeline")
+            assert e.value.code == 404
+            # the kernel counters are independent of obs_enabled (they
+            # back /debug/xprof): still visible, no timeline section
+            _s, body, _h = get(srv.ops_server.port, "/debug/vars")
+            obs = json.loads(body)["obs"]
+            assert "dispatches" in obs["kernels"]
+            assert "timeline" not in obs
+        finally:
+            srv.shutdown()
+
+    def test_negative_ring_size_rejected(self):
+        from veneur_tpu.config import Config
+
+        with pytest.raises(ValueError, match="obs_timeline_intervals"):
+            Config(interval="10s",
+                   obs_timeline_intervals=-1).apply_defaults().validate()
+
+
+# ---------------------------------------------------------------------------
+# dogfooded self-telemetry: the dedicated digest group
+# ---------------------------------------------------------------------------
+
+
+class TestSelfTelemetryGroup:
+    def make_store(self, **kw):
+        from veneur_tpu.core import MetricStore
+
+        kw.setdefault("initial_capacity", 32)
+        kw.setdefault("chunk", 128)
+        return MetricStore(**kw)
+
+    def test_exact_stats_through_the_digest_pipeline(self):
+        store = self.make_store()
+        durations = [1000.0, 2000.0, 3000.0, 4000.0, 5000.0]
+        for d in durations:
+            store.sample_self_timing("store.histograms", d)
+        store.sample_self_timing("post", 7000.0)
+        final, _, _ = store.flush([0.5], AGGS, is_local=True, now=1,
+                                  forward=False)
+        by = {(m.name, tuple(m.tags)): m.value for m in final}
+        key = ("veneur.obs.stage_duration_ns.count",
+               ("stage:store.histograms",))
+        assert by[key] == len(durations)
+        assert by[("veneur.obs.stage_duration_ns.max",
+                   ("stage:store.histograms",))] == 5000.0
+        assert by[("veneur.obs.stage_duration_ns.min",
+                   ("stage:store.histograms",))] == 1000.0
+        p50 = by[("veneur.obs.stage_duration_ns.50percentile",
+                  ("stage:store.histograms",))]
+        assert abs(p50 - float(np.median(durations))) <= 500.0
+        assert by[("veneur.obs.stage_duration_ns.count",
+                   ("stage:post",))] == 1
+
+    def test_exempt_from_overload_freeze(self):
+        """Under a level-1 freeze customer first-sight series spill to
+        the overflow row; self-telemetry rows still intern."""
+        from veneur_tpu.overload import (OVERFLOW_NAME,
+                                         OverloadController)
+        from veneur_tpu.samplers.parser import MetricKey
+
+        ctl = OverloadController(clock=lambda: 0.0)
+        ctl._level = 1  # forced freeze; no recompute (clock frozen)
+        ctl._next_recompute = float("inf")
+        store = self.make_store(overload=ctl, max_series=1000)
+        store.sample_self_timing("store", 123.0)
+        assert len(store.self_timers) == 1
+        names = store.self_timers.interner.names
+        assert OVERFLOW_NAME not in names
+        # a customer histogram first-sight series DOES spill
+        store.local_timers.sample(
+            MetricKey(name="cust.t", type="timer"), [], 1.0, 1.0)
+        assert OVERFLOW_NAME in store.local_timers.interner.names
+
+    def test_group_survives_checkpoint_round_trip(self):
+        store = self.make_store()
+        store.sample_self_timing("store", 1000.0)
+        store.sample_self_timing("store", 3000.0)
+        groups, _epoch = store.snapshot_state()
+        assert "self_timers" in groups
+        fresh = self.make_store()
+        fresh.restore_state(groups)
+        final, _, _ = fresh.flush([], AGGS, is_local=True, now=1,
+                                  forward=False)
+        by = {(m.name, tuple(m.tags)): m.value for m in final}
+        assert by[("veneur.obs.stage_duration_ns.count",
+                   ("stage:store",))] == 2
+        assert by[("veneur.obs.stage_duration_ns.max",
+                   ("stage:store",))] == 3000.0
+
+
+# ---------------------------------------------------------------------------
+# kernel scopes: inventory coverage + live counters
+# ---------------------------------------------------------------------------
+
+
+class TestKernelScopes:
+    def test_program_scopes_cover_the_inventory_exactly(self):
+        """Drift check, same contract as the generated docs table: the
+        recompile pass's compiled-program inventory and
+        obs/kernels.PROGRAM_SCOPES must name the same programs."""
+        import os
+
+        from veneur_tpu.lint import recompile
+        from veneur_tpu.lint.framework import Project
+
+        repo_root = os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))
+        project = Project(repo_root)
+        p = recompile._build(project)
+        inventory = {f"{key[0]}::{key[1]}" for key in p.programs}
+        assert inventory, "recompile pass found no programs (vacuous)"
+        mapped = set(obs_kernels.PROGRAM_SCOPES)
+        assert mapped == inventory, (
+            f"PROGRAM_SCOPES drift: missing={sorted(inventory - mapped)} "
+            f"extra={sorted(mapped - inventory)}")
+
+    def test_bindings_resolve_to_jit_objects(self):
+        import importlib
+
+        for program, (_scope, binding) in \
+                obs_kernels.PROGRAM_SCOPES.items():
+            if binding is None:
+                continue
+            fn = getattr(importlib.import_module(binding[0]), binding[1])
+            assert hasattr(fn, "_cache_size"), \
+                f"{program}: {binding} is not a jit binding"
+
+    def test_scope_counts_dispatches(self):
+        before = obs_kernels.dispatch_snapshot().get("test.scope", 0)
+        with obs_kernels.scope("test.scope"):
+            pass
+        assert obs_kernels.dispatch_snapshot()["test.scope"] == before + 1
+
+    def test_compile_snapshot_tracks_imported_programs(self):
+        # core.store is imported by this test module's dependencies;
+        # its programs have run at least once in this session
+        snap = obs_kernels.compile_snapshot()
+        assert "veneur_tpu/core/store.py::_flush_digests" in snap
+        assert obs_kernels.compiles_total() >= 0
+
+    def test_xprof_capture_serializes_concurrent_requests(self):
+        results = []
+
+        def capture():
+            results.append(obs_kernels.capture_xprof(0.3))
+
+        t = threading.Thread(target=capture)
+        with obs_kernels._xprof_lock:
+            t.start()
+            time.sleep(0.05)
+        t.join(timeout=10)
+        # the thread hit the held lock and returned 409 (one capture
+        # at a time), never a double start_trace
+        assert results and results[0][0] == 409

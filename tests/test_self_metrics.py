@@ -132,3 +132,42 @@ class TestSinkSamples:
         assert "content_length_bytes" in kinds
         # drained
         assert sink.drain_flush_telemetry() == []
+
+
+class TestTraceClientSamples:
+    """The veneur.trace_client.* set: send_client_statistics (exported
+    since round 1, wired into the interval emission by the obs PR)
+    drains + RESETS the trace client's backpressure counters."""
+
+    def _client_with_backpressure(self):
+        import queue
+
+        from veneur_tpu.trace.client import (WouldBlockError,
+                                             new_channel_client, record)
+
+        cl = new_channel_client(queue.Queue(1))
+        record(cl, object())  # 1 success
+        try:
+            record(cl, object())  # queue full -> 1 failure
+        except WouldBlockError:
+            pass
+        return cl
+
+    def test_names_values_and_reset(self):
+        cl = self._client_with_backpressure()
+
+        class Srv:
+            trace_client = cl
+
+        samples = flusher._trace_client_samples(Srv())
+        by = {s.name: s.value for s in samples}
+        assert by["veneur.trace_client.records_succeeded_total"] == 1.0
+        assert by["veneur.trace_client.records_failed_total"] == 1.0
+        assert by["veneur.trace_client.flushes_failed_total"] == 0.0
+        # send_client_statistics reset the counters: next interval is 0s
+        by2 = {s.name: s.value
+               for s in flusher._trace_client_samples(Srv())}
+        assert all(v == 0.0 for v in by2.values())
+
+    def test_no_client_is_silent(self):
+        assert flusher._trace_client_samples(_StubServer()) == []

@@ -248,6 +248,50 @@ class TestMetricsReporting:
             trace_metrics.report_batch(Client(span_queue=queue.Queue(1)), [])
 
 
+class TestFlushStageSpans:
+    def test_child_spans_parent_under_the_flush_root(self):
+        """Each flush interval's stages become child SSF spans of the
+        veneur.flush root (veneur_tpu/obs/): same trace id, top-level
+        stages parented on the root span, nested stages parented on
+        their dotted-path parent's span."""
+        from veneur_tpu.config import Config
+        from veneur_tpu.server import Server
+        from veneur_tpu.sinks import ChannelMetricSink
+
+        cfg = Config(statsd_listen_addresses=[], interval="86400s",
+                     store_initial_capacity=32, store_chunk=128)
+        srv = Server(cfg, metric_sinks=[ChannelMetricSink()])
+        # NOT started: no span workers drain the channel, so every
+        # recorded span is still there to inspect
+        srv.handle_metric_packet(b"sp:2.5|h")
+        srv.flush()
+        spans = []
+        while True:
+            try:
+                spans.append(srv.span_chan.get_nowait())
+            except queue.Empty:
+                break
+        by_name = {s.name: s for s in spans}
+        root = by_name["flush"]
+        assert root.parent_id == 0
+        stage_spans = [s for s in spans
+                       if s.name.startswith("veneur.flush.")]
+        assert stage_spans, "no stage child spans recorded"
+        by_stage = {s.name[len("veneur.flush."):]: s for s in stage_spans}
+        for path, s in by_stage.items():
+            assert s.trace_id == root.trace_id
+            parent = by_stage.get(path.rsplit(".", 1)[0]) \
+                if "." in path else None
+            expected_parent = parent.id if parent is not None else root.id
+            assert s.parent_id == expected_parent, path
+            assert s.end_timestamp >= s.start_timestamp
+        # the load-bearing ones are present and carry their attrs
+        assert "store" in by_stage and "store.histograms" in by_stage
+        histo = by_stage["store.histograms"]
+        assert histo.tags["rung"] in ("pallas", "xla")
+        assert histo.tags["series"] == "1"
+
+
 class TestSelfTelemetryLoop:
     def test_flush_span_metrics_reenter_store(self):
         """The flush span's samples are extracted back into the

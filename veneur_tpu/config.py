@@ -263,6 +263,24 @@ class Config:
     compute_breaker_failure_threshold: int = 0
     compute_breaker_reset_timeout: str = ""
 
+    # ---- flush-interval observability (veneur_tpu/obs/) ------------------
+    # per-stage flush self-tracing: the StageRecorder threads through
+    # the whole flush path (store swap, per-group device compute/fetch,
+    # serialize, per-sink POST, forward), each interval lands in the
+    # /debug/flush-timeline ring as a stage tree + child SSF spans, and
+    # stage durations dogfood into the store's own self-telemetry
+    # digest group. Off = zero recorders allocated and every stage hook
+    # is one thread-local read; the kernel-scope profiler annotations
+    # and dispatch counters (obs/kernels.py — a dict bump per
+    # chunk-level dispatch, never per packet) stay on either way, as
+    # they also serve /debug/xprof and /debug/vars. The 10_obs_overhead
+    # bench lane measures the on-cost of this setting.
+    obs_enabled: bool = True
+    # flush intervals the /debug/flush-timeline ring retains (0 =
+    # default 64; negative rejected) — bounds the timeline's memory on
+    # a long-lived server
+    obs_timeline_intervals: int = 0
+
     # ---- crash-safe aggregation state (veneur_tpu/persist/) --------------
     # where the interval checkpoint lives; empty disables checkpointing.
     # The atomic-write scratch file is checkpoint_path + ".tmp".
@@ -375,6 +393,11 @@ class Config:
                 f"overload watermarks must satisfy 0 < low < high < "
                 f"hard <= 1 (after 0-means-default substitution), got "
                 f"{marks[0]}/{marks[1]}/{marks[2]}")
+        if self.obs_timeline_intervals < 0:
+            raise ValueError(
+                f"obs_timeline_intervals must be >= 0 (0 = use the "
+                f"default, 64; the flush-timeline ring cannot be "
+                f"unbounded), got {self.obs_timeline_intervals}")
         if self.checkpoint_max_age_intervals < 0:
             raise ValueError(
                 f"checkpoint_max_age_intervals must be >= 0 (0 = use "
@@ -461,6 +484,8 @@ class Config:
             self.compute_breaker_failure_threshold = 2
         if not self.compute_breaker_reset_timeout:
             self.compute_breaker_reset_timeout = "60s"
+        if not self.obs_timeline_intervals:
+            self.obs_timeline_intervals = 64
         # tiered-residency hysteresis defaults (core/tiered.py)
         if not self.tier_promote_samples:
             self.tier_promote_samples = 64

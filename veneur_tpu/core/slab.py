@@ -58,6 +58,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
+from veneur_tpu.obs import kernels as obs_kernels
+from veneur_tpu.obs import recorder as obs_rec
 from veneur_tpu.ops import tdigest as td_ops
 from veneur_tpu.core.locking import requires_lock
 from veneur_tpu.ops.tdigest_pallas import _next_pow2
@@ -521,10 +523,11 @@ class SlabDigestBank:
     def ingest_slab(self, slab_idx: int, rows, values, weights):
         """Fold a flat chunk of samples whose rows are LOCAL to one slab."""
         assert self.mode == "local"
-        self.temps[slab_idx], self.digests[slab_idx] = _ingest_slab(
-            self.temps[slab_idx], self.digests[slab_idx],
-            jnp.asarray(rows), jnp.asarray(values),
-            jnp.asarray(weights), self.slab_rows, self.compression)
+        with obs_kernels.scope("drain.digest.slab"):
+            self.temps[slab_idx], self.digests[slab_idx] = _ingest_slab(
+                self.temps[slab_idx], self.digests[slab_idx],
+                jnp.asarray(rows), jnp.asarray(values),
+                jnp.asarray(weights), self.slab_rows, self.compression)
 
     def ingest(self, rows, values, weights):
         """Fold a flat chunk with GLOBAL row ids: each slab scatters the
@@ -535,25 +538,28 @@ class SlabDigestBank:
         rows = jnp.asarray(rows)
         values = jnp.asarray(values)
         weights = jnp.asarray(weights)
-        for i in range(self.num_slabs):
-            base = i * self.slab_rows
-            local = jnp.where((rows >= base)
-                              & (rows < base + self.slab_rows),
-                              rows - base, self.slab_rows)
-            self.temps[i], self.digests[i] = _ingest_slab(
-                self.temps[i], self.digests[i], local, values, weights,
-                self.slab_rows, self.compression)
+        with obs_kernels.scope("drain.digest.slab"):
+            for i in range(self.num_slabs):
+                base = i * self.slab_rows
+                local = jnp.where((rows >= base)
+                                  & (rows < base + self.slab_rows),
+                                  rows - base, self.slab_rows)
+                self.temps[i], self.digests[i] = _ingest_slab(
+                    self.temps[i], self.digests[i], local, values, weights,
+                    self.slab_rows, self.compression)
 
     # -- global role: digest import --------------------------------------
 
     def merge_digests(self, slab_idx: int, mean, weight, mins, maxs):
         """Merge imported digests for one slab: mean/weight [slab, M] f32
         (weight==0 padding), mins/maxs [slab] f32."""
-        self.digests[slab_idx] = _merge_slab(
-            self.digests[slab_idx], jnp.asarray(mean, jnp.float32),
-            jnp.asarray(weight, jnp.float32),
-            jnp.asarray(mins, jnp.float32), jnp.asarray(maxs, jnp.float32),
-            self.slab_rows, self.compression)
+        with obs_kernels.scope("drain.digest.slab"):
+            self.digests[slab_idx] = _merge_slab(
+                self.digests[slab_idx], jnp.asarray(mean, jnp.float32),
+                jnp.asarray(weight, jnp.float32),
+                jnp.asarray(mins, jnp.float32),
+                jnp.asarray(maxs, jnp.float32),
+                self.slab_rows, self.compression)
 
     # -- flush ------------------------------------------------------------
 
@@ -568,24 +574,28 @@ class SlabDigestBank:
         actually forwards."""
         qs = jnp.asarray(list(percentiles), jnp.float32)
         outs = []
-        for i in range(self.num_slabs):
-            if self.mode == "local":
-                (self.digests[i], self.temps[i], mean, weight, dmin, dmax,
-                 pcts, count, vsum, vmin, vmax, recip) = _flush_slab(
-                    self.digests[i], self.temps[i], qs, self.slab_rows,
-                    self.compression, want_digest)
-                out = {"percentiles": pcts, "count": count,
-                       "sum": vsum, "min": vmin, "max": vmax,
-                       "recip": recip}
-                if want_digest:
-                    out["digest_mean"] = mean
-                    out["digest_weight"] = weight
-                outs.append(out)
-            else:
-                (self.digests[i], pcts, counts, dmin, dmax) = _quantile_slab(
-                    self.digests[i], qs, self.slab_rows, self.compression)
-                outs.append({"percentiles": pcts, "count": counts,
-                             "min": dmin, "max": dmax})
+        with obs_kernels.scope("flush.digest.slab"):
+            for i in range(self.num_slabs):
+                if self.mode == "local":
+                    (self.digests[i], self.temps[i], mean, weight, dmin,
+                     dmax, pcts, count, vsum, vmin, vmax,
+                     recip) = _flush_slab(
+                        self.digests[i], self.temps[i], qs, self.slab_rows,
+                        self.compression, want_digest)
+                    out = {"percentiles": pcts, "count": count,
+                           "sum": vsum, "min": vmin, "max": vmax,
+                           "recip": recip}
+                    if want_digest:
+                        out["digest_mean"] = mean
+                        out["digest_weight"] = weight
+                    outs.append(out)
+                else:
+                    (self.digests[i], pcts, counts, dmin,
+                     dmax) = _quantile_slab(
+                        self.digests[i], qs, self.slab_rows,
+                        self.compression)
+                    outs.append({"percentiles": pcts, "count": counts,
+                                 "min": dmin, "max": dmax})
         if not fetch:
             return outs
         n = self.num_series
@@ -826,11 +836,12 @@ class SlabDigestGroup(OverloadLimited):
         self._device_dirty = True
         rows, vals, wts = self._rows, self._vals, self._wts
         self._new_sample_buffers()
-        for i, local, (v, w) in self._per_slab(rows, vals, wts):
-            self.temps[i], self.digests[i] = _ingest_slab(
-                self.temps[i], self.digests[i], jnp.asarray(local),
-                jnp.asarray(v), jnp.asarray(w), self.slab_rows,
-                self.compression, self._pallas_allowed())
+        with obs_kernels.scope("drain.digest.slab"):
+            for i, local, (v, w) in self._per_slab(rows, vals, wts):
+                self.temps[i], self.digests[i] = _ingest_slab(
+                    self.temps[i], self.digests[i], jnp.asarray(local),
+                    jnp.asarray(v), jnp.asarray(w), self.slab_rows,
+                    self.compression, self._pallas_allowed())
 
     def _drain_imports(self):
         if self._imp_fill == 0 and self._imp_stat_fill == 0:
@@ -851,19 +862,20 @@ class SlabDigestGroup(OverloadLimited):
             if len(stat_rows) else {}
         empty_f = np.zeros(2, np.float32)
         empty_r = np.full(2, self.slab_rows, np.int32)
-        for i in sorted(set(by_slab) | set(stats)):
-            c_local, c_pad = by_slab.get(
-                i, (empty_r, [empty_f, empty_f]))
-            s_local, s_pad = stats.get(
-                i, (empty_r, [np.full(2, np.inf, np.float32),
-                              np.full(2, -np.inf, np.float32)]))
-            self.temps[i], self.digests[i] = _import_slab(
-                self.temps[i], self.digests[i],
-                jnp.asarray(c_local), jnp.asarray(c_pad[0]),
-                jnp.asarray(c_pad[1]), jnp.asarray(s_local),
-                jnp.asarray(s_pad[0]), jnp.asarray(s_pad[1]),
-                self.slab_rows, self.compression,
-                self._pallas_allowed())
+        with obs_kernels.scope("drain.digest.slab"):
+            for i in sorted(set(by_slab) | set(stats)):
+                c_local, c_pad = by_slab.get(
+                    i, (empty_r, [empty_f, empty_f]))
+                s_local, s_pad = stats.get(
+                    i, (empty_r, [np.full(2, np.inf, np.float32),
+                                  np.full(2, -np.inf, np.float32)]))
+                self.temps[i], self.digests[i] = _import_slab(
+                    self.temps[i], self.digests[i],
+                    jnp.asarray(c_local), jnp.asarray(c_pad[0]),
+                    jnp.asarray(c_pad[1]), jnp.asarray(s_local),
+                    jnp.asarray(s_pad[0]), jnp.asarray(s_pad[1]),
+                    self.slab_rows, self.compression,
+                    self._pallas_allowed())
 
     def _drain_staging(self):
         self._drain_samples()
@@ -976,42 +988,44 @@ class SlabDigestGroup(OverloadLimited):
         pk_counts, pk_means, pk_wts = [], [], []
         new_digests = list(self.digests)
         new_temps = list(self.temps)
-        for i in range(len(self.digests)):
-            need = min(n - i * self.slab_rows, self.slab_rows)
-            # want_digest=False also skips the device-side cast+write of
-            # the drained planes, not just the host fetch; a retired
-            # generation additionally skips allocating fresh slabs (its
-            # donated planes free outright, slab by slab)
-            (new_digests[i], new_temps[i], mean, weight, dmin, dmax,
-             pcts, count, vsum, vmin, vmax, recip) = _flush_slab(
-                self.digests[i], self.temps[i], qs, self.slab_rows,
-                self.compression, bool(want_digests),
-                not self._retired, use_pallas)
-            if need <= 0:
-                continue
-            k = self.k
-            # fetch this slab's interned prefix NOW so the device buffers
-            # free before the next slab's program runs
-            planes = ()
-            if packed:
-                cts, pm, pw = _pack_slab(mean, weight, dmin, dmax,
-                                         self.slab_rows, k)
-                c_h, pm_h, pw_h = _fetch_packed(cts, pm, pw, need)
-                pk_counts.append(c_h)
-                pk_means.append(pm_h)
-                pk_wts.append(pw_h)
-                planes = (dmin[:need], dmax[:need])
-            elif want_digests:
-                planes = (
-                    mean.reshape(self.slab_rows, k)[:need]
-                        .astype(jnp.float32),
-                    weight.reshape(self.slab_rows, k)[:need]
-                          .astype(jnp.float32),
-                    dmin[:need], dmax[:need])
-            stats = {"pcts": pcts, "count": count, "sum": vsum,
-                     "min": vmin, "max": vmax, "recip": recip}
-            parts.append(jax.device_get(
-                planes + tuple(stats[nm][:need] for nm in sel)))
+        with obs_kernels.scope("flush.digest.slab"):
+            for i in range(len(self.digests)):
+                need = min(n - i * self.slab_rows, self.slab_rows)
+                # want_digest=False also skips the device-side cast+write
+                # of the drained planes, not just the host fetch; a
+                # retired generation additionally skips allocating fresh
+                # slabs (its donated planes free outright, slab by slab)
+                (new_digests[i], new_temps[i], mean, weight, dmin, dmax,
+                 pcts, count, vsum, vmin, vmax, recip) = _flush_slab(
+                    self.digests[i], self.temps[i], qs, self.slab_rows,
+                    self.compression, bool(want_digests),
+                    not self._retired, use_pallas)
+                if need <= 0:
+                    continue
+                k = self.k
+                # fetch this slab's interned prefix NOW so the device
+                # buffers free before the next slab's program runs
+                planes = ()
+                if packed:
+                    cts, pm, pw = _pack_slab(mean, weight, dmin, dmax,
+                                             self.slab_rows, k)
+                    c_h, pm_h, pw_h = _fetch_packed(cts, pm, pw, need)
+                    pk_counts.append(c_h)
+                    pk_means.append(pm_h)
+                    pk_wts.append(pw_h)
+                    planes = (dmin[:need], dmax[:need])
+                elif want_digests:
+                    planes = (
+                        mean.reshape(self.slab_rows, k)[:need]
+                            .astype(jnp.float32),
+                        weight.reshape(self.slab_rows, k)[:need]
+                              .astype(jnp.float32),
+                        dmin[:need], dmax[:need])
+                stats = {"pcts": pcts, "count": count, "sum": vsum,
+                         "min": vmin, "max": vmax, "recip": recip}
+                with obs_rec.maybe_stage("fetch"):
+                    parts.append(jax.device_get(
+                        planes + tuple(stats[nm][:need] for nm in sel)))
         cols = [np.concatenate(c, axis=0) for c in zip(*parts)]
         # every slab's program + fetch succeeded: commit the fresh planes
         self.digests, self.temps = new_digests, new_temps

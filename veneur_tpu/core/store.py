@@ -58,9 +58,11 @@ import numpy as np
 from veneur_tpu.ops import hll as hll_ops
 from veneur_tpu.core.bucketing import pow2_cap
 from veneur_tpu.core.locking import acquires_lock, requires_lock
+from veneur_tpu.obs import kernels as obs_kernels
+from veneur_tpu.obs import recorder as obs_rec
 from veneur_tpu.ops import tdigest as td_ops
 from veneur_tpu.overload import (F32_ABS_MAX, MIN_SAMPLE_RATE,
-                                 OVERFLOW_NAME, Quarantine)
+                                 OVERFLOW_NAME, Quarantine, freeze_exempt)
 from veneur_tpu.samplers.intermetric import (
     Aggregate,
     HistogramAggregates,
@@ -245,7 +247,7 @@ class OverloadLimited:
             return self._spill_row()
         ctl = self._overload
         if (ctl is not None and ctl.freeze_new_series()
-                and not key.name.startswith("veneur.")):
+                and not freeze_exempt(key.name)):
             return self._spill_row()
         return interner.intern(key, tags)
 
@@ -287,12 +289,14 @@ def run_compute_ladder(compute, attempt):
     raise BEFORE execution: Mosaic compile errors after a config
     change, injected preflight faults, and trace-time errors."""
     if compute is None:
+        obs_rec.note(rung="pallas")
         return attempt(True)
     if compute.probe():
         try:
             compute.preflight()
             out = attempt(True)
             compute.record_success()
+            obs_rec.note(rung="pallas")
             return out
         except Exception:
             compute.record_failure()
@@ -301,6 +305,7 @@ def run_compute_ladder(compute, attempt):
                         exc_info=True)
     out = attempt(False)
     compute.count_fallback()
+    obs_rec.note(rung="xla")
     return out
 
 
@@ -832,10 +837,11 @@ class DigestGroup(OverloadLimited):
         self._device_dirty = True
         rows, vals, wts = self._rows, self._vals, self._wts
         self._new_sample_buffers()
-        self.digest, self.temp = _ingest_samples(
-            self.digest, self.temp, jnp.asarray(rows),
-            jnp.asarray(vals), jnp.asarray(wts), self.compression,
-            self._pallas_allowed())
+        with obs_kernels.scope("drain.digest.dense"):
+            self.digest, self.temp = _ingest_samples(
+                self.digest, self.temp, jnp.asarray(rows),
+                jnp.asarray(vals), jnp.asarray(wts), self.compression,
+                self._pallas_allowed())
 
     def _drain_imports(self):
         if self._imp_fill == 0 and self._imp_stat_fill == 0:
@@ -855,12 +861,14 @@ class DigestGroup(OverloadLimited):
         imp_rows, imp_means, imp_wts = (self._imp_rows, self._imp_means,
                                         self._imp_wts)
         self._new_import_buffers()
-        self.digest, self.temp, self.dmin, self.dmax = _ingest_centroids(
-            self.digest, self.temp, self.dmin, self.dmax,
-            jnp.asarray(imp_rows), jnp.asarray(imp_means),
-            jnp.asarray(imp_wts), jnp.asarray(stat_rows),
-            jnp.asarray(stat_mins), jnp.asarray(stat_maxs),
-            self.compression, self._pallas_allowed())
+        with obs_kernels.scope("drain.digest.dense"):
+            self.digest, self.temp, self.dmin, self.dmax = \
+                _ingest_centroids(
+                    self.digest, self.temp, self.dmin, self.dmax,
+                    jnp.asarray(imp_rows), jnp.asarray(imp_means),
+                    jnp.asarray(imp_wts), jnp.asarray(stat_rows),
+                    jnp.asarray(stat_mins), jnp.asarray(stat_maxs),
+                    self.compression, self._pallas_allowed())
 
     def _drain_staging(self):
         self._drain_samples()
@@ -938,27 +946,34 @@ class DigestGroup(OverloadLimited):
 
         sel = _select_stats(want_stats)
         qs = jnp.asarray(list(percentiles) + [0.5], jnp.float32)
-        digest, pcts, count, vsum, vmin, vmax, recip = self._run_flush(
-            qs, use_pallas)
-        # one batched transfer instead of eleven round trips
-        planes = ()
-        out = {}
-        if packed:
-            from veneur_tpu.core.slab import _fetch_packed, _pack_slab
+        # compute = async program dispatch (plus any synchronous
+        # compile); fetch = the blocking device->host transfer, which
+        # also absorbs the device execution it waits on. The split is
+        # what the flush timeline shows per group.
+        with obs_rec.maybe_stage("compute"), \
+                obs_kernels.scope("flush.digest.dense"):
+            digest, pcts, count, vsum, vmin, vmax, recip = self._run_flush(
+                qs, use_pallas)
+            # one batched transfer instead of eleven round trips
+            planes = ()
+            out = {}
+            if packed:
+                from veneur_tpu.core.slab import _fetch_packed, _pack_slab
 
-            cts, pm, pw = _pack_slab(
-                digest.mean.reshape(-1), digest.weight.reshape(-1),
-                digest.min, digest.max, self.capacity, self.k)
-            (out["packed_counts"], out["packed_means"],
-             out["packed_weights"]) = _fetch_packed(cts, pm, pw, n)
-            planes = (digest.min[:n], digest.max[:n])
-        elif want_digests:
-            planes = (digest.mean[:n], digest.weight[:n], digest.min[:n],
-                      digest.max[:n])
-        stats = {"pcts": pcts, "count": count, "sum": vsum, "min": vmin,
-                 "max": vmax, "recip": recip}
-        fetched = jax.device_get(
-            planes + tuple(stats[nm][:n] for nm in sel))
+                cts, pm, pw = _pack_slab(
+                    digest.mean.reshape(-1), digest.weight.reshape(-1),
+                    digest.min, digest.max, self.capacity, self.k)
+                (out["packed_counts"], out["packed_means"],
+                 out["packed_weights"]) = _fetch_packed(cts, pm, pw, n)
+                planes = (digest.min[:n], digest.max[:n])
+            elif want_digests:
+                planes = (digest.mean[:n], digest.weight[:n],
+                          digest.min[:n], digest.max[:n])
+            stats = {"pcts": pcts, "count": count, "sum": vsum,
+                     "min": vmin, "max": vmax, "recip": recip}
+        with obs_rec.maybe_stage("fetch"):
+            fetched = jax.device_get(
+                planes + tuple(stats[nm][:n] for nm in sel))
         if packed:
             out["digest_min"], out["digest_max"] = fetched[:2]
             fetched = fetched[2:]
@@ -1789,8 +1804,8 @@ class _Generation:
 
     __slots__ = ("counters", "global_counters", "gauges", "global_gauges",
                  "local_status_checks", "histograms", "timers",
-                 "local_histograms", "local_timers", "sets", "local_sets",
-                 "heavy_hitters", "processed", "imported")
+                 "local_histograms", "local_timers", "self_timers", "sets",
+                 "local_sets", "heavy_hitters", "processed", "imported")
 
 
 def _summarize(g) -> "MetricsSummary":
@@ -1910,6 +1925,12 @@ class MetricStore:
             self.local_timers = DigestGroup(initial_capacity, chunk,
                                             compression)
         self.local_sets = SetGroup(initial_capacity, chunk, hll_precision)
+        # the dedicated self-telemetry group (veneur_tpu/obs/): the
+        # server's own stage durations, always a small dense DigestGroup
+        # regardless of digest_storage — bounded cardinality (one row
+        # per instrumented stage), local-only, never forwarded
+        self.self_timers = DigestGroup(min(64, initial_capacity), chunk,
+                                       compression)
         self.heavy_hitters = HeavyHitterGroup(initial_capacity, chunk,
                                               depth=topk_depth,
                                               width=topk_width, k=topk_k)
@@ -1963,7 +1984,13 @@ class MetricStore:
         g.max_series = self.max_series
         g.overflow_label = name
         g._overflow_type = self._GROUP_TYPES[name]
-        g._overload = self._overload
+        # the self-telemetry group is exempt from the admission FREEZE
+        # (it is the operator's view into the overload — the veneur.*
+        # name carve-out in overload.freeze_exempt already covers its
+        # rows, and detaching the controller makes the exemption hold
+        # even if a non-veneur stage name ever lands here); the hard
+        # cardinality cap above still applies
+        g._overload = None if name == "self_timers" else self._overload
         g._quarantine = self.quarantine
         g._compute = self.compute
 
@@ -1979,6 +2006,23 @@ class MetricStore:
             return joined
         self.quarantine.count("oversized_tags")
         return truncate_joined_tags(joined, limit)
+
+    # -- dogfooded self-telemetry (veneur_tpu/obs/) ------------------------
+
+    @acquires_lock("store")
+    def sample_self_timing(self, stage: str, duration_ns: float) -> None:
+        """One observed stage duration into the dedicated self-telemetry
+        digest group: the flusher feeds every interval's stage
+        durations (and the ingest lanes' seal->merge latencies) here,
+        so the next flush emits exact p50/p99 of the server's own
+        stages through the same t-digest pipeline it sells
+        (``veneur.obs.stage_duration_ns`` tagged ``stage:<name>``).
+        Exempt from the overload freeze (_apply_overload_attrs)."""
+        tag = f"stage:{stage}"
+        key = MetricKey(name="veneur.obs.stage_duration_ns", type="timer",
+                        joined_tags=tag)
+        with self._lock:
+            self.self_timers.sample(key, [tag], float(duration_ns), 1.0)
 
     # -- ingest ------------------------------------------------------------
 
@@ -2528,6 +2572,7 @@ class MetricStore:
         "local_status_checks": "status",
         "histograms": "histogram", "local_histograms": "histogram",
         "timers": "timer", "local_timers": "timer",
+        "self_timers": "timer",
         "sets": "set", "local_sets": "set", "heavy_hitters": "set"}
 
     @acquires_lock("store")
@@ -2721,17 +2766,20 @@ class MetricStore:
         # it serializes overlapping flush() calls (only the flusher and
         # shutdown ever contend) while ingest proceeds on _lock
         with self._flush_gate:  # lint: ok(lock-across-blocking)
-            with self._lock:
-                gen = self._swap_generation()
+            with obs_rec.maybe_stage("swap"):
+                with self._lock:
+                    gen = self._swap_generation()
             return self._flush_generation(
                 gen, percentiles, aggregates, is_local, now, forward,
                 forward_topk, columnar, digest_format)
 
-    # every group swapped per flush, in flush order
+    # every group swapped per flush, in flush order (self_timers is the
+    # dedicated self-telemetry group — the server's own stage durations,
+    # docs/observability.md)
     _GEN_GROUPS = ("counters", "global_counters", "gauges", "global_gauges",
                    "local_status_checks", "histograms", "timers",
-                   "local_histograms", "local_timers", "sets", "local_sets",
-                   "heavy_hitters")
+                   "local_histograms", "local_timers", "self_timers",
+                   "sets", "local_sets", "heavy_hitters")
 
     @requires_lock("store")
     def _swap_generation(self) -> "_Generation":
@@ -2779,8 +2827,11 @@ class MetricStore:
         fwd = ForwardableState()
 
         # counters & gauges (mixed scope) always flush locally
-        self._flush_scalars(g.counters, MetricType.COUNTER, final, now, col)
-        self._flush_scalars(g.gauges, MetricType.GAUGE, final, now, col)
+        with obs_rec.maybe_stage("scalars"):
+            self._flush_scalars(g.counters, MetricType.COUNTER, final,
+                                now, col)
+            self._flush_scalars(g.gauges, MetricType.GAUGE, final, now,
+                                col)
 
         # mixed histograms/timers: no percentiles on a local instance
         mixed_pcts = [] if is_local else list(percentiles)
@@ -2806,14 +2857,23 @@ class MetricStore:
                                  aggregates, final, now, fwd_list=None,
                                  col=col, gen_name="local_timers")
 
+        # the dedicated self-telemetry group: the server's own stage
+        # durations (sample_self_timing), always local, full
+        # percentiles — the server reports exact p50/p99 of its own
+        # flush stages through the same sketches it sells
+        self._flush_digest_group(g.self_timers, list(percentiles),
+                                 aggregates, final, now, fwd_list=None,
+                                 col=col, gen_name="self_timers")
+
         # local sets always flush; mixed sets flush only on a global
         # instance (they are forwarded from locals)
-        self._flush_set_group(g.local_sets, final, now, fwd_list=None,
-                              col=col)
-        self._flush_set_group(
-            g.sets, final if not is_local else None, now,
-            fwd_list=fwd.sets if (is_local and forward) else None,
-            col=col if not is_local else None)
+        with obs_rec.maybe_stage("sets"):
+            self._flush_set_group(g.local_sets, final, now,
+                                  fwd_list=None, col=col)
+            self._flush_set_group(
+                g.sets, final if not is_local else None, now,
+                fwd_list=fwd.sets if (is_local and forward) else None,
+                col=col if not is_local else None)
 
         # heavy hitters follow the mixed-SET rule (flusher.go:231-249):
         # a forwarding local ships its sketch upstream and does NOT
@@ -2823,8 +2883,9 @@ class MetricStore:
         # sketch (gRPC: forward_topk=False), the local emits its own
         # view instead so the data is never silently dropped.
         want_hh_fwd = is_local and forward and forward_topk
-        hh_interner, hh, hh_fwd = g.heavy_hitters.flush(
-            want_forward=want_hh_fwd)
+        with obs_rec.maybe_stage("topk"):
+            hh_interner, hh, hh_fwd = g.heavy_hitters.flush(
+                want_forward=want_hh_fwd)
         fwd.topk = hh_fwd
         if want_hh_fwd:
             hh = []
@@ -2900,6 +2961,24 @@ class MetricStore:
                             fwd_state=None, fwd_attr: str = "",
                             digest_format: str = "dense",
                             gen_name: str = ""):
+        """Stage-traced wrapper: the interval timeline shows one stage
+        per digest group (series count, breaker rung, compute/fetch
+        children from the group internals)."""
+        with obs_rec.maybe_stage(gen_name or "digests",
+                                 series=len(group)):
+            return self._flush_digest_group_inner(
+                group, percentiles, aggregates, out, now, fwd_list,
+                col=col, fwd_state=fwd_state, fwd_attr=fwd_attr,
+                digest_format=digest_format, gen_name=gen_name)
+
+    def _flush_digest_group_inner(self, group: DigestGroup,
+                                  percentiles: List[float],
+                                  aggregates: HistogramAggregates,
+                                  out: List[InterMetric], now: int,
+                                  fwd_list: Optional[list], col=None,
+                                  fwd_state=None, fwd_attr: str = "",
+                                  digest_format: str = "dense",
+                                  gen_name: str = ""):
         forwarding = fwd_list is not None or fwd_state is not None
         want = forwarding
         if forwarding and digest_format == "packed":
@@ -3025,6 +3104,7 @@ class MetricStore:
         failure (snapshot raising too) degrades to the checkpoint
         bound: at most checkpoint_interval of data."""
         compute = self.compute
+        obs_rec.note(rung="requeue")
         if not gen_name:
             compute.count_lost()
             return

@@ -59,6 +59,8 @@ import numpy as np
 from jax import lax
 
 from veneur_tpu.core.locking import requires_lock
+from veneur_tpu.obs import kernels as obs_kernels
+from veneur_tpu.obs import recorder as obs_rec
 from veneur_tpu.ops import tdigest as td_ops
 from veneur_tpu.ops.tdigest_pallas import _next_pow2
 
@@ -785,10 +787,12 @@ class TieredDigestGroup(OverloadLimited):
             slots, (v, w) = dense
             self._dense.sample_many(slots, v, w)
         up = self._pallas_allowed()
-        for i, local, (v, w) in pool_spans:
-            self.pools[i] = _pool_ingest(
-                self.pools[i], jnp.asarray(local), jnp.asarray(v),
-                jnp.asarray(w), self.slab_rows, self.pk, self.pcomp, up)
+        with obs_kernels.scope("drain.digest.tiered"):
+            for i, local, (v, w) in pool_spans:
+                self.pools[i] = _pool_ingest(
+                    self.pools[i], jnp.asarray(local), jnp.asarray(v),
+                    jnp.asarray(w), self.slab_rows, self.pk, self.pcomp,
+                    up)
         self._maybe_promote(np.unique(rows[:fill]))
 
     @requires_lock("store")
@@ -819,19 +823,20 @@ class TieredDigestGroup(OverloadLimited):
         up = self._pallas_allowed()
         empty_r = np.full(2, self.slab_rows, np.int32)
         cents_by_slab = {i: (local, padded) for i, local, padded in pool_c}
-        for i in sorted(set(cents_by_slab) | set(stats_by_slab)):
-            c_local, c_pad = cents_by_slab.get(
-                i, (empty_r, [np.zeros(2, np.float32),
-                              np.zeros(2, np.float32)]))
-            s_local, s_pad = stats_by_slab.get(
-                i, (empty_r, [np.full(2, np.inf, np.float32),
-                              np.full(2, -np.inf, np.float32)]))
-            self.pools[i] = _pool_import(
-                self.pools[i], jnp.asarray(c_local),
-                jnp.asarray(c_pad[0]), jnp.asarray(c_pad[1]),
-                jnp.asarray(s_local), jnp.asarray(s_pad[0]),
-                jnp.asarray(s_pad[1]), self.slab_rows, self.pk,
-                self.pcomp, up)
+        with obs_kernels.scope("drain.digest.tiered"):
+            for i in sorted(set(cents_by_slab) | set(stats_by_slab)):
+                c_local, c_pad = cents_by_slab.get(
+                    i, (empty_r, [np.zeros(2, np.float32),
+                                  np.zeros(2, np.float32)]))
+                s_local, s_pad = stats_by_slab.get(
+                    i, (empty_r, [np.full(2, np.inf, np.float32),
+                                  np.full(2, -np.inf, np.float32)]))
+                self.pools[i] = _pool_import(
+                    self.pools[i], jnp.asarray(c_local),
+                    jnp.asarray(c_pad[0]), jnp.asarray(c_pad[1]),
+                    jnp.asarray(s_local), jnp.asarray(s_pad[0]),
+                    jnp.asarray(s_pad[1]), self.slab_rows, self.pk,
+                    self.pcomp, up)
         self._maybe_promote(np.unique(rows[:nf]))
 
     @requires_lock("store")
@@ -870,19 +875,20 @@ class TieredDigestGroup(OverloadLimited):
         d._drain_staging()  # promoted mass must land on settled bins
         d._device_dirty = True
         slabs = rows // self.slab_rows
-        for i in np.unique(slabs):
-            sel = slabs == i
-            m = int(sel.sum())
-            pad = _next_pow2(m)
-            local = np.full(pad, self.slab_rows, np.int32)
-            local[:m] = rows[sel] - i * self.slab_rows
-            sl = np.full(pad, d.capacity, np.int32)
-            sl[:m] = slots[sel]
-            (self.pools[int(i)], d.temp, d.dmin,
-             d.dmax) = _promote_rows(
-                self.pools[int(i)], d.temp, d.dmin, d.dmax,
-                jnp.asarray(local), jnp.asarray(sl), self.slab_rows,
-                self.pk, self.compression)
+        with obs_kernels.scope("drain.digest.tiered"):
+            for i in np.unique(slabs):
+                sel = slabs == i
+                m = int(sel.sum())
+                pad = _next_pow2(m)
+                local = np.full(pad, self.slab_rows, np.int32)
+                local[:m] = rows[sel] - i * self.slab_rows
+                sl = np.full(pad, d.capacity, np.int32)
+                sl[:m] = slots[sel]
+                (self.pools[int(i)], d.temp, d.dmin,
+                 d.dmax) = _promote_rows(
+                    self.pools[int(i)], d.temp, d.dmin, d.dmax,
+                    jnp.asarray(local), jnp.asarray(sl), self.slab_rows,
+                    self.pk, self.compression)
         self.directory.note_promoted(
             [(names[r], joined[r]) for r in promote])
         log.debug("promoted %d series to the dense tier", len(promote))
@@ -986,32 +992,34 @@ class TieredDigestGroup(OverloadLimited):
         parts = []
         pk_counts, pk_means, pk_wts = [], [], []
         new_pools = list(self.pools)
-        for i in range(len(self.pools)):
-            need = min(n - i * R, R)
-            (mean_flat, weight_flat, mn, mx, pcts, count, vsum, vmin,
-             vmax, recip) = _pool_flush(self.pools[i], qs, R, pk,
-                                        self.pcomp, use_pallas)
-            new_pools[i] = None if self._retired else \
-                _init_pool_slab(R, pk)
-            if need <= 0:
-                continue
-            planes = ()
-            if packed:
-                cts, pm, pw = _pack_slab(mean_flat, weight_flat, mn, mx,
-                                         R, pk)
-                c_h, pm_h, pw_h = _fetch_packed(cts, pm, pw, need)
-                pk_counts.append(c_h)
-                pk_means.append(pm_h)
-                pk_wts.append(pw_h)
-                planes = (mn[:need], mx[:need])
-            elif want_digests:
-                planes = (mean_flat.reshape(R, pk)[:need],
-                          weight_flat.reshape(R, pk)[:need],
-                          mn[:need], mx[:need])
-            stats = {"pcts": pcts, "count": count, "sum": vsum,
-                     "min": vmin, "max": vmax, "recip": recip}
-            parts.append(jax.device_get(
-                planes + tuple(stats[nm][:need] for nm in sel)))
+        with obs_kernels.scope("flush.digest.tiered"):
+            for i in range(len(self.pools)):
+                need = min(n - i * R, R)
+                (mean_flat, weight_flat, mn, mx, pcts, count, vsum, vmin,
+                 vmax, recip) = _pool_flush(self.pools[i], qs, R, pk,
+                                            self.pcomp, use_pallas)
+                new_pools[i] = None if self._retired else \
+                    _init_pool_slab(R, pk)
+                if need <= 0:
+                    continue
+                planes = ()
+                if packed:
+                    cts, pm, pw = _pack_slab(mean_flat, weight_flat, mn,
+                                             mx, R, pk)
+                    c_h, pm_h, pw_h = _fetch_packed(cts, pm, pw, need)
+                    pk_counts.append(c_h)
+                    pk_means.append(pm_h)
+                    pk_wts.append(pw_h)
+                    planes = (mn[:need], mx[:need])
+                elif want_digests:
+                    planes = (mean_flat.reshape(R, pk)[:need],
+                              weight_flat.reshape(R, pk)[:need],
+                              mn[:need], mx[:need])
+                stats = {"pcts": pcts, "count": count, "sum": vsum,
+                         "min": vmin, "max": vmax, "recip": recip}
+                with obs_rec.maybe_stage("fetch"):
+                    parts.append(jax.device_get(
+                        planes + tuple(stats[nm][:need] for nm in sel)))
         nd = len(self._dense_rows)
         dense_out = None
         if nd:
@@ -1224,12 +1232,13 @@ class TieredDigestGroup(OverloadLimited):
         if dense is not None:
             slots, (c, s, mn, mx, rc) = dense
             self._dense.restore_stats(slots, c, s, mn, mx, rc)
-        for i, local, (c, s, mn, mx, rc) in pool_spans:
-            # pow2 padding zero-fills; min/max identities re-stamp
-            pad_rows = local >= self.slab_rows
-            mn[pad_rows] = np.inf
-            mx[pad_rows] = -np.inf
-            self.pools[i] = _pool_restore_stats(
-                self.pools[i], jnp.asarray(local), jnp.asarray(c),
-                jnp.asarray(s), jnp.asarray(mn), jnp.asarray(mx),
-                jnp.asarray(rc), self.slab_rows)
+        with obs_kernels.scope("drain.digest.tiered"):
+            for i, local, (c, s, mn, mx, rc) in pool_spans:
+                # pow2 padding zero-fills; min/max identities re-stamp
+                pad_rows = local >= self.slab_rows
+                mn[pad_rows] = np.inf
+                mx[pad_rows] = -np.inf
+                self.pools[i] = _pool_restore_stats(
+                    self.pools[i], jnp.asarray(local), jnp.asarray(c),
+                    jnp.asarray(s), jnp.asarray(mn), jnp.asarray(mx),
+                    jnp.asarray(rc), self.slab_rows)

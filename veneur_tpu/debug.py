@@ -14,6 +14,13 @@ be profiled in place. The Python equivalents here:
                                     graph-ready, hottest stack first
     GET /debug/vars                 JSON of store/lane/queue depths and
                                     ingest counters (expvar's role)
+    GET /debug/flush-timeline       last-N flush intervals as stage
+                                    trees (veneur_tpu/obs/; server only)
+    GET /debug/xprof?seconds=N      on-demand jax.profiler capture —
+                                    device kernels labeled by the named
+                                    scopes of obs/kernels.py (server
+                                    only; gated one-at-a-time + clamped
+                                    like /debug/profile)
 
 Mounted on both the server's OpsServer and the proxy's mux.
 """
@@ -54,11 +61,18 @@ def dump_threads() -> str:
 def sample_profile(seconds: float, hz: float = PROFILE_HZ) -> str:
     """Statistical whole-process profile: poll every thread's stack at
     ``hz`` for ``seconds``, aggregate identical stacks. Lines are
-    ``frames;joined;by;semicolon <count>`` (collapsed-stack format)."""
+    ``frames;joined;by;semicolon <count>`` (collapsed-stack format).
+
+    The sampler excludes ITSELF from what it reports: its own thread
+    (by ident) and any thread currently inside ``sample_profile`` (by
+    code object — a second /debug/profile request waits up to 1s on
+    the lock INSIDE this function, and without the filter that waiter
+    shows up as a bogus hot stack in the winner's profile)."""
     seconds = max(0.1, min(float(seconds), MAX_PROFILE_SECONDS))
     interval = 1.0 / hz
     stacks: Counter = Counter()
     me = threading.get_ident()
+    my_code = sample_profile.__code__
     samples = 0
     if not _profile_lock.acquire(timeout=1.0):
         return "another profile is already running\n"
@@ -70,11 +84,17 @@ def sample_profile(seconds: float, hz: float = PROFILE_HZ) -> str:
                     continue
                 parts = []
                 f = frame
+                sampler = False
                 while f is not None:
                     code = f.f_code
+                    if code is my_code:
+                        sampler = True
+                        break
                     parts.append(f"{code.co_filename.rsplit('/', 1)[-1]}"
                                  f":{code.co_name}:{f.f_lineno}")
                     f = f.f_back
+                if sampler:
+                    continue
                 stacks[";".join(reversed(parts))] += 1
             samples += 1
             time.sleep(interval)
@@ -202,25 +222,51 @@ def collect_vars(server) -> dict:
             out["degraded"] = server.degradation()
     except Exception as e:  # pragma: no cover - diagnostic only
         out["overload_error"] = repr(e)
+    try:
+        # flush-interval observability (veneur_tpu/obs/): timeline ring
+        # summary + per-scope kernel dispatches and live compiled-
+        # variant counts (the recompile lint pass's inventory,
+        # observed). The kernel counters run regardless of obs_enabled
+        # (they also back /debug/xprof), so they are reported even when
+        # the timeline ring is off.
+        if hasattr(server, "obs_timeline"):
+            from veneur_tpu.obs import kernels
+
+            section = {"kernels": kernels.snapshot()}
+            timeline = server.obs_timeline
+            if timeline is not None:
+                section["timeline"] = timeline.snapshot()
+            out["obs"] = section
+    except Exception as e:  # pragma: no cover - diagnostic only
+        out["obs_error"] = repr(e)
     return out
 
 
 def mount(add_route, server=None, extra_vars=None):
     """Register the /debug/* routes on a mux via its add_route(path, fn).
 
-    Handlers receive the parsed query dict. ``extra_vars`` is an optional
-    callable returning a dict merged into /debug/vars (the proxy passes
-    its ring stats)."""
+    Handlers receive the parsed query dict and return
+    ``(status, body, content_type[, headers])`` — the optional fourth
+    element carries extra response headers (the profile handler sets
+    ``Content-Disposition`` so its output drops straight into
+    flamegraph tooling). ``extra_vars`` is an optional callable
+    returning a dict merged into /debug/vars (the proxy passes its
+    ring stats)."""
 
     def threads(query) -> Tuple[int, str, str]:
         return 200, dump_threads(), "text/plain"
 
-    def profile(query) -> Tuple[int, str, str]:
+    def profile(query):
         try:
             seconds = float(query.get("seconds", "5"))
         except ValueError:
             return 400, "seconds must be a number", "text/plain"
-        return 200, sample_profile(seconds), "text/plain"
+        body = sample_profile(seconds)
+        # a curl -O / browser fetch lands as a .collapsed file that
+        # flamegraph.pl / speedscope / inferno ingest directly
+        return (200, body, "text/plain",
+                {"Content-Disposition":
+                 'attachment; filename="veneur-profile.collapsed"'})
 
     def dvars(query) -> Tuple[int, str, str]:
         data = collect_vars(server) if server is not None else {
@@ -233,6 +279,27 @@ def mount(add_route, server=None, extra_vars=None):
                 data["extra_vars_error"] = repr(e)
         return 200, json.dumps(data, default=str), "application/json"
 
+    def flush_timeline(query) -> Tuple[int, str, str]:
+        timeline = getattr(server, "obs_timeline", None)
+        if timeline is None:
+            return (404, "flush timeline disabled (obs_enabled: false)",
+                    "text/plain")
+        return timeline.handler(query)
+
+    def xprof(query) -> Tuple[int, str, str]:
+        from veneur_tpu.obs import kernels
+
+        try:
+            seconds = float(query.get("seconds", "2"))
+        except ValueError:
+            return 400, "seconds must be a number", "text/plain"
+        return kernels.capture_xprof(seconds)
+
     add_route("/debug/threads", threads)
     add_route("/debug/profile", profile)
     add_route("/debug/vars", dvars)
+    if server is not None and hasattr(server, "obs_timeline"):
+        # server-only observability routes (the proxy has no flush
+        # pipeline and no device programs to capture)
+        add_route("/debug/flush-timeline", flush_timeline)
+        add_route("/debug/xprof", xprof)

@@ -28,32 +28,134 @@ def flush_once(server: "Server"):
     Records flush-staleness state on the server: a completed pass stamps
     ``last_flush_time`` (what /healthcheck/ready and
     ``veneur.flush.age_seconds`` read); a raising one marks
-    ``last_flush_ok`` False and leaves the stamp stale."""
+    ``last_flush_ok`` False and leaves the stamp stale.
+
+    With observability on (``obs_enabled``), the whole pass runs under a
+    :class:`veneur_tpu.obs.StageRecorder`: every stage lands in the
+    ``/debug/flush-timeline`` ring, becomes a child SSF span under this
+    root span, and dogfoods into the store's self-telemetry digest
+    group (docs/observability.md)."""
+    from veneur_tpu import obs
     from veneur_tpu.trace import Trace
     span = Trace.start_trace("veneur.flush")
     span.name = "flush"
+    timeline = getattr(server, "obs_timeline", None)
+    rec = obs.StageRecorder() if timeline is not None else None
     try:
-        _flush_once(server, span)
+        with obs.activate(rec):
+            _flush_once(server, span, rec)
         server.last_flush_time = time.time()
         server.last_flush_ok = True
     except Exception:
         server.last_flush_ok = False
         raise
     finally:
+        if rec is not None:
+            try:
+                _publish_interval(server, span, rec, timeline)
+            except Exception:  # telemetry must never fail a flush
+                log.exception("flush-timeline publication failed")
         span.client_record(getattr(server, "trace_client", None))
 
 
-def _flush_once(server: "Server", span):
+def _publish_interval(server, span, rec, timeline):
+    """Interval-end merge: finish the stage record, publish it to the
+    timeline ring, mirror the stage tree as child SSF spans under the
+    flush root, and sample every stage duration (plus the ingest
+    lanes' seal->merge latencies) into the self-telemetry group."""
+    from veneur_tpu.obs import kernels as obs_kernels
+    from veneur_tpu.trace import samples as ssf_samples
+
+    entry = rec.finish()
+    latencies = _drain_ingest_latencies(server)
+    if latencies:
+        entry["ingest_seal_to_merge"] = {
+            "count": len(latencies),
+            "max_ns": int(max(latencies)),
+            "avg_ns": int(sum(latencies) / len(latencies))}
+    timeline.publish(entry)
+    _record_stage_spans(server, span, entry)
+    store = getattr(server, "store", None)
+    if store is not None and hasattr(store, "sample_self_timing"):
+        for stage in entry["stages"]:
+            store.sample_self_timing(stage["name"], stage["duration_ns"])
+        for ns in latencies:
+            store.sample_self_timing("ingest.seal_to_merge", float(ns))
+    # live device observability: coverage of the interval's stages plus
+    # compile/dispatch deltas per kernel scope (what the recompile lint
+    # pass proves statically, observed at runtime)
+    span.add(
+        ssf_samples.gauge("veneur.obs.stage_coverage_ratio",
+                          float(entry["coverage_ratio"]), None),
+        # clamped: live _cache_size sums SHRINK when jax caches clear,
+        # and a negative compile count would read as a leak reversing
+        ssf_samples.count(
+            "veneur.obs.kernel_compiles_total",
+            max(0.0, float(_delta_since(server, "_last_kernel_compiles",
+                                        obs_kernels.compiles_total()))),
+            None))
+    for scope_name, n in sorted(obs_kernels.dispatch_snapshot().items()):
+        span.add(ssf_samples.count(
+            "veneur.obs.kernel_dispatches_total",
+            float(_delta_since(server, f"_last_dispatch_{scope_name}", n)),
+            {"scope": scope_name}))
+
+
+def _drain_ingest_latencies(server) -> list:
+    """Collect the interval's seal->merge latencies (ns) from every
+    ingest fleet (ingest/lanes.py stamps each SealedChunk at seal; the
+    merger measures the gap when it folds the chunk in)."""
+    out: list = []
+    for fleet in getattr(server, "_ingest_fleets", None) or ():
+        try:
+            out.extend(fleet.take_merge_latencies())
+        except Exception:  # pragma: no cover - telemetry only
+            log.exception("ingest latency drain failed")
+    return out
+
+
+def _record_stage_spans(server, root, entry):
+    """Mirror the interval's stage tree as child SSF spans: one span
+    per stage, parented on its dotted-path parent's span (top-level
+    stages hang off the flush root), start/end mapped onto the root's
+    wall clock. Same nonblocking client as the root — a full span
+    channel drops them."""
+    cl = getattr(server, "trace_client", None)
+    if cl is None:
+        return
+    wall0 = entry["wall_start"]
+    by_path = {}
+    for stage in entry["stages"]:
+        path = stage["name"]
+        parent = by_path.get(path.rsplit(".", 1)[0]) \
+            if "." in path else None
+        if parent is None:
+            parent = root
+        child = parent.start_child_span()
+        child.name = f"veneur.flush.{path}"
+        child.start = wall0 + stage["start_ns"] / 1e9
+        child.end = child.start + stage["duration_ns"] / 1e9
+        for key, value in stage.items():
+            if key not in ("name", "start_ns", "duration_ns"):
+                child.tags[key] = str(value)
+        by_path[path] = child
+        child.client_record(cl)
+
+
+def _flush_once(server: "Server", span, rec=None):
+    from veneur_tpu import obs
     from veneur_tpu.trace import samples as ssf_samples
     now = int(time.time())
 
     # events → FlushOtherSamples on each metric sink (flusher.go:42-47)
-    samples = server.event_worker.flush()
-    for sink in server.metric_sinks:
-        try:
-            sink.flush_other_samples(samples)
-        except Exception:
-            log.exception("sink %s flush_other_samples failed", sink.name)
+    with obs.maybe_stage("events"):
+        samples = server.event_worker.flush()
+        for sink in server.metric_sinks:
+            try:
+                sink.flush_other_samples(samples)
+            except Exception:
+                log.exception("sink %s flush_other_samples failed",
+                              sink.name)
 
     # span sinks flush concurrently with the metric path (flusher.go:49).
     # A wedged span sink can hold its barrier for 9s, so with short
@@ -108,7 +210,11 @@ def _flush_once(server: "Server", span):
     if use_columnar:
         from veneur_tpu.native import egress
 
-        use_columnar = egress.available()
+        # the first call may BUILD the native egress library (seconds);
+        # without a stage of its own it reads as unaccounted time on
+        # the first interval's timeline
+        with obs.maybe_stage("egress_detect"):
+            use_columnar = egress.available()
     # device-compacted digest forwarding (PackedDigestPlanes) whenever
     # the forwarder can take it: the raw [S,K] f32 plane fetch is what
     # blew the interval at 1M+ forwarded series
@@ -117,10 +223,11 @@ def _flush_once(server: "Server", span):
         and getattr(server._forwarder, "wants_packed_digests", False)) \
         else "dense"
     t0 = time.perf_counter()
-    final_metrics, forwardable, ms = server.store.flush(
-        percentiles, server.histogram_aggregates, is_local=is_local, now=now,
-        forward=forwarding, forward_topk=topk_ok, columnar=use_columnar,
-        digest_format=digest_format)
+    with obs.maybe_stage("store"):
+        final_metrics, forwardable, ms = server.store.flush(
+            percentiles, server.histogram_aggregates, is_local=is_local,
+            now=now, forward=forwarding, forward_topk=topk_ok,
+            columnar=use_columnar, digest_format=digest_format)
     flush_elapsed = time.perf_counter() - t0
     log.debug("store flush took %.1f ms (%s)", flush_elapsed * 1e3, ms)
     # the store just drained: any existing checkpoint captured state
@@ -158,6 +265,7 @@ def _flush_once(server: "Server", span):
         *_forward_samples(server),
         *_import_samples(server),
         *_checkpoint_samples(server),
+        *_trace_client_samples(server),
         *_runtime_samples())
 
     # local → global forwarding happens off the flush path
@@ -177,25 +285,43 @@ def _flush_once(server: "Server", span):
             # the forward runs off the flush path but shares the flush
             # budget: its retries must finish before the next interval
             kwargs["deadline"] = deadline
-        fwd = lambda: server.forward_fn(forwardable, **kwargs)
+        def fwd():
+            # the forward runs off the flush path; with observability
+            # on it lands in the interval's already-published timeline
+            # entry as an off-path stage (recorder.record_late)
+            t_fwd = time.monotonic_ns()
+            try:
+                server.forward_fn(forwardable, **kwargs)
+            finally:
+                if rec is not None:
+                    rec.record_late("forward", t_fwd, time.monotonic_ns(),
+                                    series=len(forwardable))
         threading.Thread(target=fwd, daemon=True).start()
 
     if not final_metrics:
-        span_flusher.join(timeout=10.0)
+        with obs.maybe_stage("span_join"):
+            span_flusher.join(timeout=10.0)
         return
 
     # one thread per metric sink (flusher.go:82-93)
     t0 = time.perf_counter()
+    post_t0 = time.monotonic_ns()
     threads = []
     sink_elapsed: dict = {}
 
     def timed(fn, sink, arg):
         def run():
             ts = time.perf_counter()
+            ts_ns = time.monotonic_ns()
             try:
                 fn(sink, arg)
             finally:
                 sink_elapsed[sink.name] = time.perf_counter() - ts
+                if rec is not None:
+                    # sink threads are outside the flusher's stage
+                    # stack: absolute path, nested under "post"
+                    rec.record_abs(f"post.{sink.name}", ts_ns,
+                                   time.monotonic_ns())
         return run
 
     for sink in server.metric_sinks:
@@ -216,6 +342,11 @@ def _flush_once(server: "Server", span):
         threads.append(t)
     for t in threads:
         t.join(timeout=30.0)
+    if rec is not None:
+        # the sink fan-out's wall-clock (its per-sink children recorded
+        # from their own threads above)
+        rec.record_abs("post", post_t0, time.monotonic_ns(),
+                       sinks=len(threads))
     _check_flush_overrun(server, deadline, budget, sink_elapsed)
     # total time across the parallel sink POSTs (README.md:264), plus
     # the per-sink breakdown and each sink's errors/marshal/post parts
@@ -225,17 +356,19 @@ def _flush_once(server: "Server", span):
     span.add(*_sink_samples(server, sink_elapsed))
 
     # plugins run after the sinks (flusher.go:95-109)
-    for plugin in server.plugins:
-        try:
-            if use_columnar and hasattr(plugin, "flush_columnar"):
-                plugin.flush_columnar(final_metrics)
-            else:
-                plugin.flush(final_metrics.to_intermetrics()
-                             if use_columnar else final_metrics)
-        except Exception:
-            log.exception("plugin %s flush failed", plugin.name)
+    with obs.maybe_stage("plugins"):
+        for plugin in server.plugins:
+            try:
+                if use_columnar and hasattr(plugin, "flush_columnar"):
+                    plugin.flush_columnar(final_metrics)
+                else:
+                    plugin.flush(final_metrics.to_intermetrics()
+                                 if use_columnar else final_metrics)
+            except Exception:
+                log.exception("plugin %s flush failed", plugin.name)
 
-    span_flusher.join(timeout=10.0)
+    with obs.maybe_stage("span_join"):
+        span_flusher.join(timeout=10.0)
 
 
 def _check_flush_overrun(server, deadline, budget: float,
@@ -301,6 +434,41 @@ def _checkpoint_samples(server):
                                ckpt.write_errors)), None),
     ]
     return out
+
+
+def _trace_client_samples(server):
+    """The trace client's own backpressure counters
+    (``veneur.trace_client.*``): drained + reset once per interval via
+    ``send_client_statistics`` (trace/client.py, the reference's
+    client.go:446-452) so queue drops on the self-telemetry path are
+    themselves visible as self-metrics."""
+    from veneur_tpu.trace import samples as ssf_samples
+    from veneur_tpu.trace.client import send_client_statistics
+
+    cl = getattr(server, "trace_client", None)
+    if cl is None:
+        return []
+    stats: dict = {}
+    try:
+        send_client_statistics(cl, lambda name, value:
+                               stats.__setitem__(name, value))
+    except Exception:  # pragma: no cover - telemetry must not abort
+        log.exception("trace-client statistics drain failed")
+        return []
+    return [
+        ssf_samples.count("veneur.trace_client.flushes_failed_total",
+                          stats.get("trace_client.flushes_failed_total",
+                                    0.0), None),
+        ssf_samples.count("veneur.trace_client.flushes_succeeded_total",
+                          stats.get("trace_client.flushes_succeeded_total",
+                                    0.0), None),
+        ssf_samples.count("veneur.trace_client.records_failed_total",
+                          stats.get("trace_client.records_failed_total",
+                                    0.0), None),
+        ssf_samples.count("veneur.trace_client.records_succeeded_total",
+                          stats.get("trace_client.records_succeeded_total",
+                                    0.0), None),
+    ]
 
 
 def _worker_samples(server, ms):
@@ -493,19 +661,30 @@ def _sink_samples(server, sink_elapsed: dict):
                 "veneur.breaker.state", breaker.state_gauge(),
                 {"destination": breaker.name or name, "sink": name}))
         if hasattr(sink, "drain_flush_telemetry"):
+            from veneur_tpu import obs
+
+            rec = obs.current()
             for kind, value in sink.drain_flush_telemetry():
                 if kind == "marshal_s":
                     out.append(ssf_samples.timing(
                         "veneur.flush.duration_ns", value,
                         {"sink": name, "part": "marshal"}))
+                    if rec is not None:
+                        rec.amend(f"post.{name}",
+                                  serialize_ns=int(value * 1e9))
                 elif kind == "post_s":
                     out.append(ssf_samples.timing(
                         "veneur.flush.duration_ns", value,
                         {"sink": name, "part": "post"}))
+                    if rec is not None:
+                        rec.amend(f"post.{name}",
+                                  post_ns=int(value * 1e9))
                 elif kind == "content_length_bytes":
                     out.append(ssf_samples.histogram(
                         "veneur.flush.content_length_bytes", float(value),
                         {"sink": name}))
+                    if rec is not None:
+                        rec.amend(f"post.{name}", bytes=int(value))
     return out
 
 

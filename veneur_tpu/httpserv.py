@@ -89,11 +89,14 @@ class _Handler(BaseHTTPRequestHandler):
     def log_message(self, fmt, *args):  # route to logging, not stderr
         log.debug("http: " + fmt, *args)
 
-    def _reply(self, status: int, body: str = "", content_type="text/plain"):
+    def _reply(self, status: int, body: str = "", content_type="text/plain",
+               headers=None):
         data = body.encode("utf-8")
         self.send_response(status)
         self.send_header("Content-Type", content_type)
         self.send_header("Content-Length", str(len(data)))
+        for key, value in (headers or {}).items():
+            self.send_header(key, value)
         self.end_headers()
         self.wfile.write(data)
 
@@ -117,8 +120,10 @@ class _Handler(BaseHTTPRequestHandler):
             if extra is not None:
                 query = dict(urllib.parse.parse_qsl(qs))
                 try:
-                    status, body, ctype = extra(query)
-                    self._reply(status, body, ctype)
+                    # handlers return (status, body, ctype[, headers])
+                    status, body, ctype, *rest = extra(query)
+                    self._reply(status, body, ctype,
+                                headers=rest[0] if rest else None)
                 except Exception as e:
                     log.exception("handler for %s failed", path)
                     self._reply(500, str(e))

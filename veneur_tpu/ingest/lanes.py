@@ -136,10 +136,16 @@ class _KindStage:
 class SealedChunk:
     """An immutable hand-off unit: per-kind staged spans plus the lane
     intern entries minted since the previous seal (the resolver learns
-    them even when a backlogged chunk's payload is shed)."""
+    them even when a backlogged chunk's payload is shed).
+
+    ``sealed_ns`` stamps the hand-off (monotonic): the merger measures
+    seal->merge latency from it (veneur.obs.stage_duration_ns tagged
+    ``stage:ingest.seal_to_merge``). The stamp is one clock read on the
+    lane thread — the ``@lockfree_hot_path`` assertion on the lane loop
+    still holds."""
 
     __slots__ = ("lane_id", "gen", "records", "spans", "new_entries",
-                 "raws")
+                 "raws", "sealed_ns")
 
     def __init__(self, lane_id: int, gen: int, records: int,
                  spans: Dict[int, tuple],
@@ -150,6 +156,7 @@ class SealedChunk:
         self.spans = spans
         self.new_entries = new_entries
         self.raws = raws
+        self.sealed_ns = time.monotonic_ns()
 
 
 class LaneResolver:
@@ -686,6 +693,16 @@ class IngestFleet:
         self._resolvers: Dict[int, LaneResolver] = {}
         self.merged_records: Dict[int, int] = {}
         self.merged_raws: Dict[int, int] = {}
+        # seal->merge latency observability: the merger (single writer)
+        # appends each merged chunk's latency; the flusher drains the
+        # deque per interval into the self-telemetry group, the running
+        # aggregates ride /debug/vars. deque append/popleft are
+        # GIL-atomic — no lock between merger and flusher.
+        self._merge_latencies: "collections.deque" = collections.deque(
+            maxlen=4096)
+        self.merge_latency_count = 0
+        self.merge_latency_max_ns = 0
+        self._merge_latency_sum_ns = 0
         self.unrouted_raws: list = []  # only without a raw_handler (tests)
         intern_limit = (intern_limit
                         or getattr(store, "max_series", 0) or (1 << 20))
@@ -754,6 +771,13 @@ class IngestFleet:
             # must never remap them
             res = self._resolvers[chunk.lane_id] = LaneResolver(chunk.gen)
         raws = self._store.import_lane_chunk(chunk, res)
+        latency = time.monotonic_ns() - chunk.sealed_ns
+        if latency >= 0:
+            self._merge_latencies.append(latency)
+            self.merge_latency_count += 1
+            self._merge_latency_sum_ns += latency
+            if latency > self.merge_latency_max_ns:
+                self.merge_latency_max_ns = latency
         if chunk.records:
             self.merged_records[chunk.lane_id] = (
                 self.merged_records.get(chunk.lane_id, 0) + chunk.records)
@@ -818,6 +842,25 @@ class IngestFleet:
 
     # -- read-side telemetry -------------------------------------------------
 
+    def take_merge_latencies(self) -> List[int]:
+        """Drain the interval's seal->merge latencies (ns) for the
+        flusher's self-telemetry sampling; running aggregates stay for
+        /debug/vars. popleft-until-empty is safe against the merger's
+        concurrent appends (GIL-atomic deque ops, no lock)."""
+        out: List[int] = []
+        latencies = self._merge_latencies
+        while True:
+            try:
+                out.append(latencies.popleft())
+            except IndexError:
+                return out
+
+    def merge_latency_snapshot(self) -> dict:
+        n = self.merge_latency_count
+        return {"count": n,
+                "max_ns": self.merge_latency_max_ns,
+                "avg_ns": (self._merge_latency_sum_ns // n) if n else 0}
+
     def pressure(self) -> float:
         """Backlog fill ratio feeding the overload watermarks: sealed
         chunks waiting on the merger, against the per-lane shed cap."""
@@ -873,5 +916,6 @@ class IngestFleet:
         return {"totals": self.totals(),
                 "balance": self.balance(),
                 "pressure": round(self.pressure(), 4),
+                "seal_to_merge": self.merge_latency_snapshot(),
                 "per_lane": [lane.counters_snapshot()
                              for lane in self.lanes]}

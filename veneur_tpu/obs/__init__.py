@@ -1,0 +1,30 @@
+"""Interval-timeline observability: per-stage self-tracing for the
+flush path, kernel-level profiling hooks, and the dogfooded
+self-telemetry plumbing.
+
+The reference traces its own flush with one SSF span per interval
+(``/root/reference/flusher.go:26-29``) and mounts pprof everywhere; the
+layer here goes further and makes the pipeline's interior visible:
+
+- :mod:`veneur_tpu.obs.recorder` — ``StageRecorder``, a lock-cheap
+  (monotonic-ns stamps, single-writer-per-thread deque appends, merged
+  at interval end like the ingest lanes) begin/end tracer the flusher
+  threads through the whole hot path.
+- :mod:`veneur_tpu.obs.timeline` — the bounded per-interval ring buffer
+  behind ``GET /debug/flush-timeline``.
+- :mod:`veneur_tpu.obs.kernels` — ``jax.profiler`` named scopes over
+  every compiled program in the static-analysis inventory, live
+  compile/dispatch counters, and the on-demand ``/debug/xprof``
+  capture.
+
+``docs/observability.md`` is the reading guide.
+"""
+
+from __future__ import annotations
+
+from veneur_tpu.obs.recorder import (StageRecorder, activate, current,
+                                     maybe_stage, note)
+from veneur_tpu.obs.timeline import FlushTimeline
+
+__all__ = ["StageRecorder", "FlushTimeline", "activate", "current",
+           "maybe_stage", "note"]

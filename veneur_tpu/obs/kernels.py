@@ -1,0 +1,202 @@
+"""Device observability: profiler scopes, compile/dispatch counters,
+and the on-demand xprof capture.
+
+The recompile lint pass (lint/recompile.py) proves statically which
+compiled programs exist and what can retrigger their compilation; this
+module surfaces the same inventory LIVE:
+
+- :func:`scope` wraps every host-side dispatch choke point in a
+  ``jax.profiler.TraceAnnotation`` named scope, so an xprof capture of
+  a running server labels device work by pipeline stage instead of by
+  mangled HLO module names. Entering a scope also counts a dispatch.
+- :data:`PROGRAM_SCOPES` maps every program in the generated
+  compiled-program inventory (docs/static-analysis.md) to the scope
+  that covers its dispatches; tests drift-check the mapping against
+  the lint pass exactly like the docs table, so a new program cannot
+  ship unannotated.
+- :func:`compile_snapshot` reads each program's live compiled-variant
+  count (``PjitFunction._cache_size``), turning the lint pass's
+  "bounded static args" proof into an observable number: a variant
+  count that grows interval over interval is a recompile leak.
+- :func:`capture_xprof` runs a bounded ``jax.profiler``
+  start/stop_trace capture for ``GET /debug/xprof?seconds=N`` —
+  one at a time, clamped, like ``/debug/profile``.
+"""
+
+from __future__ import annotations
+
+import importlib
+import json
+import os
+import threading
+import time
+from contextlib import contextmanager
+from typing import Dict, Optional, Tuple
+
+try:  # pragma: no cover - jax is present everywhere we run
+    from jax.profiler import TraceAnnotation as _TraceAnnotation
+except Exception:  # pragma: no cover
+    _TraceAnnotation = None
+
+# profiler scope names carry this prefix in xprof captures
+SCOPE_PREFIX = "veneur."
+
+MAX_XPROF_SECONDS = 30.0
+
+# one capture at a time (mirrors debug._profile_lock for /debug/profile)
+_xprof_lock = threading.Lock()
+
+# scope -> dispatch count. Plain dict int bumps: every writer holds the
+# GIL across the read-modify-write (single bytecode effects are close
+# enough for telemetry; dispatches are chunk-scale, not packet-scale).
+_dispatches: Dict[str, int] = {}
+
+# ---------------------------------------------------------------------------
+# the scope coverage map — drift-checked against the lint inventory
+# ---------------------------------------------------------------------------
+
+# Every compiled program in the static-analysis inventory, mapped to
+# the named scope whose dispatch site covers it (tests/test_obs.py
+# fails when the inventory and this map drift apart — same contract as
+# the generated docs table). Third field: the importable module-level
+# jit binding for compile counting, or None when the program has no
+# module-level PjitFunction (ingest_chunk_guarded is jitted inline by
+# its callers and inside enclosing programs).
+PROGRAM_SCOPES: Dict[str, Tuple[str, Optional[Tuple[str, str]]]] = {
+    "veneur_tpu/core/store.py::_flush_digests":
+        ("flush.digest.dense", ("veneur_tpu.core.store", "_flush_digests")),
+    "veneur_tpu/core/store.py::_ingest_samples":
+        ("drain.digest.dense", ("veneur_tpu.core.store", "_ingest_samples")),
+    "veneur_tpu/core/store.py::_ingest_centroids":
+        ("drain.digest.dense",
+         ("veneur_tpu.core.store", "_ingest_centroids")),
+    "veneur_tpu/ops/tdigest.py::ingest_chunk_guarded":
+        ("drain.digest.dense", None),
+    "veneur_tpu/ops/tdigest_pallas.py::_compress_presorted_pallas":
+        ("flush.digest.dense",
+         ("veneur_tpu.ops.tdigest_pallas", "_compress_presorted_pallas")),
+    "veneur_tpu/ops/tdigest_pallas.py::_drain_quantile_pallas":
+        ("flush.digest.dense",
+         ("veneur_tpu.ops.tdigest_pallas", "_drain_quantile_pallas")),
+    "veneur_tpu/core/slab.py::_ingest_slab":
+        ("drain.digest.slab", ("veneur_tpu.core.slab", "_ingest_slab")),
+    "veneur_tpu/core/slab.py::_import_slab":
+        ("drain.digest.slab", ("veneur_tpu.core.slab", "_import_slab")),
+    "veneur_tpu/core/slab.py::_merge_slab":
+        ("drain.digest.slab", ("veneur_tpu.core.slab", "_merge_slab")),
+    "veneur_tpu/core/slab.py::_flush_slab":
+        ("flush.digest.slab", ("veneur_tpu.core.slab", "_flush_slab")),
+    "veneur_tpu/core/slab.py::_quantile_slab":
+        ("flush.digest.slab", ("veneur_tpu.core.slab", "_quantile_slab")),
+    "veneur_tpu/core/slab.py::_pack_slab":
+        ("flush.digest.slab", ("veneur_tpu.core.slab", "_pack_slab")),
+    "veneur_tpu/core/slab.py::_slice_pack":
+        ("flush.digest.slab", ("veneur_tpu.core.slab", "_slice_pack")),
+    "veneur_tpu/core/slab.py::_gather_pack":
+        ("flush.digest.slab", ("veneur_tpu.core.slab", "_gather_pack")),
+    "veneur_tpu/core/tiered.py::_pool_ingest":
+        ("drain.digest.tiered", ("veneur_tpu.core.tiered", "_pool_ingest")),
+    "veneur_tpu/core/tiered.py::_pool_import":
+        ("drain.digest.tiered", ("veneur_tpu.core.tiered", "_pool_import")),
+    "veneur_tpu/core/tiered.py::_pool_restore_stats":
+        ("drain.digest.tiered",
+         ("veneur_tpu.core.tiered", "_pool_restore_stats")),
+    "veneur_tpu/core/tiered.py::_promote_rows":
+        ("drain.digest.tiered", ("veneur_tpu.core.tiered", "_promote_rows")),
+    "veneur_tpu/core/tiered.py::_pool_flush":
+        ("flush.digest.tiered", ("veneur_tpu.core.tiered", "_pool_flush")),
+}
+
+
+@contextmanager
+def scope(name: str):
+    """One named dispatch region: counts the dispatch and, when the
+    profiler is importable, labels the region in xprof captures. Cheap
+    enough for the per-chunk drain paths (a dict bump + one context
+    object); NOT for per-packet paths."""
+    _dispatches[name] = _dispatches.get(name, 0) + 1
+    if _TraceAnnotation is None:  # pragma: no cover - jax always present
+        yield
+        return
+    with _TraceAnnotation(SCOPE_PREFIX + name):
+        yield
+
+
+def dispatch_snapshot() -> Dict[str, int]:
+    return dict(_dispatches)
+
+
+def compile_snapshot() -> Dict[str, Optional[int]]:
+    """program -> live compiled-variant count (None = the program has
+    no module-level jit binding to read). Only programs whose module is
+    ALREADY imported are counted — a debug read must not pull the slab
+    or tiered stack into a dense-only process."""
+    import sys
+
+    out: Dict[str, Optional[int]] = {}
+    for program, (_scope_name, binding) in PROGRAM_SCOPES.items():
+        count: Optional[int] = None
+        if binding is not None and binding[0] in sys.modules:
+            fn = getattr(importlib.import_module(binding[0]), binding[1],
+                         None)
+            cache_size = getattr(fn, "_cache_size", None)
+            if cache_size is not None:
+                try:
+                    count = int(cache_size())
+                except Exception:  # pragma: no cover - jax API drift
+                    count = None
+        out[program] = count
+    return out
+
+
+def compiles_total() -> int:
+    """Sum of live compiled variants across tracked programs (the
+    interval-delta self-metric veneur.obs.kernel_compiles_total)."""
+    return sum(v for v in compile_snapshot().values() if v)
+
+
+def snapshot() -> dict:
+    """The /debug/vars "kernels" section: dispatches per scope plus
+    compiled-variant counts per inventory program."""
+    return {"dispatches": dispatch_snapshot(),
+            "compiled_variants": compile_snapshot()}
+
+
+def capture_xprof(seconds: float, base_dir: Optional[str] = None) -> tuple:
+    """Run one bounded xprof capture; returns the (status, body, ctype)
+    triple for the /debug/xprof route. The trace lands on local disk
+    (xprof traces are directory trees, not a streamable body) and the
+    response names the directory + files so an operator can pull them
+    with scp / TensorBoard's profile plugin."""
+    seconds = max(0.05, min(float(seconds), MAX_XPROF_SECONDS))
+    if not _xprof_lock.acquire(blocking=False):
+        return 409, "another xprof capture is already running", "text/plain"
+    try:
+        import tempfile
+
+        import jax
+
+        trace_dir = tempfile.mkdtemp(prefix="veneur-xprof-", dir=base_dir)
+        t0 = time.perf_counter()
+        jax.profiler.start_trace(trace_dir)
+        try:
+            time.sleep(seconds)
+        finally:
+            jax.profiler.stop_trace()
+        took = time.perf_counter() - t0
+        files = []
+        for root, _dirs, names in os.walk(trace_dir):
+            for name in names:
+                path = os.path.join(root, name)
+                files.append({"path": path,
+                              "bytes": os.path.getsize(path)})
+        body = json.dumps({"trace_dir": trace_dir,
+                           "seconds": round(took, 3),
+                           "files": files,
+                           "scopes": sorted({s for s, _ in
+                                             PROGRAM_SCOPES.values()})})
+        return 200, body, "application/json"
+    except Exception as e:  # profiler unavailable / double-start etc.
+        return 500, f"xprof capture failed: {e!r}", "text/plain"
+    finally:
+        _xprof_lock.release()

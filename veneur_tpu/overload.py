@@ -40,6 +40,19 @@ log = logging.getLogger("veneur.overload")
 # the single per-group spill row new series collapse into past max_series
 OVERFLOW_NAME = "veneur.overload.overflow"
 
+# Self-telemetry carve-out: series under this prefix are the operator's
+# only view INTO an overload, so the first-sight freeze (level >= 1)
+# never applies to them (the hard per-group cap still does). The
+# store's interners and the dedicated self-telemetry digest group
+# (MetricStore.self_timers) both consult this ONE predicate.
+SELF_TELEMETRY_PREFIX = "veneur."
+
+
+def freeze_exempt(name: str) -> bool:
+    """True when a first-sight series must survive the admission
+    freeze (the ``veneur.*`` carve-out)."""
+    return name.startswith(SELF_TELEMETRY_PREFIX)
+
 # numeric bounds the quarantine enforces: values outside these ranges
 # would silently launder into inf (f32 digest staging) or overflow the
 # exact int64 counter lanes

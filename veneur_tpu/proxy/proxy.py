@@ -45,10 +45,12 @@ class _ProxyHandler(BaseHTTPRequestHandler):
     def log_message(self, fmt, *args):
         log.debug("proxy http: " + fmt, *args)
 
-    def _reply(self, status: int, body: str = ""):
+    def _reply(self, status: int, body: str = "", headers=None):
         data = body.encode()
         self.send_response(status)
         self.send_header("Content-Length", str(len(data)))
+        for key, value in (headers or {}).items():
+            self.send_header(key, value)
         self.end_headers()
         self.wfile.write(data)
 
@@ -68,8 +70,11 @@ class _ProxyHandler(BaseHTTPRequestHandler):
             import urllib.parse
 
             try:
-                status, body, _ = extra(dict(urllib.parse.parse_qsl(qs)))
-                self._reply(status, body)
+                # handlers return (status, body, ctype[, headers])
+                status, body, _, *rest = extra(
+                    dict(urllib.parse.parse_qsl(qs)))
+                self._reply(status, body,
+                            headers=rest[0] if rest else None)
             except Exception as e:
                 log.exception("handler for %s failed", path)
                 self._reply(500, str(e))

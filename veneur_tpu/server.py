@@ -344,6 +344,18 @@ class Server:
         # (server.go:196-202)
         from veneur_tpu.trace import new_channel_client
         self.trace_client = new_channel_client(self.span_chan)
+        # flush-interval observability (veneur_tpu/obs/): the bounded
+        # timeline ring behind GET /debug/flush-timeline; None when
+        # obs_enabled is off — the flusher then allocates no recorder
+        # and every stage hook is one thread-local read
+        self.obs_timeline = None
+        if config.obs_enabled:
+            from veneur_tpu.obs import FlushTimeline
+
+            # apply_defaults (above) already substituted the 0-means-64
+            # default; config is the single source of truth here
+            self.obs_timeline = FlushTimeline(
+                config.obs_timeline_intervals)
         # set by the forwarding layer (veneur_tpu.forward) when local
         self.forward_fn: Optional[Callable] = None
         self._forwarder = None
