@@ -2366,6 +2366,86 @@ print(json.dumps({{"p50_ms": round(dt * 1e3, 3),
         return {"error": str(e)[:120]}
 
 
+def bench_fleet_mesh(num_series: int = 1 << 13):
+    """Config #11: fleet mode — the mesh-sharded TIERED store's global
+    merge (shard-routed import drains + sharded flush) vs shard count
+    on the 8-device virtual CPU mesh, in a subprocess so the
+    TPU-initialized parent is untouched. The wall-clock-vs-shards curve
+    is the program-structure signal (collective + partitioning
+    overhead); absolute speedup needs real chips — all 8 virtual
+    devices share this host's cores, so ratios ~1.0 here are expected
+    and honest."""
+    code = f"""
+import jax
+jax.config.update('jax_platforms', 'cpu')  # before any backend use
+import json, time
+import numpy as np
+from veneur_tpu.fleet import ShardRouter
+from veneur_tpu.fleet.mesh_tiered import MeshTieredDigestGroup
+from veneur_tpu.parallel.mesh import fleet_mesh
+from veneur_tpu.samplers.parser import MetricKey
+N = {num_series}
+rng = np.random.default_rng(0)
+vals = rng.gamma(2.0, 30.0, (4, N)).astype(np.float32)
+imp_means = np.sort(rng.gamma(2.0, 30.0, (N, 8)), axis=1)
+out = {{}}
+for shards in (1, 2, 4, 8):
+    mesh = fleet_mesh(jax.devices()[:shards], hosts=1)
+    router = ShardRouter(shards)
+    def build():
+        g = MeshTieredDigestGroup(mesh, router, slab_rows=1 << 14,
+                                  chunk=1 << 14, promote_samples=1 << 30,
+                                  dense_capacity=256)
+        rows = np.asarray([g._row(MetricKey(name=f'f{{i}}',
+                                            type='histogram'), [])
+                           for i in range(N)], np.int64)
+        return g, rows
+    def drive(g, rows):
+        wts = np.ones(N, np.float32)
+        for r in range(4):
+            g.sample_many(rows, vals[r], wts)
+        # shard-routed import: one 8-centroid run per series
+        g.import_centroids_bulk(
+            np.repeat(rows, 8), imp_means.reshape(-1),
+            np.ones(N * 8, np.float32), rows,
+            imp_means[:, 0], imp_means[:, -1])
+        g._drain_staging()
+        occ = g.placement.occupancy()  # before flush resets placement
+        g.flush([0.5, 0.99])
+        return occ
+    g, rows = build()
+    drive(g, rows)          # warmup: compile the sharded programs
+    times = []
+    occ = None
+    for _ in range(3):
+        g, rows = build()
+        t0 = time.perf_counter()
+        occ = drive(g, rows)
+        times.append(time.perf_counter() - t0)
+    out[str(shards)] = {{
+        "merge_flush_ms": round(sorted(times)[1] * 1e3, 1),
+        "balance_ratio": occ["balance_ratio"]}}
+base = out["1"]["merge_flush_ms"]
+for k, v in out.items():
+    v["vs_1_shard"] = round(base / v["merge_flush_ms"], 2)
+print(json.dumps({{"series": N, "per_shards": out,
+                   "note": "virtual CPU mesh shares host cores; the "
+                           "curve is structure, not speedup"}}))
+"""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    env.pop("PYTHONSTARTUP", None)
+    try:
+        out = subprocess.run([sys.executable, "-c", code], env=env,
+                             capture_output=True, timeout=600, text=True,
+                             cwd=_HERE)
+        return json.loads(out.stdout.strip().splitlines()[-1])
+    except Exception as e:  # pragma: no cover
+        print(f"fleet bench failed: {e}", file=sys.stderr)
+        return {"error": str(e)[:160]}
+
+
 def bench_heavy_hitters():
     """Config #5: count-min + top-k at high key cardinality."""
     import jax
@@ -2545,6 +2625,11 @@ def _lane_plan(result, guarded):
         # the observability tax: flush p50/p99 with stage tracing on vs
         # obs_enabled: false — the <=3% acceptance gate, measured
         ("10_obs_overhead", guarded(bench_obs_overhead), 300),
+        # fleet mode: the mesh-sharded tiered store's global merge
+        # (shard-routed import + sharded flush) vs shard count on the
+        # 8-device virtual mesh (subprocess; see bench_fleet_mesh for
+        # why the curve, not the speedup, is the signal here)
+        ("11_fleet", guarded(bench_fleet_mesh), 600),
     ]
 
 
@@ -2654,6 +2739,7 @@ def _headline(result) -> dict:
                           "rsa_2048_conn_s"),
             "9_proxy": pick("9_proxy_fanout", "metrics_per_s",
                             "forward_errors"),
+            "11_fleet": pick("11_fleet", "per_shards", "series"),
         },
         "detail_file": "BENCH_DETAIL.json",
     }

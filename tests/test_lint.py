@@ -121,7 +121,7 @@ class TestRealCodebase:
             "veneur_tpu/ops/hll.py::estimate",
             "veneur_tpu/parallel/global_agg.py::"
             "GlobalAggregator._local_step",
-            "veneur_tpu/core/mesh_store.py::_digest_programs.local_ingest",
+            "veneur_tpu/core/mesh_store.py::_mesh_ingest_samples",
             "veneur_tpu/ops/countmin.py::update",
         ]:
             assert expected in hot_names, (

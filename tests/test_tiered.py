@@ -414,6 +414,25 @@ class TestTieredGroupParity:
 
 
 class TestCheckpointRoundTrip:
+    def test_snapshot_includes_staged_bank_residue(self):
+        """Regression (found by the fleet acceptance lane): samples of
+        an already-promoted row stage into the embedded dense bank via
+        sample_many, which only drains FULL chunks — a snapshot taken
+        with a partial bank chunk staged must drain it first, or a
+        promoted row's tail silently misses the checkpoint (the flush
+        path always drained it; the snapshot path did not)."""
+        g = make_group(chunk=16, promote_samples=8, promote_intervals=1)
+        key = MetricKey(name="resid.h", type="histogram")
+        # 16 samples drain (one full chunk) and promote the row; the
+        # next 5 stage into the BANK and stay below its chunk bound
+        for j in range(21):
+            g.sample(key, [], float(j % 7), 1.0)
+        g._drain_staging()
+        assert g._slot[0] >= 0, "row should be dense by now"
+        assert g._dense._fill > 0, "test needs staged bank residue"
+        snap = g.snapshot_state()
+        assert float(np.sum(snap["count"])) == 21.0
+
     def _emissions(self, store):
         final, _, _ = _flush(store, now=100)
         return {(m.name, tuple(m.tags)): m.value for m in final}
@@ -616,7 +635,10 @@ class TestConfigSurface:
         {"tier_pool_centroids": 4},    # below the floor
         {"tier_promote_samples": -1},
         {"tier_demote_intervals": -2},
-        {"digest_storage": "tiered", "mesh_enabled": True},
+        # mesh × tiered became LEGAL in fleet mode (fleet/mesh_tiered);
+        # slab × mesh and mesh-on-a-local remain config contradictions
+        {"digest_storage": "slab", "mesh_enabled": True},
+        {"mesh_enabled": True, "forward_address": "127.0.0.1:1"},
         {"digest_storage": "ragged"},
     ])
     def test_invalid_rejected(self, kw):

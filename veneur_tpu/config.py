@@ -341,16 +341,21 @@ class Config:
         if self.slab_rows <= 0:
             raise ValueError(f"slab_rows must be positive, got "
                              f"{self.slab_rows}")
-        if self.digest_storage != "dense" and self.mesh_enabled:
+        if self.digest_storage == "slab" and self.mesh_enabled:
             raise ValueError(
-                f"digest_storage: {self.digest_storage} cannot combine "
-                f"with mesh_enabled yet: the mesh store shards DENSE "
-                f"[S,K] planes across chips and does not speak the slab "
-                f"layout or the tiered packed-pool residency "
-                f"(core/tiered.py). Run the mesh dense, or drop "
-                f"mesh_enabled — sharding the tiered store across the "
-                f"device mesh is the ROADMAP fleet-mode item (open "
-                f"item 1)")
+                "digest_storage: slab cannot combine with mesh_enabled: "
+                "the slab layout is the single-chip capacity plan and "
+                "fleet mode supersedes it. Run the mesh dense, or use "
+                "digest_storage: tiered — fleet mode composes with the "
+                "tiered packed-pool residency (fleet/mesh_tiered.py, "
+                "docs/internals.md \"Fleet mode\")")
+        if self.mesh_enabled and self.forward_address:
+            raise ValueError(
+                "mesh_enabled requires a GLOBAL instance, but "
+                "forward_address is set (a local forwards its sketches "
+                "upstream instead of sharding a store over the mesh). "
+                "Unset one of them: mesh_enabled belongs on the "
+                "instance the fleet forwards INTO")
         if self.ingest_lanes < -1:
             raise ValueError(
                 f"ingest_lanes must be -1 (disabled), 0 (auto: one lane "

@@ -8,26 +8,38 @@ TPU form of the reference's global veneur merging forwarded sketches across
 its worker shards (``/root/reference/importsrv/server.go:101-132`` +
 ``flusher.go:56-58``).
 
-Layout (cf. ``parallel/mesh.py``):
+Layout (cf. ``parallel/mesh.py``; shard placement in ``fleet/router.py``):
 
-- **series axis** — every device owns a contiguous slab of rows, exactly
-  like one reference worker owns its ``map[MetricKey]*sampler``
-  (``worker.go:54-91``). Staged host chunks scatter with ``mode='drop'``
-  after re-localizing row ids, so each device keeps only its own rows.
-- **hosts axis** — staged chunks are *sharded* over this axis, so the
+- **series axis** — every device owns a contiguous block of physical rows,
+  exactly like one reference worker owns its ``map[MetricKey]*sampler``
+  (``worker.go:54-91``). A series' physical row is chosen at intern time
+  by the fleet :class:`~veneur_tpu.fleet.router.ShardRouter` — the SAME
+  consistent-hash rule the proxy ring uses — so ownership is balanced
+  from the first interval and agrees with any ring-routed upstream.
+  The interner stays dense/sequential; flushes and snapshots gather the
+  placement's permutation so every consumer still sees interner order.
+- **hosts axis** — sample chunks are *sharded* over this axis, so the
   expensive chunk binning (sort + prefix sums in ``ops/tdigest.py``)
   parallelizes across it; one ``psum``/``pmax`` per drain completes the
   merge over ICI (``parallel/collectives.py``).
+- **shard-routed import** — staged import chunks drain as ``[shards, b]``
+  stacks sharded over the series axis: each device receives exactly its
+  own rows' sub-chunk (whole centroid runs, order preserved) and bins
+  only that — no replicated full-chunk binning, no device-side
+  re-scatter. The shift-guard DECISION still psums over the series axis
+  so every shard takes the same drain the dense store would.
 
-The groups subclass the single-device ones and override only device-state
-placement and the jitted programs; all interning/staging/flush-assembly
-logic is shared. Programs are cached per (mesh, dtype-params) so the four
-digest groups of one store share compilations.
+The compiled programs are module-level ``jax.jit`` definitions taking the
+``Mesh`` as a static argument (one compile per mesh per dtype-config, all
+four digest groups of one store share it) — which also puts them in the
+static-analysis compiled-program inventory and under the
+``obs/kernels.py`` scope drift-check like every other program.
 """
 
 from __future__ import annotations
 
-from typing import Dict, Tuple
+from functools import partial
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
@@ -35,16 +47,17 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-# version-compat shard_map wrapper (check_vma/check_rep rename)
-from veneur_tpu.parallel.mesh import shard_map
-
-from veneur_tpu.core.store import IMPORT_DRAIN_BATCH, DigestGroup, SetGroup
+from veneur_tpu.core.store import (IMPORT_DRAIN_BATCH, _GROW_FACTOR,
+                                   DigestGroup, HeavyHitterGroup,
+                                   ScalarGroup, SetGroup)
+from veneur_tpu.core.locking import requires_lock
+from veneur_tpu.fleet.router import ShardPlacement, ShardRouter, route_stack
+from veneur_tpu.obs import kernels as obs_kernels
+from veneur_tpu.obs import recorder as obs_rec
 from veneur_tpu.ops import hll as hll_ops
 from veneur_tpu.ops import tdigest as td_ops
 from veneur_tpu.parallel import collectives
-from veneur_tpu.parallel.mesh import HOSTS_AXIS, SERIES_AXIS
-
-_PROGRAMS: Dict[Tuple, tuple] = {}
+from veneur_tpu.parallel.mesh import HOSTS_AXIS, SERIES_AXIS, shard_map
 
 
 def _round_up(n: int, mult: int) -> int:
@@ -52,12 +65,25 @@ def _round_up(n: int, mult: int) -> int:
 
 
 def _relocal(rows: jax.Array, s_loc: int) -> jax.Array:
-    """Global row ids → this device's local ids; out-of-slab rows map to
+    """Global row ids → this device's local ids; out-of-block rows map to
     s_loc so scatters drop them (the proxy's destForMetric invariant,
     reshaped: a series belongs to exactly one shard)."""
     r = rows.astype(jnp.int32)
     start = lax.axis_index(SERIES_AXIS) * s_loc
     return jnp.where((r >= start) & (r < start + s_loc), r - start, s_loc)
+
+
+def _blocked_pad(arr: jax.Array, shards: int, old_block: int,
+                 fill=0) -> jax.Array:
+    """Double every shard's contiguous block of dim 0 in place: reshape
+    to per-shard blocks, pad each block, reshape back. The device twin
+    of ``ShardPlacement.grow`` — physical row (shard, local) moves from
+    ``shard*B + local`` to ``shard*2B + local`` on both sides."""
+    rest = arr.shape[1:]
+    a = arr.reshape((shards, old_block) + rest)
+    pad = [(0, 0), (0, old_block)] + [(0, 0)] * len(rest)
+    return jnp.pad(a, pad, constant_values=fill).reshape(
+        (shards * old_block * 2,) + rest)
 
 
 def _add_temp(a: td_ops.TempCentroids,
@@ -71,39 +97,49 @@ def _add_temp(a: td_ops.TempCentroids,
         recip=a.recip + b.recip)
 
 
-def _digest_programs(mesh: Mesh, compression: float, k: int):
-    key = ("digest", mesh, compression, k)
-    if key in _PROGRAMS:
-        return _PROGRAMS[key]
-    hosts = mesh.shape.get(HOSTS_AXIS, 1)
-    sk, s, h, rep = P(SERIES_AXIS, None), P(SERIES_AXIS), P(HOSTS_AXIS), P()
+def _digest_specs():
+    sk, s = P(SERIES_AXIS, None), P(SERIES_AXIS)
     temp_spec = td_ops.TempCentroids(sum_w=sk, sum_wm=sk, seg_w=sk,
                                      seg_wm=sk, count=s, vsum=s,
                                      vmin=s, vmax=s, recip=s)
     dig_spec = td_ops.TDigest(mean=sk, weight=sk, min=s, max=s)
+    return temp_spec, dig_spec, sk, s
 
-    def guarded_drain(temp, digest, rows_l, vals, wts, s_loc, axes):
-        # the dense/slab stores' shift guard, mesh form: the drain is
-        # row-local (no collective inside the cond), but the DECISION
-        # psums the shift/total masses over ``axes`` so every shard
-        # takes the same drain the dense store would on the same data
-        shifted, total = td_ops.shift_masses(
-            temp.seg_w, temp.seg_wm, rows_l, vals, wts, s_loc)
-        shifted = lax.psum(shifted, axes)
-        total = lax.psum(total, axes)
-        pred = shifted > td_ops.SHIFT_GUARD_FRAC * jnp.maximum(
-            total, jnp.finfo(jnp.float32).tiny)
 
-        def do_drain(args):
-            t, d = args
-            d2 = td_ops.drain_temp(d, t, compression)
-            t2 = t._replace(sum_w=jnp.zeros_like(t.sum_w),
-                            sum_wm=jnp.zeros_like(t.sum_wm),
-                            seg_w=jnp.zeros_like(t.seg_w),
-                            seg_wm=jnp.zeros_like(t.seg_wm))
-            return t2, d2
+def _guarded_drain(temp, digest, rows_l, vals, wts, s_loc, axes,
+                   compression):
+    """The dense/slab stores' shift guard, mesh form: the drain is
+    row-local (no collective inside the cond), but the DECISION psums
+    the shift/total masses over ``axes`` so every shard takes the same
+    drain the dense store would on the same data."""
+    shifted, total = td_ops.shift_masses(
+        temp.seg_w, temp.seg_wm, rows_l, vals, wts, s_loc)
+    shifted = lax.psum(shifted, axes)
+    total = lax.psum(total, axes)
+    pred = shifted > td_ops.SHIFT_GUARD_FRAC * jnp.maximum(
+        total, jnp.finfo(jnp.float32).tiny)
 
-        return lax.cond(pred, do_drain, lambda a: a, (temp, digest))
+    def do_drain(args):
+        t, d = args
+        d2 = td_ops.drain_temp(d, t, compression)
+        t2 = t._replace(sum_w=jnp.zeros_like(t.sum_w),
+                        sum_wm=jnp.zeros_like(t.sum_wm),
+                        seg_w=jnp.zeros_like(t.seg_w),
+                        seg_wm=jnp.zeros_like(t.seg_wm))
+        return t2, d2
+
+    return lax.cond(pred, do_drain, lambda a: a, (temp, digest))
+
+
+@partial(jax.jit, donate_argnums=(0, 1), static_argnums=(5, 6, 7))
+def _mesh_ingest_samples(temp, digest, rows, vals, wts, mesh: Mesh,
+                         compression: float, k: int):
+    """Hosts-sharded sample ingest: each device bins its hosts-axis
+    slice of the chunk against its series block, then ONE psum merges
+    the additive bin deltas over ICI (``collectives.merge_temp``)."""
+    hosts = mesh.shape.get(HOSTS_AXIS, 1)
+    temp_spec, dig_spec, _, _ = _digest_specs()
+    h = P(HOSTS_AXIS)
 
     def local_ingest(temp, digest, rows, vals, wts):
         s_loc = temp.sum_w.shape[0]
@@ -111,8 +147,8 @@ def _digest_programs(mesh: Mesh, compression: float, k: int):
         # hosts-sharded chunk: the guard masses psum over BOTH axes
         # (each shard sees its sub-chunk x its rows)
         axes = (SERIES_AXIS, HOSTS_AXIS) if hosts > 1 else SERIES_AXIS
-        temp, digest = guarded_drain(temp, digest, rows_l, vals, wts,
-                                     s_loc, axes)
+        temp, digest = _guarded_drain(temp, digest, rows_l, vals, wts,
+                                      s_loc, axes, compression)
         # bin into a FRESH temp (the delta rides the hosts-axis
         # collective) but anchor bin ids on the ACCUMULATED bins so
         # ordered arrival stays value-coherent across chunks (the
@@ -125,26 +161,35 @@ def _digest_programs(mesh: Mesh, compression: float, k: int):
             binned = collectives.merge_temp(binned, HOSTS_AXIS)
         return _add_temp(temp, binned), digest
 
-    ingest = jax.jit(
-        shard_map(local_ingest, mesh=mesh,
-                  in_specs=(temp_spec, dig_spec, h, h, h),
-                  out_specs=(temp_spec, dig_spec), check_vma=False),
-        donate_argnums=(0, 1))
+    return shard_map(local_ingest, mesh=mesh,
+                     in_specs=(temp_spec, dig_spec, h, h, h),
+                     out_specs=(temp_spec, dig_spec),
+                     check_vma=False)(temp, digest, rows, vals, wts)
+
+
+@partial(jax.jit, donate_argnums=(0, 1, 2, 3), static_argnums=(10, 11, 12))
+def _mesh_import_routed(temp, digest, dmin, dmax, rows, means, wts,
+                        srows, smins, smaxs, mesh: Mesh,
+                        compression: float, k: int):
+    """Shard-routed centroid import: the staged chunk arrives as a
+    ``[shards, b]`` stack partitioned by the fleet router's placement
+    (``route_stack``), sharded over the series axis — each device bins
+    ONLY its own rows' sub-chunk (whole sorted centroid runs: a row's
+    run lives on exactly one shard, so the run-skew aliasing the old
+    replicated path avoided by replicating cannot occur either). The
+    guard masses psum over the series axis: summed over the disjoint
+    sub-chunks they equal the dense store's whole-chunk decision."""
+    temp_spec, dig_spec, _, s = _digest_specs()
+    st = P(SERIES_AXIS, None)  # [shards, b] stacks: dim 0 = shard
 
     def local_import(temp, digest, dmin, dmax, rows, means, wts,
                      srows, smins, smaxs):
-        # NB: the import chunk is REPLICATED (not hosts-sharded): imported
-        # centroid arrays arrive sorted by mean and staged sequentially, so
-        # a hosts-axis split would hand each shard a systematically skewed
-        # slice and the per-shard quantile binning would collapse different
-        # quantile bands into the same bin. Every device bins the full
-        # chunk and keeps its own rows; no collective is needed.
         s_loc = temp.sum_w.shape[0]
-        rows_l = _relocal(rows, s_loc)
-        # replicated chunk: psum the guard masses over SERIES only
-        # (hosts-lines compute identical values)
-        temp, digest = guarded_drain(temp, digest, rows_l, means, wts,
-                                     s_loc, SERIES_AXIS)
+        rows_l = _relocal(rows.reshape(-1), s_loc)
+        means = means.reshape(-1)
+        wts = wts.reshape(-1)
+        temp, digest = _guarded_drain(temp, digest, rows_l, means, wts,
+                                      s_loc, SERIES_AXIS, compression)
         binned = td_ops.ingest_chunk(
             td_ops.init_temp(s_loc, k, compression),
             rows_l, means, wts, compression,
@@ -156,40 +201,46 @@ def _digest_programs(mesh: Mesh, compression: float, k: int):
                              sum_wm=temp.sum_wm + binned.sum_wm,
                              seg_w=temp.seg_w + binned.seg_w,
                              seg_wm=temp.seg_wm + binned.seg_wm)
-        sr = _relocal(srows, s_loc)
-        dmin = dmin.at[sr].min(smins, mode="drop")
-        dmax = dmax.at[sr].max(smaxs, mode="drop")
+        sr = _relocal(srows.reshape(-1), s_loc)
+        dmin = dmin.at[sr].min(smins.reshape(-1), mode="drop")
+        dmax = dmax.at[sr].max(smaxs.reshape(-1), mode="drop")
         return temp, digest, dmin, dmax
 
-    import_ = jax.jit(
-        shard_map(local_import, mesh=mesh,
-                  in_specs=(temp_spec, dig_spec, s, s, rep, rep, rep,
-                            rep, rep, rep),
-                  out_specs=(temp_spec, dig_spec, s, s), check_vma=False),
-        donate_argnums=(0, 1, 2, 3))
+    return shard_map(local_import, mesh=mesh,
+                     in_specs=(temp_spec, dig_spec, s, s, st, st, st,
+                               st, st, st),
+                     out_specs=(temp_spec, dig_spec, s, s),
+                     check_vma=False)(temp, digest, dmin, dmax, rows,
+                                      means, wts, srows, smins, smaxs)
+
+
+@partial(jax.jit, donate_argnums=(0, 1), static_argnums=(5, 6))
+def _mesh_flush_digests(digest, temp, dmin, dmax, qs, mesh: Mesh,
+                        compression: float):
+    """Per-interval flush: row-local compress + quantile per shard — the
+    merge already happened at scatter time (a series's whole fleet
+    state lives on its owning shard), so the flush itself needs no
+    collective at all."""
+    temp_spec, dig_spec, sk, s = _digest_specs()
 
     def local_flush(digest, temp, dmin, dmax, qs):
-        drained, pcts = td_ops.drain_and_quantile(digest, temp, dmin, dmax,
-                                                  qs, compression)
-        return (drained, pcts, temp.count, temp.vsum, temp.vmin, temp.vmax,
-                temp.recip)
+        drained, pcts = td_ops.drain_and_quantile(digest, temp, dmin,
+                                                  dmax, qs, compression)
+        return (drained, pcts, temp.count, temp.vsum, temp.vmin,
+                temp.vmax, temp.recip)
 
-    flush = jax.jit(
-        shard_map(local_flush, mesh=mesh,
-                  in_specs=(dig_spec, temp_spec, s, s, rep),
-                  out_specs=(dig_spec, sk, s, s, s, s, s), check_vma=False),
-        donate_argnums=(0, 1))
-
-    _PROGRAMS[key] = (ingest, import_, flush)
-    return _PROGRAMS[key]
+    return shard_map(local_flush, mesh=mesh,
+                     in_specs=(dig_spec, temp_spec, s, s, P()),
+                     out_specs=(dig_spec, sk, s, s, s, s, s),
+                     check_vma=False)(digest, temp, dmin, dmax, qs)
 
 
-def _set_programs(mesh: Mesh, precision: int):
-    key = ("set", mesh, precision)
-    if key in _PROGRAMS:
-        return _PROGRAMS[key]
+@partial(jax.jit, donate_argnums=(0,), static_argnums=(4, 5))
+def _mesh_ingest_hashes(regs, rows, hi, lo, mesh: Mesh, precision: int):
+    """Hosts-sharded HLL ingest: per-slice register scatter + one pmax
+    over the hosts axis (Set.Combine's register max, samplers.go:423)."""
     hosts = mesh.shape.get(HOSTS_AXIS, 1)
-    sk, s, h, rep = P(SERIES_AXIS, None), P(SERIES_AXIS), P(HOSTS_AXIS), P()
+    sk, h = P(SERIES_AXIS, None), P(HOSTS_AXIS)
 
     def local_hash(regs, rows, hi, lo):
         s_loc = regs.shape[0]
@@ -200,54 +251,148 @@ def _set_programs(mesh: Mesh, precision: int):
             regs = lax.pmax(regs, HOSTS_AXIS)
         return regs
 
-    hash_ingest = jax.jit(
-        shard_map(local_hash, mesh=mesh, in_specs=(sk, h, h, h),
-                  out_specs=sk, check_vma=False),
-        donate_argnums=(0,))
+    return shard_map(local_hash, mesh=mesh, in_specs=(sk, h, h, h),
+                     out_specs=sk, check_vma=False)(regs, rows, hi, lo)
 
-    def local_reg_merge(regs, rows, updates):
+
+@partial(jax.jit, donate_argnums=(0,), static_argnums=(3,))
+def _mesh_merge_registers(regs, rows, updates, mesh: Mesh):
+    """Shard-routed register import: ``[shards, b]`` row /
+    ``[shards, b, m]`` register stacks land each forwarded sketch on
+    its owning device without replicating the 2^p-register payload to
+    every shard."""
+    sk = P(SERIES_AXIS, None)
+    st2, st3 = P(SERIES_AXIS, None), P(SERIES_AXIS, None, None)
+
+    def local_merge(regs, rows, updates):
         s_loc = regs.shape[0]
-        return regs.at[_relocal(rows, s_loc)].max(
-            updates.astype(regs.dtype), mode="drop")
+        r = _relocal(rows.reshape(-1), s_loc)
+        u = updates.reshape((-1,) + updates.shape[2:])
+        return regs.at[r].max(u.astype(regs.dtype), mode="drop")
 
-    reg_merge = jax.jit(
-        shard_map(local_reg_merge, mesh=mesh, in_specs=(sk, rep, rep),
-                  out_specs=sk, check_vma=False),
-        donate_argnums=(0,))
+    return shard_map(local_merge, mesh=mesh, in_specs=(sk, st2, st3),
+                     out_specs=sk, check_vma=False)(regs, rows, updates)
+
+
+@partial(jax.jit, static_argnums=(1, 2))
+def _mesh_estimate(regs, mesh: Mesh, precision: int):
+    sk, s = P(SERIES_AXIS, None), P(SERIES_AXIS)
 
     def local_estimate(regs):
         return hll_ops.estimate(regs.astype(jnp.int32), precision)
 
-    estimate = jax.jit(
-        shard_map(local_estimate, mesh=mesh, in_specs=(sk,), out_specs=s,
-                  check_vma=False))
-
-    _PROGRAMS[key] = (hash_ingest, reg_merge, estimate)
-    return _PROGRAMS[key]
+    return shard_map(local_estimate, mesh=mesh, in_specs=(sk,),
+                     out_specs=s, check_vma=False)(regs)
 
 
-class MeshDigestGroup(DigestGroup):
-    """A DigestGroup whose device state is sharded over a fleet mesh."""
+class _PlacementMixin:
+    """Router-driven shard assignment shared by every mesh group.
+
+    The id contract: everything that crosses the group boundary —
+    ``_row`` results, staged buffers, the native intern memos, lane
+    resolvers, bulk-ingest row lists — speaks LOGICAL (interner) rows,
+    which are stable for the life of a generation. The placement's
+    shard-blocked PHYSICAL rows appear only inside the drains
+    (``_to_phys`` translates each chunk at drain time against the
+    CURRENT placement) and the flush/snapshot permutation gathers — so
+    a mid-interval ``_grow``, which moves every physical id, can never
+    stale a cached row."""
+
+    router: Optional[ShardRouter]
+    placement: Optional[ShardPlacement]
+
+    def _route_new_row(self, row: int, key) -> None:
+        """Assign a freshly interned logical row to its shard (the
+        overflow row routes by its own interned identity, so every
+        instance of the fleet places it identically)."""
+        mtype = (self._overflow_type if row == self._overflow_row
+                 else key.type)
+        shard = self.router.shard_for(self.interner.names[row], mtype,
+                                      self.interner.joined[row])
+        while self.placement.full(shard):
+            self._grow()
+        self.placement.assign(row, shard)
+
+    @requires_lock("store")
+    def _row(self, key, tags) -> int:
+        row = self._intern_row(key, tags)
+        # bank mode (fleet/mesh_tiered.py) has no placement: the owner
+        # assigns physical slots directly and never interns here
+        if self.placement is not None and not self.placement.assigned(row):
+            self._route_new_row(row, key)
+        return row
+
+    @requires_lock("store")
+    def ensure_capacity(self, max_row: int):
+        while max_row >= self.capacity:
+            self._grow()
+
+    def _to_phys(self, rows: np.ndarray) -> np.ndarray:
+        """One staged chunk's logical rows → current physical rows
+        (sentinels and unassigned → capacity, the scatter-drop id). In
+        bank mode the caller already speaks physical slots."""
+        if self.placement is None:
+            return rows
+        return self.placement.to_phys(rows, self.capacity)
+
+    def _shard_of_phys(self, phys: np.ndarray) -> np.ndarray:
+        """Owning shard of physical rows — the ONE copy of the
+        block-layout rule (sentinels clamp to the last shard; their
+        payloads drop device-side regardless of lane)."""
+        return np.minimum(np.asarray(phys) // (self.capacity
+                                               // self.shards),
+                          self.shards - 1)
+
+    def _reset_placement(self) -> None:
+        """In-place (non-retired) flush reset: the interner swapped, so
+        the placement must too — the next interval's first series must
+        consult the router, not inherit last interval's slot (the
+        generation-swap path gets this for free via ``fresh()``)."""
+        if self.placement is not None and not getattr(self, "_retired",
+                                                      False):
+            self.placement = ShardPlacement(self.shards, self.capacity)
+
+    def _flush_rows(self, n: int) -> np.ndarray:
+        """Physical rows of logical rows 0..n-1 — the gather that
+        restores interner order in flush/snapshot output."""
+        if self.placement is not None:
+            return self.placement.perm(n)
+        if self._ext_rows is not None:  # bank mode: owner-assigned slots
+            return np.asarray(self._ext_rows[:n], np.int64)
+        # router-less direct construction: rows intern sequentially,
+        # physical == logical
+        return np.arange(n, dtype=np.int64)
+
+
+class MeshDigestGroup(_PlacementMixin, DigestGroup):
+    """A DigestGroup whose device state is sharded over a fleet mesh.
+
+    With a ``router``, series place via the fleet consistent hash
+    (balanced shards + ring-aligned ownership); without one (bank mode)
+    the owning :class:`~veneur_tpu.fleet.mesh_tiered.
+    MeshTieredDigestGroup` assigns physical slots itself."""
 
     def __init__(self, mesh: Mesh, capacity: int, chunk: int,
-                 compression: float):
+                 compression: float, router: Optional[ShardRouter] = None):
         self.mesh = mesh
         self.shards = mesh.shape[SERIES_AXIS]
         self.hosts = mesh.shape.get(HOSTS_AXIS, 1)
+        self.router = router
         self._sk = NamedSharding(mesh, P(SERIES_AXIS, None))
         self._s = NamedSharding(mesh, P(SERIES_AXIS))
-        super().__init__(_round_up(capacity, self.shards),
-                         _round_up(chunk, self.hosts), compression)
-        self._ingest_p, self._import_p, self._flush_p = _digest_programs(
-            mesh, self.compression, self.k)
+        cap = _round_up(capacity, self.shards)
+        self.placement = (ShardPlacement(self.shards, cap)
+                          if router is not None else None)
+        self._ext_rows: Optional[np.ndarray] = None  # bank mode
+        super().__init__(cap, _round_up(chunk, self.hosts), compression)
 
     def _place(self):
         temp_sh = td_ops.TempCentroids(
             sum_w=self._sk, sum_wm=self._sk, seg_w=self._sk,
             seg_wm=self._sk, count=self._s, vsum=self._s,
             vmin=self._s, vmax=self._s, recip=self._s)
-        dig_sh = td_ops.TDigest(mean=self._sk, weight=self._sk, min=self._s,
-                                max=self._s)
+        dig_sh = td_ops.TDigest(mean=self._sk, weight=self._sk,
+                                min=self._s, max=self._s)
         self.temp = jax.device_put(self.temp, temp_sh)
         self.digest = jax.device_put(self.digest, dig_sh)
         self.dmin = jax.device_put(self.dmin, self._s)
@@ -258,8 +403,40 @@ class MeshDigestGroup(DigestGroup):
         self._place()
 
     def _grow(self):
-        super()._grow()  # x2 growth keeps capacity % shards == 0
+        """x2 growth that preserves the shard-blocked layout: every
+        plane pads PER SHARD BLOCK (``_blocked_pad``) and the placement
+        recomputes physical ids to match — a tail pad would hand the
+        new rows entirely to the last shard."""
+        self._drain_staging()
+        old_block = self.capacity // self.shards
+        self.capacity *= _GROW_FACTOR
+        sh, ob = self.shards, old_block
+        self.temp = td_ops.TempCentroids(
+            sum_w=_blocked_pad(self.temp.sum_w, sh, ob),
+            sum_wm=_blocked_pad(self.temp.sum_wm, sh, ob),
+            seg_w=_blocked_pad(self.temp.seg_w, sh, ob),
+            seg_wm=_blocked_pad(self.temp.seg_wm, sh, ob),
+            count=_blocked_pad(self.temp.count, sh, ob),
+            vsum=_blocked_pad(self.temp.vsum, sh, ob),
+            vmin=_blocked_pad(self.temp.vmin, sh, ob, fill=np.inf),
+            vmax=_blocked_pad(self.temp.vmax, sh, ob, fill=-np.inf),
+            recip=_blocked_pad(self.temp.recip, sh, ob),
+        )
+        self.digest = td_ops.TDigest(
+            mean=_blocked_pad(self.digest.mean, sh, ob, fill=np.inf),
+            weight=_blocked_pad(self.digest.weight, sh, ob),
+            min=_blocked_pad(self.digest.min, sh, ob, fill=np.inf),
+            max=_blocked_pad(self.digest.max, sh, ob, fill=-np.inf),
+        )
+        self.dmin = _blocked_pad(self.dmin, sh, ob, fill=np.inf)
+        self.dmax = _blocked_pad(self.dmax, sh, ob, fill=-np.inf)
         self._place()
+        if self.placement is not None:
+            self.placement.grow()
+        # re-point staging padding at the new out-of-range row id
+        self._rows[self._fill:] = self.capacity
+        self._imp_rows[self._imp_fill:] = self.capacity
+        self._imp_stat_rows[self._imp_stat_fill:] = self.capacity
 
     def _drain_samples(self):
         if self._fill == 0:
@@ -267,61 +444,177 @@ class MeshDigestGroup(DigestGroup):
         self._device_dirty = True
         rows, vals, wts = self._rows, self._vals, self._wts
         self._new_sample_buffers()
-        self.temp, self.digest = self._ingest_p(self.temp, self.digest,
-                                                rows, vals, wts)
+        with obs_kernels.scope("drain.digest.mesh"):
+            self.temp, self.digest = _mesh_ingest_samples(
+                self.temp, self.digest, jnp.asarray(self._to_phys(rows)),
+                jnp.asarray(vals), jnp.asarray(wts), self.mesh,
+                self.compression, self.k)
 
     def _drain_imports(self):
         if self._imp_fill == 0 and self._imp_stat_fill == 0:
             return
         self._device_dirty = True
-        # fixed-size stat scatter so import drains never retrace; the
-        # staged buffers are chunk-sized and sentinel-padded already
-        stat_rows = self._imp_stat_rows
-        stat_mins = self._imp_stat_mins
-        stat_maxs = self._imp_stat_maxs
-        imp = (self._imp_rows, self._imp_means, self._imp_wts)
+        nf, ns = self._imp_fill, self._imp_stat_fill
+        rows = self._to_phys(self._imp_rows[:nf])
+        means = self._imp_means[:nf]
+        wts = self._imp_wts[:nf]
+        srows = self._to_phys(self._imp_stat_rows[:ns])
+        smins = self._imp_stat_mins[:ns]
+        smaxs = self._imp_stat_maxs[:ns]
         self._new_import_buffers()
-        self.temp, self.digest, self.dmin, self.dmax = self._import_p(
-            self.temp, self.digest, self.dmin, self.dmax, *imp,
-            stat_rows, stat_mins, stat_maxs)
+        r_st, (m_st, w_st) = route_stack(
+            self.shards, self._shard_of_phys(rows), rows, [means, wts],
+            self.capacity)
+        sr_st, (mn_st, mx_st) = route_stack(
+            self.shards, self._shard_of_phys(srows), srows,
+            [smins, smaxs], self.capacity)
+        with obs_kernels.scope("drain.digest.mesh"):
+            self.temp, self.digest, self.dmin, self.dmax = \
+                _mesh_import_routed(
+                    self.temp, self.digest, self.dmin, self.dmax,
+                    jnp.asarray(r_st), jnp.asarray(m_st),
+                    jnp.asarray(w_st), jnp.asarray(sr_st),
+                    jnp.asarray(mn_st), jnp.asarray(mx_st), self.mesh,
+                    self.compression, self.k)
 
     def _run_flush(self, qs, use_pallas: bool = True):
-        # the sharded programs compile once per mesh at import; the
-        # compute ladder's retry re-runs the same program here (the
-        # mesh path has no separate kernel variant to fall back to)
-        return self._flush_p(self.digest, self.temp, self.dmin, self.dmax,
-                             jnp.asarray(qs, jnp.float32))
+        # the sharded programs compile once per mesh; the compute
+        # ladder's retry re-runs the same program here (the mesh path
+        # has no separate kernel variant to fall back to)
+        return _mesh_flush_digests(self.digest, self.temp, self.dmin,
+                                   self.dmax,
+                                   jnp.asarray(qs, jnp.float32),
+                                   self.mesh, self.compression)
+
+    def _flush_fetch(self, n: int, percentiles, want_digests, want_stats,
+                     use_pallas: bool) -> dict:
+        """One complete flush attempt: the sharded flush program, then a
+        permutation gather back to interner order (physical rows are
+        shard-placed, not sequential) fetched in one transfer."""
+        if want_digests == "packed":
+            raise NotImplementedError(
+                "packed digest export is a forwarding-local concern; a "
+                "mesh global emits percentiles and never re-forwards")
+        from veneur_tpu.core.slab import _fill_stat_results, _select_stats
+
+        sel = _select_stats(want_stats)
+        qs = jnp.asarray(list(percentiles) + [0.5], jnp.float32)
+        rows = jnp.asarray(self._flush_rows(n), jnp.int32)
+        with obs_rec.maybe_stage("compute"), \
+                obs_kernels.scope("flush.digest.mesh"):
+            digest, pcts, count, vsum, vmin, vmax, recip = \
+                self._run_flush(qs, use_pallas)
+            planes = ()
+            out = {}
+            if want_digests:
+                planes = (digest.mean[rows], digest.weight[rows],
+                          digest.min[rows], digest.max[rows])
+            stats = {"pcts": pcts, "count": count, "sum": vsum,
+                     "min": vmin, "max": vmax, "recip": recip}
+        with obs_rec.maybe_stage("fetch"):
+            fetched = jax.device_get(
+                planes + tuple(stats[nm][rows] for nm in sel))
+        if want_digests:
+            (out["digest_mean"], out["digest_weight"], out["digest_min"],
+             out["digest_max"]) = fetched[:4]
+            fetched = fetched[4:]
+        _fill_stat_results(sel, fetched, n, percentiles, out)
+        return out
+
+    @requires_lock("store")
+    def snapshot_begin(self):
+        """Two-phase snapshot, mesh form: the permutation gather back to
+        interner order dispatches under the lock (fresh buffers), the
+        blocking fetch runs off-lock — same contract as the base."""
+        from veneur_tpu.core.store import flatten_digest_state
+
+        self._drain_staging()
+        n = len(self.interner)
+        snap = {"kind": "digest", "names": list(self.interner.names),
+                "joined": list(self.interner.joined)}
+        if n == 0:
+            return snap, None
+        rows = jnp.asarray(self._flush_rows(n), jnp.int32)
+        refs = (self.digest.mean[rows], self.digest.weight[rows],
+                self.temp.sum_w[rows], self.temp.sum_wm[rows],
+                self.dmin[rows], self.dmax[rows],
+                self.digest.min[rows], self.digest.max[rows],
+                self.temp.count[rows], self.temp.vsum[rows],
+                self.temp.vmin[rows], self.temp.vmax[rows],
+                self.temp.recip[rows])
+
+        def finish():
+            (mean, weight, bin_w, bin_wm, imp_min, imp_max, dmn, dmx,
+             cnt, vsum, vmin, vmax, recip) = jax.device_get(refs)
+            snap.update(flatten_digest_state(
+                np.asarray(mean, np.float32),
+                np.asarray(weight, np.float32),
+                np.asarray(bin_w, np.float32),
+                np.asarray(bin_wm, np.float32)))
+            snap["mins"] = np.minimum(np.asarray(imp_min, np.float32),
+                                      np.asarray(dmn, np.float32))
+            snap["maxs"] = np.maximum(np.asarray(imp_max, np.float32),
+                                      np.asarray(dmx, np.float32))
+            for nm, arr in (("count", cnt), ("vsum", vsum),
+                            ("vmin", vmin), ("vmax", vmax),
+                            ("recip", recip)):
+                snap[nm] = np.asarray(arr, np.float32)
+
+        return snap, finish
+
+    @requires_lock("store")
+    def restore_stats(self, rows: np.ndarray, count: np.ndarray,
+                      vsum: np.ndarray, vmin: np.ndarray,
+                      vmax: np.ndarray, recip: np.ndarray):
+        """Logical rows from the restore path scatter at their CURRENT
+        physical placement."""
+        if not len(rows):
+            return
+        super().restore_stats(self._to_phys(np.asarray(rows, np.int64)),
+                              count, vsum, vmin, vmax, recip)
+
+    def flush(self, percentiles, want_digests=True, want_stats=None):
+        interner, out = super().flush(percentiles, want_digests,
+                                      want_stats)
+        self._reset_placement()
+        return interner, out
 
     def fresh(self) -> "MeshDigestGroup":
-        """Empty same-config twin (swap-on-flush generation swap);
-        carries the compiled sharded programs so the swap never
-        retraces."""
-        g = MeshDigestGroup(self.mesh, self.capacity, self.chunk,
-                            self.compression)
-        g._ingest_p = self._ingest_p
-        g._import_p = self._import_p
-        g._flush_p = self._flush_p
-        return g
+        """Empty same-config twin (swap-on-flush generation swap); the
+        module-level sharded programs are cached per mesh, so the swap
+        never retraces."""
+        return MeshDigestGroup(self.mesh, self.capacity, self.chunk,
+                               self.compression, router=self.router)
 
 
-class MeshSetGroup(SetGroup):
+class MeshSetGroup(_PlacementMixin, SetGroup):
     """A SetGroup whose [S, 2^p] register tensor is series-sharded — the
     scaling story for HLL HBM cost (16 KiB/series at p=14)."""
 
-    def __init__(self, mesh: Mesh, capacity: int, chunk: int, precision: int):
+    def __init__(self, mesh: Mesh, capacity: int, chunk: int,
+                 precision: int, router: Optional[ShardRouter] = None):
         self.mesh = mesh
         self.shards = mesh.shape[SERIES_AXIS]
         self.hosts = mesh.shape.get(HOSTS_AXIS, 1)
+        self.router = router
         self._sk = NamedSharding(mesh, P(SERIES_AXIS, None))
-        super().__init__(_round_up(capacity, self.shards),
-                         _round_up(chunk, self.hosts), precision)
-        self._hash_p, self._reg_merge_p, self._estimate_p = _set_programs(
-            mesh, precision)
+        cap = _round_up(capacity, self.shards)
+        self.placement = (ShardPlacement(self.shards, cap)
+                          if router is not None else None)
+        self._ext_rows = None
+        super().__init__(cap, _round_up(chunk, self.hosts), precision)
         self.registers = jax.device_put(self.registers, self._sk)
 
     def _grow(self):
-        super()._grow()
-        self.registers = jax.device_put(self.registers, self._sk)
+        self._drain_staging()
+        old_block = self.capacity // self.shards
+        self.capacity *= _GROW_FACTOR
+        self.registers = jax.device_put(
+            _blocked_pad(self.registers, self.shards, old_block),
+            self._sk)
+        if self.placement is not None:
+            self.placement.grow()
+        self._rows[self._fill:] = self.capacity
 
     def _reset_registers(self):
         self.registers = jax.device_put(
@@ -334,33 +627,198 @@ class MeshSetGroup(SetGroup):
         self._device_dirty = True
         rows, hi, lo = self._rows, self._hi, self._lo
         self._new_sample_buffers()
-        self.registers = self._hash_p(self.registers, rows, hi, lo)
+        with obs_kernels.scope("drain.set.mesh"):
+            self.registers = _mesh_ingest_hashes(
+                self.registers, jnp.asarray(self._to_phys(rows)),
+                jnp.asarray(hi), jnp.asarray(lo), self.mesh,
+                self.precision)
 
     def _drain_imports(self):
         if not self._imp_rows:
             return
         self._device_dirty = True
-        # pad to a fixed batch so import drains never retrace
-        n = len(self._imp_rows)
-        cap = IMPORT_DRAIN_BATCH
-        rows = np.full(cap, self.capacity, np.int32)
-        regs = np.zeros((cap, self.m), np.int8)
-        rows[:n] = self._imp_rows
-        regs[:n] = np.stack(self._imp_regs).astype(np.int8)
+        # shard-routed over the LIVE rows only (route_stack pads each
+        # shard's lane to its own pow2 bucket): each forwarded sketch's
+        # 2^p registers travel to their owning device only — padding to
+        # IMPORT_DRAIN_BATCH first would funnel every sentinel into the
+        # last shard's lane and re-replicate near-full batches
+        rows = self._to_phys(np.asarray(self._imp_rows, np.int32))
+        regs = np.stack(self._imp_regs).astype(np.int8)
         self._imp_rows.clear()
         self._imp_regs.clear()
-        self.registers = self._reg_merge_p(self.registers, rows, regs)
+        r_st, (regs_st,) = route_stack(
+            self.shards, self._shard_of_phys(rows), rows, [regs],
+            self.capacity, min_width=IMPORT_DRAIN_BATCH // self.shards)
+        with obs_kernels.scope("drain.set.mesh"):
+            self.registers = _mesh_merge_registers(
+                self.registers, jnp.asarray(r_st), jnp.asarray(regs_st),
+                self.mesh)
 
     def _estimates(self):
-        return self._estimate_p(self.registers)
+        with obs_kernels.scope("flush.set.mesh"):
+            return _mesh_estimate(self.registers, self.mesh,
+                                  self.precision)
+
+    def _live_estimates(self, n: int) -> np.ndarray:
+        rows = jnp.asarray(self._flush_rows(n), jnp.int32)
+        return np.asarray(self._estimates()[rows])
+
+    def _live_registers(self, n: int) -> np.ndarray:
+        rows = jnp.asarray(self._flush_rows(n), jnp.int32)
+        return np.asarray(self.registers[rows], np.uint8)
+
+    def _snapshot_refs(self, n: int):
+        rows = jnp.asarray(self._flush_rows(n), jnp.int32)
+        return self.registers[rows]
+
+    def flush(self, want_estimates: bool = True,
+              want_registers: bool = True):
+        out = super().flush(want_estimates, want_registers)
+        self._reset_placement()
+        return out
 
     def fresh(self) -> "MeshSetGroup":
-        """Empty same-config twin (swap-on-flush generation swap);
-        carries the compiled sharded programs so the swap never
-        retraces."""
-        g = MeshSetGroup(self.mesh, self.capacity, self.chunk,
-                         self.precision)
-        g._hash_p = self._hash_p
-        g._reg_merge_p = self._reg_merge_p
-        g._estimate_p = self._estimate_p
+        """Empty same-config twin; sharded programs cached per mesh."""
+        return MeshSetGroup(self.mesh, self.capacity, self.chunk,
+                            self.precision, router=self.router)
+
+
+class MeshScalarGroup(_PlacementMixin, ScalarGroup):
+    """Counters/gauges under fleet mode: state stays host numpy (exact
+    int64 accumulation / f64 last-write — one vectorized pass per
+    interval is never the multi-chip bottleneck), but rows place
+    through the SAME shard router as the device groups, so one shard
+    owns a series across every group of the store — the ownership
+    invariant per-shard handoff (elastic resharding) builds on, and the
+    occupancy the ``/debug/vars`` mesh section reports."""
+
+    def __init__(self, kind: str, capacity: int, mesh: Mesh,
+                 router: ShardRouter):
+        if kind == "status":
+            raise ValueError("status checks are local-only; they never "
+                             "ride the mesh")
+        self.mesh = mesh
+        self.shards = mesh.shape[SERIES_AXIS]
+        self.router = router
+        cap = _round_up(capacity, self.shards)
+        super().__init__(kind, cap)
+        self.placement = ShardPlacement(self.shards, cap)
+
+    def _grow(self):
+        # host state stays LOGICAL-indexed (there are no device planes
+        # to lay out; the placement is ownership accounting only), so
+        # growth is the base tail pad
+        self.capacity *= _GROW_FACTOR
+        self.values = np.concatenate(
+            [self.values, np.zeros(self.capacity - len(self.values),
+                                   self.values.dtype)])
+        self.placement.grow()
+
+    def snapshot_and_reset(self):
+        out = super().snapshot_and_reset()
+        self._reset_placement()
+        return out
+
+    def fresh(self) -> "MeshScalarGroup":
+        return MeshScalarGroup(self.kind, self.capacity, self.mesh,
+                               self.router)
+
+
+class MeshHeavyHitterGroup(_PlacementMixin, HeavyHitterGroup):
+    """Heavy hitters under fleet mode: the per-series top-k planes
+    ([S, k] ids + counts) and sid vector shard over the series axis —
+    the per-series residency that scales with fleet cardinality — while
+    the count-min TABLE stays replicated: it is series-SHARED state
+    (every row salts into the same [depth, width] grid), and replicas
+    keep the update/estimate programs identical to the single-chip
+    semantics (GSPMD partitions the scatter across the sharded top-k
+    planes). Sharding the table itself is future work the honest way:
+    per-shard partial tables change the collision population and thus
+    the point estimates."""
+
+    def __init__(self, capacity: int, chunk: int, depth: int, width: int,
+                 k: int, mesh: Mesh, router: ShardRouter):
+        self.mesh = mesh
+        self.shards = mesh.shape[SERIES_AXIS]
+        self.router = router
+        self._sk = NamedSharding(mesh, P(SERIES_AXIS, None))
+        self._s = NamedSharding(mesh, P(SERIES_AXIS))
+        self._rep = NamedSharding(mesh, P())
+        cap = _round_up(capacity, self.shards)
+        self.placement = ShardPlacement(self.shards, cap)
+        super().__init__(cap, chunk, depth, width, k)
+        self._place_sketch()
+
+    def _place_sketch(self):
+        self.sketch = self.sketch._replace(
+            table=jax.device_put(self.sketch.table, self._rep),
+            topk_hi=jax.device_put(self.sketch.topk_hi, self._sk),
+            topk_lo=jax.device_put(self.sketch.topk_lo, self._sk),
+            topk_counts=jax.device_put(self.sketch.topk_counts,
+                                       self._sk),
+            sids=jax.device_put(self.sketch.sids, self._s))
+
+    @requires_lock("store")
+    def _row(self, key, tags) -> int:
+        # mixin placement routing; _sids_np stays LOGICAL-indexed (the
+        # sid is a per-sample VALUE gathered host-side at drain time,
+        # so it follows the stable id like everything else)
+        row = _PlacementMixin._row(self, key, tags)
+        if self._sids_np[row] == 0:  # first sight (or the 2^-32 rehash)
+            self._sids_np[row] = self.stable_sid(self.interner.names[row],
+                                                 self.interner.joined[row])
+        return row
+
+    def _grow(self):
+        self._drain_samples()
+        old_block = self.capacity // self.shards
+        self.capacity *= _GROW_FACTOR
+        sh, ob = self.shards, old_block
+        self.sketch = self.sketch._replace(
+            topk_hi=_blocked_pad(self.sketch.topk_hi, sh, ob),
+            topk_lo=_blocked_pad(self.sketch.topk_lo, sh, ob),
+            topk_counts=_blocked_pad(self.sketch.topk_counts, sh, ob),
+            sids=_blocked_pad(self.sketch.sids, sh, ob))
+        self._place_sketch()
+        self.placement.grow()
+        sids = np.zeros(self.capacity + 1, np.uint32)
+        sids[:len(self._sids_np) - 1] = self._sids_np[:-1]
+        self._sids_np = sids
+        self._rows[self._fill:] = self.capacity
+
+    def _drain_samples(self):
+        if self._fill == 0:
+            return
+        self._device_dirty = True
+        rows, hi, lo, wts = self._rows, self._hi, self._lo, self._wts
+        self._new_sample_buffers()
+        sids = self._sids_np[np.minimum(rows, self.capacity)]
+        self.sketch = self._update(self.sketch, self._to_phys(rows), sids,
+                                   hi, lo, wts)
+
+    def _scatter_rows(self, rows: np.ndarray) -> np.ndarray:
+        return self._to_phys(rows)
+
+    def _live_topk(self, n: int):
+        rows = jnp.asarray(self._flush_rows(n), jnp.int32)
+        return (self.sketch.topk_hi[rows], self.sketch.topk_lo[rows],
+                self.sketch.topk_counts[rows])
+
+    def _reset_sketch(self):
+        self.sketch = self._cm.init(self.capacity, self.depth,
+                                    self.width, self.k)
+        self._place_sketch()
+
+    def flush(self, want_forward: bool = False):
+        out = super().flush(want_forward)
+        self._reset_placement()
+        return out
+
+    def fresh(self) -> "MeshHeavyHitterGroup":
+        g = MeshHeavyHitterGroup(self.capacity, self.chunk, self.depth,
+                                 self.width, self.k, self.mesh,
+                                 self.router)
+        g._update = self._update
+        g._add_table = self._add_table
+        g._inject = self._inject
         return g

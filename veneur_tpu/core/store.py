@@ -1272,10 +1272,8 @@ class SetGroup(OverloadLimited):
                 self._reset_registers()
                 self._init_staging()
             return interner, None, None
-        estimates = (np.asarray(self._estimates()[:n])
-                     if want_estimates else None)
-        registers = (np.asarray(self.registers[:n], np.uint8)
-                     if want_registers else None)
+        estimates = self._live_estimates(n) if want_estimates else None
+        registers = self._live_registers(n) if want_registers else None
         if self._retired:
             # retired generation: free the [S, 2^p] plane now instead of
             # allocating a third one (16 KiB/series at p=14)
@@ -1289,6 +1287,20 @@ class SetGroup(OverloadLimited):
     def _estimates(self):
         """Batched cardinality estimates (override point for the mesh store)."""
         return _estimate_all(self.registers)
+
+    def _live_estimates(self, n: int) -> np.ndarray:
+        """Host estimates of the live rows, in interner order (the mesh
+        store gathers its shard-placed physical rows here)."""
+        return np.asarray(self._estimates()[:n])
+
+    def _live_registers(self, n: int) -> np.ndarray:
+        """Host registers of the live rows, in interner order."""
+        return np.asarray(self.registers[:n], np.uint8)
+
+    def _snapshot_refs(self, n: int):
+        """Device refs of the live rows for the two-phase snapshot
+        (override point for the mesh store's permutation gather)."""
+        return self.registers[:n]
 
     def _reset_registers(self):
         self.registers = jnp.zeros((self.capacity, self.m), jnp.int8)
@@ -1307,7 +1319,7 @@ class SetGroup(OverloadLimited):
                 "joined": list(self.interner.joined)}
         if n == 0:
             return snap, None
-        refs = self.registers[:n]
+        refs = self._snapshot_refs(n)
 
         def finish():
             snap["registers"] = np.asarray(jax.device_get(refs), np.uint8)
@@ -1523,7 +1535,9 @@ class HeavyHitterGroup(OverloadLimited):
                                       jnp.asarray(table, jnp.float32))
         if rows:
             self.sketch = self._inject(
-                self.sketch, jnp.asarray(rows, jnp.int32),
+                self.sketch,
+                jnp.asarray(self._scatter_rows(
+                    np.asarray(rows, np.int32))),
                 jnp.asarray(np.asarray(sids, np.uint32)),
                 jnp.asarray(np.asarray(his, np.uint32)),
                 jnp.asarray(np.asarray(los, np.uint32)),
@@ -1542,9 +1556,7 @@ class HeavyHitterGroup(OverloadLimited):
         out = []
         fwd = None
         if n:
-            hi, lo, ct = jax.device_get(
-                (self.sketch.topk_hi[:n], self.sketch.topk_lo[:n],
-                 self.sketch.topk_counts[:n]))
+            hi, lo, ct = jax.device_get(self._live_topk(n))
             # one pass builds both the emission rows and (when asked)
             # the per-row forwardable candidate lists
             by_row = {} if want_forward else None
@@ -1571,13 +1583,27 @@ class HeavyHitterGroup(OverloadLimited):
         if self._retired:
             self.sketch = None  # free the table now, never reused
         else:
-            self.sketch = self._cm.init(self.capacity, self.depth,
-                                        self.width, self.k)
+            self._reset_sketch()
             self._sids_np = np.zeros(self.capacity + 1, np.uint32)
             self._new_sample_buffers()
         self._device_dirty = False
         self._members.clear()
         return interner, out, fwd
+
+    def _live_topk(self, n: int):
+        """Device refs of the live rows' top-k planes, interner order
+        (override point for the mesh store's permutation gather)."""
+        return (self.sketch.topk_hi[:n], self.sketch.topk_lo[:n],
+                self.sketch.topk_counts[:n])
+
+    def _scatter_rows(self, rows: np.ndarray) -> np.ndarray:
+        """Row ids as the device scatter sees them (override point for
+        the mesh store's logical→physical placement translation)."""
+        return rows
+
+    def _reset_sketch(self):
+        self.sketch = self._cm.init(self.capacity, self.depth,
+                                    self.width, self.k)
 
     @requires_lock("store")
     def snapshot_begin(self):
@@ -1594,8 +1620,7 @@ class HeavyHitterGroup(OverloadLimited):
                 "joined": list(self.interner.joined)}
         if n == 0:
             return snap, None
-        refs = (self.sketch.topk_hi[:n], self.sketch.topk_lo[:n],
-                self.sketch.topk_counts[:n], jnp.copy(self.sketch.table))
+        refs = self._live_topk(n) + (jnp.copy(self.sketch.table),)
         members = dict(self._members)
 
         def finish():
@@ -1853,11 +1878,21 @@ class MetricStore:
         # only for the generation swap — see flush())
         self._flush_gate = threading.Lock()
         self.mesh = mesh
-        if mesh is not None and digest_storage in ("slab", "tiered"):
+        self.shard_router = None
+        if mesh is not None and digest_storage == "slab":
             raise ValueError(
-                f"digest_storage={digest_storage!r} cannot combine with a "
-                f"device mesh (the mesh store shards series across chips "
-                f"instead)")
+                "digest_storage: slab cannot combine with mesh_enabled: "
+                "the slab layout is the single-chip capacity plan and "
+                "the mesh supersedes it — run the mesh dense, or "
+                "digest_storage: tiered (fleet mode composes with the "
+                "tiered packed-pool residency; fleet/mesh_tiered.py)")
+        if mesh is not None:
+            # one router for every mesh group: a series owns the same
+            # shard across scalars, digests, sets and heavy hitters
+            from veneur_tpu.fleet import ShardRouter
+            from veneur_tpu.parallel.mesh import SERIES_AXIS
+
+            self.shard_router = ShardRouter(mesh.shape[SERIES_AXIS])
 
         def _slab_group():
             # the multi-million-series capacity plan (core/slab.py): flat
@@ -1884,23 +1919,63 @@ class MetricStore:
                 dense_capacity=initial_capacity)
 
         self._slab_group = _slab_group
-        self.counters = ScalarGroup("counter", initial_capacity)
-        self.global_counters = ScalarGroup("counter", initial_capacity)
-        self.gauges = ScalarGroup("gauge", initial_capacity)
-        self.global_gauges = ScalarGroup("gauge", initial_capacity)
-        self.local_status_checks = ScalarGroup("status", initial_capacity)
         if mesh is not None:
-            # Global-tier mode: the mixed (fleet-merged) groups live sharded
-            # over the device mesh; local-only groups stay single-device
+            # Fleet mode: every group (scalars included) places series
+            # by the shared router, so one shard owns a series across
+            # the WHOLE store; local-only groups stay single-device
             # (they hold only this instance's own telemetry).
+            from veneur_tpu.core.mesh_store import MeshScalarGroup
+
+            self.counters = MeshScalarGroup("counter", initial_capacity,
+                                            mesh, self.shard_router)
+            self.global_counters = MeshScalarGroup(
+                "counter", initial_capacity, mesh, self.shard_router)
+            self.gauges = MeshScalarGroup("gauge", initial_capacity,
+                                          mesh, self.shard_router)
+            self.global_gauges = MeshScalarGroup(
+                "gauge", initial_capacity, mesh, self.shard_router)
+        else:
+            self.counters = ScalarGroup("counter", initial_capacity)
+            self.global_counters = ScalarGroup("counter", initial_capacity)
+            self.gauges = ScalarGroup("gauge", initial_capacity)
+            self.global_gauges = ScalarGroup("gauge", initial_capacity)
+        self.local_status_checks = ScalarGroup("status", initial_capacity)
+        if mesh is not None and digest_storage == "tiered":
+            # Fleet mode × tiered residency: the packed pool shards over
+            # the series axis, the hot tier is a mesh bank, promotion is
+            # shard-local (fleet/mesh_tiered.py) — the capacity win of
+            # PR 6 across chips
+            from veneur_tpu.core.mesh_store import MeshSetGroup
+            from veneur_tpu.fleet.mesh_tiered import MeshTieredDigestGroup
+
+            def _mesh_tiered():
+                return MeshTieredDigestGroup(
+                    mesh, self.shard_router,
+                    slab_rows=min(slab_rows, 1 << 18), chunk=chunk,
+                    compression=compression,
+                    pool_centroids=tier_pool_centroids,
+                    promote_samples=tier_promote_samples,
+                    promote_intervals=tier_promote_intervals,
+                    demote_intervals=tier_demote_intervals,
+                    dense_capacity=initial_capacity)
+
+            self.histograms = _mesh_tiered()
+            self.timers = _mesh_tiered()
+            self.sets = MeshSetGroup(mesh, initial_capacity, chunk,
+                                     hll_precision,
+                                     router=self.shard_router)
+        elif mesh is not None:
             from veneur_tpu.core.mesh_store import (MeshDigestGroup,
                                                     MeshSetGroup)
             self.histograms = MeshDigestGroup(mesh, initial_capacity, chunk,
-                                              compression)
+                                              compression,
+                                              router=self.shard_router)
             self.timers = MeshDigestGroup(mesh, initial_capacity, chunk,
-                                          compression)
+                                          compression,
+                                          router=self.shard_router)
             self.sets = MeshSetGroup(mesh, initial_capacity, chunk,
-                                     hll_precision)
+                                     hll_precision,
+                                     router=self.shard_router)
         elif digest_storage == "slab":
             self.histograms = self._slab_group()
             self.timers = self._slab_group()
@@ -1931,9 +2006,17 @@ class MetricStore:
         # per instrumented stage), local-only, never forwarded
         self.self_timers = DigestGroup(min(64, initial_capacity), chunk,
                                        compression)
-        self.heavy_hitters = HeavyHitterGroup(initial_capacity, chunk,
-                                              depth=topk_depth,
-                                              width=topk_width, k=topk_k)
+        if mesh is not None:
+            from veneur_tpu.core.mesh_store import MeshHeavyHitterGroup
+
+            self.heavy_hitters = MeshHeavyHitterGroup(
+                initial_capacity, chunk, topk_depth, topk_width, topk_k,
+                mesh, self.shard_router)
+        else:
+            self.heavy_hitters = HeavyHitterGroup(initial_capacity, chunk,
+                                                  depth=topk_depth,
+                                                  width=topk_width,
+                                                  k=topk_k)
         self.hll_precision = hll_precision
         # overload-safety plumbing (veneur_tpu/overload.py,
         # resilience/compute.py): bounded per-group cardinality, the
@@ -2800,6 +2883,14 @@ class MetricStore:
         gen.imported = self.imported
         self.processed = 0
         self.imported = 0
+        if self.mesh is not None:
+            # fleet mode: stamp the RETIRED interval's per-shard row
+            # occupancy (the veneur.fleet.shard_occupancy self-metric;
+            # the live /debug/vars mesh section reads current fills)
+            from veneur_tpu.fleet import sum_shard_occupancy
+
+            self.last_fleet_occupancy = sum_shard_occupancy(
+                getattr(gen, attr) for attr in self._GEN_GROUPS)
         self.flush_epoch += 1
         self._kind_groups = None  # holds refs to the retired groups
         if self._native_table is not None:

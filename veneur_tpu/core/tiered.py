@@ -146,28 +146,18 @@ def _pool_compact(pool: PoolSlab, slab: int, pk: int, pcomp: float,
                                                use_pallas=use_pallas)
 
 
-def _guard_drain_pool(pool: PoolSlab, rows, values, weights, slab: int,
-                      pk: int, pcomp: float, use_pallas: bool) -> PoolSlab:
-    """The pool form of the shift guard: when the chunk's per-row value
-    ranges are disjoint from what the bins cover for enough chunk mass,
-    sort-compact-merge the bins into the packed planes first so fresh
-    bins re-anchor (lax.cond — stationary traffic pays one reduction).
-
-    A second trigger bounds bin CLUMPING: value-bracketed placement has
-    no per-bin mass cap, and the ID-bisection used for new extremes
-    leaves some bin ids unreachable, so under chunk-solo arrival an
-    oversubscribed row (count > PK) can pile 0.16+ of its mass onto
-    one shared bin (measured on 2g's promoted rows) — past the ~2/C
-    k-scale envelope the compact maintains and the quantile error
-    budget assumes. Draining is only useful BEFORE a clump forms (the
-    compressor merges, it cannot split), so the trip fires when a
-    targeted row's heaviest bin WOULD cross its envelope with this
-    chunk's mass added: the bins compact into the packed planes (each
-    cluster k-scale-capped) and all PK bin ids free up to re-anchor.
-    Rows with count <= PK sit in exact singleton bins and never trip,
-    so stationary sparse traffic stays one reduction per chunk."""
-    pred = td_ops.shift_pred(pool.bw, pool.bwm, rows, values, weights,
-                             slab, anchors=pk)
+def _pool_guard_masses(pool: PoolSlab, rows, values, weights, slab: int,
+                       pk: int, pcomp: float):
+    """The three guard-trigger signals of :func:`_guard_drain_pool`,
+    exposed UN-thresholded so the mesh pool (``fleet/mesh_tiered.py``)
+    can psum them over the series axis before deciding — every shard
+    must take the SAME drain the single-device pool would on the same
+    data (the ``ops/tdigest.py shift_masses`` decomposition, pool
+    form). Returns ``(shifted, total, over_dom)``: the shift-guard
+    mass pair plus the count of rows tripping the clump/dominance
+    triggers (an any() that sums exactly over disjoint row sets)."""
+    shifted, total = td_ops.shift_masses(pool.bw, pool.bwm, rows, values,
+                                         weights, slab, anchors=pk)
     inc = jnp.zeros((slab + 1,), jnp.float32).at[rows].add(
         weights.astype(jnp.float32), mode="drop")[:slab]
     _, pw = td_ops.dequantize_centroids(
@@ -189,7 +179,16 @@ def _guard_drain_pool(pool: PoolSlab, rows, values, weights, slab: int,
     # and turns the history into value-sorted packed centroids the
     # merged-rank anchor reads exactly.
     dom = (inc > tot) & (jnp.sum(bw2, axis=1) > 0)
-    pred = pred | jnp.any(over) | jnp.any(dom)
+    over_dom = (jnp.sum(over.astype(jnp.float32))
+                + jnp.sum(dom.astype(jnp.float32)))
+    return shifted, total, over_dom
+
+
+def _pool_guard_apply(pool: PoolSlab, pred, slab: int, pk: int,
+                      pcomp: float, use_pallas: bool) -> PoolSlab:
+    """Conditionally sort-compact-merge the bins into the packed planes
+    (the drain half of the guard; pred must already be reduced to a
+    scalar — threshold the :func:`_pool_guard_masses` signals first)."""
 
     def do_drain(p):
         nm, nw = _pool_compact(p, slab, pk, pcomp, use_pallas)
@@ -202,16 +201,55 @@ def _guard_drain_pool(pool: PoolSlab, rows, values, weights, slab: int,
     return lax.cond(pred, do_drain, lambda p: p, pool)
 
 
-@partial(jax.jit, donate_argnums=(0,), static_argnums=(4, 5, 6, 7))
-def _pool_ingest(pool: PoolSlab, rows, values, weights, slab: int, pk: int,
-                 pcomp: float, use_pallas: bool = True) -> PoolSlab:
+def _guard_drain_pool(pool: PoolSlab, rows, values, weights, slab: int,
+                      pk: int, pcomp: float, use_pallas: bool) -> PoolSlab:
+    """The pool form of the shift guard: when the chunk's per-row value
+    ranges are disjoint from what the bins cover for enough chunk mass,
+    sort-compact-merge the bins into the packed planes first so fresh
+    bins re-anchor (lax.cond — stationary traffic pays one reduction).
+
+    A second trigger bounds bin CLUMPING: value-bracketed placement has
+    no per-bin mass cap, and the ID-bisection used for new extremes
+    leaves some bin ids unreachable, so under chunk-solo arrival an
+    oversubscribed row (count > PK) can pile 0.16+ of its mass onto
+    one shared bin (measured on 2g's promoted rows) — past the ~2/C
+    k-scale envelope the compact maintains and the quantile error
+    budget assumes. Draining is only useful BEFORE a clump forms (the
+    compressor merges, it cannot split), so the trip fires when a
+    targeted row's heaviest bin WOULD cross its envelope with this
+    chunk's mass added: the bins compact into the packed planes (each
+    cluster k-scale-capped) and all PK bin ids free up to re-anchor.
+    Rows with count <= PK sit in exact singleton bins and never trip,
+    so stationary sparse traffic stays one reduction per chunk. The
+    third (dominance) trigger is documented in _pool_guard_masses."""
+    shifted, total, over_dom = _pool_guard_masses(
+        pool, rows, values, weights, slab, pk, pcomp)
+    pred = (shifted > td_ops.SHIFT_GUARD_FRAC
+            * jnp.maximum(total, jnp.finfo(jnp.float32).tiny)) \
+        | (over_dom > 0)
+    return _pool_guard_apply(pool, pred, slab, pk, pcomp, use_pallas)
+
+
+def _pool_ingest_impl(pool: PoolSlab, rows, values, weights, slab: int,
+                      pk: int, pcomp: float,
+                      use_pallas: bool = True) -> PoolSlab:
     """Scatter one flat sample chunk into a pool slab's bins + stats,
-    behind the shift guard. rows are slab-LOCAL; >= slab is padding."""
+    behind the shift guard. rows are slab-LOCAL; >= slab is padding.
+    Plain function: the jitted single-device program and the mesh
+    store's shard_map body (fleet/mesh_tiered.py, which swaps in a
+    psum'd guard decision) both build on the pieces below."""
     oor = rows >= slab
     rows = jnp.where(oor, slab, rows)
     weights = jnp.where(oor, 0.0, weights)
     pool = _guard_drain_pool(pool, rows, values, weights, slab, pk, pcomp,
                              use_pallas)
+    return _pool_scatter_samples(pool, rows, values, weights, slab, pk,
+                                 pcomp)
+
+
+def _pool_scatter_samples(pool: PoolSlab, rows, values, weights,
+                          slab: int, pk: int, pcomp: float) -> PoolSlab:
+    """The post-guard half of the sample ingest: bin + scatter."""
     r, v, w, b = td_ops.bin_pool_samples(
         rows, values, weights, slab, pk, pcomp, pool.bw, pool.bwm,
         pool.mq, pool.wb, pool.fmin, pool.fmax)
@@ -230,10 +268,18 @@ def _pool_ingest(pool: PoolSlab, rows, values, weights, slab: int, pk: int,
     )
 
 
-@partial(jax.jit, donate_argnums=(0,), static_argnums=(7, 8, 9, 10))
-def _pool_import(pool: PoolSlab, rows, means, weights, stat_rows,
-                 stat_mins, stat_maxs, slab: int, pk: int, pcomp: float,
-                 use_pallas: bool = True) -> PoolSlab:
+@partial(jax.jit, donate_argnums=(0,), static_argnums=(4, 5, 6, 7))
+def _pool_ingest(pool: PoolSlab, rows, values, weights, slab: int, pk: int,
+                 pcomp: float, use_pallas: bool = True) -> PoolSlab:
+    """The jitted single-device sample-ingest program (see
+    ``_pool_ingest_impl``)."""
+    return _pool_ingest_impl(pool, rows, values, weights, slab, pk, pcomp,
+                             use_pallas)
+
+
+def _pool_import_impl(pool: PoolSlab, rows, means, weights, stat_rows,
+                      stat_mins, stat_maxs, slab: int, pk: int,
+                      pcomp: float, use_pallas: bool = True) -> PoolSlab:
     """Fold imported digest CENTROIDS into a pool slab without touching
     the local scalar stats (samplers.go:473-480); imported per-digest
     extrema land on dmin/dmax and only bound the final digest."""
@@ -242,6 +288,14 @@ def _pool_import(pool: PoolSlab, rows, means, weights, stat_rows,
     weights = jnp.where(oor, 0.0, weights)
     pool = _guard_drain_pool(pool, rows, means, weights, slab, pk, pcomp,
                              use_pallas)
+    return _pool_scatter_imports(pool, rows, means, weights, stat_rows,
+                                 stat_mins, stat_maxs, slab, pk, pcomp)
+
+
+def _pool_scatter_imports(pool: PoolSlab, rows, means, weights, stat_rows,
+                          stat_mins, stat_maxs, slab: int, pk: int,
+                          pcomp: float) -> PoolSlab:
+    """The post-guard half of the centroid import: bin + scatter."""
     r, v, w, b = td_ops.bin_pool_samples(
         rows, means, weights, slab, pk, pcomp, pool.bw, pool.bwm,
         pool.mq, pool.wb, pool.fmin, pool.fmax)
@@ -256,9 +310,19 @@ def _pool_import(pool: PoolSlab, rows, means, weights, stat_rows,
     )
 
 
-@partial(jax.jit, donate_argnums=(0,), static_argnums=(2, 3, 4, 5))
-def _pool_flush(pool: PoolSlab, qs, slab: int, pk: int, pcomp: float,
-                use_pallas: bool = True):
+@partial(jax.jit, donate_argnums=(0,), static_argnums=(7, 8, 9, 10))
+def _pool_import(pool: PoolSlab, rows, means, weights, stat_rows,
+                 stat_mins, stat_maxs, slab: int, pk: int, pcomp: float,
+                 use_pallas: bool = True) -> PoolSlab:
+    """The jitted single-device centroid-import program (see
+    ``_pool_import_impl``)."""
+    return _pool_import_impl(pool, rows, means, weights, stat_rows,
+                             stat_mins, stat_maxs, slab, pk, pcomp,
+                             use_pallas)
+
+
+def _pool_flush_impl(pool: PoolSlab, qs, slab: int, pk: int, pcomp: float,
+                     use_pallas: bool = True):
     """Flush one pool slab directly from the packed representation:
     sort-compact-merge bins into the (dequantized) packed centroids,
     quantile over the result — never a dense [S, K] densify. Returns
@@ -273,9 +337,17 @@ def _pool_flush(pool: PoolSlab, qs, slab: int, pk: int, pcomp: float,
             pool.vsum, pool.vmin, pool.vmax, pool.recip)
 
 
-@partial(jax.jit, donate_argnums=(0, 1, 2, 3), static_argnums=(6, 7, 8))
-def _promote_rows(pool: PoolSlab, temp: td_ops.TempCentroids, ddmin, ddmax,
-                  rows, slots, slab: int, pk: int, compression: float):
+@partial(jax.jit, donate_argnums=(0,), static_argnums=(2, 3, 4, 5))
+def _pool_flush(pool: PoolSlab, qs, slab: int, pk: int, pcomp: float,
+                use_pallas: bool = True):
+    """The jitted single-device pool-flush program (see
+    ``_pool_flush_impl``)."""
+    return _pool_flush_impl(pool, qs, slab, pk, pcomp, use_pallas)
+
+
+def _promote_rows_impl(pool: PoolSlab, temp: td_ops.TempCentroids, ddmin,
+                       ddmax, rows, slots, slab: int, pk: int,
+                       compression: float):
     """Move candidate rows' pool state into the dense tier ON DEVICE:
     dequantized packed centroids + bin centroids re-enter the dense
     temp's binning pipeline as weighted samples (update_stats=False,
@@ -343,9 +415,17 @@ def _promote_rows(pool: PoolSlab, temp: td_ops.TempCentroids, ddmin, ddmax,
     return pool, temp, ddmin, ddmax
 
 
-@partial(jax.jit, donate_argnums=(0,), static_argnums=(7,))
-def _pool_restore_stats(pool: PoolSlab, rows, count, vsum, vmin, vmax,
-                        recip, slab: int) -> PoolSlab:
+@partial(jax.jit, donate_argnums=(0, 1, 2, 3), static_argnums=(6, 7, 8))
+def _promote_rows(pool: PoolSlab, temp: td_ops.TempCentroids, ddmin, ddmax,
+                  rows, slots, slab: int, pk: int, compression: float):
+    """The jitted single-device promotion program (see
+    ``_promote_rows_impl``)."""
+    return _promote_rows_impl(pool, temp, ddmin, ddmax, rows, slots, slab,
+                              pk, compression)
+
+
+def _pool_restore_stats_impl(pool: PoolSlab, rows, count, vsum, vmin,
+                             vmax, recip, slab: int) -> PoolSlab:
     """Scatter recovered per-row scalar stats into a pool slab (the
     checkpoint-restore twin of ``core.store._restore_temp_stats``)."""
     rz = jnp.where(rows >= slab, slab, rows)
@@ -356,6 +436,28 @@ def _pool_restore_stats(pool: PoolSlab, rows, count, vsum, vmin, vmax,
         vmax=pool.vmax.at[rz].max(vmax, mode="drop"),
         recip=pool.recip.at[rz].add(recip, mode="drop"),
     )
+
+
+@partial(jax.jit, donate_argnums=(0,), static_argnums=(7,))
+def _pool_restore_stats(pool: PoolSlab, rows, count, vsum, vmin, vmax,
+                        recip, slab: int) -> PoolSlab:
+    """The jitted single-device restore-stats program (see
+    ``_pool_restore_stats_impl``)."""
+    return _pool_restore_stats_impl(pool, rows, count, vsum, vmin, vmax,
+                                    recip, slab)
+
+
+def dequantize_host(mq: np.ndarray, wb: np.ndarray, fmin: np.ndarray,
+                    fmax: np.ndarray):
+    """Host-side (numpy) twin of ``ops/tdigest.dequantize_centroids``:
+    the PackedDigestPlanes u16 contract. Shared by the checkpoint
+    snapshot's flatten and the mesh tiered group's promotion path."""
+    weight = (wb.astype(np.uint32) << 16).view(np.float32)
+    span = np.where(np.isfinite(fmax - fmin), fmax - fmin, 0.0)
+    base = np.where(np.isfinite(fmin), fmin, 0.0)
+    mean = base[:, None] + mq.astype(np.float32) * (span[:, None]
+                                                    / 65535.0)
+    return mean, weight.astype(np.float32)
 
 
 class TierDirectory:
@@ -535,15 +637,26 @@ class TieredDigestGroup(OverloadLimited):
         self.directory = directory if directory is not None else \
             TierDirectory(promote_samples, promote_intervals,
                           demote_intervals)
-        self._dense = DigestGroup(dense_capacity, chunk, compression)
-        self.pools: List[PoolSlab] = [
-            _init_pool_slab(self.slab_rows, self.pk)]
+        self._dense = self._make_dense_bank(dense_capacity, chunk,
+                                            compression)
+        self.pools: List[PoolSlab] = [self._new_pool_slab()]
         self._device_dirty = False
         self._slot = np.full(self.slab_rows, -1, np.int32)
         self._activity = np.zeros(self.slab_rows, np.int64)
         self._dense_rows: List[int] = []
         self._new_sample_buffers()
         self._new_import_buffers()
+
+    def _make_dense_bank(self, dense_capacity: int, chunk: int,
+                         compression: float) -> DigestGroup:
+        """The hot-tier bank (override point: the mesh tiered group
+        embeds a series-sharded MeshDigestGroup in slot mode)."""
+        return DigestGroup(dense_capacity, chunk, compression)
+
+    def _new_pool_slab(self) -> PoolSlab:
+        """One empty pool slab (override point: the mesh tiered group
+        places the planes onto the series axis)."""
+        return _init_pool_slab(self.slab_rows, self.pk)
 
     # -- capacity ---------------------------------------------------------
 
@@ -587,7 +700,7 @@ class TieredDigestGroup(OverloadLimited):
     @requires_lock("store")
     def ensure_capacity(self, max_row: int):
         while max_row >= self.capacity:
-            self.pools.append(_init_pool_slab(self.slab_rows, self.pk))
+            self.pools.append(self._new_pool_slab())
             self._rows[self._fill:] = self.capacity
             self._imp_rows[self._imp_fill:] = self.capacity
             self._imp_stat_rows[self._imp_stat_fill:] = self.capacity
@@ -787,13 +900,20 @@ class TieredDigestGroup(OverloadLimited):
             slots, (v, w) = dense
             self._dense.sample_many(slots, v, w)
         up = self._pallas_allowed()
-        with obs_kernels.scope("drain.digest.tiered"):
-            for i, local, (v, w) in pool_spans:
-                self.pools[i] = _pool_ingest(
-                    self.pools[i], jnp.asarray(local), jnp.asarray(v),
-                    jnp.asarray(w), self.slab_rows, self.pk, self.pcomp,
-                    up)
+        for i, local, (v, w) in pool_spans:
+            self._pool_drain_samples(i, local, v, w, up)
         self._maybe_promote(np.unique(rows[:fill]))
+
+    def _pool_drain_samples(self, i: int, local, vals, wts,
+                            use_pallas: bool):
+        """Dispatch one slab's routed sample span (override point: the
+        mesh tiered group re-routes the span per shard and runs the
+        sharded program)."""
+        with obs_kernels.scope("drain.digest.tiered"):
+            self.pools[i] = _pool_ingest(
+                self.pools[i], jnp.asarray(local), jnp.asarray(vals),
+                jnp.asarray(wts), self.slab_rows, self.pk, self.pcomp,
+                use_pallas)
 
     @requires_lock("store")
     def _drain_imports(self):
@@ -823,21 +943,28 @@ class TieredDigestGroup(OverloadLimited):
         up = self._pallas_allowed()
         empty_r = np.full(2, self.slab_rows, np.int32)
         cents_by_slab = {i: (local, padded) for i, local, padded in pool_c}
-        with obs_kernels.scope("drain.digest.tiered"):
-            for i in sorted(set(cents_by_slab) | set(stats_by_slab)):
-                c_local, c_pad = cents_by_slab.get(
-                    i, (empty_r, [np.zeros(2, np.float32),
-                                  np.zeros(2, np.float32)]))
-                s_local, s_pad = stats_by_slab.get(
-                    i, (empty_r, [np.full(2, np.inf, np.float32),
-                                  np.full(2, -np.inf, np.float32)]))
-                self.pools[i] = _pool_import(
-                    self.pools[i], jnp.asarray(c_local),
-                    jnp.asarray(c_pad[0]), jnp.asarray(c_pad[1]),
-                    jnp.asarray(s_local), jnp.asarray(s_pad[0]),
-                    jnp.asarray(s_pad[1]), self.slab_rows, self.pk,
-                    self.pcomp, up)
+        for i in sorted(set(cents_by_slab) | set(stats_by_slab)):
+            c_local, c_pad = cents_by_slab.get(
+                i, (empty_r, [np.zeros(2, np.float32),
+                              np.zeros(2, np.float32)]))
+            s_local, s_pad = stats_by_slab.get(
+                i, (empty_r, [np.full(2, np.inf, np.float32),
+                              np.full(2, -np.inf, np.float32)]))
+            self._pool_drain_imports(i, c_local, c_pad[0], c_pad[1],
+                                     s_local, s_pad[0], s_pad[1], up)
         self._maybe_promote(np.unique(rows[:nf]))
+
+    def _pool_drain_imports(self, i: int, c_local, c_means, c_wts,
+                            s_local, s_mins, s_maxs, use_pallas: bool):
+        """Dispatch one slab's routed import span (override point, like
+        ``_pool_drain_samples``)."""
+        with obs_kernels.scope("drain.digest.tiered"):
+            self.pools[i] = _pool_import(
+                self.pools[i], jnp.asarray(c_local),
+                jnp.asarray(c_means), jnp.asarray(c_wts),
+                jnp.asarray(s_local), jnp.asarray(s_mins),
+                jnp.asarray(s_maxs), self.slab_rows, self.pk,
+                self.pcomp, use_pallas)
 
     @requires_lock("store")
     def _drain_staging(self):
@@ -897,8 +1024,7 @@ class TieredDigestGroup(OverloadLimited):
 
     def _reset_device(self):
         nslabs = len(self.pools)
-        self.pools = [_init_pool_slab(self.slab_rows, self.pk)
-                      for _ in range(nslabs)]
+        self.pools = [self._new_pool_slab() for _ in range(nslabs)]
         self._dense._init_device()
         self._dense._init_staging()
         self._device_dirty = False
@@ -999,7 +1125,7 @@ class TieredDigestGroup(OverloadLimited):
                  vmax, recip) = _pool_flush(self.pools[i], qs, R, pk,
                                             self.pcomp, use_pallas)
                 new_pools[i] = None if self._retired else \
-                    _init_pool_slab(R, pk)
+                    self._new_pool_slab()
                 if need <= 0:
                     continue
                 planes = ()
@@ -1104,6 +1230,12 @@ class TieredDigestGroup(OverloadLimited):
         restore merges into ANY digest store, whatever its tier
         assignment (rows appear in exactly one tier's runs)."""
         self._drain_staging()
+        # the dense bank buffers its own staging (the pool drains hand
+        # it promoted rows' samples via sample_many, which only drains
+        # FULL chunks) — flush drains it in _flush_fetch, and a
+        # snapshot must too or a promoted row's staged tail silently
+        # misses the checkpoint
+        self._dense._drain_staging()
         n = len(self.interner)
         snap = {"kind": "digest", "names": list(self.interner.names),
                 "joined": list(self.interner.joined)}
@@ -1149,12 +1281,7 @@ class TieredDigestGroup(OverloadLimited):
                  vmx, recip) = [np.asarray(a) for a in
                                 jax.device_get(refs)]
                 # host-side dequantize (the PackedDigestPlanes contract)
-                weight = (wb.astype(np.uint32) << 16).view(np.float32)
-                span = np.where(np.isfinite(fmax - fmin), fmax - fmin,
-                                0.0)
-                base = np.where(np.isfinite(fmin), fmin, 0.0)
-                mean = base[:, None] + mq.astype(np.float32) \
-                    * (span[:, None] / 65535.0)
+                mean, weight = dequantize_host(mq, wb, fmin, fmax)
                 flat = flatten_digest_state(
                     np.where(weight > 0, mean, np.inf).astype(np.float32),
                     weight.astype(np.float32), bw, bwm)
@@ -1232,13 +1359,19 @@ class TieredDigestGroup(OverloadLimited):
         if dense is not None:
             slots, (c, s, mn, mx, rc) = dense
             self._dense.restore_stats(slots, c, s, mn, mx, rc)
+        for i, local, (c, s, mn, mx, rc) in pool_spans:
+            # pow2 padding zero-fills; min/max identities re-stamp
+            pad_rows = local >= self.slab_rows
+            mn[pad_rows] = np.inf
+            mx[pad_rows] = -np.inf
+            self._pool_restore(i, local, c, s, mn, mx, rc)
+
+    def _pool_restore(self, i: int, local, count, vsum, vmin, vmax,
+                      recip):
+        """Dispatch one slab's restore-stat span (override point, like
+        ``_pool_drain_samples``)."""
         with obs_kernels.scope("drain.digest.tiered"):
-            for i, local, (c, s, mn, mx, rc) in pool_spans:
-                # pow2 padding zero-fills; min/max identities re-stamp
-                pad_rows = local >= self.slab_rows
-                mn[pad_rows] = np.inf
-                mx[pad_rows] = -np.inf
-                self.pools[i] = _pool_restore_stats(
-                    self.pools[i], jnp.asarray(local), jnp.asarray(c),
-                    jnp.asarray(s), jnp.asarray(mn), jnp.asarray(mx),
-                    jnp.asarray(rc), self.slab_rows)
+            self.pools[i] = _pool_restore_stats(
+                self.pools[i], jnp.asarray(local), jnp.asarray(count),
+                jnp.asarray(vsum), jnp.asarray(vmin), jnp.asarray(vmax),
+                jnp.asarray(recip), self.slab_rows)

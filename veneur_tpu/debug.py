@@ -223,6 +223,17 @@ def collect_vars(server) -> dict:
     except Exception as e:  # pragma: no cover - diagnostic only
         out["overload_error"] = repr(e)
     try:
+        # fleet mode (veneur_tpu/fleet/): mesh axes + per-group
+        # per-shard row occupancy and balance ratio — shard skew must
+        # be visible before it becomes one chip's OOM
+        store = getattr(server, "store", None)
+        if store is not None and getattr(store, "mesh", None) is not None:
+            from veneur_tpu.fleet import fleet_snapshot
+
+            out["mesh"] = fleet_snapshot(store)
+    except Exception as e:  # pragma: no cover - diagnostic only
+        out["mesh_error"] = repr(e)
+    try:
         # flush-interval observability (veneur_tpu/obs/): timeline ring
         # summary + per-scope kernel dispatches and live compiled-
         # variant counts (the recompile lint pass's inventory,

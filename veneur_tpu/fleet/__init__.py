@@ -1,0 +1,96 @@
+"""Fleet mode: the global tier's store sharded over a device mesh.
+
+This package owns the three concerns the multi-chip arc is built from:
+
+- **mesh construction** — :func:`build_mesh` turns config
+  (``mesh_enabled`` / ``mesh_hosts``) into the ``(series, hosts)``
+  ``jax.sharding.Mesh`` of ``parallel/mesh.py``;
+- **shard placement** — :class:`~veneur_tpu.fleet.router.ShardRouter`
+  and the placements of ``fleet/router.py`` decide which series-shard
+  owns a series (the proxy's consistent-hash ring rule, one tier down)
+  and where its rows physically live inside the sharded planes;
+- **shard-routed import** — the mesh groups (``core/mesh_store.py``)
+  and the mesh tiered store (``fleet/mesh_tiered.py``) drain staged
+  import chunks as per-shard stacks, so forwarded batches land on one
+  shard's device without a replicated re-scatter.
+
+``core/mesh_store.py`` keeps the group classes (they subclass the
+single-device groups of ``core/store.py``); ``fleet/mesh_tiered.py``
+composes them with ``core/tiered.py``'s packed-pool residency so
+``mesh_enabled: true`` + ``digest_storage: tiered`` runs the 5.7×
+capacity win across chips. See docs/internals.md "Fleet mode".
+"""
+
+from __future__ import annotations
+
+import logging
+
+from veneur_tpu.fleet.router import (PoolPlacement, ShardPlacement,
+                                     ShardRouter, route_stack)
+
+log = logging.getLogger("veneur.fleet")
+
+__all__ = ["ShardRouter", "ShardPlacement", "PoolPlacement",
+           "route_stack", "build_mesh", "fleet_snapshot",
+           "sum_shard_occupancy", "balance_ratio"]
+
+
+def sum_shard_occupancy(groups) -> "list | None":
+    """Per-shard resident-row totals summed over placed groups (None
+    when nothing is placed) — the ONE aggregate behind the
+    ``/debug/vars`` mesh section, the swap-time stamp, and the
+    ``veneur.fleet.shard_occupancy`` self-metric."""
+    occ = None
+    for g in groups:
+        placement = getattr(g, "placement", None)
+        if placement is None:
+            continue
+        per = placement.occupancy()["per_shard"]
+        occ = list(per) if occ is None else [a + b
+                                             for a, b in zip(occ, per)]
+    return occ
+
+
+def balance_ratio(occ) -> float:
+    """max/mean shard fill: 1.0 = perfectly balanced, S = everything on
+    one shard."""
+    total = sum(occ)
+    return round(max(occ) / (total / len(occ)), 4) if total else 1.0
+
+
+def build_mesh(config):
+    """The fleet mesh a global instance shards its store over: every
+    visible device, ``mesh_hosts`` wide on the fan-in axis (default 2
+    when the device count is even — one psum neighbour per shard)."""
+    import jax
+
+    from veneur_tpu.parallel.mesh import fleet_mesh
+
+    n = len(jax.devices())
+    hosts = config.mesh_hosts or (2 if n % 2 == 0 else 1)
+    mesh = fleet_mesh(jax.devices(), hosts=hosts)
+    log.info("global store sharded over %d devices (%s)", n,
+             dict(mesh.shape))
+    return mesh
+
+
+def fleet_snapshot(store) -> dict:
+    """The ``/debug/vars`` ``mesh`` section: axes, per-group per-shard
+    row occupancy and balance ratio (max/mean shard fill — 1.0 is
+    perfectly balanced). Best-effort like every debug collector."""
+    mesh = getattr(store, "mesh", None)
+    if mesh is None:
+        return {}
+    out = {"axes": {k: int(v) for k, v in dict(mesh.shape).items()},
+           "devices": int(mesh.size), "groups": {}}
+    groups = [getattr(store, name, None)
+              for name in getattr(store, "_GEN_GROUPS", ())]
+    for name, g in zip(getattr(store, "_GEN_GROUPS", ()), groups):
+        placement = getattr(g, "placement", None)
+        if placement is not None:
+            out["groups"][name] = placement.occupancy()
+    occ_total = sum_shard_occupancy(groups)
+    if occ_total:
+        out["shard_occupancy"] = occ_total
+        out["balance_ratio"] = balance_ratio(occ_total)
+    return out

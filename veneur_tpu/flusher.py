@@ -262,6 +262,7 @@ def _flush_once(server: "Server", span, rec=None):
             None),
         *_worker_samples(server, ms),
         *_overload_samples(server, ms),
+        *_fleet_samples(server),
         *_forward_samples(server),
         *_import_samples(server),
         *_checkpoint_samples(server),
@@ -469,6 +470,33 @@ def _trace_client_samples(server):
                           stats.get("trace_client.records_succeeded_total",
                                     0.0), None),
     ]
+
+
+def _fleet_samples(server):
+    """Fleet-mode shard balance (veneur_tpu/fleet/): per-shard resident
+    row occupancy summed over the mesh groups, tagged ``shard:<i>`` —
+    the self-metric twin of the ``/debug/vars`` mesh section, so shard
+    skew shows up in dashboards before it becomes one chip's OOM.
+    Empty off the mesh (the common case costs one attribute read)."""
+    store = getattr(server, "store", None)
+    if store is None or getattr(store, "mesh", None) is None:
+        return []
+    from veneur_tpu.trace import samples as ssf_samples
+
+    # stamped at the generation swap: the RETIRED interval's fills (the
+    # live store is near-empty right after the swap)
+    occ = getattr(store, "last_fleet_occupancy", None)
+    if not occ:
+        return []
+    from veneur_tpu.fleet import balance_ratio
+
+    out = []
+    for i, rows in enumerate(occ):
+        out.append(ssf_samples.gauge("veneur.fleet.shard_occupancy",
+                                     float(rows), {"shard": str(i)}))
+    out.append(ssf_samples.gauge("veneur.fleet.balance_ratio",
+                                 balance_ratio(occ), None))
+    return out
 
 
 def _worker_samples(server, ms):

@@ -105,6 +105,42 @@ PROGRAM_SCOPES: Dict[str, Tuple[str, Optional[Tuple[str, str]]]] = {
         ("drain.digest.tiered", ("veneur_tpu.core.tiered", "_promote_rows")),
     "veneur_tpu/core/tiered.py::_pool_flush":
         ("flush.digest.tiered", ("veneur_tpu.core.tiered", "_pool_flush")),
+    # fleet mode (veneur_tpu/fleet/, core/mesh_store.py): the sharded
+    # shard_map programs — module-level jit defs with the Mesh static,
+    # so the inventory drift-check covers them like any other program
+    "veneur_tpu/core/mesh_store.py::_mesh_ingest_samples":
+        ("drain.digest.mesh",
+         ("veneur_tpu.core.mesh_store", "_mesh_ingest_samples")),
+    "veneur_tpu/core/mesh_store.py::_mesh_import_routed":
+        ("drain.digest.mesh",
+         ("veneur_tpu.core.mesh_store", "_mesh_import_routed")),
+    "veneur_tpu/core/mesh_store.py::_mesh_flush_digests":
+        ("flush.digest.mesh",
+         ("veneur_tpu.core.mesh_store", "_mesh_flush_digests")),
+    "veneur_tpu/core/mesh_store.py::_mesh_ingest_hashes":
+        ("drain.set.mesh",
+         ("veneur_tpu.core.mesh_store", "_mesh_ingest_hashes")),
+    "veneur_tpu/core/mesh_store.py::_mesh_merge_registers":
+        ("drain.set.mesh",
+         ("veneur_tpu.core.mesh_store", "_mesh_merge_registers")),
+    "veneur_tpu/core/mesh_store.py::_mesh_estimate":
+        ("flush.set.mesh",
+         ("veneur_tpu.core.mesh_store", "_mesh_estimate")),
+    "veneur_tpu/fleet/mesh_tiered.py::_mesh_pool_ingest":
+        ("drain.digest.mesh_tiered",
+         ("veneur_tpu.fleet.mesh_tiered", "_mesh_pool_ingest")),
+    "veneur_tpu/fleet/mesh_tiered.py::_mesh_pool_import":
+        ("drain.digest.mesh_tiered",
+         ("veneur_tpu.fleet.mesh_tiered", "_mesh_pool_import")),
+    "veneur_tpu/fleet/mesh_tiered.py::_mesh_promote_rows":
+        ("drain.digest.mesh_tiered",
+         ("veneur_tpu.fleet.mesh_tiered", "_mesh_promote_rows")),
+    "veneur_tpu/fleet/mesh_tiered.py::_mesh_pool_restore_stats":
+        ("drain.digest.mesh_tiered",
+         ("veneur_tpu.fleet.mesh_tiered", "_mesh_pool_restore_stats")),
+    "veneur_tpu/fleet/mesh_tiered.py::_mesh_pool_flush":
+        ("flush.digest.mesh_tiered",
+         ("veneur_tpu.fleet.mesh_tiered", "_mesh_pool_flush")),
 }
 
 

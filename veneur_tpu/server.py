@@ -252,22 +252,21 @@ class Server:
         # A global instance can shard its store over every visible chip
         # (the reference scales its global tier with more worker goroutines
         # + proxy hash rings; here the series axis shards over the mesh,
-        # importsrv/server.go:101-132 → parallel/mesh.py)
+        # importsrv/server.go:101-132 → veneur_tpu/fleet/). A local with
+        # mesh_enabled is a config contradiction: config.validate()
+        # rejects it at load, and this re-check covers directly
+        # constructed Configs (tests, embedders) — silently ignoring the
+        # key hid mis-deployed fleets until someone read the logs.
         mesh = None
         if config.mesh_enabled and config.forward_address:
-            log.warning("mesh_enabled ignored: this is a local instance "
-                        "(forward_address is set); only the global tier "
-                        "shards its store")
-        elif config.mesh_enabled:
-            import jax
+            raise ValueError(
+                "mesh_enabled requires a GLOBAL instance, but "
+                "forward_address is set; unset one of them "
+                "(config.validate rejects this combination at load)")
+        if config.mesh_enabled:
+            from veneur_tpu.fleet import build_mesh
 
-            from veneur_tpu.parallel.mesh import fleet_mesh
-
-            n = len(jax.devices())
-            hosts = config.mesh_hosts or (2 if n % 2 == 0 else 1)
-            mesh = fleet_mesh(jax.devices(), hosts=hosts)
-            log.info("global store sharded over %d devices (%s)", n,
-                     dict(mesh.shape))
+            mesh = build_mesh(config)
         # hot-path overload governance (veneur_tpu/overload.py,
         # docs/resilience.md "Degradation ladder"): bounded per-group
         # cardinality, the numerics quarantine ledger, the watermark
