@@ -2504,6 +2504,130 @@ def run_isolated(fn_name: str, timeout: float = 560.0):
         return {"error": str(e)[:160]}
 
 
+def bench_reshard(num_series: int = 1 << 16, centroids: int = 8,
+                  counters: int = 8192):
+    """Config #12: elastic-resharding handoff (fleet/handoff.py) —
+    wall-clock of extract → packed-wire encode → decode → import-
+    semantics merge at two moved-key fractions (grow 2→3 ≈ 1/3 of the
+    keyspace; drain 1→2 = all of it), with the exact-conservation
+    check built into the lane (counter totals + digest centroid mass
+    across sender + receivers must equal the ingested totals). The
+    stream here is the in-process wire round trip: socket time is the
+    ordinary POST the 9_proxy lane already prices, while the extract/
+    quantize/merge compute measured here is what handoff adds. Scales
+    with the chip via num_series; the default is probe scale for this
+    container's CPU."""
+    from veneur_tpu.core.store import MetricStore
+    from veneur_tpu.fleet import RingTransition
+    from veneur_tpu.fleet.handoff import decode_handoff, encode_handoff
+    from veneur_tpu.samplers.intermetric import HistogramAggregates
+    from veneur_tpu.samplers.parser import MetricKey
+
+    agg = HistogramAggregates.from_names(["count"])
+    rng = np.random.default_rng(0)
+    means = np.sort(rng.gamma(2.0, 40.0, (num_series, centroids)), axis=1)
+    w_run = np.ones(centroids, np.float64)
+
+    def fill(store, owns):
+        """Populate only the series the OLD ring assigns to this
+        instance (the proxy routed them here), so the moved fraction
+        is the realistic ring-movement share, not a whole-keyspace
+        sweep."""
+        n_c = n_t = 0
+        for i in range(counters):
+            if not owns(f"c{i}", "counter"):
+                continue
+            store.import_counter(
+                MetricKey(name=f"c{i}", type="counter",
+                          joined_tags=""), [], 3)
+            n_c += 1
+        entries = []
+        for i in range(num_series):
+            if not owns(f"t{i}", "timer"):
+                continue
+            entries.append(
+                (MetricKey(name=f"t{i}", type="timer",
+                           joined_tags=""), [], means[i], w_run,
+                 float(means[i, 0]), float(means[i, -1])))
+            n_t += 1
+        store.import_digests_bulk(entries)
+        return n_c + n_t, 3 * n_c, float(n_t * centroids)
+
+    def totals(store):
+        _final, fwd, _ms = store.flush([0.5], agg, is_local=True,
+                                       now=0, forward=True,
+                                       columnar=False)
+        c = sum(v for _n, _t, v in fwd.counters)
+        w = sum(float(np.sum(wts)) for _n, _t, _m, wts, _mn, _mx
+                in fwd.histograms + fwd.timers)
+        return c, w
+
+    def phase(old_members, new_members, self_addr):
+        store = MetricStore(initial_capacity=1 << 12, chunk=16384)
+        tr = RingTransition(old_members, new_members)
+        resident, total_c, total_w = fill(
+            store, lambda name, mtype:
+            tr.old_owner(name, mtype, "") == self_addr)
+
+        def route(name, mtype, joined):
+            dest = tr.new_owner(name, mtype, joined)
+            return None if dest == self_addr else dest
+
+        def route_many(names, mtype, joineds):
+            return [None if d == self_addr else d
+                    for d in tr.new_owners(names, mtype, joineds)]
+
+        t0 = time.perf_counter()
+        moved, n_moved = store.handoff_extract(route,
+                                               route_many=route_many)
+        t_extract = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        blobs = {d: encode_handoff(g, {"id": d, "sender": self_addr,
+                                       "epoch": 1}, 0.0)
+                 for d, g in moved.items()}
+        t_encode = time.perf_counter() - t0
+        wire_mb = sum(len(b) for b in blobs.values()) / 2 ** 20
+        t0 = time.perf_counter()
+        recv_c = recv_w = 0.0
+        for _dest, blob in sorted(blobs.items()):
+            groups, _meta = decode_handoff(blob)
+            recv = MetricStore(initial_capacity=1 << 12, chunk=16384)
+            recv.restore_state(groups)
+            c, w = totals(recv)
+            recv_c += c
+            recv_w += w
+        t_merge = time.perf_counter() - t0
+        live_c, live_w = totals(store)
+        conserved = (live_c + recv_c == total_c
+                     and abs(live_w + recv_w - total_w)
+                     <= 1e-6 * total_w)
+        return {
+            "resident_series": resident,
+            "moved_fraction": round(n_moved / max(1, resident), 3),
+            "extract_s": round(t_extract, 2),
+            "wire_encode_s": round(t_encode, 2),
+            "merge_s": round(t_merge, 2),
+            "total_s": round(t_extract + t_encode + t_merge, 2),
+            "wire_mb": round(wire_mb, 1),
+            "conserved": conserved,
+        }
+
+    out = {
+        "series": num_series + counters,
+        "centroids_per_series": centroids,
+        # grow 2→3: every incumbent loses ~1/3 of the ring to the
+        # newcomer — the weekly scale-out shape
+        "grow_2_to_3": phase(["g-a", "g-b"], ["g-a", "g-b", "g-c"],
+                             "g-a"),
+        # drain 1→2: a departing instance hands off its whole keyspace
+        # — the scale-in / decommission shape
+        "drain_all": phase(["g-a"], ["g-b", "g-c"], "g-a"),
+    }
+    out["conserved"] = (out["grow_2_to_3"]["conserved"]
+                        and out["drain_all"]["conserved"])
+    return out
+
+
 def run_tpu_smoke(timeout: float = 560.0) -> dict:
     """Run the @pytest.mark.tpu hardware subset in the bench environment
     (VENEUR_TPU_TESTS=1 → real accelerator) and report pass/fail — each
@@ -2630,6 +2754,11 @@ def _lane_plan(result, guarded):
         # 8-device virtual mesh (subprocess; see bench_fleet_mesh for
         # why the curve, not the speedup, is the signal here)
         ("11_fleet", guarded(bench_fleet_mesh), 600),
+        # elastic resharding: handoff wall-clock vs moved-key fraction
+        # with the conservation check built in (fleet/handoff.py;
+        # isolated so the stores never touch the parent's HBM)
+        ("12_reshard",
+         lambda t: run_isolated("bench_reshard", timeout=t), 560),
     ]
 
 
@@ -2740,6 +2869,8 @@ def _headline(result) -> dict:
             "9_proxy": pick("9_proxy_fanout", "metrics_per_s",
                             "forward_errors"),
             "11_fleet": pick("11_fleet", "per_shards", "series"),
+            "12_reshard": pick("12_reshard", "grow_2_to_3",
+                               "drain_all", "series", "conserved"),
         },
         "detail_file": "BENCH_DETAIL.json",
     }

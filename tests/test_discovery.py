@@ -1,0 +1,227 @@
+"""Discovery refresh path: refresh → ring diff → moved-range
+computation, including the no-op refresh, keep-last-good on
+failure/empty, the single-member degenerate cases, the ``file://``
+peers flavor, and the seeded membership-churn fault kinds
+(``resilience/faults.py``) wired into the refresh.
+
+The Consul/Kubernetes HTTP discoverers are covered in
+``tests/test_proxy.py`` (fake Consul); this file owns the ring-change
+machinery itself — the layer PR 12's elastic resharding drives.
+"""
+
+import pytest
+
+from veneur_tpu.discovery import (FilePeersDiscoverer, MembershipChange,
+                                  RingWatcher, StaticDiscoverer)
+from veneur_tpu.fleet import RingTransition, ring_key
+from veneur_tpu.proxy.proxy import metric_ring_key
+from veneur_tpu.resilience import faults as rfaults
+
+
+class MutableDiscoverer:
+    """A StaticDiscoverer whose membership the test mutates between
+    refreshes (the shape every resize test drives)."""
+
+    def __init__(self, members):
+        self.members = list(members)
+        self.fail = False
+
+    def get_destinations_for_service(self, service_name):
+        if self.fail:
+            raise OSError("discovery down")
+        return list(self.members)
+
+
+class TestRingWatcher:
+    def test_first_refresh_adopts(self):
+        w = RingWatcher(StaticDiscoverer(["a", "b"]), "svc")
+        change = w.refresh()
+        assert isinstance(change, MembershipChange)
+        assert change.old == [] and change.new == ["a", "b"]
+        assert w.members == ["a", "b"]
+
+    def test_noop_refresh_returns_none(self):
+        w = RingWatcher(StaticDiscoverer(["a", "b"]), "svc")
+        assert w.refresh() is not None
+        assert w.refresh() is None  # unchanged membership
+        assert w.changes == 1 and w.refreshes == 2
+
+    def test_membership_change_diff(self):
+        d = MutableDiscoverer(["a", "b"])
+        w = RingWatcher(d, "svc")
+        w.refresh()
+        d.members = ["a", "b", "c"]
+        change = w.refresh()
+        assert change.added == ["c"] and change.removed == []
+        d.members = ["a", "c"]
+        change = w.refresh()
+        assert change.added == [] and change.removed == ["b"]
+
+    def test_failure_keeps_last_good(self):
+        d = MutableDiscoverer(["a", "b"])
+        w = RingWatcher(d, "svc")
+        w.refresh()
+        d.fail = True
+        assert w.refresh() is None
+        assert w.members == ["a", "b"] and w.failures == 1
+
+    def test_empty_result_keeps_last_good(self):
+        d = MutableDiscoverer(["a", "b"])
+        w = RingWatcher(d, "svc")
+        w.refresh()
+        d.members = []
+        assert w.refresh() is None
+        assert w.members == ["a", "b"] and w.failures == 1
+
+    def test_duplicate_and_order_normalized(self):
+        d = MutableDiscoverer(["b", "a", "b"])
+        w = RingWatcher(d, "svc")
+        assert w.refresh().new == ["a", "b"]
+        d.members = ["a", "b"]
+        assert w.refresh() is None  # same set, different order = no-op
+
+    def test_single_member_degenerate(self):
+        # 1 → 2: the lone member loses ~half its ranges
+        d = MutableDiscoverer(["a"])
+        w = RingWatcher(d, "svc")
+        w.refresh()
+        d.members = ["a", "b"]
+        change = w.refresh()
+        tr = RingTransition(change.old, change.new)
+        assert tr.loses_ranges("a")
+        moved = sum(1 for i in range(200)
+                    if tr.moved(f"m{i}", "counter", ""))
+        assert 0 < moved < 200
+        # 2 → 1: the survivor keeps serving; the departed loses all
+        d.members = ["a"]
+        change = w.refresh()
+        tr = RingTransition(change.old, change.new)
+        assert all(tr.new_owner(f"m{i}", "counter", "") == "a"
+                   for i in range(50))
+
+
+class TestFilePeers:
+    def test_reads_one_address_per_line(self, tmp_path):
+        p = tmp_path / "peers"
+        p.write_text("# the global fleet\na:8127\n\nb:8127\n")
+        d = FilePeersDiscoverer(str(p))
+        assert d.get_destinations_for_service("x") == ["a:8127", "b:8127"]
+
+    def test_missing_file_keeps_last_good_through_watcher(self, tmp_path):
+        p = tmp_path / "peers"
+        p.write_text("a:8127\n")
+        w = RingWatcher(FilePeersDiscoverer(str(p)), "svc")
+        assert w.refresh().new == ["a:8127"]
+        p.unlink()
+        assert w.refresh() is None
+        assert w.members == ["a:8127"]
+
+    def test_rewrite_is_one_transition(self, tmp_path):
+        p = tmp_path / "peers"
+        p.write_text("a:8127\n")
+        w = RingWatcher(FilePeersDiscoverer(str(p)), "svc")
+        w.refresh()
+        p.write_text("a:8127\nb:8127\n")
+        change = w.refresh()
+        assert change.added == ["b:8127"]
+        assert w.refresh() is None
+
+
+class TestRingTransitionRule:
+    def test_same_rule_as_proxy(self):
+        """The moved-range computation hashes the proxy's exact
+        metric_ring_key string, so instance routing and handoff
+        ownership agree by construction."""
+        members = ["g1:8127", "g2:8127", "g3:8127"]
+        tr = RingTransition(members, members + ["g4:8127"])
+        for i in range(100):
+            d = {"name": f"m{i}", "type": "timer",
+                 "tags": ["env:prod", f"shard:{i % 4}"]}
+            key = metric_ring_key(d)
+            assert key == ring_key(d["name"], d["type"],
+                                   ",".join(d["tags"]))
+            assert tr.new_ring.get(key) == tr.new_owner(
+                d["name"], d["type"], ",".join(d["tags"]))
+
+    def test_minimal_movement_on_grow(self):
+        tr = RingTransition(["a", "b", "c"], ["a", "b", "c", "d"])
+        keys = [(f"m{i}", "counter", "") for i in range(1000)]
+        moved = [k for k in keys if tr.moved(*k)]
+        # only ~1/4 of the space moves, and all of it to the new member
+        assert 0 < len(moved) < 500
+        assert all(tr.new_owner(*k) == "d" for k in moved)
+
+    def test_no_change_no_ranges_lost(self):
+        tr = RingTransition(["a", "b"], ["a", "b"])
+        assert not tr.loses_ranges("a")
+
+
+class TestChurnFaults:
+    def test_churn_kinds_not_in_all_kinds(self):
+        """Adding churn kinds must not perturb the seeded transport
+        schedules existing soaks reproduce (same contract as the
+        ingest kinds)."""
+        for k in rfaults.CHURN_KINDS:
+            assert k not in rfaults.ALL_KINDS
+            assert k not in rfaults.INGEST_KINDS
+
+    def test_seeded_schedules_reproduce(self):
+        a = rfaults.FaultInjector(0.5, seed=7, kinds=rfaults.CHURN_KINDS)
+        b = rfaults.FaultInjector(0.5, seed=7, kinds=rfaults.CHURN_KINDS)
+        members = ["m1", "m2", "m3"]
+        seq_a = [a.mangle_members("discovery.refresh", members)
+                 for _ in range(30)]
+        seq_b = [b.mangle_members("discovery.refresh", members)
+                 for _ in range(30)]
+        assert seq_a == seq_b
+
+    def test_member_add_appends_synthetic(self):
+        inj = rfaults.FaultInjector(1.0, seed=1,
+                                    kinds=(rfaults.KIND_MEMBER_ADD,))
+        out = inj.mangle_members("discovery.refresh", ["a", "b"])
+        assert out[:2] == ["a", "b"] and len(out) == 3
+        assert out[2].startswith("fault://injected-")
+
+    def test_member_remove_never_empties(self):
+        inj = rfaults.FaultInjector(1.0, seed=2,
+                                    kinds=(rfaults.KIND_MEMBER_REMOVE,))
+        assert len(inj.mangle_members("discovery.refresh",
+                                      ["a", "b"])) == 1
+        # a single member survives removal faults
+        assert inj.mangle_members("discovery.refresh", ["a"]) == ["a"]
+
+    def test_partition_blackholes_then_heals(self):
+        inj = rfaults.FaultInjector(1.0, seed=3,
+                                    kinds=(rfaults.KIND_PARTITION,))
+        members = ["a", "b", "c"]
+        out = inj.mangle_members("discovery.refresh", members)
+        assert out == members  # membership untouched
+        hit = [m for m in members if inj.is_partitioned(m)]
+        assert len(hit) == 1
+        # partitions heal after PARTITION_INTERVALS refreshes; the
+        # rate-1.0 injector schedules a new partition every refresh,
+        # so drive the tick-down with a zero-rate twin state
+        inj.rate = 0.0
+        for _ in range(rfaults.PARTITION_INTERVALS):
+            assert inj.is_partitioned(hit[0])
+            inj.mangle_members("discovery.refresh", members)
+        assert not inj.is_partitioned(hit[0])
+
+    def test_transport_paths_pass_churn_through(self):
+        inj = rfaults.FaultInjector(1.0, seed=4,
+                                    kinds=rfaults.CHURN_KINDS)
+        inj.maybe_fail("forward.http")  # must not raise
+        wrapped = inj.wrap_post(lambda *a, **k: 202, "proxy.post")
+        assert wrapped() == 202
+
+    def test_watcher_applies_churn(self):
+        inj = rfaults.FaultInjector(1.0, seed=5,
+                                    kinds=(rfaults.KIND_MEMBER_ADD,))
+        w = RingWatcher(StaticDiscoverer(["a", "b"]), "svc",
+                        injector=inj)
+        change = w.refresh()
+        assert any(m.startswith("fault://") for m in change.new)
+
+    def test_unknown_kind_rejected(self):
+        with pytest.raises(ValueError):
+            rfaults.FaultInjector(0.1, kinds=("member_addd",))

@@ -1,0 +1,707 @@
+"""Elastic fleet resharding (veneur_tpu/fleet/handoff.py): snapshot
+split + packed wire round trips, the store's epoch-guarded range
+extraction, the manager's HTTP stream with id/epoch idempotency
+guards, requeue-on-failure (late, never lost), spool crash recovery,
+and the resize acceptance test — grow 2→3 and shrink 3→2 under
+sustained mixed ingest with exact count conservation.
+
+The SIGKILL chaos soaks live in ``tests/test_handoff_e2e.py``
+(marker: ``slow``).
+"""
+
+import json
+import socket
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from veneur_tpu.config import Config
+from veneur_tpu.core.store import MetricStore
+from veneur_tpu.discovery import RingWatcher
+from veneur_tpu.fleet import RingTransition, ring_key
+from veneur_tpu.fleet.handoff import (HandoffManager, decode_handoff,
+                                      encode_handoff,
+                                      pack_digest_snapshot,
+                                      split_group_snapshot,
+                                      unpack_digest_snapshot)
+from veneur_tpu.proxy.consistent import ConsistentRing
+from veneur_tpu.samplers.intermetric import HistogramAggregates
+from veneur_tpu.samplers.parser import MetricKey, parse_metric
+from veneur_tpu.server import Server
+from veneur_tpu.sinks import ChannelMetricSink
+
+AGG = HistogramAggregates.from_names(["min", "max", "count"])
+
+
+def make_store(**kw):
+    kw.setdefault("initial_capacity", 32)
+    kw.setdefault("chunk", 128)
+    return MetricStore(**kw)
+
+
+def fill_store(store, n=30, seed=0):
+    """Mixed ring-routable state: imported global counters, imported
+    timer digests (mass = centroid weight), imported HLL sets. Returns
+    (counter_total, digest_weight_total)."""
+    rng = np.random.default_rng(seed)
+    ctotal = 0
+    wtotal = 0.0
+    for i in range(n):
+        store.import_counter(
+            MetricKey(name=f"m{i}", type="counter", joined_tags=""),
+            [], 10 + i)
+        ctotal += 10 + i
+        vals = np.sort(rng.normal(100.0, 10.0, 20))
+        store.import_digest(
+            MetricKey(name=f"t{i}", type="timer", joined_tags=""),
+            [], vals, np.ones(20), float(vals[0]), float(vals[-1]))
+        wtotal += 20.0
+        regs = np.zeros(1 << store.sets.precision, np.uint8)
+        regs[i % 100] = 3
+        store.import_set(
+            MetricKey(name=f"s{i}", type="set", joined_tags=""),
+            [], regs)
+    return ctotal, wtotal
+
+
+def flush_totals(store, percentiles=(0.5,)):
+    """Global-role flush → (counter total by name m*, digest weight
+    total, names seen). Digest mass is measured as forwarded centroid
+    weight (imports deliberately skip the local count stats,
+    samplers.go:473-480)."""
+    final, fwd, _ = store.flush(list(percentiles), AGG, is_local=True,
+                                now=0, forward=True, columnar=False)
+    ctotal = sum(v for name, tags, v in fwd.counters
+                 if name.startswith("m"))
+    wtotal = sum(float(np.sum(w))
+                 for _, _, _mns, w, _mn, _mx in fwd.histograms + fwd.timers)
+    names = {name for name, _, _ in fwd.counters}
+    return ctotal, wtotal, names
+
+
+class TestSplitAndPack:
+    def test_split_partitions_every_row_exactly_once(self):
+        store = make_store()
+        fill_store(store, n=40)
+        snap = store.timers.snapshot_state()
+        parts = split_group_snapshot(
+            snap, "timer",
+            lambda name, t, j: None if int(name[1:]) % 3 == 0
+            else f"dest{int(name[1:]) % 3}")
+        names = [n for p in parts.values() for n in p["names"]]
+        assert sorted(names) == sorted(snap["names"])
+        total_w = sum(float(np.sum(p.get("weights", ())))
+                      for p in parts.values())
+        assert total_w == pytest.approx(float(np.sum(snap["weights"])))
+        # per-row stats follow their row
+        for p in parts.values():
+            assert len(p["count"]) == len(p["names"])
+
+    def test_veneur_series_always_kept(self):
+        store = make_store()
+        store.import_counter(
+            MetricKey(name="veneur.something", type="counter",
+                      joined_tags=""), [], 5)
+        snap = store.global_counters.snapshot_state()
+        parts = split_group_snapshot(snap, "counter",
+                                     lambda *a: "elsewhere")
+        assert list(parts) == [None]
+
+    def test_pack_unpack_round_trip(self):
+        store = make_store()
+        fill_store(store, n=10)
+        snap = store.timers.snapshot_state()
+        orig_means = np.asarray(snap["means"], np.float64).copy()
+        orig_weights = np.asarray(snap["weights"], np.float64).copy()
+        packed = pack_digest_snapshot(dict(snap))
+        assert packed["packed"] and "means" not in packed
+        assert packed["means_q"].dtype == np.uint16
+        assert packed["weights_bf"].dtype == np.uint16
+        out = unpack_digest_snapshot(packed)
+        # u16 range quantization: within span/65535 of the original
+        spans = np.asarray(out["pspan"] if "pspan" in out else [],
+                           np.float64)
+        assert np.all(np.abs(out["means"] - orig_means)
+                      <= (orig_means.max() - orig_means.min()) / 65000
+                      + 1e-9)
+        # unit weights are exact in bfloat16
+        assert np.array_equal(out["weights"], orig_weights)
+        # order within each row preserved (the restore staging depends
+        # on sorted-by-(row, mean) runs)
+        rows = np.asarray(out["rows"], np.int64)
+        for r in np.unique(rows):
+            run = out["means"][rows == r]
+            assert np.all(np.diff(run) >= 0)
+
+    def test_wire_round_trip_and_corruption(self):
+        store = make_store()
+        fill_store(store, n=8)
+        groups = {"timers": store.timers.snapshot_state(),
+                  "global_counters":
+                      store.global_counters.snapshot_state()}
+        meta = {"id": "h1", "sender": "a", "epoch": 3}
+        blob = encode_handoff(groups, meta, created_at=123.0)
+        out_groups, out_meta = decode_handoff(blob)
+        assert out_meta["id"] == "h1" and out_meta["epoch"] == 3
+        assert sorted(out_groups) == ["global_counters", "timers"]
+        assert "means" in out_groups["timers"]  # unpacked for restore
+        from veneur_tpu.persist import CheckpointInvalid
+
+        with pytest.raises(CheckpointInvalid):
+            decode_handoff(blob[:-7])
+        with pytest.raises(CheckpointInvalid):
+            decode_handoff(b"garbage" + blob[7:])
+
+
+class TestStoreExtract:
+    def test_extract_everything_then_restore_conserves(self):
+        store = make_store()
+        ctotal, wtotal = fill_store(store)
+        moved, n = store.handoff_extract(lambda *a: "dest")
+        assert n > 0 and list(moved) == ["dest"]
+        # the moved state is GONE from the live store
+        c0, w0, _ = flush_totals(store)
+        assert c0 == 0 and w0 == 0.0
+        # requeue path: restore into the live store → nothing lost
+        store.restore_state(moved["dest"])
+        c1, w1, _ = flush_totals(store)
+        assert c1 == ctotal
+        assert w1 == pytest.approx(wtotal)
+
+    def test_kept_rows_survive_in_place(self):
+        store = make_store()
+        ctotal, wtotal = fill_store(store)
+        keep = lambda name, t, j: (None if int(name[1:]) % 2 == 0
+                                   else "dest")
+        moved, n_moved = store.handoff_extract(keep)
+        c_live, w_live, _ = flush_totals(store)
+        recv = make_store()
+        recv.restore_state(moved["dest"])
+        c_moved, w_moved, _ = flush_totals(recv)
+        assert c_live + c_moved == ctotal
+        assert w_live + w_moved == pytest.approx(wtotal)
+        assert c_live > 0 and c_moved > 0
+
+    def test_epoch_bumps_and_tallies_recredit(self):
+        store = make_store()
+        fill_store(store, n=5)
+        processed0 = store.processed
+        imported0 = store.imported
+        epoch0 = store.flush_epoch
+        store.handoff_extract(lambda *a: None)
+        assert store.flush_epoch == epoch0 + 1  # the swap IS the guard
+        assert store.imported == imported0
+        assert store.processed == processed0
+
+    def test_concurrent_ingest_conserved(self):
+        """Samples racing the extraction land in either the retired
+        generation (and move/stay with it) or the fresh live one —
+        never both, never neither."""
+        store = make_store()
+        stop = threading.Event()
+        sent = [0]
+
+        def ingest():
+            i = 0
+            while not stop.is_set():
+                store.import_counter(
+                    MetricKey(name=f"m{i % 50}", type="counter",
+                              joined_tags=""), [], 1)
+                sent[0] += 1
+                i += 1
+
+        t = threading.Thread(target=ingest, daemon=True)
+        t.start()
+        time.sleep(0.05)
+        moved_all = []
+        for _ in range(4):
+            moved, _n = store.handoff_extract(
+                lambda name, ty, j: "dest"
+                if int(name[1:]) % 2 else None)
+            moved_all.append(moved)
+            time.sleep(0.02)
+        stop.set()
+        t.join(timeout=5)
+        recv = make_store()
+        for moved in moved_all:
+            if "dest" in moved:
+                recv.restore_state(moved["dest"])
+        c_live, _, _ = flush_totals(store)
+        c_recv, _, _ = flush_totals(recv)
+        assert c_live + c_recv == sent[0]
+
+
+def _wait(predicate, timeout=20.0, msg="condition"):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if predicate():
+            return
+        time.sleep(0.05)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+class MutableDiscoverer:
+    def __init__(self, members):
+        self.members = list(members)
+
+    def get_destinations_for_service(self, service_name):
+        return list(self.members)
+
+
+def make_handoff_global(tag, **kw):
+    cfg = Config(statsd_listen_addresses=[], interval="86400s",
+                 http_address="127.0.0.1:0", percentiles=[0.5],
+                 aggregates=["count"], store_initial_capacity=32,
+                 store_chunk=128, flush_columnar=False,
+                 handoff_enabled=True, handoff_self=f"pending-{tag}",
+                 handoff_peers=f"pending-{tag}",
+                 handoff_refresh_interval="86400s",
+                 handoff_timeout="5s", retry_max=1,
+                 retry_base_interval="10ms", **kw)
+    sink = ChannelMetricSink()
+    server = Server(cfg, metric_sinks=[sink])
+    server.start()
+    addr = f"127.0.0.1:{server.ops_server.port}"
+    server.handoff_manager.self_addr = addr
+    return server, sink, addr
+
+
+def drain_flush_totals(server, sink):
+    server.flush()
+    metrics = sink.get_flush()
+    ctotal = sum(m.value for m in metrics
+                 if m.type.name == "COUNTER" and m.name.startswith("gc"))
+    tcount = sum(m.value for m in metrics if m.name.endswith(".count")
+                 and not m.name.startswith("veneur."))
+    return ctotal, tcount
+
+
+class TestManagerHTTP:
+    def test_handoff_over_http_and_idempotency(self):
+        a, sink_a, addr_a = make_handoff_global("a")
+        b, sink_b, addr_b = make_handoff_global("b")
+        try:
+            disc = MutableDiscoverer([addr_a])
+            mgr = a.handoff_manager
+            mgr.watcher = RingWatcher(disc, "test")
+            assert mgr.refresh()["adopted"] == [addr_a]
+            ctotal, wtotal = fill_store(a.store, n=30)
+            disc.members = [addr_a, addr_b]
+            summary = mgr.refresh()
+            assert summary["moved_series"] > 0
+            assert summary["sent"] == [addr_b]
+            assert summary["requeued"] == []
+            assert b.handoff_manager.received_series_total \
+                == summary["moved_series"]
+            c_a, w_a, _ = flush_totals(a.store)
+            c_b, w_b, _ = flush_totals(b.store)
+            assert c_a + c_b == ctotal
+            assert w_a + w_b == pytest.approx(wtotal)
+            assert c_b > 0  # something actually moved over the wire
+        finally:
+            a.shutdown()
+            b.shutdown()
+
+    def test_duplicate_post_acks_without_remerging(self):
+        b, _sink, addr_b = make_handoff_global("dup")
+        try:
+            store = make_store()
+            fill_store(store, n=6)
+            groups = {"global_counters":
+                      store.global_counters.snapshot_state()}
+            blob = encode_handoff(groups, {"id": "dup-1", "sender": "x",
+                                           "epoch": 1}, 0.0)
+            url = f"http://{addr_b}/handoff"
+
+            def post():
+                req = urllib.request.Request(
+                    url, data=blob, method="POST",
+                    headers={"Content-Type": "application/octet-stream"})
+                with urllib.request.urlopen(req, timeout=10) as resp:
+                    return resp.status, json.loads(resp.read())
+
+            status, body = post()
+            assert status == 200 and body["merged"] == 6
+            status, body = post()
+            assert status == 200 and body.get("duplicate") is True
+            assert b.handoff_manager.duplicates_total == 1
+            # merged exactly once
+            c, _, _ = flush_totals(b.store)
+            assert c == sum(10 + i for i in range(6))
+            # the status probe answers complete for the seen id
+            with urllib.request.urlopen(
+                    f"http://{addr_b}/handoff-status?id=dup-1",
+                    timeout=10) as resp:
+                assert json.loads(resp.read())["complete"] is True
+            with urllib.request.urlopen(
+                    f"http://{addr_b}/handoff-status?id=nope",
+                    timeout=10) as resp:
+                assert json.loads(resp.read())["complete"] is False
+        finally:
+            b.shutdown()
+
+    def test_stale_epoch_rejected(self):
+        b, _sink, addr_b = make_handoff_global("stale")
+        try:
+            store = make_store()
+            fill_store(store, n=3)
+            groups = {"global_counters":
+                      store.global_counters.snapshot_state()}
+
+            def post(hid, epoch):
+                blob = encode_handoff(
+                    groups, {"id": hid, "sender": "s",
+                             "epoch": epoch}, 0.0)
+                req = urllib.request.Request(
+                    f"http://{addr_b}/handoff", data=blob, method="POST")
+                try:
+                    with urllib.request.urlopen(req, timeout=10) as r:
+                        return r.status
+                except urllib.error.HTTPError as e:
+                    e.close()
+                    return e.code
+
+            import urllib.error
+
+            assert post("e5", 5) == 200
+            assert post("e4", 4) == 409  # replay of a superseded epoch
+            assert b.handoff_manager.stale_total == 1
+        finally:
+            b.shutdown()
+
+    def test_malformed_body_400(self):
+        b, _sink, addr_b = make_handoff_global("bad")
+        try:
+            import urllib.error
+
+            req = urllib.request.Request(
+                f"http://{addr_b}/handoff", data=b"not a handoff",
+                method="POST")
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(req, timeout=10)
+            assert ei.value.code == 400
+            ei.value.close()
+        finally:
+            b.shutdown()
+
+
+class TestFailureLadder:
+    def test_unreachable_destination_requeues(self, tmp_path):
+        """The receiver is a dead port: retries exhaust inside the
+        handoff deadline, the completion probe fails, and the moved
+        ranges re-merge into the live store — late, never lost. The
+        spool file is cleaned up either way."""
+        store = make_store()
+        ctotal, wtotal = fill_store(store)
+        # a port nothing listens on (bind+close reserves a dead one)
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        dead = f"127.0.0.1:{s.getsockname()[1]}"
+        s.close()
+        from veneur_tpu.resilience import RetryPolicy
+
+        disc = MutableDiscoverer(["self"])
+        mgr = HandoffManager(
+            store, "self", RingWatcher(disc, "t"), timeout=2.0,
+            retry_policy=RetryPolicy(max_attempts=2,
+                                     base_interval=0.01),
+            spool_prefix=str(tmp_path / "v.ckpt"))
+        assert mgr.refresh()["adopted"] == ["self"]
+        disc.members = ["self", dead]
+        summary = mgr.refresh()
+        assert summary["requeued"] == [dead]
+        assert mgr.send_failures_total == 1
+        assert mgr.requeued_series_total == summary["moved_series"]
+        assert not list(tmp_path.glob("*.handoff.*"))
+        c, w, _ = flush_totals(store)
+        assert c == ctotal and w == pytest.approx(wtotal)
+
+    def test_partition_fault_blackholes_then_requeues(self):
+        """A seeded partition fault black-holes the destination at the
+        transport (keyed by the bare membership address, the same
+        string mangle_members drew): the handoff fails WITHOUT touching
+        the network and the state requeues — the
+        resize-under-partition soak shape."""
+        from veneur_tpu.resilience import RetryPolicy
+        from veneur_tpu.resilience import faults as rfaults
+
+        store = make_store()
+        ctotal, _ = fill_store(store, n=10)
+        inj = rfaults.FaultInjector(0.0, kinds=rfaults.CHURN_KINDS)
+        # a LIVE listener: if the partition hook failed to fire, the
+        # POST would actually connect — the old keying bug this test
+        # now pins (the injected partition must win before the socket)
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        s.listen(1)
+        try:
+            dest = f"127.0.0.1:{s.getsockname()[1]}"
+            inj._partitions[dest] = 10
+            disc = MutableDiscoverer(["self"])
+            mgr = HandoffManager(
+                store, "self", RingWatcher(disc, "t"), timeout=1.0,
+                retry_policy=RetryPolicy(max_attempts=1,
+                                         base_interval=0.01),
+                injector=inj)
+            mgr.refresh()
+            disc.members = ["self", dest]
+            summary = mgr.refresh()
+            assert summary["requeued"] == [dest]
+            assert "injected partition" in mgr.last_error
+            c, _, _ = flush_totals(store)
+            assert c == ctotal
+        finally:
+            s.close()
+
+    def test_spool_recovery_merges_and_cleans(self, tmp_path):
+        """Spool whose destination is unreachable: the re-send fails,
+        the state merges back locally, the files clean up."""
+        store = make_store()
+        donor = make_store()
+        ctotal, wtotal = fill_store(donor)
+        groups = {
+            "global_counters": donor.global_counters.snapshot_state(),
+            "timers": donor.timers.snapshot_state()}
+        blob = encode_handoff(groups, {"id": "sp1", "sender": "s",
+                                       "epoch": 2,
+                                       "dest": "127.0.0.1:9"}, 0.0)
+        prefix = str(tmp_path / "v.ckpt")
+        from veneur_tpu.persist import write_atomic
+        from veneur_tpu.resilience import RetryPolicy
+
+        write_atomic(prefix + ".handoff.2.0", blob)
+        (tmp_path / "v.ckpt.handoff.2.1.tmp").write_bytes(b"partial")
+        disc = MutableDiscoverer(["self"])
+        mgr = HandoffManager(store, "self", RingWatcher(disc, "t"),
+                             spool_prefix=prefix, timeout=1.0,
+                             retry_policy=RetryPolicy(
+                                 max_attempts=1, base_interval=0.01))
+        recovered = mgr.recover_spool()
+        assert recovered > 0
+        assert not list(tmp_path.glob("*.handoff.*"))
+        c, w, _ = flush_totals(store)
+        assert c == ctotal and w == pytest.approx(wtotal)
+
+    def test_spool_recovery_resends_by_id_no_double_merge(self, tmp_path):
+        """The ack-then-crash window: the receiver already merged the
+        spooled handoff before the sender died. Recovery re-SENDS with
+        the original id, the receiver's id guard acks as a duplicate
+        without merging again, and the sender does NOT re-merge
+        locally — exactly-once across the restart."""
+        b, _sink, addr_b = make_handoff_global("spdup")
+        try:
+            donor = make_store()
+            ctotal, _ = fill_store(donor, n=6)
+            groups = {"global_counters":
+                      donor.global_counters.snapshot_state()}
+            blob = encode_handoff(groups, {"id": "sp-dup", "sender": "s",
+                                           "epoch": 3, "dest": addr_b},
+                                  0.0)
+            # the receiver merged it pre-crash (the lost ack)
+            req = urllib.request.Request(
+                f"http://{addr_b}/handoff", data=blob, method="POST")
+            with urllib.request.urlopen(req, timeout=10) as r:
+                assert r.status == 200
+            prefix = str(tmp_path / "v.ckpt")
+            from veneur_tpu.persist import write_atomic
+
+            write_atomic(prefix + ".handoff.3.0", blob)
+            sender_store = make_store()
+            mgr = HandoffManager(sender_store, "s",
+                                 RingWatcher(MutableDiscoverer(["s"]),
+                                             "t"),
+                                 spool_prefix=prefix, timeout=5.0)
+            recovered = mgr.recover_spool()
+            assert recovered == 0  # nothing re-merged locally
+            assert mgr.spool_resent_total == 1
+            assert b.handoff_manager.duplicates_total == 1
+            assert not list(tmp_path.glob("*.handoff.*"))
+            # the receiver holds the state exactly once
+            c, _, _ = flush_totals(b.store)
+            assert c == sum(10 + i for i in range(6))
+            c_s, _, _ = flush_totals(sender_store)
+            assert c_s == 0
+        finally:
+            b.shutdown()
+
+    def test_config_skew_rejected_whole_and_requeued(self):
+        """A receiver whose HLL precision differs cannot merge the sets
+        group; restore_state would silently skip it — the receiver must
+        refuse the WHOLE handoff (422, nothing merged, id unregistered)
+        so the sender requeues and nothing vanishes behind an ack."""
+        b, _sink, addr_b = make_handoff_global("skew")
+        try:
+            donor = make_store(hll_precision=12)  # receiver runs 14
+            ctotal, _ = fill_store(donor, n=5)
+            groups = {
+                "global_counters":
+                    donor.global_counters.snapshot_state(),
+                "sets": donor.sets.snapshot_state()}
+            status, body, _ = b.handoff_manager.handle_handoff(
+                encode_handoff(groups, {"id": "skew-1", "sender": "s",
+                                        "epoch": 1, "series": 10}, 0.0))
+            assert status == 422 and "precision" in body
+            assert b.handoff_manager.rejected_total == 1
+            # nothing merged — not even the compatible counters group
+            c, _, _ = flush_totals(b.store)
+            assert c == 0
+            # the id was NOT registered: a later retry (post-upgrade)
+            # would merge, and the status probe answers incomplete so
+            # the sender requeues now
+            with urllib.request.urlopen(
+                    f"http://{addr_b}/handoff-status?id=skew-1",
+                    timeout=10) as resp:
+                assert json.loads(resp.read())["complete"] is False
+        finally:
+            b.shutdown()
+
+    def test_epoch_monotonic_across_incarnations(self):
+        """A restarted sender must never be 409-stale against a
+        receiver that remembers its previous life's epochs: the epoch
+        bases on the wall clock, so a fresh incarnation's first
+        transition exceeds any prior incarnation's."""
+        store = make_store()
+        mgr1 = HandoffManager(store, "s",
+                              RingWatcher(MutableDiscoverer(["s"]), "t"))
+        assert mgr1.epoch >= int(time.time()) - 5
+        old_epoch = mgr1.epoch + 3  # a few transitions happened
+        mgr2 = HandoffManager(store, "s",
+                              RingWatcher(MutableDiscoverer(["s"]), "t"))
+        # the new incarnation catches up within seconds of wall clock
+        assert mgr2.epoch >= old_epoch - 5
+
+    def test_kept_remerge_prefers_live_gauge(self):
+        """A gauge sampled DURING the extraction window is newer than
+        the retired value coming back — last-write-wins must let the
+        live value survive the kept-half re-merge (and the requeue)."""
+        store = make_store()
+        k = MetricKey(name="g1", type="gauge", joined_tags="")
+        store.import_gauge(k, [], 5.0)
+        snap = {"global_gauges":
+                store.global_gauges.snapshot_state()}
+        # the race: a newer sample lands before the re-merge
+        store.import_gauge(k, [], 7.0)
+        store.restore_state(snap, prefer_live_scalars=True)
+        _final, fwd, _ = store.flush([], AGG, is_local=True, now=0,
+                                     forward=True, columnar=False)
+        assert dict((n, v) for n, _t, v in fwd.gauges)["g1"] == 7.0
+        # counters still ADD under the same flag (merge semantics)
+        kc = MetricKey(name="c1", type="counter", joined_tags="")
+        store.import_counter(kc, [], 3)
+        snap = {"global_counters":
+                store.global_counters.snapshot_state()}
+        store.import_counter(kc, [], 4)
+        store.restore_state(snap, prefer_live_scalars=True)
+        _final, fwd, _ = store.flush([], AGG, is_local=True, now=0,
+                                     forward=True, columnar=False)
+        assert dict((n, v) for n, _t, v in fwd.counters)["c1"] == 10
+
+
+class TestResizeAcceptance:
+    """The PR acceptance flow: grow 2→3 and shrink 3→2 under sustained
+    mixed ingest, exact count conservation (ingested == aggregated,
+    zero loss), handoff completing within one (default 10s) flush
+    interval at probe scale."""
+
+    def test_grow_then_shrink_conserves_under_ingest(self):
+        a, sink_a, addr_a = make_handoff_global("ra")
+        b, sink_b, addr_b = make_handoff_global("rb")
+        c, sink_c, addr_c = make_handoff_global("rc")
+        servers = {addr_a: a, addr_b: b, addr_c: c}
+        try:
+            disc = {addr: MutableDiscoverer([addr_a, addr_b])
+                    for addr in servers}
+            for addr, srv in servers.items():
+                srv.handoff_manager.watcher = RingWatcher(
+                    disc[addr], "test")
+            for addr in (addr_a, addr_b):
+                servers[addr].handoff_manager.refresh()  # adopt {a,b}
+
+            members_lock = threading.Lock()
+            members = [addr_a, addr_b]
+            stop = threading.Event()
+            sent_counters = [0]
+            sent_timer_samples = [0]
+
+            def router():
+                with members_lock:
+                    return ConsistentRing(list(members))
+
+            def ingest():
+                i = 0
+                ring = router()
+                while not stop.is_set():
+                    if i % 64 == 0:
+                        ring = router()
+                    name = f"gc{i % 40}"
+                    owner = ring.get(ring_key(name, "counter", ""))
+                    servers[owner].store.process_metric(parse_metric(
+                        f"{name}:2|c|#veneurglobalonly".encode()))
+                    sent_counters[0] += 2
+                    tname = f"lat{i % 40}"
+                    towner = ring.get(ring_key(tname, "timer", ""))
+                    servers[towner].store.process_metric(parse_metric(
+                        f"{tname}:{(i % 50) + 1}|ms".encode()))
+                    sent_timer_samples[0] += 1
+                    i += 1
+                    if i % 200 == 0:
+                        time.sleep(0.001)
+
+            t = threading.Thread(target=ingest, daemon=True)
+            t.start()
+            time.sleep(0.3)
+
+            # ---- grow 2 → 3 ----
+            for d in disc.values():
+                d.members = [addr_a, addr_b, addr_c]
+            t0 = time.monotonic()
+            servers[addr_c].handoff_manager.refresh()  # adopts
+            sum_a = servers[addr_a].handoff_manager.refresh()
+            sum_b = servers[addr_b].handoff_manager.refresh()
+            grow_s = time.monotonic() - t0
+            with members_lock:
+                members[:] = [addr_a, addr_b, addr_c]
+            assert sum_a["requeued"] == [] and sum_b["requeued"] == []
+            assert sum_a["moved_series"] + sum_b["moved_series"] > 0
+            assert grow_s < 10.0  # within one default flush interval
+            time.sleep(0.3)
+
+            # ---- shrink 3 → 2 ----
+            for d in disc.values():
+                d.members = [addr_a, addr_b]
+            with members_lock:
+                members[:] = [addr_a, addr_b]
+            time.sleep(0.05)  # let in-flight routed sends land
+            t0 = time.monotonic()
+            sum_c = servers[addr_c].handoff_manager.refresh()
+            servers[addr_a].handoff_manager.refresh()
+            servers[addr_b].handoff_manager.refresh()
+            shrink_s = time.monotonic() - t0
+            assert sum_c["requeued"] == []
+            assert sum_c["moved_series"] > 0
+            assert shrink_s < 10.0
+            time.sleep(0.2)
+
+            stop.set()
+            t.join(timeout=10)
+            assert not t.is_alive()
+
+            # ---- exact conservation across the whole episode ----
+            got_c = 0.0
+            got_t = 0.0
+            for addr, srv in servers.items():
+                cc, tc = drain_flush_totals(srv, {
+                    addr_a: sink_a, addr_b: sink_b,
+                    addr_c: sink_c}[addr])
+                got_c += cc
+                got_t += tc
+            assert got_c == sent_counters[0]
+            assert got_t == sent_timer_samples[0]
+            # the handoff stages dogfooded into self-telemetry
+            assert servers[addr_a].handoff_manager.last_duration_ns > 0
+        finally:
+            for srv in servers.values():
+                srv.shutdown()

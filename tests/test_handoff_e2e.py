@@ -1,0 +1,219 @@
+"""SIGKILL chaos coverage for elastic resharding: real server
+subprocesses killed -9 mid-handoff, on both ends of the stream.
+
+* **losing instance killed mid-handoff**: the sender is wedged inside
+  the stream phase (the receiver address accepts the TCP connection
+  but never answers — a half-open peer), so the moved ranges exist
+  only in the post-swap checkpoint + the handoff spool file. SIGKILL,
+  restart on the same paths, prove exact conservation: the regular
+  checkpoint restores the kept half, ``recover_spool`` re-merges the
+  moved half, and the final flush emits everything exactly once.
+* **receiver killed mid-handoff**: the receiver dies before merging;
+  the sender's stream fails, the completion probe fails, and the
+  requeue keeps the moved ranges live — the sender's own flush emits
+  them, zero loss, no double count.
+
+Driven entirely through process boundaries (UDP in, peers file as the
+membership lever, ``flush_file`` TSV out) like
+``tests/test_persist_e2e.py``; each phase pays a full jax import,
+hence the ``slow`` marker.
+"""
+
+import os
+import socket
+import time
+
+import pytest
+
+from tests.test_persist_e2e import (Proc, counter_total,
+                                    read_flush_rows, send_udp,
+                                    wait_for_checkpointed)
+
+pytestmark = pytest.mark.slow
+
+N_SERIES = 40
+
+CONFIG = """
+statsd_listen_addresses: ["udp://127.0.0.1:0"]
+interval: "600s"
+percentiles: [0.5]
+aggregates: ["min", "max", "count"]
+hostname: "e2e"
+omit_empty_hostname: false
+http_address: "{http_address}"
+checkpoint_path: "{ckpt}"
+checkpoint_interval: "250ms"
+checkpoint_max_age_intervals: 10.0
+flush_file: "{flush}"
+store_initial_capacity: 32
+store_chunk: 128
+flush_columnar: false
+handoff_enabled: true
+handoff_self: "{self_addr}"
+handoff_peers: "file://{peers}"
+handoff_refresh_interval: "250ms"
+handoff_timeout: "{handoff_timeout}"
+retry_max: {retry_max}
+retry_base_interval: "100ms"
+"""
+
+
+def write_config(tmp_path, peers, self_addr, handoff_timeout="60s",
+                 retry_max=2, http_address="127.0.0.1:0"):
+    ckpt = tmp_path / "v.ckpt"
+    flush = tmp_path / "flush.tsv.gz"
+    config = tmp_path / "cfg.yaml"
+    config.write_text(CONFIG.format(
+        ckpt=ckpt, flush=flush, peers=peers, self_addr=self_addr,
+        handoff_timeout=handoff_timeout, retry_max=retry_max,
+        http_address=http_address))
+    return ckpt, flush, config
+
+
+def ingest_fleet_shape(port, prefix):
+    """N_SERIES global counters (value 2 each) + N_SERIES timer samples
+    — enough series that any membership change moves a non-trivial
+    fraction each way."""
+    for i in range(N_SERIES):
+        send_udp(port, f"{prefix}.c{i}:2|c|#veneurglobalonly".encode())
+        send_udp(port, f"{prefix}.lat{i}:{i + 1}|ms".encode())
+
+
+def assert_conserved(flush, prefix):
+    rows = read_flush_rows(flush)
+    got_c = sum(counter_total(rows, f"{prefix}.c{i}")
+                for i in range(N_SERIES))
+    got_t = sum(counter_total(rows, f"{prefix}.lat{i}.count")
+                for i in range(N_SERIES))
+    assert got_c == pytest.approx(2.0 * N_SERIES)
+    assert got_t == pytest.approx(float(N_SERIES))
+
+
+def checkpoint_has(ckpt, prefix, what=("global_counters", "timers")):
+    def check(groups):
+        return (f"{prefix}.c0" in groups["global_counters"]["names"]
+                and f"{prefix}.lat0" in groups["timers"]["names"])
+    return check
+
+
+def wait_for_spool(tmp_path, timeout=90.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        spools = [p for p in os.listdir(tmp_path)
+                  if ".handoff." in p and not p.endswith(".tmp")]
+        if spools:
+            return spools
+        time.sleep(0.05)
+    raise AssertionError("handoff spool never appeared")
+
+
+def test_sigkill_sender_midhandoff_recovers_from_checkpoints(tmp_path):
+    peers = tmp_path / "peers"
+    peers.write_text("sender-a\n")
+    ckpt, flush, config = write_config(tmp_path, peers, "sender-a")
+
+    # a half-open receiver: accepts the TCP connect (kernel backlog)
+    # but never reads or answers — the sender's POST blocks inside the
+    # stream phase for the whole 60s handoff deadline
+    blackhole = socket.socket()
+    blackhole.bind(("127.0.0.1", 0))
+    blackhole.listen(1)
+    dead_addr = f"127.0.0.1:{blackhole.getsockname()[1]}"
+
+    p1 = Proc(tmp_path, config, "sender-crash")
+    try:
+        port = p1.wait_ready()
+        ingest_fleet_shape(port, "crash")
+        wait_for_checkpointed(ckpt, checkpoint_has(ckpt, "crash"))
+        # trigger the resize: the peers file now names the black hole
+        peers.write_text(f"sender-a\n{dead_addr}\n")
+        wait_for_spool(tmp_path)
+        p1.sigkill()  # mid-handoff: spool written, stream unacked
+    finally:
+        p1.close()
+        blackhole.close()
+    assert not flush.exists()
+
+    # restart on the same paths with the resize rolled back: the
+    # regular (post-swap) checkpoint restores the kept half, the spool
+    # recovery re-merges the moved half, and the clean shutdown
+    # flushes it all — exactly once
+    peers.write_text("sender-a\n")
+    p2 = Proc(tmp_path, config, "sender-recover")
+    try:
+        p2.wait_ready()
+        p2.sigterm_clean()
+    finally:
+        p2.close()
+    assert_conserved(flush, "crash")
+    # no orphaned spool files after recovery
+    assert not [p for p in os.listdir(tmp_path) if ".handoff." in p]
+
+
+def test_sigkill_receiver_midhandoff_sender_requeues(tmp_path):
+    # boot a REAL receiver on a pre-picked port, then SIGKILL it so
+    # the sender's stream lands on a dead peer mid-handoff
+    probe = socket.socket()
+    probe.bind(("127.0.0.1", 0))
+    recv_port = probe.getsockname()[1]
+    probe.close()
+    recv_addr = f"127.0.0.1:{recv_port}"
+
+    recv_dir = tmp_path / "recv"
+    recv_dir.mkdir()
+    recv_peers = recv_dir / "peers"
+    recv_peers.write_text(f"{recv_addr}\n")
+    _rckpt, rflush, rconfig = write_config(
+        recv_dir, recv_peers, recv_addr,
+        http_address=f"127.0.0.1:{recv_port}")
+    pr = Proc(recv_dir, rconfig, "receiver")
+    try:
+        pr.wait_ready()
+        pr.sigkill()
+    finally:
+        pr.close()
+
+    probe = socket.socket()
+    probe.bind(("127.0.0.1", 0))
+    send_http = probe.getsockname()[1]
+    probe.close()
+    send_dir = tmp_path / "send"
+    send_dir.mkdir()
+    peers = send_dir / "peers"
+    peers.write_text("sender-a\n")
+    ckpt, flush, config = write_config(
+        send_dir, peers, "sender-a", handoff_timeout="2s", retry_max=1,
+        http_address=f"127.0.0.1:{send_http}")
+    p1 = Proc(send_dir, config, "sender")
+    try:
+        port = p1.wait_ready()
+        ingest_fleet_shape(port, "keep")
+        wait_for_checkpointed(ckpt, checkpoint_has(ckpt, "keep"))
+        # resize toward the dead receiver: stream fails, the
+        # completion probe fails, the moved ranges requeue — the
+        # authoritative cross-process signal is the sender's own
+        # /debug/vars handoff section
+        peers.write_text(f"sender-a\n{recv_addr}\n")
+        import json
+        import urllib.request
+
+        deadline = time.time() + 120
+        requeued = False
+        while time.time() < deadline and not requeued:
+            time.sleep(0.2)
+            try:
+                with urllib.request.urlopen(
+                        f"http://127.0.0.1:{send_http}/debug/vars",
+                        timeout=5) as r:
+                    h = json.loads(r.read()).get("handoff") or {}
+                requeued = h.get("requeued_series_total", 0) > 0
+            except Exception:
+                pass
+        assert requeued, "moved ranges never re-entered the live store"
+        p1.sigterm_clean()
+    finally:
+        p1.close()
+    # zero loss, no double count: the sender emitted everything once
+    assert_conserved(flush, "keep")
+    # the receiver never flushed anything
+    assert not rflush.exists()

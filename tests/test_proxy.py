@@ -58,6 +58,54 @@ class TestConsistentRing:
         # ~1/3 of the space moves to the new member, not everything
         assert 0 < changed < 350
 
+    def test_set_members_bumps_version_once(self):
+        ring = ConsistentRing(["a", "b"])
+        v0 = ring.version
+        ring.set_members(["a", "b", "c", "d"])
+        assert ring.version == v0 + 1  # one atomic transition
+        ring.set_members(["a", "b", "c", "d"])
+        assert ring.version == v0 + 1  # no-op refresh = no transition
+
+    def test_get_many_matches_get(self):
+        ring = ConsistentRing(["a", "b", "c"])
+        keys = [f"k{i}" for i in range(200)]
+        assert ring.get_many(keys) == [ring.get(k) for k in keys]
+
+    def test_get_many_empty_ring_raises(self):
+        with pytest.raises(EmptyRingError):
+            ConsistentRing().get_many(["k"])
+
+    def test_atomic_swap_never_visible_half_transitioned(self):
+        """A reader racing set_members must only ever observe the old
+        ring or the new one — never an intermediate state where a key
+        routes to neither ring's owner (the ring-transition
+        double-count window)."""
+        ring = ConsistentRing(["a", "b"])
+        old = ConsistentRing(["a", "b"])
+        new = ConsistentRing(["a", "b", "c"])
+        keys = [f"k{i}" for i in range(64)]
+        valid = {k: {old.get(k), new.get(k)} for k in keys}
+        bad = []
+        stop = threading.Event()
+
+        def reader():
+            while not stop.is_set():
+                for k, owner in zip(keys, ring.get_many(keys)):
+                    if owner not in valid[k]:
+                        bad.append((k, owner))
+
+        threads = [threading.Thread(target=reader, daemon=True)
+                   for _ in range(3)]
+        for t in threads:
+            t.start()
+        for _ in range(200):
+            ring.set_members(["a", "b", "c"])
+            ring.set_members(["a", "b"])
+        stop.set()
+        for t in threads:
+            t.join(timeout=5)
+        assert not bad
+
 
 class _FakeConsul(BaseHTTPRequestHandler):
     def log_message(self, *a):
@@ -183,6 +231,58 @@ class TestHTTPProxyPipeline:
         finally:
             g1.shutdown()
             g2.shutdown()
+
+    def test_ring_swap_conserves_counts_under_concurrent_ingest(self):
+        """The ring-transition regression (PR 12 satellite): while the
+        membership swaps back and forth, every proxied metric is
+        delivered to EXACTLY one destination — exact count
+        conservation, no double-POST and no drop — and each batch
+        routes coherently by one ring version (its series cannot split
+        across the old and the new ring)."""
+        proxy = Proxy(ProxyConfig(http_address="127.0.0.1:0",
+                                  forward_timeout="5s", retry_max=0),
+                      discoverer=StaticDiscoverer(["d1", "d2"]))
+        proxy.refresh_destinations()
+        delivered = []  # (dest_url, batch_ids)
+        dlock = threading.Lock()
+
+        def fake_post(url, batch, **kw):
+            with dlock:
+                delivered.append((url, [m["id"] for m in batch]))
+            return 202
+
+        proxy._post = fake_post
+        sent = []
+        slock = threading.Lock()
+        stop = threading.Event()
+
+        def ingest(tid):
+            i = 0
+            while not stop.is_set():
+                batch = [{"name": f"series{(i + j) % 16}",
+                          "type": "counter", "tags": [],
+                          "id": f"{tid}:{i}:{j}"} for j in range(8)]
+                with slock:
+                    sent.extend(m["id"] for m in batch)
+                proxy.proxy_metrics(batch)
+                i += 1
+
+        threads = [threading.Thread(target=ingest, args=(t,),
+                                    daemon=True) for t in range(3)]
+        for t in threads:
+            t.start()
+        for _ in range(60):
+            proxy.ring.set_members(["d1", "d2", "d3"])
+            time.sleep(0.001)
+            proxy.ring.set_members(["d1", "d2"])
+            time.sleep(0.001)
+        stop.set()
+        for t in threads:
+            t.join(timeout=10)
+            assert not t.is_alive()
+        got = [mid for _, ids in delivered for mid in ids]
+        assert sorted(got) == sorted(sent)  # exactly-once, zero loss
+        assert proxy.forward_errors == 0
 
     def test_unreachable_destination_counted(self):
         proxy = Proxy(ProxyConfig(http_address="127.0.0.1:0",

@@ -281,6 +281,32 @@ class Config:
     # a long-lived server
     obs_timeline_intervals: int = 0
 
+    # ---- elastic fleet resharding (veneur_tpu/fleet/handoff.py) ----------
+    # live resharding for the GLOBAL tier (docs/resilience.md "Elastic
+    # resharding"): on a fleet membership change, the moved key ranges
+    # stream as packed digests to their new owner with zero sample
+    # loss. Requires handoff_self, a membership source (handoff_peers
+    # or Consul via handoff_service_name), and http_address (peers
+    # stream into POST /handoff on it). Only valid on a global.
+    handoff_enabled: bool = False
+    # this instance's address exactly as the membership source reports
+    # it — the ring identity handoffs route around
+    handoff_self: str = ""
+    # static membership: comma-separated peer addresses (including
+    # handoff_self), or "file:///path" to re-read one address per line
+    # each refresh (the configmap/orchestrator-managed flavor)
+    handoff_peers: str = ""
+    # Consul service to discover the global fleet from when
+    # handoff_peers is unset (default service name: veneur-global)
+    handoff_service_name: str = ""
+    # how often membership is re-resolved (a ring change is detected
+    # within one refresh); parsed ONCE at load. Empty = 10s
+    handoff_refresh_interval: str = ""
+    # per-destination transfer budget: retries + backoff for one
+    # handoff POST never exceed this before the state re-queues
+    # locally; parsed ONCE at load. Empty = forward_timeout
+    handoff_timeout: str = ""
+
     # ---- crash-safe aggregation state (veneur_tpu/persist/) --------------
     # where the interval checkpoint lives; empty disables checkpointing.
     # The atomic-write scratch file is checkpoint_path + ".tmp".
@@ -412,10 +438,32 @@ class Config:
             raise ValueError(
                 f"fault_injection_rate must be in [0, 1], got "
                 f"{self.fault_injection_rate}")
+        if self.handoff_enabled:
+            if self.forward_address:
+                raise ValueError(
+                    "handoff_enabled requires a GLOBAL instance, but "
+                    "forward_address is set (a local owns no ring "
+                    "ranges to hand off). Unset one of them")
+            if not self.handoff_self:
+                raise ValueError(
+                    "handoff_enabled requires handoff_self: the address "
+                    "this instance appears as in the fleet membership "
+                    "(handoff_peers / discovery)")
+            if not self.handoff_peers and not self.handoff_service_name:
+                raise ValueError(
+                    "handoff_enabled requires a membership source: set "
+                    "handoff_peers (static CSV or file://...) or "
+                    "handoff_service_name (Consul)")
+            if not self.http_address:
+                raise ValueError(
+                    "handoff_enabled requires http_address: peers "
+                    "stream moved ranges into POST /handoff on it")
         if self.fault_injection_kinds:
-            from veneur_tpu.resilience.faults import ALL_KINDS, INGEST_KINDS
+            from veneur_tpu.resilience.faults import (ALL_KINDS,
+                                                      CHURN_KINDS,
+                                                      INGEST_KINDS)
 
-            known = ALL_KINDS + INGEST_KINDS
+            known = ALL_KINDS + INGEST_KINDS + CHURN_KINDS
             bad = [k.strip()
                    for k in self.fault_injection_kinds.split(",")
                    if k.strip() and k.strip() not in known]
@@ -500,12 +548,21 @@ class Config:
             self.tier_demote_intervals = 3
         self.compute_breaker_reset_timeout_seconds = parse_duration(
             self.compute_breaker_reset_timeout)
+        # elastic-resharding durations, parse-once like every other
+        # duration knob (handoff_timeout defaults to the forward
+        # budget, resolved after apply_resilience_defaults below)
+        self.handoff_refresh_interval_seconds = (
+            parse_duration(self.handoff_refresh_interval)
+            if self.handoff_refresh_interval else 10.0)
         # parse-once (round-1 audit policy): 0.0 = unset, the server
         # derives interval / 4 at start
         self.checkpoint_interval_seconds = (
             parse_duration(self.checkpoint_interval)
             if self.checkpoint_interval else 0.0)
         self.apply_resilience_defaults()
+        self.handoff_timeout_seconds = (
+            parse_duration(self.handoff_timeout) if self.handoff_timeout
+            else self.forward_timeout_seconds)
         return self
 
     def apply_resilience_defaults(self):

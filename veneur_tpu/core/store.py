@@ -2699,7 +2699,8 @@ class MetricStore:
         return groups, epoch
 
     @acquires_lock("store")
-    def restore_state(self, groups: Dict[str, dict]) -> int:
+    def restore_state(self, groups: Dict[str, dict],
+                      prefer_live_scalars: bool = False) -> int:
         """Merge a recovered snapshot into the live store with the same
         semantics as the import path (counters add, gauges last-write,
         digests re-enter the centroid binning pipeline, sets register-
@@ -2707,7 +2708,16 @@ class MetricStore:
         aggregation exactly like a forwarded sketch would. Returns the
         number of series merged. Unknown groups and config mismatches
         (HLL precision, count-min geometry) skip that group with a
-        warning; nothing here raises."""
+        warning; nothing here raises.
+
+        ``prefer_live_scalars=True`` is for re-merging RETIRED state
+        into a store that kept ingesting (the handoff kept-half and
+        requeue paths): an overwrite-semantics scalar row (gauge,
+        status) that already exists live carries a NEWER sample than
+        the retired snapshot — last-write-wins must let the live value
+        win, so those rows are skipped instead of clobbered. Counters
+        always add; a cold startup restore (empty store) is
+        unaffected either way."""
         merged = 0
         with self._lock:
             for name, snap in groups.items():
@@ -2719,8 +2729,9 @@ class MetricStore:
                                 "skipping", name)
                     continue
                 try:
-                    merged += self._restore_group(name, tname, target,
-                                                  snap)
+                    merged += self._restore_group(
+                        name, tname, target, snap,
+                        prefer_live_scalars=prefer_live_scalars)
                 except Exception:
                     log.exception("checkpoint restore: group %s failed; "
                                   "skipping it", name)
@@ -2728,7 +2739,7 @@ class MetricStore:
 
     @requires_lock("store")
     def _restore_group(self, name: str, tname: str, target,
-                       snap: dict) -> int:
+                       snap: dict, prefer_live_scalars: bool = False) -> int:
         kind = snap.get("kind")
         names, joined = snap.get("names", []), snap.get("joined", [])
         n = len(names)
@@ -2744,14 +2755,23 @@ class MetricStore:
             values = snap.get("values", ())
             messages = snap.get("messages")
             hostnames = snap.get("hostnames")
+            # overwrite-semantics rows (gauges, status): when the live
+            # store kept ingesting past the snapshot, its value is the
+            # newer write — skip, don't clobber (see restore_state)
+            skip_live = (prefer_live_scalars
+                         and getattr(target, "kind", "") != "counter")
+            merged = 0
             for i, key, tags in keys():
+                if skip_live and key in target.interner.rows:
+                    continue
+                merged += 1
                 if messages is not None:
                     target.sample(key, tags, float(values[i]), 1.0,
                                   message=messages[i],
                                   hostname=hostnames[i])
                 else:
                     target.combine(key, tags, values[i])
-            return n
+            return merged
         if kind == "digest":
             if n == 0:
                 return 0
@@ -2803,6 +2823,79 @@ class MetricStore:
         log.warning("checkpoint restore: group %s has unknown kind %r; "
                     "skipping", name, kind)
         return 0
+
+    # -- elastic resharding (veneur_tpu/fleet/handoff.py) ------------------
+
+    # the ring-routed groups: the state the import path feeds, i.e.
+    # what locals forward through the proxy ring and what a fleet
+    # resize therefore moves. Mixed scalars/locals are this host's own
+    # telemetry and the heavy-hitter count-min table is cross-series
+    # (not partitionable by key) — they always stay.
+    _HANDOFF_GROUPS = ("global_counters", "global_gauges", "histograms",
+                       "timers", "sets")
+
+    @acquires_lock("store")
+    def handoff_extract(self, route_fn,
+                        route_many=None) -> Tuple[Dict[str, Dict[str, dict]],
+                                                  int]:
+        """Elastic-resharding range extraction (docs/resilience.md
+        "Elastic resharding"): atomically retire the live generation —
+        the same swap a flush performs, so the flush-epoch guard covers
+        it (checkpoint commits and lane resolvers straddling the swap
+        invalidate exactly as they do for a flush) — snapshot the
+        retired groups OFF-lock (two-phase, exclusively owned), split
+        the ring-routed groups by ``route_fn``, and re-merge everything
+        that STAYS into the live store with import semantics. Owned
+        state lives in exactly one place at every instant: samples
+        arriving during the extraction land in the fresh live
+        generation, so a resize can neither lose nor double-count.
+
+        ``route_fn(name, type_str, joined_tags)`` returns the new
+        owner's address, or None to keep; ``route_many`` is the
+        optional batched form (one ring-lock hold per group — see
+        ``split_group_snapshot``). Returns ``(moved, moved_series)``:
+        ``moved`` maps destination -> {group: snapshot} ready for the
+        handoff wire."""
+        from veneur_tpu.fleet.handoff import split_group_snapshot
+
+        # the gate serializes the swap+snapshot against a concurrent
+        # flush (same contract as flush(): ingest proceeds on _lock);
+        # the snapshot's blocking device fetches run under it by design
+        # — a flush racing a resize would interleave two generation
+        # drains otherwise
+        with self._flush_gate:  # lint: ok(lock-across-blocking)
+            with self._lock:
+                gen = self._swap_generation()
+            snaps: Dict[str, dict] = {}
+            for name in self._GEN_GROUPS:
+                # retired generation: this thread is the sole owner,
+                # the store lock is not required (cf. _requeue_group)
+                group = getattr(gen, name)
+                snaps[name] = group.snapshot_state()  # lint: ok(unlocked-call)
+        moved: Dict[str, Dict[str, dict]] = {}
+        kept: Dict[str, dict] = {}
+        moved_series = 0
+        for name, snap in snaps.items():
+            if name in self._HANDOFF_GROUPS:
+                parts = split_group_snapshot(
+                    snap, self._GROUP_TYPES[name], route_fn,
+                    route_many=route_many)
+            else:
+                parts = {None: snap}
+            for dest, part in parts.items():
+                if dest is None:
+                    kept[name] = part
+                else:
+                    moved.setdefault(dest, {})[name] = part
+                    moved_series += len(part.get("names") or ())
+        self.restore_state(kept, prefer_live_scalars=True)
+        with self._lock:
+            # re-credit the retired interval's tallies: the samples are
+            # back (kept) or leaving as owned state (moved) — either
+            # way this instance processed them this interval
+            self.processed += gen.processed
+            self.imported += gen.imported
+        return moved, moved_series
 
     # -- flush -------------------------------------------------------------
 

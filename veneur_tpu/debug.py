@@ -234,6 +234,14 @@ def collect_vars(server) -> dict:
     except Exception as e:  # pragma: no cover - diagnostic only
         out["mesh_error"] = repr(e)
     try:
+        # elastic resharding (veneur_tpu/fleet/handoff.py): membership,
+        # handoff epoch, moved/requeued/received tallies and breakers
+        mgr = getattr(server, "handoff_manager", None)
+        if mgr is not None:
+            out["handoff"] = mgr.snapshot()
+    except Exception as e:  # pragma: no cover - diagnostic only
+        out["handoff_error"] = repr(e)
+    try:
         # flush-interval observability (veneur_tpu/obs/): timeline ring
         # summary + per-scope kernel dispatches and live compiled-
         # variant counts (the recompile lint pass's inventory,

@@ -13,7 +13,7 @@ import logging
 import os
 import ssl
 import urllib.request
-from typing import List, Protocol, Sequence
+from typing import List, Optional, Protocol, Sequence
 
 log = logging.getLogger("veneur.discovery")
 
@@ -32,6 +32,104 @@ class StaticDiscoverer:
 
     def get_destinations_for_service(self, service_name: str) -> List[str]:
         return list(self._destinations)
+
+
+class FilePeersDiscoverer:
+    """Membership from a local file, one address per line (``#`` starts
+    a comment). The configmap/ansible-managed flavor of discovery: an
+    operator (or an orchestrator sidecar) rewrites the file and the
+    next refresh sees the new fleet — no Consul required. Also the
+    lever the elastic-resharding chaos tests pull across a process
+    boundary. A missing/unreadable file raises, which the refresh
+    paths translate into keep-last-good."""
+
+    def __init__(self, path: str):
+        self.path = path
+
+    def get_destinations_for_service(self, service_name: str) -> List[str]:
+        with open(self.path) as f:
+            lines = f.read().splitlines()
+        return [ln.strip() for ln in lines
+                if ln.strip() and not ln.lstrip().startswith("#")]
+
+
+class MembershipChange:
+    """One observed fleet-membership transition (old → new)."""
+
+    def __init__(self, old: Sequence[str], new: Sequence[str]):
+        self.old = list(old)
+        self.new = list(new)
+
+    @property
+    def added(self) -> List[str]:
+        return sorted(set(self.new) - set(self.old))
+
+    @property
+    def removed(self) -> List[str]:
+        return sorted(set(self.old) - set(self.new))
+
+    def __repr__(self):
+        return (f"MembershipChange(+{self.added} -{self.removed} "
+                f"-> {len(self.new)} members)")
+
+
+class RingWatcher:
+    """Discovery refresh → membership diff, with the same
+    keep-last-good semantics the proxy's ``_refresh_ring`` applies
+    (proxy.go:337-371; the proxy keeps its own copy because its
+    refresh also budgets retries and prunes breakers per ring). Ring
+    consumers one tier down — the elastic-resharding handoff manager
+    (``fleet/handoff.py``) — drive this one:
+
+    * a refresh failure or an EMPTY result keeps the previous
+      membership (and returns None — no transition happened);
+    * an unchanged membership is a no-op refresh (None);
+    * a changed membership returns a :class:`MembershipChange` AND
+      adopts the new set — the caller reacts to the diff (ring swap,
+      handoff) exactly once per transition.
+
+    ``injector`` (``resilience/faults.py``) mangles the resolved
+    membership with the seeded churn kinds (member_add /
+    member_remove / partition) so resize-under-failure soaks
+    reproduce."""
+
+    def __init__(self, discoverer: "Discoverer", service_name: str,
+                 injector=None):
+        self.discoverer = discoverer
+        self.service_name = service_name
+        self.injector = injector
+        self.members: List[str] = []
+        self.refreshes = 0
+        self.failures = 0
+        self.changes = 0
+
+    def refresh(self) -> "Optional[MembershipChange]":
+        self.refreshes += 1
+        try:
+            dests = self.discoverer.get_destinations_for_service(
+                self.service_name)
+        except Exception as e:
+            self.failures += 1
+            log.warning("membership refresh failed, keeping %d known: %s",
+                        len(self.members), e)
+            return None
+        if not dests:
+            self.failures += 1
+            log.warning("discovery returned zero members, keeping %d",
+                        len(self.members))
+            return None
+        if self.injector is not None:
+            mangled = self.injector.mangle_members(
+                f"discovery.refresh.{self.service_name}", dests)
+            # churn must degrade the fleet, never erase it
+            dests = mangled or dests
+        new = sorted(set(dests))
+        if new == self.members:
+            return None
+        change = MembershipChange(self.members, new)
+        self.members = new
+        self.changes += 1
+        return change
 
 
 class RetryingDiscoverer:

@@ -25,12 +25,14 @@ from __future__ import annotations
 
 import logging
 
-from veneur_tpu.fleet.router import (PoolPlacement, ShardPlacement,
-                                     ShardRouter, route_stack)
+from veneur_tpu.fleet.router import (PoolPlacement, RingTransition,
+                                     ShardPlacement, ShardRouter,
+                                     ring_key, route_stack)
 
 log = logging.getLogger("veneur.fleet")
 
 __all__ = ["ShardRouter", "ShardPlacement", "PoolPlacement",
+           "RingTransition", "ring_key",
            "route_stack", "build_mesh", "fleet_snapshot",
            "sum_shard_occupancy", "balance_ratio"]
 

@@ -1,0 +1,875 @@
+"""Elastic fleet: live resharding with zero-loss packed-digest handoff.
+
+A global tier sized for millions of users resizes under load; before
+this module, a ring membership change silently orphaned every in-flight
+sketch keyed to the moved ranges (the proxy re-routes NEW samples, but
+the state already resident on the old owner emitted nowhere near its
+new half). Scale-out/scale-in is now a first-class flow
+(docs/resilience.md "Elastic resharding"):
+
+1. **Watch** — :class:`~veneur_tpu.discovery.RingWatcher` runs the
+   proxy's keep-last-good discovery refresh against the global fleet's
+   own membership (static CSV, ``file://`` peers file, or Consul).
+2. **Extract** — on a membership change, the losing instance computes
+   the moved key ranges with the shared hash rule
+   (:func:`~veneur_tpu.fleet.router.ring_key` over a
+   :class:`~veneur_tpu.fleet.router.RingTransition`) and calls
+   ``MetricStore.handoff_extract``: one atomic generation swap (the
+   flush-epoch guard), a two-phase off-lock snapshot, a host-side
+   split, and a re-merge of everything that stays. Owned state lives in
+   exactly one place at every instant — samples arriving mid-extraction
+   land in the fresh live generation, so nothing is lost and nothing
+   can double-count.
+3. **Stream** — moved ranges travel as *packed* digests (the tdigest
+   field-16/17 sort-compact contract: u16 range-quantized means + u16
+   bfloat16 weight bits; :func:`pack_digest_snapshot`) inside the
+   versioned/CRC-guarded ``persist/format.py`` envelope, POSTed to the
+   new owner's ``/handoff`` endpoint, which merges through the
+   import-semantics restore (counters add, centroids re-bin, HLL max,
+   per-row stats fold) and acks only after the merge lands.
+4. **Survive** — failures ride the existing resilience ladder:
+   per-destination breaker + retry with full jitter inside a handoff
+   deadline; an unacked handoff re-queues into the live store (late,
+   never lost), after a completion probe closes the ack-lost
+   double-count window. Checkpoints cover the crash case on both ends:
+   the sender anchors a post-swap checkpoint and spools each pending
+   handoff next to it (recovered into the live store at restart); the
+   receiver registers the handoff id BEFORE merging, so a retried
+   stream can never merge twice.
+
+The receiver guards by **handoff epoch** per sender (a stale epoch is
+rejected 409) and by id (a duplicate acks without merging).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+import time
+import urllib.error
+import urllib.request
+import uuid
+from typing import Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from veneur_tpu.fleet.router import RingTransition
+from veneur_tpu.persist import format as ckpt_format
+from veneur_tpu.persist.format import CheckpointInvalid
+
+log = logging.getLogger("veneur.fleet.handoff")
+
+# bounded receiver-side idempotency memory: ids beyond this age out
+# (oldest first); a sender retries within one handoff deadline, not
+# thousands of transitions later
+SEEN_LIMIT = 512
+
+
+# ---------------------------------------------------------------------------
+# snapshot split: one group snapshot -> per-destination snapshots
+# ---------------------------------------------------------------------------
+
+
+def _filter_rows(snap: dict, keep_ix: np.ndarray) -> dict:
+    """A group snapshot restricted to the rows in ``keep_ix`` (row ids
+    into the snapshot's interner order), with the digest centroid runs
+    re-rowed onto the compacted 0..k-1 space ``restore_state``
+    expects."""
+    kind = snap.get("kind")
+    out = {"kind": kind,
+           "names": [snap["names"][i] for i in keep_ix],
+           "joined": [snap["joined"][i] for i in keep_ix]}
+    if kind == "scalar":
+        out["values"] = np.asarray(snap["values"])[keep_ix]
+        if snap.get("messages") is not None:
+            out["messages"] = [snap["messages"][i] for i in keep_ix]
+            out["hostnames"] = [snap["hostnames"][i] for i in keep_ix]
+        return out
+    if kind == "set":
+        out["precision"] = snap.get("precision")
+        if "registers" in snap:
+            out["registers"] = np.asarray(snap["registers"])[keep_ix]
+        return out
+    if kind == "digest":
+        if "rows" not in snap:
+            return out
+        n = len(snap["names"])
+        keep = np.zeros(n, bool)
+        keep[keep_ix] = True
+        remap = np.full(n, -1, np.int64)
+        remap[keep_ix] = np.arange(len(keep_ix))
+        rows = np.asarray(snap["rows"], np.int64)
+        m = keep[rows]
+        out["rows"] = remap[rows[m]].astype(np.int32)
+        out["means"] = np.asarray(snap["means"])[m]
+        out["weights"] = np.asarray(snap["weights"])[m]
+        for k in ("mins", "maxs", "count", "vsum", "vmin", "vmax",
+                  "recip"):
+            out[k] = np.asarray(snap[k])[keep_ix]
+        return out
+    # unknown kinds (topk etc.) never split — the caller keeps them whole
+    return snap
+
+
+def split_group_snapshot(snap: dict, type_str: str,
+                         route_fn: Callable[[str, str, str],
+                                            Optional[str]],
+                         route_many=None) -> dict:
+    """One group snapshot -> {destination-or-None: snapshot}. ``None``
+    keys the kept half. ``veneur.*`` self-telemetry series are
+    instance-local by definition and always stay.
+
+    ``route_many(names, type_str, joineds) -> [dest-or-None]`` is the
+    batched fast path (one ring-lock hold for the whole group via
+    ``ConsistentRing.get_many`` instead of a locked hash walk per
+    series — the term ``bench_reshard`` measures as extract_s);
+    ``route_fn`` is the per-key fallback."""
+    names = snap.get("names") or []
+    joined = snap.get("joined") or []
+    if not names:
+        return {None: snap}
+    dest_of: List[Optional[str]] = [None] * len(names)
+    routable = [i for i, nm in enumerate(names)
+                if not nm.startswith("veneur.")]
+    if routable:
+        if route_many is not None:
+            dests = route_many([names[i] for i in routable], type_str,
+                               [joined[i] for i in routable])
+        else:
+            dests = [route_fn(names[i], type_str, joined[i])
+                     for i in routable]
+        for i, dest in zip(routable, dests):
+            dest_of[i] = dest
+    by_dest: Dict[Optional[str], List[int]] = {}
+    for i, dest in enumerate(dest_of):
+        by_dest.setdefault(dest, []).append(i)
+    if set(by_dest) == {None}:
+        return {None: snap}
+    return {dest: _filter_rows(snap, np.asarray(ix, np.int64))
+            for dest, ix in by_dest.items()}
+
+
+# ---------------------------------------------------------------------------
+# packed digest wire (the tdigest field-16/17 sort-compact contract)
+# ---------------------------------------------------------------------------
+
+
+def pack_digest_snapshot(snap: dict) -> dict:
+    """Quantize a digest snapshot's centroid runs to the packed wire:
+    u16 range-quantized means against a per-row [pmin, pmin+pspan]
+    frame plus u16 bfloat16 weight bits — 4 bytes/centroid instead of
+    16, the same contract ``PackedDigestPlanes`` proved on the forward
+    path (``_digest_arrays`` decodes the identical fields off protobuf
+    16/17). Quantization is order-preserving per row, so the
+    sorted-by-(row, mean) layout the restore staging depends on
+    survives. Mutates and returns ``snap``."""
+    if snap.get("kind") != "digest" or snap.get("packed") \
+            or "rows" not in snap:
+        return snap
+    rows = np.asarray(snap["rows"], np.int64)
+    means = np.asarray(snap["means"], np.float64)
+    weights = np.asarray(snap["weights"], np.float64)
+    n = len(snap["names"])
+    pmin = np.full(n, np.inf, np.float64)
+    pmax = np.full(n, -np.inf, np.float64)
+    np.minimum.at(pmin, rows, means)
+    np.maximum.at(pmax, rows, means)
+    span = pmax - pmin
+    ok = np.isfinite(span) & (span > 0)
+    scale = np.zeros(n, np.float64)
+    np.divide(65535.0, span, where=ok, out=scale)
+    q = np.rint((means - pmin[rows]) * scale[rows])
+    snap["means_q"] = np.clip(q, 0, 65535).astype(np.uint16)
+    bits = np.ascontiguousarray(weights, np.float32).view(np.uint32)
+    # round-to-nearest-even into bfloat16, matching the device packer
+    bits = bits + np.uint32(0x7FFF) + ((bits >> np.uint32(16))
+                                       & np.uint32(1))
+    snap["weights_bf"] = (bits >> np.uint32(16)).astype(np.uint16)
+    snap["pmin"] = np.where(np.isfinite(pmin), pmin, 0.0).astype(
+        np.float32)
+    snap["pspan"] = np.where(ok, span, 0.0).astype(np.float32)
+    snap["packed"] = True
+    del snap["means"]
+    del snap["weights"]
+    return snap
+
+
+def unpack_digest_snapshot(snap: dict) -> dict:
+    """Inverse of :func:`pack_digest_snapshot`: rebuild the f64
+    centroid arrays ``restore_state`` consumes. Mutates and returns
+    ``snap``."""
+    if not snap.get("packed"):
+        return snap
+    rows = np.asarray(snap["rows"], np.int64)
+    q = np.asarray(snap["means_q"], np.uint16).astype(np.float64)
+    pmin = np.asarray(snap["pmin"], np.float64)
+    pspan = np.asarray(snap["pspan"], np.float64)
+    snap["means"] = pmin[rows] + q * (pspan[rows] / 65535.0)
+    wb = np.ascontiguousarray(snap["weights_bf"], np.uint16)
+    snap["weights"] = (wb.astype(np.uint32) << np.uint32(16)).view(
+        np.float32).astype(np.float64)
+    for k in ("means_q", "weights_bf", "pmin", "pspan", "packed"):
+        snap.pop(k, None)
+    return snap
+
+
+# ---------------------------------------------------------------------------
+# wire envelope (shared by the POST body and the crash spool file)
+# ---------------------------------------------------------------------------
+
+
+def encode_handoff(groups: Dict[str, dict], meta: dict,
+                   created_at: float) -> bytes:
+    """Moved group snapshots -> one versioned/CRC-guarded blob: the
+    ``persist/format.py`` checkpoint layout with digests packed and a
+    ``handoff`` section in the manifest meta. One serialization serves
+    both the wire (``POST /handoff``) and the sender's crash spool."""
+    wire: Dict[str, dict] = {}
+    for name, snap in groups.items():
+        if snap.get("kind") == "digest":
+            snap = pack_digest_snapshot(dict(snap))
+        wire[name] = snap
+    return ckpt_format.serialize(wire, created_at=created_at,
+                                 interval=0.0, meta={"handoff": meta})
+
+
+def decode_handoff(blob: bytes) -> Tuple[Dict[str, dict], dict]:
+    """Wire/spool blob -> (restorable groups, handoff meta). Raises
+    :class:`CheckpointInvalid` on anything not provably whole."""
+    groups, manifest = ckpt_format.deserialize(blob)
+    for snap in groups.values():
+        unpack_digest_snapshot(snap)
+    meta = (manifest.get("meta") or {}).get("handoff") or {}
+    return groups, meta
+
+
+def snapshot_counts(groups: Dict[str, dict]) -> Dict[str, int]:
+    """Per-group series counts (the wire meta's conservation ledger)."""
+    return {name: len(snap.get("names") or ())
+            for name, snap in groups.items()}
+
+
+# ---------------------------------------------------------------------------
+# the manager: watch -> extract -> spool -> stream -> ack/requeue
+# ---------------------------------------------------------------------------
+
+
+class HandoffManager:
+    """Owns one instance's elastic-resharding flow, both roles: the
+    sender side (refresh loop, extraction, spool, stream) and the
+    receiver side (``/handoff`` merge with id/epoch guards)."""
+
+    def __init__(self, store, self_addr: str, watcher,
+                 timeout: float = 10.0, retry_policy=None, breakers=None,
+                 spool_prefix: str = "", checkpointer=None, timeline=None,
+                 refresh_interval: float = 10.0, injector=None,
+                 replicas: int = 20):
+        from veneur_tpu.resilience import BreakerRegistry, RetryPolicy
+
+        self.store = store
+        self.self_addr = self_addr
+        self.watcher = watcher
+        self.timeout = timeout
+        self.retry_policy = retry_policy or RetryPolicy()
+        self.breakers = breakers or BreakerRegistry()
+        self.spool_prefix = spool_prefix
+        self.checkpointer = checkpointer
+        self.timeline = timeline
+        self.refresh_interval = refresh_interval
+        self.injector = injector
+        self.replicas = replicas
+        # sender state. The handoff epoch must be monotonic ACROSS
+        # restarts (receivers remember the last epoch per sender
+        # in-memory; a restart that reset to 0 would see every handoff
+        # rejected 409-stale until the old high-water mark was passed
+        # again), so it bases on the wall clock and transitions take
+        # max(epoch + 1, now) — resizes are rare, clocks only have to
+        # not run backwards between process lives.
+        self.epoch = int(time.time())
+        self._seq = 0
+        self._lock = threading.Lock()
+        # held across one whole transition (extract→stream→requeue);
+        # shutdown quiesces on it before the final flush
+        self._busy = threading.Lock()
+        # receiver state: id -> merged count (registered BEFORE the
+        # merge, the at-most-once anchor) + last epoch per sender
+        self._seen: "Dict[str, int]" = {}
+        self._seen_order: List[str] = []
+        self._sender_epochs: Dict[str, int] = {}
+        # telemetry (read by flusher._handoff_samples and /debug/vars)
+        self.resizes_total = 0
+        self.moved_series_total = 0
+        self.sent_total = 0
+        self.send_failures_total = 0
+        self.requeued_series_total = 0
+        self.receives_total = 0
+        self.received_series_total = 0
+        self.duplicates_total = 0
+        self.stale_total = 0
+        self.rejected_total = 0
+        self.short_merges_total = 0
+        self.spool_resent_total = 0
+        self.spool_recovered_total = 0
+        self.retries_total = 0
+        self.last_duration_ns = 0
+        self.last_error = ""
+
+    # -- construction -------------------------------------------------------
+
+    @classmethod
+    def for_server(cls, server) -> "HandoffManager":
+        """Build from a server's config: membership source
+        (handoff_peers CSV / ``file://`` peers file / Consul service),
+        the shared resilience knobs, the checkpointer as crash anchor,
+        and the seeded churn injector when one is configured."""
+        from veneur_tpu.discovery import (ConsulDiscoverer,
+                                          FilePeersDiscoverer,
+                                          RingWatcher, StaticDiscoverer)
+        from veneur_tpu.resilience import BreakerRegistry, RetryPolicy
+        from veneur_tpu.resilience import faults as rfaults
+
+        cfg = server.config
+        peers = (cfg.handoff_peers or "").strip()
+        if peers.startswith("file://"):
+            discoverer = FilePeersDiscoverer(peers[len("file://"):])
+        elif peers:
+            discoverer = StaticDiscoverer(
+                [p.strip() for p in peers.split(",") if p.strip()])
+        else:
+            discoverer = ConsulDiscoverer()
+        injector = None
+        cfg_kinds = [k.strip() for k in
+                     (cfg.fault_injection_kinds or "").split(",")
+                     if k.strip()]
+        if cfg.fault_injection_rate > 0 and any(
+                k in rfaults.CHURN_KINDS for k in cfg_kinds):
+            injector = rfaults.FaultInjector(
+                rate=cfg.fault_injection_rate,
+                seed=cfg.fault_injection_seed,
+                kinds=tuple(cfg_kinds),
+                scope=cfg.fault_injection_scope)
+        watcher = RingWatcher(
+            discoverer, cfg.handoff_service_name or "veneur-global",
+            injector=injector)
+        return cls(
+            store=server.store, self_addr=cfg.handoff_self,
+            watcher=watcher, timeout=cfg.handoff_timeout_seconds,
+            retry_policy=RetryPolicy.from_config(cfg),
+            breakers=BreakerRegistry(
+                failure_threshold=cfg.breaker_failure_threshold,
+                reset_timeout=cfg.breaker_reset_timeout_seconds),
+            spool_prefix=cfg.checkpoint_path,
+            checkpointer=server.checkpointer,
+            timeline=getattr(server, "obs_timeline", None),
+            refresh_interval=cfg.handoff_refresh_interval_seconds,
+            injector=injector)
+
+    # -- sender: refresh loop ----------------------------------------------
+
+    def run(self, stop: threading.Event):
+        """Background loop: one membership refresh per
+        ``handoff_refresh_interval`` until ``stop``. A failing refresh
+        or handoff never kills the thread — the next cadence retries."""
+        while not stop.wait(self.refresh_interval):
+            try:
+                self.refresh()
+            except Exception:
+                log.exception("handoff refresh failed; retrying next "
+                              "interval")
+
+    def refresh(self) -> Optional[dict]:
+        """One discovery refresh. A no-op/failed refresh returns None
+        (keep-last-good). On a membership change: the FIRST observed
+        membership just adopts (nothing owned yet to move); afterwards
+        any transition runs the extraction — the split decides what
+        actually moves, so a change that costs this instance nothing
+        is one cheap swap-and-restore cycle that also self-heals any
+        misrouted residue."""
+        change = self.watcher.refresh()
+        if change is None:
+            return None
+        transition = RingTransition(change.old, change.new,
+                                    replicas=self.replicas)
+        if not change.old:
+            log.info("handoff: adopted initial membership %s", change.new)
+            return {"adopted": change.new}
+        log.info("handoff: membership change +%s -%s", change.added,
+                 change.removed)
+        return self._run_handoff(transition)
+
+    def _route_fn(self, transition: RingTransition):
+        def route(name: str, mtype: str, joined: str) -> Optional[str]:
+            dest = transition.new_owner(name, mtype, joined)
+            return None if dest == self.self_addr else dest
+        return route
+
+    def _route_many(self, transition: RingTransition):
+        def route_many(names, mtype, joineds):
+            return [None if dest == self.self_addr else dest
+                    for dest in transition.new_owners(names, mtype,
+                                                      joineds)]
+        return route_many
+
+    def quiesce(self, timeout: float = 30.0) -> bool:
+        """Block until no handoff is in flight (bounded) — the clean
+        shutdown calls this before the final flush, so a SIGTERM
+        landing mid-handoff cannot race the requeue against the drain
+        (the moved state would miss the final flush; its spool would
+        still recover it on the next life, but a CLEAN shutdown must
+        not need one). False = still busy at the timeout."""
+        if self._busy.acquire(timeout=timeout):
+            self._busy.release()
+            return True
+        return False
+
+    def _run_handoff(self, transition: RingTransition) -> dict:
+        from veneur_tpu import obs
+
+        t0 = time.monotonic_ns()
+        rec = obs.StageRecorder() if self.timeline is not None else None
+        # _busy deliberately spans the WHOLE transition incl. the spool
+        # fsync and the stream: it is the shutdown quiesce barrier, not
+        # a data lock — its only other user is quiesce(), which exists
+        # to wait on exactly these blocking ops
+        with self._busy, obs.activate(rec):  # lint: ok(lock-across-blocking)
+            summary = self._run_handoff_staged(transition)
+        self.last_duration_ns = time.monotonic_ns() - t0
+        if rec is not None:
+            try:
+                entry = rec.finish()
+                entry["kind"] = "handoff"
+                entry["epoch"] = summary["epoch"]
+                entry["moved_series"] = summary["moved_series"]
+                self.timeline.publish(entry)
+            except Exception:  # telemetry must never fail a handoff
+                log.exception("handoff timeline publication failed")
+        if hasattr(self.store, "sample_self_timing"):
+            self.store.sample_self_timing("handoff.total",
+                                          float(self.last_duration_ns))
+        return summary
+
+    def _run_handoff_staged(self, transition: RingTransition) -> dict:
+        from veneur_tpu import obs
+
+        with self._lock:
+            self.epoch = max(self.epoch + 1, int(time.time()))
+            epoch = self.epoch
+        with obs.maybe_stage("handoff.extract"):
+            moved, moved_series = self.store.handoff_extract(
+                self._route_fn(transition),
+                route_many=self._route_many(transition))
+        self.resizes_total += 1
+        self.moved_series_total += moved_series
+        summary = {"epoch": epoch, "moved_series": moved_series,
+                   "destinations": sorted(moved), "sent": [],
+                   "requeued": []}
+        if not moved:
+            return summary
+        # the post-swap checkpoint anchor: after the extraction the
+        # moved state is NOT in the live store, so the pre-swap file on
+        # disk (which still holds it) must be replaced before the spool
+        # exists — disk never simultaneously holds both copies, which
+        # is what makes crash recovery (regular restore + spool
+        # recovery) exactly-once. If the anchor CANNOT be written the
+        # stale pre-swap file survives, and spooling/streaming anyway
+        # would set up a crash-restart double count (old checkpoint +
+        # spool/receiver both holding the moved series) — abort the
+        # transition instead: requeue everything now and let a later
+        # refresh retry. A False return (flush-epoch race) is safe to
+        # proceed past: the racing flush truncated the file, so no
+        # stale copy exists.
+        if self.checkpointer is not None:
+            with obs.maybe_stage("handoff.checkpoint"):
+                try:
+                    self.checkpointer.write_once()
+                except Exception:
+                    log.exception(
+                        "post-extraction checkpoint failed; aborting "
+                        "the handoff (streaming against a stale "
+                        "pre-swap checkpoint risks a crash-restart "
+                        "double count) — re-merging the moved ranges")
+                    for dest in sorted(moved):
+                        self.send_failures_total += 1
+                        self._requeue(moved[dest], dest,
+                                      f"{self.self_addr}:{epoch}:abort")
+                        summary["requeued"].append(dest)
+                    return summary
+        pending = []  # (dest, groups, blob, handoff_id, spool_path)
+        with obs.maybe_stage("handoff.spool"):
+            for dest in sorted(moved):
+                groups = moved[dest]
+                handoff_id = (f"{self.self_addr}:{epoch}:{self._seq}:"
+                              f"{uuid.uuid4().hex[:12]}")
+                self._seq += 1
+                meta = {"id": handoff_id, "sender": self.self_addr,
+                        "epoch": epoch, "dest": dest,
+                        "series": sum(snapshot_counts(groups).values()),
+                        "counts": snapshot_counts(groups)}
+                blob = encode_handoff(groups, meta, time.time())
+                spool = ""
+                if self.spool_prefix:
+                    spool = (f"{self.spool_prefix}.handoff."
+                             f"{epoch}.{len(pending)}")
+                    try:
+                        ckpt_format.write_atomic(spool, blob)
+                    except OSError:
+                        log.exception("could not spool handoff %s; "
+                                      "continuing unspooled", handoff_id)
+                        spool = ""
+                pending.append((dest, groups, blob, handoff_id, spool))
+        for dest, groups, blob, handoff_id, spool in pending:
+            n = sum(snapshot_counts(groups).values())
+            with obs.maybe_stage("handoff.stream", dest=dest, series=n):
+                ok = self._send(dest, blob, handoff_id)
+            if ok:
+                self.sent_total += 1
+                summary["sent"].append(dest)
+                log.info("handoff %s: %d series -> %s acked",
+                         handoff_id, n, dest)
+            else:
+                self.send_failures_total += 1
+                # the spool goes FIRST: once the requeue re-anchors the
+                # checkpoint below, a surviving spool would be a second
+                # on-disk copy of the same series (crash-restart double
+                # count); dropping it first accepts the documented
+                # bounded-loss trade instead
+                if spool:
+                    try:
+                        os.unlink(spool)
+                    except OSError:
+                        pass
+                    spool = ""
+                self._requeue(groups, dest, handoff_id)
+                summary["requeued"].append(dest)
+                # the requeued state is memory-only and the post-swap
+                # anchor excludes it; re-anchor so a crash right after
+                # still recovers it (an epoch-raced/failed write keeps
+                # the loss bound at the regular cadence — same as any
+                # fresh sample)
+                if self.checkpointer is not None:
+                    try:
+                        self.checkpointer.write_once()
+                    except Exception:
+                        log.exception("post-requeue checkpoint failed; "
+                                      "the next cadence covers it")
+            if spool:
+                try:
+                    os.unlink(spool)
+                except OSError:
+                    pass
+        return summary
+
+    def _requeue(self, groups: Dict[str, dict], dest: str,
+                 handoff_id: str):
+        """The unacked handoff re-enters the LIVE store with import
+        semantics (``MetricStore._requeue_group``'s contract: late,
+        never lost) — the moved ranges keep serving from here until a
+        later refresh retries the transition."""
+        n = 0
+        try:
+            # prefer_live_scalars: a gauge sampled since the extraction
+            # is newer than the retired value coming back
+            n = self.store.restore_state(groups,
+                                         prefer_live_scalars=True)
+        except Exception:
+            log.exception("handoff %s requeue failed; the last "
+                          "checkpoint bounds the damage", handoff_id)
+        self.requeued_series_total += n
+        log.warning("handoff %s to %s failed; re-merged %d series into "
+                    "the live store (late, never lost)", handoff_id,
+                    dest, n)
+
+    # -- sender: transport --------------------------------------------------
+
+    @staticmethod
+    def _base_url(dest: str) -> str:
+        url = dest.rstrip("/")
+        if not url.startswith(("http://", "https://")):
+            url = "http://" + url
+        return url
+
+    def _post_blob(self, url: str, blob: bytes, timeout: float,
+                   out: dict) -> int:
+        if self.injector is not None:
+            self.injector.maybe_fail(f"handoff.post.{url}")
+        req = urllib.request.Request(
+            url, data=blob,
+            headers={"Content-Type": "application/octet-stream"},
+            method="POST")
+        try:
+            with urllib.request.urlopen(req, timeout=timeout) as resp:
+                out["body"] = resp.read()
+                return resp.status
+        except urllib.error.HTTPError as e:
+            try:
+                out["body"] = e.read()
+            finally:
+                e.close()
+            return e.code
+
+    def _send(self, dest: str, blob: bytes, handoff_id: str) -> bool:
+        from veneur_tpu.resilience import (Deadline, is_transient_status,
+                                           post_with_retry)
+
+        base = self._base_url(dest)
+        breaker = self.breakers.get(dest)
+        if self.injector is not None and self.injector.is_partitioned(dest):
+            # a scheduled partition black-holes this member (keyed by
+            # the bare membership address, the same string
+            # mangle_members drew); the completion probe would be
+            # black-holed too, so fail straight into the requeue
+            breaker.record_failure()
+            self.last_error = f"{dest}: injected partition"
+            log.warning("handoff %s to %s black-holed by injected "
+                        "partition", handoff_id, dest)
+            return False
+        if not breaker.allow():
+            log.warning("handoff %s to %s skipped: circuit breaker open",
+                        handoff_id, dest)
+            return self._probe_completed(base, handoff_id)
+        deadline = Deadline.after(self.timeout)
+        info: dict = {}
+
+        def on_retry(retry_index, exc, pause):
+            self.retries_total += 1
+
+        try:
+            status = post_with_retry(
+                lambda: self._post_blob(
+                    base + "/handoff", blob,
+                    deadline.clamp(self.timeout), info),
+                self.retry_policy, deadline=deadline, on_retry=on_retry)
+        except Exception as e:
+            breaker.record_failure()
+            self.last_error = f"{dest}: {e}"
+            # the POST may have LANDED with its response lost — ask
+            # before re-queueing, or a merged handoff double-counts
+            return self._probe_completed(base, handoff_id)
+        if 200 <= status < 300:
+            breaker.record_success()
+            return True
+        if is_transient_status(status):
+            breaker.record_failure()
+        else:
+            breaker.record_success()
+        self.last_error = f"{dest}: HTTP {status}"
+        log.warning("handoff %s to %s returned HTTP %d (%s)", handoff_id,
+                    dest, status, (info.get("body") or b"")[:120])
+        return self._probe_completed(base, handoff_id)
+
+    def _probe_completed(self, base: str, handoff_id: str) -> bool:
+        """Best-effort ack recovery: did the receiver complete this id?
+        True closes the ack-lost window without a requeue; any probe
+        failure (receiver down — the chaos case) answers False and the
+        state re-queues locally."""
+        try:
+            import urllib.parse
+
+            url = (f"{base}/handoff-status?id="
+                   f"{urllib.parse.quote(handoff_id)}")
+            with urllib.request.urlopen(url, timeout=2.0) as resp:
+                body = json.loads(resp.read())
+            return bool(body.get("complete"))
+        except Exception:
+            return False
+
+    # -- receiver -----------------------------------------------------------
+
+    def handle_handoff(self, body: bytes) -> Tuple[int, str, str]:
+        """The ``POST /handoff`` merge: decode, guard by id (duplicate
+        acks without merging — the id is registered BEFORE the merge,
+        so a retry of a crashed-mid-merge attempt is at-most-once) and
+        by per-sender epoch (a stale epoch is a replay of a superseded
+        transition: 409), then merge through the import-semantics
+        restore and ack with the merged count."""
+        try:
+            groups, meta = decode_handoff(body)
+        except CheckpointInvalid as e:
+            return 400, json.dumps({"error": str(e)}), "application/json"
+        except Exception as e:
+            return 400, json.dumps({"error": f"undecodable: {e}"}), \
+                "application/json"
+        handoff_id = meta.get("id")
+        sender = meta.get("sender", "")
+        epoch = int(meta.get("epoch", 0) or 0)
+        if not handoff_id:
+            return 400, json.dumps({"error": "missing handoff id"}), \
+                "application/json"
+        # config-skew guard BEFORE anything merges: restore_state skips
+        # incompatible groups (HLL precision, count-min geometry) with
+        # only a warning — acking such a merge would delete the sender's
+        # spool while the skipped series vanished. Rejecting whole, with
+        # nothing merged and the id unregistered, keeps the state at the
+        # sender (requeue: late, never lost) until the skew is fixed.
+        # Read-only, so it runs before the guard block below.
+        reason = self._refuse_reason(groups)
+        if reason is not None:
+            with self._lock:
+                self.rejected_total += 1
+            log.warning("refusing handoff %s from %s: %s", handoff_id,
+                        sender, reason)
+            return 422, json.dumps({"error": reason}), "application/json"
+        # the id/epoch guards and the registration are ONE lock hold:
+        # the ops mux is a ThreadingHTTPServer, so a client-side retry
+        # of an in-flight POST runs concurrently — check-then-act
+        # across two holds would let both merge (double count)
+        with self._lock:
+            if handoff_id in self._seen:
+                self.duplicates_total += 1
+                return 200, json.dumps(
+                    {"id": handoff_id, "duplicate": True,
+                     "merged": self._seen[handoff_id]}), "application/json"
+            last = self._sender_epochs.get(sender, 0)
+            if epoch < last:
+                self.stale_total += 1
+                return 409, json.dumps(
+                    {"error": f"stale handoff epoch {epoch} < {last} "
+                              f"from {sender}"}), "application/json"
+            self._sender_epochs[sender] = epoch
+            self._register_seen(handoff_id, 0)
+        # prefer_live_scalars: the proxy re-routes NEW samples here the
+        # moment the ring changes, while the old owner's extract+stream
+        # takes seconds — a gauge sampled here since the resize is newer
+        # than the handed-off value arriving now
+        merged = self.store.restore_state(groups,
+                                          prefer_live_scalars=True)
+        with self._lock:
+            self._seen[handoff_id] = merged
+            self.receives_total += 1
+            self.received_series_total += merged
+        expected = int(meta.get("series", merged) or merged)
+        if merged != expected:
+            # partial merges can't be undone; make the shortfall loud
+            # and countable instead of silently acking it away
+            with self._lock:
+                self.short_merges_total += 1
+            log.error("handoff %s from %s merged %d of %d series — "
+                      "investigate the receiver's restore path",
+                      handoff_id, sender, merged, expected)
+        log.info("handoff %s from %s (epoch %d): merged %d series",
+                 handoff_id, sender, epoch, merged)
+        return 200, json.dumps({"id": handoff_id, "merged": merged}), \
+            "application/json"
+
+    def _refuse_reason(self, groups: Dict[str, dict]) -> Optional[str]:
+        """A whole-handoff rejection reason when any group could not
+        merge completely on this store's config, or None to accept."""
+        for name, snap in groups.items():
+            target = getattr(self.store, name, None)
+            if target is None:
+                return f"unknown group {name!r}"
+            kind = snap.get("kind")
+            if kind == "set":
+                want = getattr(target, "precision", None)
+                if snap.get("precision") != want:
+                    return (f"{name}: HLL precision "
+                            f"{snap.get('precision')} != store {want}")
+            elif kind == "topk":
+                geom = (snap.get("depth"), snap.get("width"))
+                if geom != (getattr(target, "depth", None),
+                            getattr(target, "width", None)):
+                    return f"{name}: count-min geometry {geom} mismatch"
+        return None
+
+    def _register_seen(self, handoff_id: str, merged: int):
+        # caller holds self._lock (handle_handoff's guard block)
+        self._seen[handoff_id] = merged  # lint: ok(inconsistent-lockset)
+        self._seen_order.append(handoff_id)
+        while len(self._seen_order) > SEEN_LIMIT:
+            old = self._seen_order.pop(0)
+            self._seen.pop(old, None)
+
+    def status_route(self, query) -> Tuple[int, str, str]:
+        """``GET /handoff-status?id=`` — the sender's ack-recovery
+        probe."""
+        handoff_id = query.get("id", "")
+        with self._lock:
+            complete = handoff_id in self._seen
+            merged = self._seen.get(handoff_id, 0)
+        return 200, json.dumps({"id": handoff_id, "complete": complete,
+                                "merged": merged}), "application/json"
+
+    # -- crash recovery -----------------------------------------------------
+
+    def recover_spool(self) -> int:
+        """Resolve any spooled (in-flight at crash time) handoffs.
+        Each spool file first RE-SENDS with its ORIGINAL handoff id:
+        if the receiver already merged it before the crash (the
+        ack-then-crash window), the id guard acks as a duplicate
+        without merging again — exactly-once across the restart. Only
+        when the re-send fails (receiver down: the same contract as a
+        live failure) does the state merge back into the live store —
+        late, never lost. Runs at startup, after the regular checkpoint
+        restore (the post-swap anchor ordering makes the two files
+        disjoint). Returns the number of series re-merged locally."""
+        if not self.spool_prefix:
+            return 0
+        import glob
+
+        recovered = 0
+        for path in sorted(glob.glob(self.spool_prefix + ".handoff.*")):
+            if path.endswith(".tmp"):
+                try:
+                    os.unlink(path)
+                except OSError:
+                    pass
+                continue
+            try:
+                blob = ckpt_format.read_file(path)
+                if blob is None:
+                    continue
+                groups, meta = decode_handoff(blob)
+                handoff_id = meta.get("id", path)
+                dest = meta.get("dest", "")
+                if dest and self._send(dest, blob, handoff_id):
+                    self.spool_resent_total += 1
+                    self.sent_total += 1
+                    log.warning("re-delivered spooled handoff %s to %s "
+                                "(duplicate-safe by id)", handoff_id,
+                                dest)
+                else:
+                    n = self.store.restore_state(
+                        groups, prefer_live_scalars=True)
+                    recovered += n
+                    log.warning("recovered spooled handoff %s (%d "
+                                "series) into the live store",
+                                handoff_id, n)
+            except Exception:
+                log.exception("discarding unreadable handoff spool %s",
+                              path)
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+        self.spool_recovered_total += recovered
+        return recovered
+
+    # -- introspection ------------------------------------------------------
+
+    def snapshot(self) -> dict:
+        """The ``/debug/vars`` ``handoff`` section."""
+        return {
+            "self": self.self_addr,
+            "members": list(self.watcher.members),
+            "epoch": self.epoch,
+            "resizes_total": self.resizes_total,
+            "moved_series_total": self.moved_series_total,
+            "sent_total": self.sent_total,
+            "send_failures_total": self.send_failures_total,
+            "requeued_series_total": self.requeued_series_total,
+            "receives_total": self.receives_total,
+            "received_series_total": self.received_series_total,
+            "duplicates_total": self.duplicates_total,
+            "stale_total": self.stale_total,
+            "rejected_total": self.rejected_total,
+            "short_merges_total": self.short_merges_total,
+            "spool_recovered_total": self.spool_recovered_total,
+            "spool_resent_total": self.spool_resent_total,
+            "retries_total": self.retries_total,
+            "refresh_failures": self.watcher.failures,
+            "last_duration_ns": self.last_duration_ns,
+            "last_error": self.last_error,
+            "breakers": dict(self.breakers.states()),
+        }

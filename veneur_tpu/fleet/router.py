@@ -45,7 +45,64 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from veneur_tpu.proxy.consistent import ConsistentRing
+from veneur_tpu.proxy.consistent import ConsistentRing, ring_key
+
+__all__ = ["ring_key", "RingTransition", "ShardRouter",
+           "ShardPlacement", "PoolPlacement", "route_stack",
+           "inverse_perm"]
+
+
+class RingTransition:
+    """One fleet-membership change as a routing object: which instance
+    owned a series before, which owns it after, and whether a given
+    instance loses it. Built from a discovery refresh diff
+    (``discovery.RingWatcher``); consumed by the handoff manager's
+    moved-range extraction (``fleet/handoff.py``) and by tests that
+    assert the proxy and the handoff agree on ownership."""
+
+    def __init__(self, old_members: Sequence[str],
+                 new_members: Sequence[str], replicas: int = 20):
+        self.old_members = sorted(set(old_members))
+        self.new_members = sorted(set(new_members))
+        self.old_ring = ConsistentRing(self.old_members, replicas=replicas) \
+            if self.old_members else None
+        self.new_ring = ConsistentRing(self.new_members, replicas=replicas) \
+            if self.new_members else None
+
+    def new_owner(self, name: str, mtype: str, joined_tags: str) -> Optional[str]:
+        if self.new_ring is None:
+            return None
+        return self.new_ring.get(ring_key(name, mtype, joined_tags))
+
+    def new_owners(self, names: Sequence[str], mtype: str,
+                   joined_tags: Sequence[str]) -> List[Optional[str]]:
+        """Batched :meth:`new_owner`: one ring-lock hold for the whole
+        series list (``ConsistentRing.get_many``) — the handoff
+        extraction's moved-range computation routes per group batch,
+        not per key."""
+        if self.new_ring is None:
+            return [None] * len(names)
+        return self.new_ring.get_many(
+            [ring_key(n, mtype, j) for n, j in zip(names, joined_tags)])
+
+    def old_owner(self, name: str, mtype: str, joined_tags: str) -> Optional[str]:
+        if self.old_ring is None:
+            return None
+        return self.old_ring.get(ring_key(name, mtype, joined_tags))
+
+    def moved(self, name: str, mtype: str, joined_tags: str) -> bool:
+        """Whether this series' owner changed across the transition."""
+        return (self.old_owner(name, mtype, joined_tags)
+                != self.new_owner(name, mtype, joined_tags))
+
+    def loses_ranges(self, member: str) -> bool:
+        """Whether ``member`` can lose any range: it owned ranges
+        before (was a member) and the membership actually changed.
+        The single-member degenerate cases fall out naturally: 1→N
+        loses ranges, N→1 loses everything on the departing members,
+        1→1 (same member) never does."""
+        return (member in self.old_members
+                and self.old_members != self.new_members)
 
 
 class ShardRouter:
@@ -66,11 +123,12 @@ class ShardRouter:
         self._ring = ConsistentRing(list(self._index), replicas=replicas)
 
     def shard_for(self, name: str, mtype: str, joined_tags: str) -> int:
-        """The shard owning one series — the proxy's ``metric_ring_key``
-        (``name + type + joined tags``) against a ring of shards."""
+        """The shard owning one series — the shared :func:`ring_key`
+        rule against a ring of shards."""
         if self.shards == 1:
             return 0
-        return self._index[self._ring.get(name + mtype + joined_tags)]
+        return self._index[self._ring.get(ring_key(name, mtype,
+                                                   joined_tags))]
 
 
 class ShardPlacement:

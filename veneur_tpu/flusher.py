@@ -263,6 +263,7 @@ def _flush_once(server: "Server", span, rec=None):
         *_worker_samples(server, ms),
         *_overload_samples(server, ms),
         *_fleet_samples(server),
+        *_handoff_samples(server),
         *_forward_samples(server),
         *_import_samples(server),
         *_checkpoint_samples(server),
@@ -496,6 +497,63 @@ def _fleet_samples(server):
                                      float(rows), {"shard": str(i)}))
     out.append(ssf_samples.gauge("veneur.fleet.balance_ratio",
                                  balance_ratio(occ), None))
+    return out
+
+
+def _handoff_samples(server):
+    """The veneur.handoff.* set (docs/resilience.md "Elastic
+    resharding"): resize transitions, moved/requeued/received series,
+    duplicate-and-stale guard hits, and the last transition's
+    wall-clock — counters as interval deltas like every other set.
+    Empty when elastic resharding is off (one attribute read)."""
+    mgr = getattr(server, "handoff_manager", None)
+    if mgr is None:
+        return []
+    from veneur_tpu.trace import samples as ssf_samples
+
+    out = [
+        ssf_samples.count(
+            "veneur.handoff.resizes_total",
+            float(_delta_since(mgr, "_last_resizes",
+                               mgr.resizes_total)), None),
+        ssf_samples.count(
+            "veneur.handoff.moved_series_total",
+            float(_delta_since(mgr, "_last_moved",
+                               mgr.moved_series_total)), None),
+        ssf_samples.count(
+            "veneur.handoff.sent_total",
+            float(_delta_since(mgr, "_last_sent", mgr.sent_total)),
+            None),
+        ssf_samples.count(
+            "veneur.handoff.failed_total",
+            float(_delta_since(mgr, "_last_failed",
+                               mgr.send_failures_total)), None),
+        ssf_samples.count(
+            "veneur.handoff.requeued_series_total",
+            float(_delta_since(mgr, "_last_requeued",
+                               mgr.requeued_series_total)), None),
+        ssf_samples.count(
+            "veneur.handoff.received_series_total",
+            float(_delta_since(mgr, "_last_received",
+                               mgr.received_series_total)), None),
+        ssf_samples.count(
+            "veneur.handoff.duplicate_total",
+            float(_delta_since(mgr, "_last_duplicates",
+                               mgr.duplicates_total)), None),
+        ssf_samples.count(
+            "veneur.handoff.retries_total",
+            float(_delta_since(mgr, "_last_retries",
+                               mgr.retries_total)), None),
+        ssf_samples.gauge("veneur.handoff.epoch", float(mgr.epoch),
+                          None),
+    ]
+    if mgr.last_duration_ns:
+        out.append(ssf_samples.timing(
+            "veneur.handoff.duration_ns",
+            mgr.last_duration_ns / 1e9, None))
+    for dest, gauge in mgr.breakers.states():
+        out.append(ssf_samples.gauge(
+            "veneur.breaker.state", gauge, {"destination": dest}))
     return out
 
 

@@ -132,7 +132,21 @@ class _Handler(BaseHTTPRequestHandler):
 
     def do_POST(self):
         body = self._drain_body()
-        if self.path != "/import":
+        path = self.path.partition("?")[0]
+        extra = getattr(self.server, "veneur_post_routes", {}).get(path)
+        if extra is not None:
+            # handlers take the raw body and return (status, body,
+            # content_type) — the synchronous-merge endpoints (POST
+            # /handoff) live here: their 2xx IS the ack, so they must
+            # not ride the async import pool
+            try:
+                status, rbody, ctype = extra(self.headers, body)
+                self._reply(status, rbody, ctype)
+            except Exception as e:
+                log.exception("POST handler for %s failed", path)
+                self._reply(500, str(e))
+            return
+        if path != "/import":
             self._reply(404, "not found")
             return
         pool = self.server.veneur_import_pool
@@ -292,6 +306,7 @@ class OpsServer:
         self._httpd.veneur_import_pool = self.import_pool
         self._httpd.veneur_trace_client = trace_client
         self._httpd.veneur_get_routes = {}
+        self._httpd.veneur_post_routes = {}
         self._thread: Optional[threading.Thread] = None
 
     @classmethod
@@ -353,6 +368,11 @@ class OpsServer:
     def add_route(self, path: str, fn: Callable):
         """fn(query: dict) -> (status, body, content_type)."""
         self._httpd.veneur_get_routes[path] = fn
+
+    def add_post_route(self, path: str, fn: Callable):
+        """fn(headers, body: bytes) -> (status, body, content_type) —
+        synchronous POST endpoints (the handoff receiver)."""
+        self._httpd.veneur_post_routes[path] = fn
 
     @property
     def port(self) -> int:

@@ -25,7 +25,8 @@ from veneur_tpu.forward.http_forward import post_helper
 from veneur_tpu.httpserv import (ImportError400, ReuseportHTTPServer,
                                  bounded_inflate,
                                  unmarshal_metrics_from_http)
-from veneur_tpu.proxy.consistent import ConsistentRing, EmptyRingError
+from veneur_tpu.proxy.consistent import (ConsistentRing, EmptyRingError,
+                                         ring_key)
 from veneur_tpu.resilience import (BreakerRegistry, Deadline, RetryPolicy,
                                    faults_from_config, is_transient_status,
                                    post_with_retry)
@@ -35,8 +36,11 @@ log = logging.getLogger("veneur.proxy")
 
 def metric_ring_key(d: dict) -> str:
     """The hash key for one JSON metric — MetricKey.String()
-    (samplers/parser.go:50-56): name + type + joined sorted tags."""
-    return d["name"] + d["type"] + ",".join(d.get("tags") or [])
+    (samplers/parser.go:50-56): the shared ``ring_key`` rule (name +
+    type + joined sorted tags; ``proxy/consistent.py``), so proxy
+    routing, shard placement and moved-range computation can never
+    diverge."""
+    return ring_key(d["name"], d["type"], ",".join(d.get("tags") or []))
 
 
 class _ProxyHandler(BaseHTTPRequestHandler):
@@ -268,14 +272,28 @@ class Proxy:
     def _fan_out(self, items: List[dict], ring: ConsistentRing, key_fn,
                  path: str, compress: bool, counter: str, what: str):
         """The shared partition → parallel-POST machinery behind both
-        fan-outs."""
+        fan-outs. The whole batch resolves through ONE ``get_many``
+        call — one ring version — so a discovery refresh swapping the
+        membership mid-batch can never split one batch's keys across
+        the old and the new ring (the double-count window the
+        ring-transition handoff closes; the swap itself is atomic in
+        ``ConsistentRing.set_members``)."""
         by_dest: Dict[str, List[dict]] = defaultdict(list)
         dropped = 0
+        keyed: List[tuple] = []
         for d in items:
             try:
-                by_dest[ring.get(key_fn(d))].append(d)
-            except (EmptyRingError, KeyError, TypeError, ValueError):
+                keyed.append((key_fn(d), d))
+            except (KeyError, TypeError, ValueError):
                 dropped += 1
+        try:
+            owners = ring.get_many([k for k, _ in keyed])
+        except EmptyRingError:
+            dropped += len(keyed)
+            owners = []
+            keyed = []
+        for owner, (_, d) in zip(owners, keyed):
+            by_dest[owner].append(d)
         if dropped:
             log.warning("dropped %d unroutable %s", dropped, what)
         threads = []
@@ -382,6 +400,7 @@ class Proxy:
         def ring_vars():
             return {"ring": {
                 "destinations": len(self.ring),
+                "version": self.ring.version,
                 "trace_destinations": len(self.trace_ring),
                 "proxied": self.proxied,
                 "traces_proxied": self.traces_proxied,

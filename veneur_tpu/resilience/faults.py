@@ -38,6 +38,19 @@ KIND_TRUNCATE = "truncate"
 KIND_BURST = "burst"
 INGEST_KINDS = (KIND_TRUNCATE, KIND_BURST)
 BURST_MAX_COPIES = 8
+# membership-churn faults (mangle_members): discovery reports a member
+# that does not exist, loses a member that does, or a member stays in
+# the ring while the network to it is dead — the three shapes a fleet
+# resize under failure actually produces. Like the ingest kinds these
+# stay OUT of ALL_KINDS so the seeded schedules every existing
+# transport soak reproduces are untouched.
+KIND_MEMBER_ADD = "member_add"
+KIND_MEMBER_REMOVE = "member_remove"
+KIND_PARTITION = "partition"
+CHURN_KINDS = (KIND_MEMBER_ADD, KIND_MEMBER_REMOVE, KIND_PARTITION)
+# how many refresh intervals (mangle_members calls) a partition
+# black-holes its destination before healing
+PARTITION_INTERVALS = 3
 
 # the status wrap_post returns for an injected 5xx
 INJECTED_STATUS = 503
@@ -66,10 +79,11 @@ class FaultInjector:
                  kinds: Sequence[str] = ALL_KINDS, scope: str = ""):
         if not 0.0 <= rate <= 1.0:
             raise ValueError(f"fault rate must be in [0, 1], got {rate}")
-        bad = [k for k in kinds if k not in ALL_KINDS + INGEST_KINDS]
+        known = ALL_KINDS + INGEST_KINDS + CHURN_KINDS
+        bad = [k for k in kinds if k not in known]
         if bad:
             raise ValueError(f"unknown fault kinds {bad}; known: "
-                             f"{list(ALL_KINDS + INGEST_KINDS)}")
+                             f"{list(known)}")
         self.rate = rate
         self.seed = seed
         self.kinds = tuple(kinds) or ALL_KINDS
@@ -78,6 +92,9 @@ class FaultInjector:
         self._lock = threading.Lock()
         self.calls = 0
         self.injected: Dict[str, int] = {k: 0 for k in self.kinds}
+        # live partitions: destination -> refresh intervals left before
+        # the network to it heals (KIND_PARTITION)
+        self._partitions: Dict[str, int] = {}
 
     def should_fail(self, op: str) -> Optional[str]:
         """The kind to inject for this call, or None. Exactly two rng
@@ -104,7 +121,7 @@ class FaultInjector:
         egress hooks must not turn a scheduled packet mangle into a
         transport error the operator never configured."""
         kind = self.should_fail(op)
-        if kind is None or kind in INGEST_KINDS:
+        if kind is None or kind in INGEST_KINDS or kind in CHURN_KINDS:
             return
         if kind == KIND_CONNECT:
             raise InjectedConnectError(f"injected connect error ({op})")
@@ -158,6 +175,51 @@ class FaultInjector:
                 copies = self._rng.randrange(2, BURST_MAX_COPIES + 1)
             return [data] * copies
         return [data]
+
+    def mangle_members(self, op: str, members: List[str]) -> List[str]:
+        """Apply the scheduled CHURN fault to one discovery refresh
+        result, returning the membership the ring consumer should see:
+
+        * no fault → ``members`` untouched;
+        * ``member_add`` → one synthetic (black-hole) member appended —
+          handoffs routed to it must ride the breaker/requeue ladder;
+        * ``member_remove`` → a seeded member dropped (never the last
+          one: churn must not empty the fleet and trip the
+          keep-last-good path every refresh);
+        * ``partition`` → membership untouched, but a seeded member is
+          black-holed for ``PARTITION_INTERVALS`` refreshes —
+          ``is_partitioned`` answers the transport hook.
+
+        One call = one refresh interval: live partitions tick down here,
+        so the heal schedule is as reproducible as the fault schedule.
+        Non-churn scheduled kinds pass through untouched (one injector
+        can drive transport, ingest and churn faults off one seed)."""
+        with self._lock:
+            for dest in list(self._partitions):
+                self._partitions[dest] -= 1
+                if self._partitions[dest] <= 0:
+                    del self._partitions[dest]
+        kind = self.should_fail(op)
+        if kind == KIND_MEMBER_ADD:
+            with self._lock:
+                idx = self._rng.randrange(1 << 16)
+            return list(members) + [f"fault://injected-{idx}"]
+        if kind == KIND_MEMBER_REMOVE and len(members) > 1:
+            with self._lock:
+                idx = self._rng.randrange(len(members))
+            return [m for i, m in enumerate(members) if i != idx]
+        if kind == KIND_PARTITION and members:
+            with self._lock:
+                idx = self._rng.randrange(len(members))
+                self._partitions[members[idx]] = PARTITION_INTERVALS
+        return list(members)
+
+    def is_partitioned(self, dest: str) -> bool:
+        """Whether a scheduled ``partition`` fault currently black-holes
+        ``dest`` — transports consult this before the send and raise
+        their connect error as if the peer were unreachable."""
+        with self._lock:
+            return dest in self._partitions
 
     def schedule(self, n: int) -> Tuple[Optional[str], ...]:
         """The next ``n`` outcomes, consumed — test/debug helper for

@@ -409,6 +409,24 @@ class Server:
                            * self.interval),
                 hostname=self.hostname)
 
+        # elastic fleet resharding (veneur_tpu/fleet/handoff.py,
+        # docs/resilience.md "Elastic resharding"): membership watcher
+        # + zero-loss packed-digest handoff, both roles (sender and
+        # /handoff receiver). Built after the checkpointer and the
+        # timeline — it anchors crash recovery on the former and
+        # publishes its stage trees into the latter.
+        self.handoff_manager = None
+        if config.handoff_enabled:
+            if config.forward_address:
+                # mirrors config.validate for directly-built Configs
+                raise ValueError(
+                    "handoff_enabled requires a GLOBAL instance, but "
+                    "forward_address is set (config.validate rejects "
+                    "this combination at load)")
+            from veneur_tpu.fleet.handoff import HandoffManager
+
+            self.handoff_manager = HandoffManager.for_server(self)
+
         # ingest error/telemetry counters. packet_errors/spans_dropped
         # are SHARDED (veneur_tpu/ingest/counters.py): the hot paths —
         # every reader thread on every bad packet, every span shed —
@@ -634,6 +652,10 @@ class Server:
         self._started_wall = time.time()
         if self.checkpointer is not None:
             self.checkpointer.restore()
+        if self.handoff_manager is not None:
+            # sent-but-unacked handoffs spooled by a crashed previous
+            # life re-enter the live store (late, never lost)
+            self.handoff_manager.recover_spool()
 
         # shared per-sink ingest lanes: every worker feeds the same lanes,
         # so each sink has one ingest thread and one flush barrier
@@ -686,6 +708,16 @@ class Server:
             from veneur_tpu.httpserv import OpsServer
 
             self.ops_server = OpsServer.for_server(self, cfg.http_address)
+            if self.handoff_manager is not None:
+                # the receiver half: a peer's moved ranges merge here
+                # synchronously — the 2xx IS the ack — with the id /
+                # epoch guards making retries at-most-once
+                mgr = self.handoff_manager
+                self.ops_server.add_post_route(
+                    "/handoff",
+                    lambda headers, body: mgr.handle_handoff(body))
+                self.ops_server.add_route("/handoff-status",
+                                          mgr.status_route)
             self.ops_server.start()
         # gRPC import ingest (server.go:536-546, importsrv/)
         if cfg.grpc_address:
@@ -707,6 +739,13 @@ class Server:
 
             self._forwarder = configure_forwarding(self)
 
+        if self.handoff_manager is not None:
+            self._handoff_thread = threading.Thread(
+                target=self._guard(
+                    lambda: self.handoff_manager.run(self._stop)),
+                name="handoff-refresh", daemon=True)
+            self._handoff_thread.start()
+            self._threads.append(self._handoff_thread)
         self._flush_thread = threading.Thread(
             target=self._guard(self._flush_loop), name="flush-ticker",
             daemon=True)
@@ -1245,6 +1284,21 @@ class Server:
         # truncate
         if self._ckpt_thread is not None:
             self._ckpt_thread.join(timeout=10.0)
+        # an in-flight handoff must finish (stream or requeue) before
+        # the final flush, or a SIGTERM mid-resize would drain the
+        # store while the moved ranges are still in the manager's
+        # hands — they would miss this life's final emission. JOIN the
+        # refresh thread first: quiesce alone is check-then-act — a
+        # refresh blocked in discovery I/O when _stop was set could
+        # still START a transition after quiesce returned
+        if self.handoff_manager is not None:
+            t = getattr(self, "_handoff_thread", None)
+            if t is not None:
+                t.join(timeout=30.0)
+            if (t is not None and t.is_alive()) or \
+                    not self.handoff_manager.quiesce(timeout=30.0):
+                log.warning("handoff still in flight at shutdown; its "
+                            "spool will recover on the next start")
         try:
             self.flush()
         except Exception:
