@@ -21,7 +21,8 @@ import pytest
 from veneur_tpu.lint import PASSES, Baseline, Project, run_passes
 from veneur_tpu.lint.framework import Finding, SourceFile
 from veneur_tpu.lint import (configdrift, deadcode, lockorder, locks,
-                             lockset, metricnames, purity, recompile)
+                             lockset, metricnames, purity, recompile,
+                             stagenames)
 
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
@@ -65,7 +66,7 @@ class TestRealCodebase:
         assert set(PASSES) == {"lock-discipline", "lock-order", "lockset",
                                "jax-purity", "recompile-hazard",
                                "config-drift", "metric-registry",
-                               "dead-code"}
+                               "stage-registry", "dead-code"}
 
     def test_full_run_stays_under_wallclock_budget(self):
         """Runtime-budget guard: the full pass suite over the real
@@ -1014,6 +1015,75 @@ def reachable_branches(x):
         return 1
     return 2
 '''
+
+
+class TestStageRegistry:
+    REL = "veneur_tpu/_fixture_stages.py"
+
+    def test_real_package_collection_is_not_vacuous(self, project):
+        names = {s.name for s in stagenames.collect_stages(project)}
+        # flusher + handoff stage vocabulary must be visible
+        assert "events" in names
+        assert "handoff.extract" in names
+        routes = {s.name for s in stagenames.collect_traced_routes(project)}
+        assert routes == {"/import", "/handoff"}
+
+    def test_real_package_is_documented(self, project):
+        assert stagenames.run(project) == []
+
+    def test_undocumented_stage_flagged(self, project):
+        clone = synthetic(project, self.REL, '''
+from veneur_tpu import obs
+
+def flush():
+    with obs.maybe_stage("fixture_nonexistent_stage"):
+        pass
+''')
+        found = findings_in(stagenames.run(clone), self.REL)
+        assert [f.code for f in found] == ["undocumented-stage"]
+        assert found[0].anchor == "fixture_nonexistent_stage"
+
+    def test_documented_leaf_and_fstring_hole_not_flagged(self, project):
+        # "fetch" is documented as store.<group>.fetch (leaf-segment
+        # match); f"post.{sink.name}" normalizes to a hole that must
+        # match the documented post.<sink> row
+        clone = synthetic(project, self.REL, '''
+from veneur_tpu import obs
+
+def flush(rec, sink):
+    with obs.maybe_stage("fetch"):
+        pass
+    rec.record_abs(f"post.{sink.name}", 0, 1)
+''')
+        assert findings_in(stagenames.run(clone), self.REL) == []
+
+    def test_pragma_suppresses(self, project):
+        clone = synthetic(project, self.REL, '''
+from veneur_tpu import obs
+
+def flush():
+    with obs.maybe_stage("fixture_secret_stage"):  # lint: ok(undocumented-stage) fixture
+        pass
+''')
+        assert findings_in(stagenames.run(clone), self.REL) == []
+
+    def test_undocumented_traced_route_flagged(self, project):
+        clone = synthetic(project, "veneur_tpu/obs/tracectx.py", '''
+TRACED_ROUTES = ("/import", "/handoff", "/fixture-route")
+''')
+        found = [f for f in stagenames.run(clone)
+                 if f.code == "undocumented-route"]
+        assert [f.anchor for f in found] == ["/fixture-route"]
+
+    def test_non_literal_stage_names_skipped(self, project):
+        clone = synthetic(project, self.REL, '''
+from veneur_tpu import obs
+
+def flush(gen_name):
+    with obs.maybe_stage(gen_name):
+        pass
+''')
+        assert findings_in(stagenames.run(clone), self.REL) == []
 
 
 class TestDeadCode:

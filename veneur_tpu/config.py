@@ -280,6 +280,22 @@ class Config:
     # default 64; negative rejected) — bounds the timeline's memory on
     # a long-lived server
     obs_timeline_intervals: int = 0
+    # fleet trace plane (obs/fleet.py, docs/observability.md "Fleet
+    # tracing"): peers whose /debug/flush-timeline + /debug/vars the
+    # GET /debug/fleet aggregation pulls — comma-separated addresses,
+    # or "file:///path" re-read each refresh (one address per line).
+    # Empty = fall back to handoff_peers when elastic resharding is
+    # on, else this instance serves only its own entries at
+    # /debug/trace. List LOCAL instances too: the stitched trace view
+    # needs their flush entries.
+    fleet_peers: str = ""
+    # minimum seconds between /debug/fleet peer-pull rounds (a
+    # hammered endpoint costs peers one pull per window); parsed ONCE
+    # at load. Empty = 5s
+    fleet_pull_interval: str = ""
+    # per-peer HTTP budget for one /debug/fleet pull; parsed ONCE at
+    # load. Empty = 2s
+    fleet_pull_timeout: str = ""
 
     # ---- elastic fleet resharding (veneur_tpu/fleet/handoff.py) ----------
     # live resharding for the GLOBAL tier (docs/resilience.md "Elastic
@@ -554,6 +570,13 @@ class Config:
         self.handoff_refresh_interval_seconds = (
             parse_duration(self.handoff_refresh_interval)
             if self.handoff_refresh_interval else 10.0)
+        # fleet trace plane pull knobs (obs/fleet.py), parse-once
+        self.fleet_pull_interval_seconds = (
+            parse_duration(self.fleet_pull_interval)
+            if self.fleet_pull_interval else 5.0)
+        self.fleet_pull_timeout_seconds = (
+            parse_duration(self.fleet_pull_timeout)
+            if self.fleet_pull_timeout else 2.0)
         # parse-once (round-1 audit policy): 0.0 = unset, the server
         # derives interval / 4 at start
         self.checkpoint_interval_seconds = (

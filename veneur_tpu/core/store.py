@@ -2093,17 +2093,21 @@ class MetricStore:
     # -- dogfooded self-telemetry (veneur_tpu/obs/) ------------------------
 
     @acquires_lock("store")
-    def sample_self_timing(self, stage: str, duration_ns: float) -> None:
+    def sample_self_timing(self, stage: str, duration_ns: float,
+                           name: str = "veneur.obs.stage_duration_ns"
+                           ) -> None:
         """One observed stage duration into the dedicated self-telemetry
         digest group: the flusher feeds every interval's stage
         durations (and the ingest lanes' seal->merge latencies) here,
         so the next flush emits exact p50/p99 of the server's own
         stages through the same t-digest pipeline it sells
         (``veneur.obs.stage_duration_ns`` tagged ``stage:<name>``).
-        Exempt from the overload freeze (_apply_overload_attrs)."""
+        ``name`` overrides the metric for the few rows that are their
+        own metric (``veneur.fleet.e2e_age_ns``, the fleet-freshness
+        measure — docs/observability.md "Fleet tracing"). Exempt from
+        the overload freeze (_apply_overload_attrs)."""
         tag = f"stage:{stage}"
-        key = MetricKey(name="veneur.obs.stage_duration_ns", type="timer",
-                        joined_tags=tag)
+        key = MetricKey(name=name, type="timer", joined_tags=tag)
         with self._lock:
             self.self_timers.sample(key, [tag], float(duration_ns), 1.0)
 

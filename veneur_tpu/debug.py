@@ -21,6 +21,10 @@ be profiled in place. The Python equivalents here:
                                     scopes of obs/kernels.py (server
                                     only; gated one-at-a-time + clamped
                                     like /debug/profile)
+    GET /debug/fleet                peers' timelines + vars, pulled
+                                    keep-last-good (obs/fleet.py)
+    GET /debug/trace?id=N           the stitched per-trace cross-hop
+                                    view (the fleet trace plane)
 
 Mounted on both the server's OpsServer and the proxy's mux.
 """
@@ -255,6 +259,12 @@ def collect_vars(server) -> dict:
             timeline = server.obs_timeline
             if timeline is not None:
                 section["timeline"] = timeline.snapshot()
+            hops = getattr(server, "obs_hops", None)
+            if hops is not None:
+                section["hops"] = hops.snapshot()
+            agg = getattr(server, "fleet_aggregator", None)
+            if agg is not None:
+                section["fleet"] = agg.snapshot()
             out["obs"] = section
     except Exception as e:  # pragma: no cover - diagnostic only
         out["obs_error"] = repr(e)
@@ -322,3 +332,9 @@ def mount(add_route, server=None, extra_vars=None):
         # pipeline and no device programs to capture)
         add_route("/debug/flush-timeline", flush_timeline)
         add_route("/debug/xprof", xprof)
+        agg = getattr(server, "fleet_aggregator", None)
+        if agg is not None:
+            # the fleet trace plane (obs/fleet.py): peer aggregation +
+            # the stitched per-trace hop view
+            add_route("/debug/fleet", agg.fleet_route)
+            add_route("/debug/trace", agg.trace_route)

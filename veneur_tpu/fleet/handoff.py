@@ -265,7 +265,7 @@ class HandoffManager:
                  timeout: float = 10.0, retry_policy=None, breakers=None,
                  spool_prefix: str = "", checkpointer=None, timeline=None,
                  refresh_interval: float = 10.0, injector=None,
-                 replicas: int = 20):
+                 replicas: int = 20, hop_log=None):
         from veneur_tpu.resilience import BreakerRegistry, RetryPolicy
 
         self.store = store
@@ -280,6 +280,16 @@ class HandoffManager:
         self.refresh_interval = refresh_interval
         self.injector = injector
         self.replicas = replicas
+        # fleet trace plane (obs/tracectx.py): received handoffs record
+        # their hop here so /debug/trace can stitch the resharding hop
+        self.hop_log = hop_log
+        # requeued-handoff retry (ROADMAP item 4 REMAINING): once a
+        # transition requeues anything, the NEXT refresh cadence —
+        # membership change or not — re-runs a same-ring transition,
+        # which re-extracts exactly the misrouted residue
+        self.retry_pending = False
+        self._retry_dests: set = set()  # dests whose requeue is owed
+        self.requeue_retries_total = 0
         # sender state. The handoff epoch must be monotonic ACROSS
         # restarts (receivers remember the last epoch per sender
         # in-memory; a restart that reset to 0 would see every handoff
@@ -364,7 +374,8 @@ class HandoffManager:
             checkpointer=server.checkpointer,
             timeline=getattr(server, "obs_timeline", None),
             refresh_interval=cfg.handoff_refresh_interval_seconds,
-            injector=injector)
+            injector=injector,
+            hop_log=getattr(server, "obs_hops", None))
 
     # -- sender: refresh loop ----------------------------------------------
 
@@ -389,6 +400,33 @@ class HandoffManager:
         misrouted residue."""
         change = self.watcher.refresh()
         if change is None:
+            if self.retry_pending and self.watcher.members:
+                # ROADMAP item 4 REMAINING, closed: a requeued handoff
+                # no longer waits for the next membership CHANGE — the
+                # next refresh cadence re-runs a same-ring transition,
+                # whose split re-extracts exactly the requeued residue
+                # (anything whose current-ring owner is not this
+                # instance). While every requeued destination's breaker
+                # is still OPEN the retry is NOT armed — the transition
+                # itself is a full extract/checkpoint/spool/restore
+                # cycle, far too heavy to burn against a peer that is
+                # known-down; blocked() is the non-consuming state
+                # check, so a dead peer really does cost one breaker
+                # read per cadence until its reset timeout readies a
+                # half-open probe.
+                dests = [d for d in self._retry_dests
+                         if d in self.watcher.members]
+                if dests and all(self.breakers.get(d).blocked()
+                                 for d in dests):
+                    return None
+                members = list(self.watcher.members)
+                self.requeue_retries_total += 1
+                log.info("handoff: retrying requeued ranges on the "
+                         "refresh cadence (membership unchanged: %s)",
+                         members)
+                return self._run_handoff(
+                    RingTransition(members, members,
+                                   replicas=self.replicas))
             return None
         transition = RingTransition(change.old, change.new,
                                     replicas=self.replicas)
@@ -429,6 +467,13 @@ class HandoffManager:
 
         t0 = time.monotonic_ns()
         rec = obs.StageRecorder() if self.timeline is not None else None
+        if rec is not None:
+            from veneur_tpu.obs import tracectx
+
+            # a handoff starts its own distributed trace: the receiver
+            # parents its merge under this hop's span via the
+            # X-Veneur-Trace header on POST /handoff
+            rec.adopt_trace(tracectx.new_span_id(), hop="handoff.send")
         # _busy deliberately spans the WHOLE transition incl. the spool
         # fsync and the stream: it is the shutdown quiesce barrier, not
         # a data lock — its only other user is quiesce(), which exists
@@ -452,7 +497,14 @@ class HandoffManager:
 
     def _run_handoff_staged(self, transition: RingTransition) -> dict:
         from veneur_tpu import obs
+        from veneur_tpu.obs import TraceContext
 
+        self.retry_pending = False  # re-set below by any requeue
+        self._retry_dests.clear()
+        ctx = None
+        rec = obs.current()
+        if rec is not None and rec.trace_id:
+            ctx = TraceContext(rec.trace_id, rec.span_id)
         with self._lock:
             self.epoch = max(self.epoch + 1, int(time.time()))
             epoch = self.epoch
@@ -495,6 +547,8 @@ class HandoffManager:
                         self._requeue(moved[dest], dest,
                                       f"{self.self_addr}:{epoch}:abort")
                         summary["requeued"].append(dest)
+                        self._retry_dests.add(dest)
+                    self.retry_pending = True
                     return summary
         pending = []  # (dest, groups, blob, handoff_id, spool_path)
         with obs.maybe_stage("handoff.spool"):
@@ -522,7 +576,7 @@ class HandoffManager:
         for dest, groups, blob, handoff_id, spool in pending:
             n = sum(snapshot_counts(groups).values())
             with obs.maybe_stage("handoff.stream", dest=dest, series=n):
-                ok = self._send(dest, blob, handoff_id)
+                ok = self._send(dest, blob, handoff_id, ctx=ctx)
             if ok:
                 self.sent_total += 1
                 summary["sent"].append(dest)
@@ -543,6 +597,8 @@ class HandoffManager:
                     spool = ""
                 self._requeue(groups, dest, handoff_id)
                 summary["requeued"].append(dest)
+                self._retry_dests.add(dest)
+                self.retry_pending = True
                 # the requeued state is memory-only and the post-swap
                 # anchor excludes it; re-anchor so a crash right after
                 # still recovers it (an epoch-raced/failed write keeps
@@ -591,13 +647,16 @@ class HandoffManager:
         return url
 
     def _post_blob(self, url: str, blob: bytes, timeout: float,
-                   out: dict) -> int:
+                   out: dict, ctx=None) -> int:
         if self.injector is not None:
             self.injector.maybe_fail(f"handoff.post.{url}")
+        headers = {"Content-Type": "application/octet-stream"}
+        if ctx is not None:
+            from veneur_tpu.obs import tracectx
+
+            headers[tracectx.HEADER] = ctx.encode()
         req = urllib.request.Request(
-            url, data=blob,
-            headers={"Content-Type": "application/octet-stream"},
-            method="POST")
+            url, data=blob, headers=headers, method="POST")
         try:
             with urllib.request.urlopen(req, timeout=timeout) as resp:
                 out["body"] = resp.read()
@@ -609,7 +668,8 @@ class HandoffManager:
                 e.close()
             return e.code
 
-    def _send(self, dest: str, blob: bytes, handoff_id: str) -> bool:
+    def _send(self, dest: str, blob: bytes, handoff_id: str,
+              ctx=None) -> bool:
         from veneur_tpu.resilience import (Deadline, is_transient_status,
                                            post_with_retry)
 
@@ -639,7 +699,7 @@ class HandoffManager:
             status = post_with_retry(
                 lambda: self._post_blob(
                     base + "/handoff", blob,
-                    deadline.clamp(self.timeout), info),
+                    deadline.clamp(self.timeout), info, ctx=ctx),
                 self.retry_policy, deadline=deadline, on_retry=on_retry)
         except Exception as e:
             breaker.record_failure()
@@ -677,13 +737,17 @@ class HandoffManager:
 
     # -- receiver -----------------------------------------------------------
 
-    def handle_handoff(self, body: bytes) -> Tuple[int, str, str]:
+    def handle_handoff(self, body: bytes,
+                       headers=None) -> Tuple[int, str, str]:
         """The ``POST /handoff`` merge: decode, guard by id (duplicate
         acks without merging — the id is registered BEFORE the merge,
         so a retry of a crashed-mid-merge attempt is at-most-once) and
         by per-sender epoch (a stale epoch is a replay of a superseded
         transition: 409), then merge through the import-semantics
-        restore and ack with the merged count."""
+        restore and ack with the merged count. A trace-bearing stream
+        (``X-Veneur-Trace``) records its hop so ``/debug/trace``
+        stitches the resharding path like any other hop."""
+        t0_wall = time.time()
         try:
             groups, meta = decode_handoff(body)
         except CheckpointInvalid as e:
@@ -750,6 +814,14 @@ class HandoffManager:
                       handoff_id, sender, merged, expected)
         log.info("handoff %s from %s (epoch %d): merged %d series",
                  handoff_id, sender, epoch, merged)
+        if self.hop_log is not None:
+            from veneur_tpu.obs import TraceContext
+
+            ctx = TraceContext.from_headers(headers)
+            if ctx is not None:
+                self.hop_log.record("handoff.receive", ctx, t0_wall,
+                                    time.time(), series=merged,
+                                    sender=sender)
         return 200, json.dumps({"id": handoff_id, "merged": merged}), \
             "application/json"
 
@@ -868,6 +940,8 @@ class HandoffManager:
             "spool_recovered_total": self.spool_recovered_total,
             "spool_resent_total": self.spool_resent_total,
             "retries_total": self.retries_total,
+            "requeue_retries_total": self.requeue_retries_total,
+            "retry_pending": self.retry_pending,
             "refresh_failures": self.watcher.failures,
             "last_duration_ns": self.last_duration_ns,
             "last_error": self.last_error,

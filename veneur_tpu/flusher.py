@@ -41,6 +41,13 @@ def flush_once(server: "Server"):
     span.name = "flush"
     timeline = getattr(server, "obs_timeline", None)
     rec = obs.StageRecorder() if timeline is not None else None
+    if rec is not None:
+        # join the fleet trace plane (obs/tracectx.py): this interval's
+        # stage tree publishes under the flush span's ids, so the hop a
+        # forward stamps downstream (X-Veneur-Trace) parents back here
+        rec.adopt_trace(span.trace_id, span_id=span.span_id,
+                        hop="local.flush" if server.is_local()
+                        else "global.flush")
     try:
         with obs.activate(rec):
             _flush_once(server, span, rec)
@@ -62,17 +69,73 @@ def _publish_interval(server, span, rec, timeline):
     """Interval-end merge: finish the stage record, publish it to the
     timeline ring, mirror the stage tree as child SSF spans under the
     flush root, and sample every stage duration (plus the ingest
-    lanes' seal->merge latencies) into the self-telemetry group."""
+    lanes' seal->merge latencies) into the self-telemetry group.
+
+    Fleet trace plane additions (obs/tracectx.py): the interval's
+    received cross-hop records (imports, handoffs) drain out of the
+    server's HopLog into this entry as off-path stages carrying their
+    trace ids, the entry is stamped with the contributing trace-id set
+    (``import_traces`` — what /debug/trace matches the global flush
+    on), the ingest lanes' per-stage trees land under an off-path
+    ``ingest`` stage, and on a global the oldest ingest-era stamp
+    aboard becomes ``veneur.fleet.e2e_age_ns`` — measured HERE, after
+    the sink joins, so the age really covers ingest → sink 2xx."""
     from veneur_tpu.obs import kernels as obs_kernels
+    from veneur_tpu.obs import tracectx
     from veneur_tpu.trace import samples as ssf_samples
 
+    hop_log = getattr(server, "obs_hops", None)
+    hops = hop_log.drain() if hop_log is not None else []
+    for h in hops:
+        # the true wall times ride as attrs: a hop that landed BEFORE
+        # this interval started gets its start clamped to 0 in the
+        # recorder's relative frame, and the /debug/trace stitcher
+        # needs the real ordering
+        attrs = {k: v for k, v in h.items()
+                 if k not in ("hop", "duration_ns")}
+        rec.record_abs(h["hop"],
+                       tracectx.wall_to_mono_ns(rec, h["wall_start"]),
+                       tracectx.wall_to_mono_ns(rec, h["wall_end"]),
+                       off_path=True, **attrs)
+    ingest_stages = _drain_ingest_stages(server)
+    if ingest_stages:
+        # the ingest-path stage tree: cumulative lane-time since the
+        # last interval (recv includes socket wait), anchored at the
+        # interval start and off-path — ingest overlaps the whole
+        # interval, so it must not count against flush coverage
+        total = sum(ingest_stages[s]
+                    for s in ("recv", "decode", "stage", "seal"))
+        rec.record_abs("ingest", rec.t0_ns, rec.t0_ns + total,
+                       off_path=True, lanes=ingest_stages["lanes"],
+                       iters=ingest_stages["iters"])
+        for stage in ("recv", "decode", "stage", "seal"):
+            rec.record_abs(f"ingest.{stage}", rec.t0_ns,
+                           rec.t0_ns + ingest_stages[stage],
+                           off_path=True)
     entry = rec.finish()
+    if hops:
+        tids = sorted({h["trace_id"] for h in hops if h.get("trace_id")})
+        if tids:
+            entry["import_traces"] = tids
     latencies = _drain_ingest_latencies(server)
     if latencies:
         entry["ingest_seal_to_merge"] = {
             "count": len(latencies),
             "max_ns": int(max(latencies)),
             "avg_ns": int(sum(latencies) / len(latencies))}
+    # freshness: the oldest ingest-era stamp this interval aggregated —
+    # own lanes and received hops, both taken AT the swap boundary in
+    # _flush_once (a post-swap arrival ages the next interval)
+    oldest = getattr(server, "_interval_oldest_ingest_ns", None)
+    e2e_ns = None
+    if oldest:
+        age_ns = max(0, time.time_ns() - oldest)
+        entry["oldest_sample_age_ns"] = age_ns
+        if not server.is_local():
+            # the sink threads joined before this runs: the age spans
+            # ingest stamp -> global sink 2xx, the true e2e freshness
+            e2e_ns = age_ns
+            entry["e2e_age_ns"] = e2e_ns
     timeline.publish(entry)
     _record_stage_spans(server, span, entry)
     store = getattr(server, "store", None)
@@ -81,6 +144,22 @@ def _publish_interval(server, span, rec, timeline):
             store.sample_self_timing(stage["name"], stage["duration_ns"])
         for ns in latencies:
             store.sample_self_timing("ingest.seal_to_merge", float(ns))
+        if e2e_ns is not None:
+            # exact p50/p99 through the dedicated digest group, under
+            # its own metric name (docs/observability.md "Fleet
+            # tracing")
+            store.sample_self_timing("e2e", float(e2e_ns),
+                                     name="veneur.fleet.e2e_age_ns")
+    for hop_name, n in sorted(
+            _count_by(hops, "hop").items()):
+        span.add(ssf_samples.count("veneur.trace.hops_total", float(n),
+                                   {"hop": hop_name}))
+    agg = getattr(server, "fleet_aggregator", None)
+    if agg is not None:
+        span.add(ssf_samples.count(
+            "veneur.trace.fleet_pull_errors_total",
+            float(_delta_since(agg, "_last_pull_errors",
+                               agg.pull_errors_total)), None))
     # live device observability: coverage of the interval's stages plus
     # compile/dispatch deltas per kernel scope (what the recompile lint
     # pass proves statically, observed at runtime)
@@ -99,6 +178,51 @@ def _publish_interval(server, span, rec, timeline):
             "veneur.obs.kernel_dispatches_total",
             float(_delta_since(server, f"_last_dispatch_{scope_name}", n)),
             {"scope": scope_name}))
+
+
+def _count_by(records: list, key: str) -> dict:
+    out: dict = {}
+    for r in records:
+        k = r.get(key)
+        if k:
+            out[k] = out.get(k, 0) + 1
+    return out
+
+
+def _drain_ingest_stages(server):
+    """Sum the interval's per-stage ingest-lane time over every fleet
+    (ingest/lanes.py take_ingest_stages); None when lanes are absent
+    or stage tracing is off."""
+    total = None
+    for fleet in getattr(server, "_ingest_fleets", None) or ():
+        try:
+            stages = fleet.take_ingest_stages()
+        except Exception:  # pragma: no cover - telemetry only
+            log.exception("ingest stage drain failed")
+            continue
+        if not stages:
+            continue
+        if total is None:
+            total = stages
+        else:
+            for k in ("recv", "decode", "stage", "seal", "iters",
+                      "lanes"):
+                total[k] += stages[k]
+    return total
+
+
+def _take_oldest_ingest_ns(server):
+    """The oldest ingest-era stamp among lane chunks merged since the
+    last flush (read-and-reset per fleet)."""
+    oldest = None
+    for fleet in getattr(server, "_ingest_fleets", None) or ():
+        try:
+            v = fleet.take_oldest_ingest_ns()
+        except Exception:  # pragma: no cover - telemetry only
+            continue
+        if v and (oldest is None or v < oldest):
+            oldest = v
+    return oldest
 
 
 def _drain_ingest_latencies(server) -> list:
@@ -222,6 +346,22 @@ def _flush_once(server: "Server", span, rec=None):
         forwarding and use_columnar
         and getattr(server._forwarder, "wants_packed_digests", False)) \
         else "dense"
+    # freshness anchor, read-and-reset AT the swap boundary: the
+    # oldest lane chunk merged before the swap plus the oldest
+    # received-hop stamp recorded before it — the samples THIS flush
+    # drains. A stamp arriving after the swap merges into the next
+    # generation and must age the NEXT interval (taking it at publish
+    # time would attribute a late import's age to an interval that
+    # never emitted its samples, and rob the interval that does).
+    # _publish_interval and the forward's trace context read the stash.
+    oldest_ingest = _take_oldest_ingest_ns(server)
+    hop_log = getattr(server, "obs_hops", None)
+    if hop_log is not None:
+        hop_oldest = hop_log.take_oldest_ingest_ns()
+        if hop_oldest and (oldest_ingest is None
+                           or hop_oldest < oldest_ingest):
+            oldest_ingest = hop_oldest
+    server._interval_oldest_ingest_ns = oldest_ingest
     t0 = time.perf_counter()
     with obs.maybe_stage("store"):
         final_metrics, forwardable, ms = server.store.flush(
@@ -287,6 +427,17 @@ def _flush_once(server: "Server", span, rec=None):
             # the forward runs off the flush path but shares the flush
             # budget: its retries must finish before the next interval
             kwargs["deadline"] = deadline
+        if "trace_ctx" in fwd_params:
+            # the fleet trace plane's hop baggage (obs/tracectx.py):
+            # this flush's span ids + the oldest ingest-era stamp
+            # aboard the forwarded state (interval start when the
+            # legacy readers left no stamp)
+            from veneur_tpu.obs import TraceContext
+
+            ingest_ns = (getattr(server, "_interval_oldest_ingest_ns",
+                                 None) or int(now * 1e9))
+            kwargs["trace_ctx"] = TraceContext(span.trace_id,
+                                               span.span_id, ingest_ns)
         def fwd():
             # the forward runs off the flush path; with observability
             # on it lands in the interval's already-published timeline
@@ -544,6 +695,12 @@ def _handoff_samples(server):
             "veneur.handoff.retries_total",
             float(_delta_since(mgr, "_last_retries",
                                mgr.retries_total)), None),
+        # requeued ranges retried on the refresh cadence (no
+        # membership change needed) — docs/resilience.md
+        ssf_samples.count(
+            "veneur.handoff.requeue_retries_total",
+            float(_delta_since(mgr, "_last_requeue_retries",
+                               mgr.requeue_retries_total)), None),
         ssf_samples.gauge("veneur.handoff.epoch", float(mgr.epoch),
                           None),
     ]

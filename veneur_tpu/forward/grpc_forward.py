@@ -176,7 +176,8 @@ class GRPCForwarder:
                         "open", self.addr)
         return rejected
 
-    def forward(self, state, parent_span=None, deadline=None):
+    def forward(self, state, parent_span=None, deadline=None,
+                trace_ctx=None):
         if self._rejected_by_breaker(consume_probe=False):
             return
         # columnar digest planes encode natively — serialized MetricList
@@ -188,12 +189,18 @@ class GRPCForwarder:
             self.CHUNK_BYTES)
         if not frames:
             return
-        metadata = None
+        metadata = []
         if parent_span is not None:
             # same propagation as the HTTP path, as gRPC metadata
-            metadata = tuple(
-                (k.lower(), v)
-                for k, v in parent_span.context_as_parent().items())
+            metadata = [(k.lower(), v)
+                        for k, v in parent_span.context_as_parent().items()]
+        if trace_ctx is not None:
+            # the fleet trace plane's hop contract (obs/tracectx.py),
+            # lowercased per gRPC metadata rules
+            from veneur_tpu.obs import tracectx
+
+            metadata.append((tracectx.HEADER.lower(), trace_ctx.encode()))
+        metadata = tuple(metadata) or None
         from veneur_tpu.resilience import Deadline, call_with_retry
 
         total = sum(rows for _, rows in frames)
@@ -261,10 +268,11 @@ class ImportServer:
 
     def __init__(self, store=None,
                  apply: Optional[Callable] = None, workers: int = 4,
-                 trace_client=None):
+                 trace_client=None, hop_log=None):
         from veneur_tpu.native import egress
 
         self._trace_client = trace_client
+        self._hop_log = hop_log  # fleet trace plane (obs/tracectx.py)
         self._store = store if apply is None else None
         if apply is None:
             if store is None:
@@ -350,6 +358,15 @@ class ImportServer:
                                    None))
         span.finish()
         span.client_record(self._trace_client)
+        if self._hop_log is not None:
+            from veneur_tpu.obs import tracectx
+
+            # a contextless legacy import still records (unstitchable
+            # but counted) — same contract as the HTTP carrier
+            ctx = tracectx.TraceContext.from_headers(carrier)
+            self._hop_log.record("global.import", ctx, span.start,
+                                 time.time(), metrics=n_ok,
+                                 protocol="grpc")
         return empty_pb2.Empty()
 
     def start(self, addr: str = "[::]:0") -> int:

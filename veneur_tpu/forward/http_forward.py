@@ -121,7 +121,8 @@ class HTTPForwarder:
                         self.base)
         return rejected
 
-    def forward(self, state, parent_span=None, deadline=None):
+    def forward(self, state, parent_span=None, deadline=None,
+                trace_ctx=None):
         if self._rejected_by_breaker(consume_probe=False):
             return
         # the JSON wire is per-row; columnar digest planes (a columnar
@@ -141,6 +142,15 @@ class HTTPForwarder:
             # propagate the flush span's context so the global's import
             # span stitches into the same trace (http/http.go:184-188)
             headers = parent_span.context_as_parent()
+        if trace_ctx is not None:
+            # the fleet trace plane's one-header hop contract
+            # (obs/tracectx.py): trace id + parent span + the oldest
+            # ingest-era stamp riding this body, adopted by the
+            # receiver's hop log so /debug/trace stitches the hop
+            headers = dict(headers or {})
+            from veneur_tpu.obs import tracectx
+
+            headers[tracectx.HEADER] = trace_ctx.encode()
         info = {}
         t0 = time.perf_counter()
         # the flush deadline bounds every attempt + backoff sleep; a

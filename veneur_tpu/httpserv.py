@@ -172,7 +172,8 @@ class _Handler(BaseHTTPRequestHandler):
         else:
             self._reply(429, "import queue full; retry next interval")
 
-def _merge_one(handle, metrics, carrier=None, trace_client=None):
+def _merge_one(handle, metrics, carrier=None, trace_client=None,
+               hop_log=None):
     from veneur_tpu import trace as vtrace
     from veneur_tpu.trace import samples as ssf_samples
 
@@ -190,6 +191,20 @@ def _merge_one(handle, metrics, carrier=None, trace_client=None):
     finally:
         span.finish()
         span.client_record(trace_client)
+    if hop_log is not None:
+        # fleet trace plane (obs/tracectx.py): the import parks its hop
+        # record here; the next flush drains it into the published
+        # timeline entry, and /debug/trace stitches it under the
+        # sender's flush span. The context's ingest-era stamp folds
+        # into the freshness min behind veneur.fleet.e2e_age_ns. An
+        # un-traced legacy sender's import still records (real work,
+        # counted in veneur.trace.hops_total), just unstitchable.
+        from veneur_tpu.obs import tracectx
+
+        ctx = tracectx.TraceContext.from_headers(carrier)
+        hop_log.record("global.import", ctx, span.start,
+                       span.end or time.time(), metrics=len(metrics),
+                       protocol="http")
 
 
 class ImportQueuePool:
@@ -203,9 +218,10 @@ class ImportQueuePool:
     otherwise pile up arbitrarily). ``shed`` counts rejected batches."""
 
     def __init__(self, handle, workers: int = 2, max_queue: int = 64,
-                 trace_client=None):
+                 trace_client=None, hop_log=None):
         self._handle = handle
         self._trace_client = trace_client
+        self._hop_log = hop_log
         # queue.Queue(maxsize<=0) means UNBOUNDED — the opposite of this
         # pool's purpose; clamp a zero/negative config to the smallest
         # real bound
@@ -245,7 +261,8 @@ class ImportQueuePool:
             if self._stopping.is_set():
                 continue  # drain without merging; exit on sentinel
             metrics, carrier = item
-            _merge_one(self._handle, metrics, carrier, self._trace_client)
+            _merge_one(self._handle, metrics, carrier, self._trace_client,
+                       hop_log=self._hop_log)
             with self._lock:
                 self.merged_batches += 1
 
@@ -293,7 +310,7 @@ class OpsServer:
     def __init__(self, addr: str = "127.0.0.1:0",
                  import_fn: Optional[Callable[[List[dict]], None]] = None,
                  trace_client=None, import_workers: int = 2,
-                 import_queue: int = 64):
+                 import_queue: int = 64, hop_log=None):
         host, _, port = addr.rpartition(":")
         self._httpd = ReuseportHTTPServer((host or "127.0.0.1", int(port)),
                                           _Handler)
@@ -301,7 +318,7 @@ class OpsServer:
         self.import_pool = (
             ImportQueuePool(import_fn, workers=import_workers,
                             max_queue=import_queue,
-                            trace_client=trace_client)
+                            trace_client=trace_client, hop_log=hop_log)
             if import_fn is not None else None)
         self._httpd.veneur_import_pool = self.import_pool
         self._httpd.veneur_trace_client = trace_client
@@ -324,7 +341,8 @@ class OpsServer:
         ops = cls(addr, import_fn=import_metrics,
                   trace_client=getattr(server, "trace_client", None),
                   import_workers=getattr(cfg, "http_import_workers", 2),
-                  import_queue=getattr(cfg, "http_import_queue", 64))
+                  import_queue=getattr(cfg, "http_import_queue", 64),
+                  hop_log=getattr(server, "obs_hops", None))
 
         def ready(query):
             # readiness, as distinct from the /healthcheck liveness

@@ -140,16 +140,21 @@ class SealedChunk:
 
     ``sealed_ns`` stamps the hand-off (monotonic): the merger measures
     seal->merge latency from it (veneur.obs.stage_duration_ns tagged
-    ``stage:ingest.seal_to_merge``). The stamp is one clock read on the
-    lane thread — the ``@lockfree_hot_path`` assertion on the lane loop
-    still holds."""
+    ``stage:ingest.seal_to_merge``). ``ingest_wall_ns`` is the WALL
+    clock of the chunk's first staged record — the ingest-era stamp
+    the fleet trace plane threads through every downstream hop
+    (obs/tracectx.py) to measure true end-to-end freshness
+    (``veneur.fleet.e2e_age_ns``). Both stamps are one clock read on
+    the lane thread — the ``@lockfree_hot_path`` assertion on the lane
+    loop still holds."""
 
     __slots__ = ("lane_id", "gen", "records", "spans", "new_entries",
-                 "raws", "sealed_ns")
+                 "raws", "sealed_ns", "ingest_wall_ns")
 
     def __init__(self, lane_id: int, gen: int, records: int,
                  spans: Dict[int, tuple],
-                 new_entries: Dict[int, list], raws: list):
+                 new_entries: Dict[int, list], raws: list,
+                 ingest_wall_ns: int = 0):
         self.lane_id = lane_id
         self.gen = gen
         self.records = records
@@ -157,6 +162,7 @@ class SealedChunk:
         self.new_entries = new_entries
         self.raws = raws
         self.sealed_ns = time.monotonic_ns()
+        self.ingest_wall_ns = ingest_wall_ns or time.time_ns()
 
 
 class LaneResolver:
@@ -208,7 +214,7 @@ class IngestLane:
                  max_backlog: int = DEFAULT_MAX_BACKLOG,
                  intern_limit: int = 1 << 20,
                  use_native: Optional[bool] = None,
-                 limiter=None):
+                 limiter=None, trace_stages: bool = True):
         self.lane_id = lane_id
         self.sock = sock
         self._stop = stop
@@ -244,6 +250,17 @@ class IngestLane:
         self._nrows = [0] * KIND_COUNT
         self._intern_total = 0
         self._first_stage_t = 0.0
+        # the current chunk's ingest-era stamp (wall ns of its first
+        # staged record; always on — one clock read per chunk, cheaper
+        # than the freshness blindness of not having it)
+        self._first_stage_wall_ns = 0
+        # ingest-path stage tracing (obs_enabled): per-stage cumulative
+        # ns, single-writer (this lane's thread), diffed read-side by
+        # IngestFleet.take_ingest_stages — recv includes socket wait
+        # (lane-idle time is real, and hiding it would fake utilization)
+        self._obs = trace_stages
+        self.stage_ns = {"recv": 0, "decode": 0, "stage": 0, "seal": 0}
+        self.stage_iters = 0
 
         # native decode: a reusable C++ parse batch + this lane's own
         # intern table; both bound ONCE here so the hot loop never
@@ -290,8 +307,12 @@ class IngestLane:
         boundary. Returns the number of datagrams received (0 on
         timeout). The lock-order lint pass asserts this call graph
         reaches no lock."""
+        obs = self._obs
+        t_recv0 = time.monotonic_ns() if obs else 0
         datagrams = self._receiver.recv_batch(RECV_TIMEOUT)
         if not datagrams:
+            if obs:
+                self.stage_ns["recv"] += time.monotonic_ns() - t_recv0
             if self._staged_total or self._raws:
                 self._seal()
             return 0
@@ -312,6 +333,9 @@ class IngestLane:
                 if len(more) < self._receiver.batch:
                     hot = False
                     break
+        if obs:
+            self.stage_ns["recv"] += time.monotonic_ns() - t_recv0
+            self.stage_iters += 1
         now = time.monotonic()
         n = len(datagrams)
         self.packets += n
@@ -356,6 +380,8 @@ class IngestLane:
         intern table, scrub, and stage columnar per kind."""
         if self._intern_total >= self._intern_limit:
             self._reset_interner()
+        obs = self._obs
+        t0 = time.monotonic_ns() if obs else 0
         vt = self._vt
         buf = b"\n".join(datagrams)
         b = self._batch
@@ -364,11 +390,17 @@ class IngestLane:
         pb = self._pb_cls(b.contents)
         self.parse_errors += int(pb.parse_errors)
         if pb.count == 0:
+            if obs:
+                self.stage_ns["decode"] += time.monotonic_ns() - t0
             return
         self.parsed += int(pb.count)
         rows, kinds, miss = self._table.assign(pb)
         if len(miss):
             self._intern_misses(pb, rows, kinds, miss)
+        if obs:
+            t1 = time.monotonic_ns()
+            self.stage_ns["decode"] += t1 - t0
+            t0 = t1
         arena = pb.arena
         values, rates = pb.value, pb.sample_rate
         member_hashes = None
@@ -430,6 +462,8 @@ class IngestLane:
                         continue
                 self._stage_span(kind, krows, vals64.astype(np.float32),
                                  wts)
+        if obs:
+            self.stage_ns["stage"] += time.monotonic_ns() - t0
 
     def _intern_misses(self, pb, rows, kinds, miss) -> None:
         arena = pb.arena
@@ -456,9 +490,13 @@ class IngestLane:
 
     def _stage_python(self, datagrams: list) -> None:
         """Pure-Python decode fallback (no native library): per-line
-        parse into the same columnar stages. Slower, same semantics."""
+        parse into the same columnar stages. Slower, same semantics.
+        Parse and staging interleave per line here, so the whole call
+        reports as ``decode`` (the native path splits the two)."""
         from veneur_tpu.samplers import parser as p
 
+        obs = self._obs
+        t0 = time.monotonic_ns() if obs else 0
         if self._intern_total >= self._intern_limit:
             self._reset_interner()
         interner = self._py_interner
@@ -497,6 +535,8 @@ class IngestLane:
                         (m.key.name.encode("utf-8"),
                          m.key.joined_tags.encode("utf-8")))
                 self._stage_one_metric(kind, row, m)
+        if obs:
+            self.stage_ns["decode"] += time.monotonic_ns() - t0
 
     def _stage_one_metric(self, kind: int, row: int, m) -> None:
         from veneur_tpu.ops import hll as hll_ops
@@ -530,6 +570,8 @@ class IngestLane:
                           b=np.float32(1.0) / np.float32(m.sample_rate))
 
     def _put_one(self, kind, row, a, b=None, member=None) -> None:
+        if not self._first_stage_wall_ns:
+            self._first_stage_wall_ns = time.time_ns()
         if self._chunk - self._staged_total == 0:
             self._seal()
         st = self._stages[kind]
@@ -539,6 +581,10 @@ class IngestLane:
         self._staged_total += 1
 
     def _stage_span(self, kind, rows, a, b=None, members=None) -> None:
+        if not self._first_stage_wall_ns:
+            # the chunk's ingest-era stamp: one wall-clock read per
+            # chunk (per staged SPAN at most, never per record)
+            self._first_stage_wall_ns = time.time_ns()
         st = self._stages[kind]
         if st is None:
             st = self._stages[kind] = _KindStage(kind, self._chunk)
@@ -582,15 +628,19 @@ class IngestLane:
         total = self._staged_total
         if total == 0 and not self._raws and not self._pending_entries:
             return
+        obs = self._obs
+        t0 = time.monotonic_ns() if obs else 0
         spans: Dict[int, tuple] = {}
         for kind, st in enumerate(self._stages):
             if st is not None and st.fill:
                 spans[kind] = st.take()
         chunk = SealedChunk(self.lane_id, self.gen, total, spans,
-                            self._pending_entries, self._raws)
+                            self._pending_entries, self._raws,
+                            ingest_wall_ns=self._first_stage_wall_ns)
         self._pending_entries = {}
         self._raws = []
         self._staged_total = 0
+        self._first_stage_wall_ns = 0
         self.staged += total
         if len(self.sealed) >= self._max_backlog:
             self.shed_records += total
@@ -601,6 +651,8 @@ class IngestLane:
             chunk.raws = []
         self.sealed_chunks += 1
         self.sealed.append(chunk)
+        if obs:
+            self.stage_ns["seal"] += time.monotonic_ns() - t0
 
     # -- reader loop ---------------------------------------------------------
 
@@ -657,6 +709,7 @@ class IngestLane:
             "intern_rows": self._intern_total,
             "intern_gen": self.gen,
             "native_decode": self.using_native,
+            "stage_ns": dict(self.stage_ns) if self._obs else None,
         }
 
 
@@ -680,7 +733,7 @@ class IngestFleet:
                  max_backlog: int = DEFAULT_MAX_BACKLOG,
                  use_native: Optional[bool] = None,
                  intern_limit: int = 0,
-                 limiter=None):
+                 limiter=None, trace_stages: bool = True):
         from veneur_tpu import networking
 
         self._store = store
@@ -703,6 +756,13 @@ class IngestFleet:
         self.merge_latency_count = 0
         self.merge_latency_max_ns = 0
         self._merge_latency_sum_ns = 0
+        # fleet freshness: the oldest ingest-era stamp (wall ns) among
+        # chunks merged since the last flush took it; written by the
+        # merger under _merge_lock, read-and-reset the same way
+        self._oldest_ingest_ns: Optional[int] = None
+        # per-lane stage-tracing watermarks (take_ingest_stages diffs
+        # the lanes' cumulative single-writer counters per interval)
+        self._stage_reported: Dict[tuple, int] = {}
         self.unrouted_raws: list = []  # only without a raw_handler (tests)
         intern_limit = (intern_limit
                         or getattr(store, "max_series", 0) or (1 << 20))
@@ -723,7 +783,8 @@ class IngestFleet:
                 i, sock, max_len, chunk_records, self._stop,
                 overload=overload, recv_batch=recv_batch,
                 max_backlog=max_backlog, intern_limit=intern_limit,
-                use_native=use_native, limiter=limiter))
+                use_native=use_native, limiter=limiter,
+                trace_stages=trace_stages))
         self._threads: List[threading.Thread] = []
         self._merger: Optional[threading.Thread] = None
 
@@ -771,6 +832,12 @@ class IngestFleet:
             # must never remap them
             res = self._resolvers[chunk.lane_id] = LaneResolver(chunk.gen)
         raws = self._store.import_lane_chunk(chunk, res)
+        if chunk.records and chunk.ingest_wall_ns:
+            # caller (merge_sealed) holds _merge_lock — the same hold
+            # take_oldest_ingest_ns resets under
+            if (self._oldest_ingest_ns is None
+                    or chunk.ingest_wall_ns < self._oldest_ingest_ns):
+                self._oldest_ingest_ns = chunk.ingest_wall_ns
         latency = time.monotonic_ns() - chunk.sealed_ns
         if latency >= 0:
             self._merge_latencies.append(latency)
@@ -854,6 +921,45 @@ class IngestFleet:
                 out.append(latencies.popleft())
             except IndexError:
                 return out
+
+    def take_oldest_ingest_ns(self) -> Optional[int]:
+        """Read-and-reset the oldest ingest-era stamp (wall ns) among
+        chunks merged since the last call — the flusher's freshness
+        anchor (chunks merged after the generation swap attribute to
+        the NEXT interval, which only over-estimates age: freshness
+        reads conservative, never optimistic)."""
+        with self._merge_lock:
+            oldest, self._oldest_ingest_ns = self._oldest_ingest_ns, None
+        return oldest
+
+    def take_ingest_stages(self) -> Optional[dict]:
+        """The interval's ingest-path stage tree: per-stage ns summed
+        over every lane since the last call (recv includes socket
+        wait, so the sums are lane-seconds of wall clock, up to
+        ``lanes`` x the interval). None when stage tracing is off or
+        nothing accrued. Single reader (the flusher); lane counters
+        are single-writer ints, read GIL-atomically."""
+        out = {"recv": 0, "decode": 0, "stage": 0, "seal": 0}
+        iters = 0
+        traced = False
+        for lane in self.lanes:
+            if not lane._obs:
+                continue
+            traced = True
+            for stage in out:
+                cur = lane.stage_ns[stage]
+                key = (lane.lane_id, stage)
+                out[stage] += cur - self._stage_reported.get(key, 0)
+                self._stage_reported[key] = cur
+            cur = lane.stage_iters
+            key = (lane.lane_id, "iters")
+            iters += cur - self._stage_reported.get(key, 0)
+            self._stage_reported[key] = cur
+        if not traced or not any(out.values()):
+            return None
+        out["iters"] = iters
+        out["lanes"] = len(self.lanes)
+        return out
 
     def merge_latency_snapshot(self) -> dict:
         n = self.merge_latency_count

@@ -1,7 +1,7 @@
 """veneur_tpu.lint — project-native static analysis.
 
 The Python/JAX substitute for the toolchain the reference leans on
-(``go vet``, the race detector, "imported and not used"). Eight passes,
+(``go vet``, the race detector, "imported and not used"). Nine passes,
 all AST-based, no third-party lint dependency:
 
 - ``lock-discipline``  — ``@requires_lock`` call sites hold the store
@@ -21,6 +21,9 @@ all AST-based, no third-party lint dependency:
   bidirectionally (``lint/configdrift.py``)
 - ``metric-registry``  — one ``veneur.*`` name, one tag schema, all
   documented (``lint/metricnames.py``)
+- ``stage-registry``   — every StageRecorder stage string and every
+  ``X-Veneur-Trace``-bearing route documented in
+  docs/observability.md (``lint/stagenames.py``)
 - ``dead-code``        — unused module-level imports, unreachable
   statements (``lint/deadcode.py``)
 
@@ -39,6 +42,7 @@ from veneur_tpu.lint import purity as _purity          # noqa: F401
 from veneur_tpu.lint import recompile as _recompile    # noqa: F401
 from veneur_tpu.lint import configdrift as _configdrift  # noqa: F401
 from veneur_tpu.lint import metricnames as _metricnames  # noqa: F401
+from veneur_tpu.lint import stagenames as _stagenames  # noqa: F401
 from veneur_tpu.lint import deadcode as _deadcode      # noqa: F401
 
 __all__ = ["Baseline", "Finding", "Project", "PASSES", "run_passes"]

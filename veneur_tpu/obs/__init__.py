@@ -16,6 +16,13 @@ layer here goes further and makes the pipeline's interior visible:
   every compiled program in the static-analysis inventory, live
   compile/dispatch counters, and the on-demand ``/debug/xprof``
   capture.
+- :mod:`veneur_tpu.obs.tracectx` — the fleet trace plane's cross-hop
+  contract: ``TraceContext`` + the ``X-Veneur-Trace`` header stamped
+  into every forward/proxy/import/handoff envelope, and the receiving
+  side's ``HopLog``.
+- :mod:`veneur_tpu.obs.fleet` — the global's fleet aggregation view:
+  ``GET /debug/fleet`` (peer timelines, keep-last-good) and
+  ``GET /debug/trace?id=…`` (the stitched per-trace hop view).
 
 ``docs/observability.md`` is the reading guide.
 """
@@ -25,6 +32,7 @@ from __future__ import annotations
 from veneur_tpu.obs.recorder import (StageRecorder, activate, current,
                                      maybe_stage, note)
 from veneur_tpu.obs.timeline import FlushTimeline
+from veneur_tpu.obs.tracectx import HopLog, TraceContext
 
-__all__ = ["StageRecorder", "FlushTimeline", "activate", "current",
-           "maybe_stage", "note"]
+__all__ = ["StageRecorder", "FlushTimeline", "HopLog", "TraceContext",
+           "activate", "current", "maybe_stage", "note"]

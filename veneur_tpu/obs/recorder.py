@@ -95,6 +95,28 @@ class StageRecorder:
         self.t0_ns = clock_ns()
         self.wall_start = time.time()
         self.entry: Optional[dict] = None  # set by finish()
+        # fleet trace plane (obs/tracectx.py): the distributed-trace
+        # identity this interval's stage tree publishes under. Zero =
+        # unstitched (a bare recorder outside the hop contract).
+        self.trace_id = 0
+        self.span_id = 0
+        self.parent_span_id = 0
+        self.hop = ""
+
+    def adopt_trace(self, trace_id: int, span_id: int = 0,
+                    parent_id: int = 0, hop: str = "") -> None:
+        """Join this recorder's stage tree into a distributed trace:
+        the published entry gains ``trace_id``/``span_id``/
+        ``parent_span_id``/``hop``, which is what ``GET /debug/trace``
+        stitches on. The flusher adopts its flush span's ids; a
+        receiving hop adopts the ids off the ``X-Veneur-Trace``
+        header."""
+        from veneur_tpu.obs import tracectx
+
+        self.trace_id = int(trace_id)
+        self.span_id = int(span_id) or tracectx.new_span_id()
+        self.parent_span_id = int(parent_id)
+        self.hop = hop
 
     # -- recording ---------------------------------------------------------
 
@@ -198,6 +220,11 @@ class StageRecorder:
             "stages": stages,
             "tree": _build_tree(stages),
         }
+        if self.trace_id:
+            entry["trace_id"] = self.trace_id
+            entry["span_id"] = self.span_id
+            entry["parent_span_id"] = self.parent_span_id
+            entry["hop"] = self.hop
         self.entry = entry
         # straggler pass: events recorded between the drain above and
         # the entry publication (record_late saw entry None and fell

@@ -93,11 +93,16 @@ class _ProxyHandler(BaseHTTPRequestHandler):
             except ImportError400 as e:
                 self._reply(400, str(e))
                 return
+            # the fleet trace plane rides through: the local's
+            # X-Veneur-Trace header re-parents under this fan-out's
+            # span and lands on every destination POST (obs/tracectx)
+            trace_header = self.headers.get("X-Veneur-Trace")
             # accept, then fan out off the request thread
             # (handlers_global.go:28-43: "go p.ProxyMetrics")
             self._reply(202, "accepted")
             threading.Thread(target=self.server.veneur_proxy.proxy_metrics,
-                             args=(metrics,), daemon=True).start()
+                             args=(metrics, trace_header),
+                             daemon=True).start()
         elif self.path == "/spans":
             # Datadog trace spans fan out over their own ring
             # (handlers_global.go:45-56 → ProxyTraces, proxy.go:393-434)
@@ -177,6 +182,14 @@ class Proxy:
             self.trace_discoverer = None  # static trace_address, if any
             if config.trace_address:
                 self.trace_ring.set_members([config.trace_address])
+        # proxy hop visibility (the fleet trace plane, obs/tracectx.py):
+        # every trace-bearing fan-out publishes a stage entry — one per
+        # inbound batch, bounded ring — served at the proxy's own
+        # GET /debug/flush-timeline so /debug/trace can stitch the
+        # proxy hop between the local's flush and the global's import
+        from veneur_tpu.obs import FlushTimeline
+
+        self.obs_timeline = FlushTimeline(64)
         self._stop = threading.Event()
         self._httpd: Optional[ThreadingHTTPServer] = None
         # gRPC listener (proxysrv.Server flavor), started when
@@ -254,11 +267,12 @@ class Proxy:
 
     # -- proxying -----------------------------------------------------------
 
-    def proxy_metrics(self, metrics: List[dict]):
+    def proxy_metrics(self, metrics: List[dict], trace_header=None):
         """Hash each metric to its destination, batch, POST in parallel
         (proxy.go:437-505)."""
         self._fan_out(metrics, self.ring, metric_ring_key, "/import",
-                      compress=True, counter="proxied", what="metrics")
+                      compress=True, counter="proxied", what="metrics",
+                      trace_header=trace_header)
 
     def proxy_traces(self, traces: List[dict]):
         """Partition Datadog trace spans by trace id over the trace ring
@@ -270,14 +284,34 @@ class Proxy:
                       what="trace spans")
 
     def _fan_out(self, items: List[dict], ring: ConsistentRing, key_fn,
-                 path: str, compress: bool, counter: str, what: str):
+                 path: str, compress: bool, counter: str, what: str,
+                 trace_header=None):
         """The shared partition → parallel-POST machinery behind both
         fan-outs. The whole batch resolves through ONE ``get_many``
         call — one ring version — so a discovery refresh swapping the
         membership mid-batch can never split one batch's keys across
         the old and the new ring (the double-count window the
         ring-transition handoff closes; the swap itself is atomic in
-        ``ConsistentRing.set_members``)."""
+        ``ConsistentRing.set_members``).
+
+        A trace-bearing batch (``X-Veneur-Trace`` on the inbound POST)
+        runs under a StageRecorder: the fan-out publishes a
+        ``proxy.fan_out`` hop entry into the proxy's timeline ring,
+        and every destination POST carries the context RE-PARENTED
+        under this hop's span."""
+        from veneur_tpu import obs
+        from veneur_tpu.obs import tracectx
+
+        ctx = tracectx.TraceContext.decode(trace_header) \
+            if trace_header else None
+        rec = None
+        fwd_headers = None
+        if ctx is not None:
+            rec = obs.StageRecorder()
+            rec.adopt_trace(ctx.trace_id, parent_id=ctx.parent_id,
+                            hop="proxy.fan_out")
+            fwd_headers = {tracectx.HEADER:
+                           ctx.child(rec.span_id).encode()}
         by_dest: Dict[str, List[dict]] = defaultdict(list)
         dropped = 0
         keyed: List[tuple] = []
@@ -301,14 +335,41 @@ class Proxy:
             t = threading.Thread(
                 target=self._post_batch,
                 args=(dest, batch, path, compress, counter, what),
+                kwargs={"headers": fwd_headers, "rec": rec},
                 daemon=True)
             t.start()
             threads.append(t)
         for t in threads:
             t.join(timeout=self.forward_timeout + 1.0)
+        if rec is not None:
+            try:
+                entry = rec.finish()
+                entry["what"] = what
+                entry["items"] = len(items)
+                entry["destinations"] = len(by_dest)
+                self.obs_timeline.publish(entry)
+            except Exception:  # telemetry must never fail a fan-out
+                log.exception("proxy hop publication failed")
 
     def _post_batch(self, dest: str, batch: List[dict], path: str,
-                    compress: bool, counter: str, what: str):
+                    compress: bool, counter: str, what: str,
+                    headers=None, rec=None):
+        import time as _time
+
+        t0_ns = _time.monotonic_ns() if rec is not None else 0
+        try:
+            self._post_batch_inner(dest, batch, path, compress, counter,
+                                   what, headers)
+        finally:
+            if rec is not None:
+                # each destination's POST is a child stage of the
+                # fan-out hop, recorded from its own thread
+                rec.record_abs(f"post.{dest}", t0_ns,
+                               _time.monotonic_ns(), items=len(batch))
+
+    def _post_batch_inner(self, dest: str, batch: List[dict], path: str,
+                          compress: bool, counter: str, what: str,
+                          headers=None):
         url = dest.rstrip("/")
         if not url.startswith(("http://", "https://")):
             url = "http://" + url
@@ -335,7 +396,8 @@ class Proxy:
             status = post_with_retry(
                 lambda: self._post(url + path, batch, compress=compress,
                                    timeout=deadline.clamp(
-                                       self.forward_timeout)),
+                                       self.forward_timeout),
+                                   headers=headers),
                 self.retry_policy, deadline=deadline, on_retry=on_retry)
         except Exception as e:
             breaker.record_failure()
@@ -415,6 +477,11 @@ class Proxy:
             lambda path, fn: self._httpd.veneur_get_routes.__setitem__(
                 path, fn),
             extra_vars=ring_vars)
+        # the proxy-hop timeline (trace-bearing fan-outs) on the same
+        # path the server uses, so the fleet aggregator pulls peers
+        # uniformly
+        self._httpd.veneur_get_routes["/debug/flush-timeline"] = \
+            self.obs_timeline.handler
         t = threading.Thread(target=self._httpd.serve_forever,
                              name="proxy-http", daemon=True)
         t.start()

@@ -348,13 +348,27 @@ class Server:
         # obs_enabled is off — the flusher then allocates no recorder
         # and every stage hook is one thread-local read
         self.obs_timeline = None
+        self.obs_hops = None         # cross-hop records (obs/tracectx.py)
+        self.fleet_aggregator = None  # /debug/fleet + /debug/trace
         if config.obs_enabled:
-            from veneur_tpu.obs import FlushTimeline
+            from veneur_tpu.obs import FlushTimeline, HopLog
+            from veneur_tpu.obs.fleet import FleetAggregator
 
             # apply_defaults (above) already substituted the 0-means-64
             # default; config is the single source of truth here
             self.obs_timeline = FlushTimeline(
                 config.obs_timeline_intervals)
+            self.obs_hops = HopLog()
+            # the fleet trace plane's aggregation view: peers come from
+            # fleet_peers (falling back to the resharding membership),
+            # pulled keep-last-good; with no peer source the aggregator
+            # still serves this instance's own entries at /debug/trace
+            self.fleet_aggregator = FleetAggregator(
+                self_addr=config.handoff_self or "",
+                watcher=self._build_fleet_watcher(config),
+                timeline=self.obs_timeline, hop_log=self.obs_hops,
+                pull_timeout=config.fleet_pull_timeout_seconds,
+                pull_interval=config.fleet_pull_interval_seconds)
         # set by the forwarding layer (veneur_tpu.forward) when local
         self.forward_fn: Optional[Callable] = None
         self._forwarder = None
@@ -480,6 +494,25 @@ class Server:
     def spans_dropped(self, value: int) -> None:
         self._spans_dropped_adjust = 0
         self._spans_dropped_adjust = value - self.spans_dropped
+
+    @staticmethod
+    def _build_fleet_watcher(config):
+        """Membership source for the /debug/fleet aggregation
+        (obs/fleet.py): fleet_peers (CSV or file://), falling back to
+        the elastic-resharding peer list; None = own entries only."""
+        peers = ((config.fleet_peers or "").strip()
+                 or (config.handoff_peers or "").strip())
+        if not peers:
+            return None
+        from veneur_tpu.discovery import (FilePeersDiscoverer,
+                                          RingWatcher, StaticDiscoverer)
+
+        if peers.startswith("file://"):
+            discoverer = FilePeersDiscoverer(peers[len("file://"):])
+        else:
+            discoverer = StaticDiscoverer(
+                [p.strip() for p in peers.split(",") if p.strip()])
+        return RingWatcher(discoverer, "veneur-fleet-debug")
 
     # -- role ---------------------------------------------------------------
 
@@ -715,7 +748,8 @@ class Server:
                 mgr = self.handoff_manager
                 self.ops_server.add_post_route(
                     "/handoff",
-                    lambda headers, body: mgr.handle_handoff(body))
+                    lambda headers, body: mgr.handle_handoff(
+                        body, headers=headers))
                 self.ops_server.add_route("/handoff-status",
                                           mgr.status_route)
             self.ops_server.start()
@@ -724,7 +758,8 @@ class Server:
             from veneur_tpu.forward.grpc_forward import ImportServer
 
             self.import_server = ImportServer(
-                self.store, trace_client=self.trace_client)
+                self.store, trace_client=self.trace_client,
+                hop_log=self.obs_hops)
             self.import_server.start(cfg.grpc_address)
         # framed-TCP import ingest (framework extension fast lane)
         if cfg.native_import_address:
@@ -815,7 +850,8 @@ class Server:
                 overload=self.overload,
                 raw_handler=self.handle_metric_packet,
                 thread_wrap=self._guard,
-                limiter=networking._LogLimiter(self.interval))
+                limiter=networking._LogLimiter(self.interval),
+                trace_stages=bool(cfg.obs_enabled))
         except OSError as e:
             log.warning("ingest lanes failed to bind (%s); falling back "
                         "to the legacy readers", e)
