@@ -353,6 +353,7 @@ class TestJaxPurity:
 LOCKORDER_FIXTURE = '''
 import os
 import threading
+import urllib.request
 
 import jax
 
@@ -424,6 +425,26 @@ class DeviceHolder:
         with self._lock:
             ref = self.plane[:4]            # async dispatch: fine
         return jax.device_get(ref)          # must NOT flag
+
+
+class StreamPoster:
+    """The streamed-POST shape the egress pipeline must never take: a
+    lock held into the chunk worker's HTTP round trip."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def _post_body(self, req):
+        return urllib.request.urlopen(req)
+
+    def locked_post(self, req):
+        with self._lock:
+            return self._post_body(req)     # MUST flag (transitive)
+
+    def post_outside(self, req):
+        with self._lock:
+            url = req
+        return self._post_body(url)         # must NOT flag
 '''
 
 
@@ -460,6 +481,31 @@ class TestLockOrder:
     def test_pragma_suppresses_blocking(self, order_findings):
         assert not any("acknowledged_fsync" in f.anchor
                        for f in order_findings)
+
+    def test_lock_across_streamed_post_flagged(self, order_findings):
+        """The streamed-POST verb (urlopen) joined the blocking reach:
+        a lock held into an HTTP round trip — even transitively through
+        a helper, the chunk-worker shape — is flagged; the same POST
+        after the lock released is not."""
+        anchors = {f.anchor for f in order_findings
+                   if f.code == "lock-across-blocking"}
+        assert any("locked_post" in a and "urlopen" in a
+                   for a in anchors), anchors
+        assert not any("post_outside" in a for a in anchors)
+
+    def test_pipeline_posts_run_off_the_store_lock(self, project):
+        """Non-vacuity for the REAL pipeline: the package's blocking
+        reach knows the streamed-POST verb, and neither the store lock
+        nor the flush gate ever reaches it — the machine-checked
+        off-lock guarantee of the overlapped egress (the snapshot
+        path's device_get assertion, one layer out)."""
+        graph = lockorder.lock_graph(project)
+        blocking = {(b["lock"], b["op"]) for b in graph["blocking"]}
+        assert any(op == "urllib urlopen()" for _l, op in blocking), \
+            blocking  # the verb is live somewhere (kafka wire, etc.)
+        assert ("<store>", "urllib urlopen()") not in blocking
+        assert ("MetricStore._flush_gate", "urllib urlopen()") \
+            not in blocking
 
     def test_graph_includes_fixture_edges(self, project):
         clone = synthetic(project, self.REL, LOCKORDER_FIXTURE)

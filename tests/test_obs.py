@@ -217,9 +217,15 @@ class TestServerTimeline:
         self.flush(srv, sink)
         e = srv.obs_timeline.entries()[-1]
         names = {s["name"] for s in e["stages"]}
+        # pipelined flush shape (docs/internals.md "Life of a flush"):
+        # dispatch stages carry the async program enqueue (compute),
+        # the per-group stages carry the blocking fetch, and the
+        # serializer lane's emission work rides serialize.<group>
         for expected in ("events", "store", "store.swap",
-                         "store.histograms", "store.histograms.compute",
-                         "store.histograms.fetch", "store.self_timers",
+                         "store.dispatch", "store.dispatch.histograms",
+                         "store.dispatch.histograms.compute",
+                         "store.histograms", "store.histograms.fetch",
+                         "store.self_timers", "serialize.histograms",
                          "post", "post.channel", "span_join"):
             assert expected in names, (expected, sorted(names))
         histo = next(s for s in e["stages"]

@@ -1,0 +1,504 @@
+"""Overlapped flush egress (core/pipeline.py + the two-phase
+``flush_begin`` surface): pipelined-vs-sequential parity, per-group
+compute-ladder isolation under the pipeline, streamed-chunk
+conservation through sink faults, the checkpoint-truncate race, and
+the timeline's overlap measures.
+
+The conservation invariant under test everywhere: ingested ==
+emitted(acked) + requeued — a chunk that could not POST is late,
+never lost.
+"""
+
+import json
+import threading
+import zlib
+
+import numpy as np
+import pytest
+
+from veneur_tpu.core import MetricStore
+from veneur_tpu.core.pipeline import ChunkStream, SerializerLane
+from veneur_tpu.core.store import DigestGroup
+from veneur_tpu.obs.timeline import annotate_overlap
+from veneur_tpu.samplers import HistogramAggregates, parse_metric
+
+AGGS = HistogramAggregates.from_names(["min", "max", "count"])
+
+
+def make_store(**kw):
+    kw.setdefault("initial_capacity", 32)
+    kw.setdefault("chunk", 128)
+    return MetricStore(**kw)
+
+
+def fill(store, n_hist=6, n_counters=4, n_sets=3, samples=5):
+    """A mixed interval with exactly known counts."""
+    for i in range(n_hist):
+        for v in range(samples):
+            store.process_metric(
+                parse_metric(f"lat.{i}:{v * 10 + i}|ms".encode()))
+    for i in range(n_counters):
+        store.process_metric(parse_metric(f"hits.{i}:3|c".encode()))
+    for i in range(n_sets):
+        store.process_metric(parse_metric(f"uniq.{i}:u{i}|s".encode()))
+
+
+def emission_map(final):
+    if hasattr(final, "to_intermetrics"):
+        final = final.to_intermetrics()
+    return {(m.name, tuple(sorted(m.tags))): m.value for m in final}
+
+
+class TestPipelineParity:
+    """The pipelined drain must emit exactly what the sequential one
+    does — same names, same values — for every flush shape."""
+
+    @pytest.mark.parametrize("columnar", [False, True])
+    @pytest.mark.parametrize("is_local", [False, True])
+    def test_same_emissions(self, columnar, is_local):
+        if columnar:
+            from veneur_tpu.native import egress
+
+            if not egress.available():
+                pytest.skip("no native toolchain")
+        results = {}
+        for depth in (0, 3):
+            s = make_store(flush_pipeline_depth=depth)
+            fill(s)
+            final, fwd, ms = s.flush([0.5, 0.99], AGGS,
+                                     is_local=is_local, now=7,
+                                     forward=False, columnar=columnar)
+            results[depth] = (emission_map(final), ms)
+        assert results[0][0] == results[3][0]
+        assert results[0][0], "vacuous parity: nothing emitted"
+        assert results[0][1].histograms == results[3][1].histograms
+
+    def test_forwarding_parity(self):
+        """A forwarding local's ForwardableState is identical either
+        way (counters/digest rows/sets)."""
+        out = {}
+        for depth in (0, 2):
+            s = make_store(flush_pipeline_depth=depth)
+            fill(s)
+            s.process_metric(parse_metric(b"g:1|c|#veneurglobalonly"))
+            _final, fwd, _ms = s.flush([], AGGS, is_local=True, now=7,
+                                       forward=True)
+            out[depth] = (sorted(fwd.counters),
+                          sorted((n, tuple(t), float(w.sum()))
+                                 for n, t, _m, w, _mn, _mx
+                                 in fwd.timers),
+                          sorted(n for n, _t, _r, _p in fwd.sets))
+        assert out[0] == out[2]
+        assert out[0][1], "vacuous: no forwarded digests"
+
+
+class TestLadderIsolation:
+    """(a) of the fault matrix: a kernel failure mid-dispatch retries
+    ONLY the failed group through the ladder while every other group
+    streams on."""
+
+    def test_pallas_dispatch_failure_falls_to_xla_rung(self):
+        s = make_store(flush_pipeline_depth=2)
+        fill(s)
+        orig = DigestGroup._run_flush
+        g = s.timers  # `|ms` samples; retires at the swap
+
+        def failing(qs, use_pallas=True):
+            if use_pallas:
+                raise RuntimeError("injected pallas dispatch failure")
+            return orig(g, qs, use_pallas)
+
+        g._run_flush = failing
+        final, _fwd, ms = s.flush([0.5], AGGS, is_local=False, now=7,
+                                  forward=False)
+        em = emission_map(final)
+        # the failed group still emitted this interval (XLA rung)...
+        assert any(n.startswith("lat.0") for n, _t in em)
+        assert ms.timers == 6
+        # ...and the breaker counted exactly one fallback
+        assert s.compute.fallback_total == 1
+
+    def test_double_failure_requeues_only_that_group(self):
+        s = make_store(flush_pipeline_depth=2)
+        fill(s)
+        g = s.timers
+
+        def always_failing(qs, use_pallas=True):
+            raise RuntimeError("injected kernel failure, both rungs")
+
+        g._run_flush = always_failing
+        final, _fwd, _ms = s.flush([0.5], AGGS, is_local=False, now=7,
+                                   forward=False)
+        em = emission_map(final)
+        # every OTHER unit of the plan emitted normally
+        assert ("hits.0", ()) in em
+        assert any(n.startswith("uniq.0") or n == "uniq.0"
+                   for n, _t in em)
+        # the failed group re-merged into the LIVE store: late, not lost
+        assert not any(n.startswith("lat.") for n, _t in em)
+        assert s.compute.requeued_total == 1
+        final2, _fwd2, _ms2 = s.flush([0.5], AGGS, is_local=False,
+                                      now=8, forward=False)
+        em2 = emission_map(final2)
+        counts = sum(v for (n, _t), v in em2.items()
+                     if n.startswith("lat.") and n.endswith(".count"))
+        assert counts == 6 * 5  # the whole requeued interval, exactly once
+
+
+@pytest.fixture
+def native_egress():
+    from veneur_tpu.native import egress
+
+    if not egress.available():
+        pytest.skip("no native toolchain")
+    return egress
+
+
+class _FaultyPost:
+    """Datadog post stub: 5xx for a configured chunk body range, 202
+    otherwise; remembers every acked body's series payload."""
+
+    def __init__(self, fail_calls=()):
+        self.calls = 0
+        self.fail_calls = set(fail_calls)
+        self.acked_rows = 0
+
+    def __call__(self, url, payload, compress=True, method="POST",
+                 precompressed=False, out_info=None):
+        self.calls += 1
+        if self.calls in self.fail_calls:
+            return 500
+        if precompressed:
+            body = json.loads(zlib.decompress(payload))
+            self.acked_rows += len(body["series"])
+        return 202
+
+
+def make_dd_sink(post, **kw):
+    from veneur_tpu.resilience import RetryPolicy
+    from veneur_tpu.sinks.datadog import DatadogMetricSink
+
+    kw.setdefault("interval", 10)
+    kw.setdefault("flush_max_per_body", 4)
+    sink = DatadogMetricSink(hostname="h0", tags=[], dd_hostname="http://dd",
+                             api_key="k", post=post,
+                             retry_policy=RetryPolicy(max_attempts=1),
+                             **kw)
+    sink.set_flush_deadline(None)
+    return sink
+
+
+class TestStreamedSinkConservation:
+    """(b) of the fault matrix: a sink 5xx on chunk k of n — the
+    unacked bodies requeue exactly once; everything else acks."""
+
+    def test_clean_stream_acks_every_row(self, native_egress):
+        post = _FaultyPost()
+        sink = make_dd_sink(post)
+        s = make_store(flush_pipeline_depth=2)
+        fill(s)
+        stream = ChunkStream([sink], 7, depth=2)
+        final, _fwd, _ms = s.flush([0.5], AGGS, is_local=False, now=7,
+                                   forward=False, columnar=True,
+                                   stream=stream)
+        stream.close()
+        assert stream.chunks >= 2  # scalars + digest groups + sets
+        assert sink.chunk_rows_acked == stream.rows
+        assert sink.chunk_rows_pending() == 0
+        assert post.acked_rows == stream.rows
+
+    def test_5xx_chunk_requeues_once_with_exact_conservation(
+            self, native_egress):
+        post = _FaultyPost(fail_calls={2})  # the 2nd body POST 5xxes
+        sink = make_dd_sink(post)
+        s = make_store(flush_pipeline_depth=2)
+        fill(s)
+        stream = ChunkStream([sink], 7, depth=2)
+        s.flush([0.5], AGGS, is_local=False, now=7, forward=False,
+                columnar=True, stream=stream)
+        stream.close()
+        pending = sink.chunk_rows_pending()
+        assert pending > 0
+        # conservation: every emitted row is acked or parked, none lost
+        assert sink.chunk_rows_acked + pending == stream.rows
+        assert sink.chunk_rows_dropped == 0
+        total_first = stream.rows
+
+        # next interval: the parked bodies get their ONE retry first
+        fill(s)
+        stream2 = ChunkStream([sink], 8, depth=2)
+        s.flush([0.5], AGGS, is_local=False, now=8, forward=False,
+                columnar=True, stream=stream2)
+        stream2.close()
+        assert sink.chunks_requeued_total == 1
+        assert sink.chunk_rows_pending() == 0
+        assert sink.chunk_rows_acked == total_first + stream2.rows
+
+    def test_requeued_body_failing_again_drops_bounded(
+            self, native_egress):
+        post = _FaultyPost(fail_calls=set(range(1, 100)))  # always 5xx
+        sink = make_dd_sink(post)
+        s = make_store(flush_pipeline_depth=2)
+        fill(s)
+        stream = ChunkStream([sink], 7, depth=2)
+        s.flush([0.5], AGGS, is_local=False, now=7, forward=False,
+                columnar=True, stream=stream)
+        stream.close()
+        parked = sink.chunk_rows_pending()
+        assert parked == stream.rows
+        fill(s)
+        stream2 = ChunkStream([sink], 8, depth=2)
+        s.flush([0.5], AGGS, is_local=False, now=8, forward=False,
+                columnar=True, stream=stream2)
+        stream2.close()
+        # the retry consumed the parked bodies: dropped, not re-parked
+        assert sink.chunk_rows_dropped == parked
+        assert sink.chunk_rows_pending() == stream2.rows
+        assert sink.chunk_rows_acked == 0
+
+
+class TestStreamedForwardConservation:
+    """A terminally-failed streamed forward part re-merges into the
+    live store with import semantics (late, never lost)."""
+
+    def test_failed_part_requeues_into_live_store(self):
+        from veneur_tpu import flusher as flusher_mod
+
+        s = make_store(flush_pipeline_depth=2)
+        fill(s, n_counters=0, n_sets=0)
+        parts = []
+
+        def failing_forward(attr, part):
+            parts.append(attr)
+            return False
+
+        stream = ChunkStream(
+            [], 7, depth=2, forward_fn=failing_forward,
+            forward_requeue=lambda attr, part:
+                flusher_mod._requeue_forward_part(s, attr, part))
+        _final, fwd, _ms = s.flush([], AGGS, is_local=True, now=7,
+                                   forward=True, columnar=False,
+                                   stream=stream)
+        stream.close()
+        assert parts == ["timers_columnar"] or parts == []
+        if not parts:
+            pytest.skip("non-columnar flush forwards per-row lists")
+
+    def test_failed_columnar_part_reemits_next_flush(self, native_egress):
+        from veneur_tpu import flusher as flusher_mod
+
+        s = make_store(flush_pipeline_depth=2)
+        fill(s, n_counters=0, n_sets=0)
+
+        stream = ChunkStream(
+            [], 7, depth=2, forward_fn=lambda attr, part: False,
+            forward_requeue=lambda attr, part:
+                flusher_mod._requeue_forward_part(s, attr, part))
+        _final, fwd, _ms = s.flush([], AGGS, is_local=True, now=7,
+                                   forward=True, columnar=True,
+                                   stream=stream)
+        stream.close()
+        assert stream.forward_parts == 1
+        assert stream.forward_requeued_rows == 6
+        # the streamed attr never landed on the batch ForwardableState
+        assert fwd.timers_columnar is None
+        # next flush forwards the re-merged interval, exactly once
+        _f2, fwd2, _m2 = s.flush([], AGGS, is_local=True, now=8,
+                                 forward=True, columnar=True)
+        fwd2.materialize_digests()
+        names = {n for n, *_rest in fwd2.timers}
+        assert names == {f"lat.{i}" for i in range(6)}
+        total_w = sum(float(np.sum(w))
+                      for _n, _t, _m, w, _mn, _mx in fwd2.timers)
+        assert total_w == 6 * 5  # every requeued sample, once
+
+
+class TestCheckpointTruncateRace:
+    """(c) of the fault matrix: checkpoint truncation racing a
+    streaming flush never deadlocks and never double-counts."""
+
+    def test_truncate_races_streaming_flush(self, tmp_path,
+                                            native_egress):
+        from veneur_tpu.persist.checkpoint import Checkpointer
+
+        post = _FaultyPost()
+        sink = make_dd_sink(post)
+        s = make_store(flush_pipeline_depth=2)
+        path = str(tmp_path / "race.ckpt")
+        ck = Checkpointer(s, path, interval_s=3600.0, max_age_s=3600)
+        fill(s)
+        ck.write_once()
+        stop = threading.Event()
+
+        def truncator():
+            while not stop.is_set():
+                ck.truncate(blocking=False)
+                ck.write_once()
+
+        t = threading.Thread(target=truncator, daemon=True)
+        t.start()
+        try:
+            for now in (7, 8, 9):
+                stream = ChunkStream([sink], now, depth=2)
+                s.flush([0.5], AGGS, is_local=False, now=now,
+                        forward=False, columnar=True, stream=stream)
+                stream.close()
+                fill(s)
+        finally:
+            stop.set()
+            t.join(timeout=10)
+        assert not t.is_alive()
+        assert sink.chunk_rows_acked == post.acked_rows
+        assert sink.chunk_rows_pending() == 0
+        # a restore of whatever checkpoint survived must not explode
+        fresh = make_store(flush_pipeline_depth=2)
+        ck2 = Checkpointer(fresh, path, interval_s=3600.0,
+                           max_age_s=3600)
+        ck2.restore()
+
+
+class TestOverlapMeasures:
+    """The timeline's lanes / overlap_ratio / sum-vs-max gap — what
+    the `6_egress_1m` gate reads off `/debug/flush-timeline`."""
+
+    @staticmethod
+    def entry(stages):
+        return {"stages": [
+            {"name": n, "start_ns": s, "duration_ns": d, **a}
+            for n, s, d, a in stages]}
+
+    def test_sequential_interval_ratio_near_one(self):
+        e = self.entry([
+            ("store", 0, 400, {}),
+            ("store.histograms.compute", 0, 100, {}),
+            ("store.histograms.fetch", 100, 100, {}),
+            ("serialize.histograms", 200, 100, {}),
+            ("post.datadog.post", 300, 100, {"chunk": 0}),
+        ])
+        annotate_overlap(e)
+        assert e["lanes"] == {"compute": 100, "fetch": 100,
+                              "serialize": 100, "post": 100}
+        assert e["egress_wall_ns"] == 400
+        assert e["overlap_ratio"] == 1.0
+        assert e["sum_vs_max_gap_ns"] == 300
+
+    def test_overlapped_interval_ratio_approaches_max_over_sum(self):
+        e = self.entry([
+            ("store", 0, 115, {}),
+            ("store.dispatch.histograms.compute", 0, 100, {}),
+            ("store.histograms.fetch", 5, 100, {}),
+            ("serialize.histograms", 10, 100, {}),
+            ("post.datadog.post", 15, 100, {"chunk": 0}),
+        ])
+        annotate_overlap(e)
+        assert e["egress_wall_ns"] == 115
+        assert e["overlap_ratio"] == round(115 / 400, 4)
+        # the bench gate shape: wall <= 1.2 x max(lane)
+        assert e["egress_wall_ns"] <= 1.2 * max(e["lanes"].values())
+
+    def test_batch_fanout_amends_split_serialize_from_post(self):
+        e = self.entry([
+            ("store", 0, 100, {}),
+            ("store.histograms.fetch", 0, 100, {}),
+            ("post.datadog", 100, 300,
+             {"serialize_ns": 120, "post_ns": 180}),
+        ])
+        annotate_overlap(e)
+        assert e["lanes"]["serialize"] == 120
+        assert e["lanes"]["post"] == 180
+
+    def test_off_path_stages_excluded(self):
+        e = self.entry([
+            ("store", 0, 100, {}),
+            ("store.histograms.fetch", 0, 100, {}),
+            ("forward", 0, 10_000, {"off_path": True}),
+        ])
+        annotate_overlap(e)
+        assert e["lanes"]["post"] == 0
+        assert e["egress_wall_ns"] == 100
+
+    def test_server_timeline_carries_overlap_fields(self):
+        """End to end through a real flush: the published entry the
+        debug endpoint serves carries the overlap measures."""
+        from veneur_tpu import obs
+        from veneur_tpu.obs import FlushTimeline
+
+        s = make_store(flush_pipeline_depth=2)
+        fill(s)
+        rec = obs.StageRecorder()
+        with obs.activate(rec):
+            with rec.stage("store"):
+                s.flush([0.5], AGGS, is_local=False, now=7,
+                        forward=False)
+        entry = annotate_overlap(rec.finish())
+        tl = FlushTimeline(4)
+        tl.publish(entry)
+        served = json.loads(tl.handler({"n": "1"})[1])
+        got = served["intervals"][-1]
+        assert got["lanes"]["compute"] > 0
+        assert got["lanes"]["fetch"] > 0
+        assert 0 < got["overlap_ratio"]
+        assert got["sum_vs_max_gap_ns"] >= 0
+
+
+class TestFlusherStreaming:
+    """The flusher's end of the pipe: _build_stream wires chunk-capable
+    sinks into the interval, streamed sinks get only extras at the
+    batch fan-out, and the published entry carries the overlap
+    measures — through a REAL Server."""
+
+    def test_server_streams_chunks_and_publishes_overlap(
+            self, native_egress):
+        from veneur_tpu.config import Config
+        from veneur_tpu.server import Server
+        from veneur_tpu.sinks import ChannelMetricSink
+
+        post = _FaultyPost()
+        dd = make_dd_sink(post)
+        cfg = Config(statsd_listen_addresses=[], interval="86400s",
+                     http_address="127.0.0.1:0", percentiles=[0.5],
+                     obs_timeline_intervals=4,
+                     store_initial_capacity=32, store_chunk=128,
+                     flush_pipeline_depth=2, flush_streaming=True)
+        chan = ChannelMetricSink()
+        srv = Server(cfg, metric_sinks=[dd, chan])
+        try:
+            srv.start()
+            for pkt in (b"to:3.5|h", b"tc:1|c", b"tu:u1|s"):
+                srv.handle_metric_packet(pkt)
+            srv.flush()
+            chan.get_flush()
+            # the datadog sink took the interval as streamed chunks
+            assert dd.chunks_flushed >= 2
+            assert dd.chunk_rows_acked > 0
+            assert dd.chunk_rows_pending() == 0
+            entry = srv.obs_timeline.entries()[-1]
+            assert entry["lanes"]["fetch"] > 0
+            assert entry["overlap_ratio"] > 0
+            names = {s["name"] for s in entry["stages"]}
+            assert "post.datadog.post" in names
+            assert any(n.startswith("serialize.") for n in names)
+        finally:
+            srv.shutdown()
+
+
+class TestSerializerLane:
+    def test_order_preserved_and_errors_reraise(self):
+        lane = SerializerLane(2)
+        out = []
+        for i in range(5):
+            lane.submit(f"u{i}", out.append, i)
+        lane.close()
+        assert out == [0, 1, 2, 3, 4]
+
+        lane = SerializerLane(1)
+
+        def boom(_):
+            raise ValueError("emit failed")
+
+        lane.submit("bad", boom, None)
+        lane.submit("after", out.append, 99)
+        with pytest.raises(ValueError, match="emit failed"):
+            lane.close()
+        # the lane drained (no deadlock) but skipped work after the error
+        assert 99 not in out

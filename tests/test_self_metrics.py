@@ -98,9 +98,11 @@ class TestSinkSamples:
         # (retries_total joined the documented set with the egress
         # resilience layer, docs/resilience.md)
         samples2 = flusher._sink_samples(server, {})
-        assert _names(samples2) == ["veneur.flush.error_total",
-                                    "veneur.sink.datadog.retries_total"]
-        assert samples2[0].value == 0 and samples2[1].value == 0
+        assert _names(samples2) == [
+            "veneur.flush.error_total",
+            "veneur.sink.datadog.retries_total",
+            "veneur.sink.datadog.chunks_requeued_total"]
+        assert all(s.value == 0 for s in samples2)
 
     def test_datadog_columnar_flush_records_telemetry(self):
         import pytest

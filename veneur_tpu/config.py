@@ -195,6 +195,20 @@ class Config:
     # through native sink serialization (falls back automatically when
     # the native egress library cannot build)
     flush_columnar: bool = True
+    # overlapped flush egress (docs/internals.md "Life of a flush"):
+    # every retired group's flush program dispatches before any
+    # blocking device->host fetch, one serializer thread builds chunks
+    # while the next group's fetch blocks, and this depth bounds BOTH
+    # the fetched-but-unserialized chunks resident host-side and the
+    # slab groups' dispatch-ahead window on device. 0 = fully
+    # sequential drain (the pre-pipeline shape); negative rejected.
+    flush_pipeline_depth: int = 2
+    # streaming egress: chunk-capable sinks (and a chunk-capable
+    # forwarder) POST each completed group the moment it exists
+    # instead of batching the whole interval; unacked chunks requeue
+    # exactly once (late, never lost). Needs flush_columnar and
+    # flush_pipeline_depth > 0; other sinks keep the batch fan-out.
+    flush_streaming: bool = True
     # POST /import backpressure (the reference's bounded worker
     # channels, http.go:54-142): merge worker threads and the bounded
     # batch queue behind them — past capacity, requests shed with 429
@@ -445,6 +459,11 @@ class Config:
                 f"obs_timeline_intervals must be >= 0 (0 = use the "
                 f"default, 64; the flush-timeline ring cannot be "
                 f"unbounded), got {self.obs_timeline_intervals}")
+        if self.flush_pipeline_depth < 0:
+            raise ValueError(
+                f"flush_pipeline_depth must be >= 0 (0 = sequential "
+                f"flush, N = overlapped pipeline bounded at N in-flight "
+                f"chunks), got {self.flush_pipeline_depth}")
         if self.checkpoint_max_age_intervals < 0:
             raise ValueError(
                 f"checkpoint_max_age_intervals must be >= 0 (0 = use "

@@ -159,7 +159,7 @@ def digest_block(names: Arenas, tags: Arenas, r: dict, agg: Aggregate,
                  percentiles: List[float]) -> Optional[EmissionBlock]:
     """Histogram/timer flush results → emissions, masks computed
     vectorized (the emission rules of Histo.Flush,
-    samplers.go:511-636, identical to MetricStore._flush_digest_group)."""
+    samplers.go:511-636, identical to MetricStore._emit_digest_result)."""
     n = len(names[1])
     if n == 0:
         return None

@@ -486,16 +486,17 @@ class MeshDigestGroup(_PlacementMixin, DigestGroup):
                                    jnp.asarray(qs, jnp.float32),
                                    self.mesh, self.compression)
 
-    def _flush_fetch(self, n: int, percentiles, want_digests, want_stats,
-                     use_pallas: bool) -> dict:
-        """One complete flush attempt: the sharded flush program, then a
-        permutation gather back to interner order (physical rows are
-        shard-placed, not sequential) fetched in one transfer."""
+    def _flush_dispatch(self, n: int, percentiles, want_digests,
+                        want_stats, use_pallas: bool):
+        """Async half of one flush attempt: the sharded flush program
+        plus a permutation gather back to interner order (physical rows
+        are shard-placed, not sequential); the base ``_flush_collect``
+        fetches the gathered refs in one transfer."""
         if want_digests == "packed":
             raise NotImplementedError(
                 "packed digest export is a forwarding-local concern; a "
                 "mesh global emits percentiles and never re-forwards")
-        from veneur_tpu.core.slab import _fill_stat_results, _select_stats
+        from veneur_tpu.core.slab import _select_stats
 
         sel = _select_stats(want_stats)
         qs = jnp.asarray(list(percentiles) + [0.5], jnp.float32)
@@ -505,21 +506,13 @@ class MeshDigestGroup(_PlacementMixin, DigestGroup):
             digest, pcts, count, vsum, vmin, vmax, recip = \
                 self._run_flush(qs, use_pallas)
             planes = ()
-            out = {}
             if want_digests:
                 planes = (digest.mean[rows], digest.weight[rows],
                           digest.min[rows], digest.max[rows])
             stats = {"pcts": pcts, "count": count, "sum": vsum,
                      "min": vmin, "max": vmax, "recip": recip}
-        with obs_rec.maybe_stage("fetch"):
-            fetched = jax.device_get(
-                planes + tuple(stats[nm][rows] for nm in sel))
-        if want_digests:
-            (out["digest_mean"], out["digest_weight"], out["digest_min"],
-             out["digest_max"]) = fetched[:4]
-            fetched = fetched[4:]
-        _fill_stat_results(sel, fetched, n, percentiles, out)
-        return out
+            refs = planes + tuple(stats[nm][rows] for nm in sel)
+        return (sel, False, None, refs)
 
     @requires_lock("store")
     def snapshot_begin(self):
@@ -578,6 +571,20 @@ class MeshDigestGroup(_PlacementMixin, DigestGroup):
                                       want_stats)
         self._reset_placement()
         return interner, out
+
+    def flush_begin(self, percentiles, want_digests=True,
+                    want_stats=None):
+        """Two-phase flush (see ``DigestGroup.flush_begin``): the
+        sharded flush program + permutation gather dispatch now; the
+        placement resets with the interner once ``finish`` commits."""
+        fin = super().flush_begin(percentiles, want_digests, want_stats)
+
+        def finish():
+            out = fin()
+            self._reset_placement()
+            return out
+
+        return finish
 
     def fresh(self) -> "MeshDigestGroup":
         """Empty same-config twin (swap-on-flush generation swap); the
@@ -659,23 +666,35 @@ class MeshSetGroup(_PlacementMixin, SetGroup):
             return _mesh_estimate(self.registers, self.mesh,
                                   self.precision)
 
-    def _live_estimates(self, n: int) -> np.ndarray:
+    def _estimate_refs(self, n: int):
         rows = jnp.asarray(self._flush_rows(n), jnp.int32)
-        return np.asarray(self._estimates()[rows])
+        return self._estimates()[rows]
 
-    def _live_registers(self, n: int) -> np.ndarray:
-        rows = jnp.asarray(self._flush_rows(n), jnp.int32)
-        return np.asarray(self.registers[rows], np.uint8)
-
-    def _snapshot_refs(self, n: int):
+    def _register_refs(self, n: int):
         rows = jnp.asarray(self._flush_rows(n), jnp.int32)
         return self.registers[rows]
+
+    def _snapshot_refs(self, n: int):
+        return self._register_refs(n)
 
     def flush(self, want_estimates: bool = True,
               want_registers: bool = True):
         out = super().flush(want_estimates, want_registers)
         self._reset_placement()
         return out
+
+    def flush_begin(self, want_estimates: bool = True,
+                    want_registers: bool = True):
+        """Two-phase flush: the permutation-gathered estimate/register
+        refs dispatch now; the placement resets once ``finish`` runs."""
+        fin = super().flush_begin(want_estimates, want_registers)
+
+        def finish():
+            out = fin()
+            self._reset_placement()
+            return out
+
+        return finish
 
     def fresh(self) -> "MeshSetGroup":
         """Empty same-config twin; sharded programs cached per mesh."""
@@ -813,6 +832,18 @@ class MeshHeavyHitterGroup(_PlacementMixin, HeavyHitterGroup):
         out = super().flush(want_forward)
         self._reset_placement()
         return out
+
+    def flush_begin(self, want_forward: bool = False):
+        """Two-phase flush: the gathered top-k plane refs dispatch now;
+        the placement resets once ``finish`` runs."""
+        fin = super().flush_begin(want_forward)
+
+        def finish():
+            out = fin()
+            self._reset_placement()
+            return out
+
+        return finish
 
     def fresh(self) -> "MeshHeavyHitterGroup":
         g = MeshHeavyHitterGroup(self.capacity, self.chunk, self.depth,

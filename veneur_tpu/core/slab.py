@@ -335,7 +335,7 @@ def _fill_stat_results(sel, cols, n: int, percentiles, out: dict) -> dict:
     """Map fetched stat columns into the flush result dict, zero-filling
     the unfetched ones. The zero-fill contract is load-bearing: it only
     holds because the SAME aggregate mask that excluded a key from the
-    fetch (MetricStore._flush_digest_group) gates its emissions — so
+    fetch (core/store.py _digest_want) gates its emissions — so
     this mapping lives in exactly one place for both the dense and slab
     digest groups. The shared zeros array is read-only: an accidental
     in-place write would otherwise corrupt every aliased key at once."""
@@ -939,26 +939,55 @@ class SlabDigestGroup(OverloadLimited):
         self._drain_staging()
         n = len(self.interner)
         if n == 0:
-            interner, self.interner = self.interner, self._interner_cls()
-            if self._retired:
-                # release order: device planes first, then host staging;
-                # a dead twin must not allocate fresh buffers
-                self.digests = []
-                self.temps = []
-                self._device_dirty = False
-                self._drop_staging()
-                return interner, {}
-            if self._device_dirty:
-                self._reset_device()
-            self._new_sample_buffers()
-            self._new_import_buffers()
-            return interner, {}
+            return self._flush_empty()
         from veneur_tpu.core.store import run_compute_ladder
 
         out = run_compute_ladder(
             self._compute,
             lambda use_pallas: self._flush_fetch(
                 n, percentiles, want_digests, want_stats, use_pallas))
+        return self._flush_commit(out)
+
+    def flush_begin(self, percentiles: List[float], want_digests=True,
+                    want_stats=None):
+        """Two-phase flush for the pipelined egress (see
+        ``DigestGroup.flush_begin``): the first ``_pipeline_window``
+        slabs' flush programs DISPATCH now; the returned ``finish()``
+        runs the windowed fetch loop — fetching slab j while slab
+        j+window executes — then commits. The compute ladder retries
+        inside ``finish`` (:func:`begin_compute_ladder` semantics)."""
+        self._drain_staging()
+        n = len(self.interner)
+        if n == 0:
+            res = self._flush_empty()
+            return lambda: res
+        from veneur_tpu.core.store import begin_compute_ladder
+
+        fin = begin_compute_ladder(
+            self._compute,
+            lambda use_pallas: self._flush_dispatch(
+                n, percentiles, want_digests, want_stats, use_pallas),
+            lambda st, use_pallas: self._flush_collect(
+                st, n, percentiles, want_digests))
+        return lambda: self._flush_commit(fin())
+
+    def _flush_empty(self):
+        interner, self.interner = self.interner, self._interner_cls()
+        if self._retired:
+            # release order: device planes first, then host staging;
+            # a dead twin must not allocate fresh buffers
+            self.digests = []
+            self.temps = []
+            self._device_dirty = False
+            self._drop_staging()
+            return interner, {}
+        if self._device_dirty:
+            self._reset_device()
+        self._new_sample_buffers()
+        self._new_import_buffers()
+        return interner, {}
+
+    def _flush_commit(self, out: dict):
         interner, self.interner = self.interner, self._interner_cls()
         self._device_dirty = False
         if self._retired:
@@ -975,62 +1004,113 @@ class SlabDigestGroup(OverloadLimited):
     def _flush_fetch(self, n: int, percentiles, want_digests, want_stats,
                      use_pallas: bool) -> dict:
         """One complete flush attempt over every slab (device programs +
-        host fetches into the result dict). The fresh planes each slab's
-        program returns are committed to ``self`` only once EVERY slab
+        host fetches into the result dict), dispatch and collect
+        composed back to back. The fresh planes each slab's program
+        returns are committed to ``self`` only once EVERY slab
         succeeded: a mid-loop kernel failure must leave the group's
         references intact for the fallback rung / the store's re-merge
         (on a backend that honors donation the consumed inputs are gone
         either way, and the ladder degrades to the checkpoint bound)."""
-        packed = want_digests == "packed"
-        sel = _select_stats(want_stats)
-        qs = jnp.asarray(list(percentiles) + [0.5], jnp.float32)
+        st = self._flush_dispatch(n, percentiles, want_digests,
+                                  want_stats, use_pallas)
+        return self._flush_collect(st, n, percentiles, want_digests)
+
+    def _flush_dispatch(self, n: int, percentiles, want_digests,
+                        want_stats, use_pallas: bool) -> dict:
+        """Async half of one flush attempt: dispatch the first
+        ``_pipeline_window`` slabs' flush (+pack) programs and slice
+        out their device refs. The window bounds how many slabs are
+        in flight at once — each in-flight slab holds its drained
+        output planes alive until its fetch lands — so device memory
+        stays flat at window size instead of doubling across every
+        slab."""
+        st = {"packed": want_digests == "packed",
+              "sel": _select_stats(want_stats),
+              "qs": jnp.asarray(list(percentiles) + [0.5], jnp.float32),
+              "use_pallas": use_pallas,
+              "want_digests": want_digests,
+              "n": n,
+              "nslabs": len(self.digests),
+              "new_digests": list(self.digests),
+              "new_temps": list(self.temps),
+              "refs": [],
+              "next": 0}
+        window = max(1, getattr(self, "_pipeline_window", 1))
+        for _ in range(min(window, st["nslabs"])):
+            self._dispatch_slab(st)
+        return st
+
+    def _dispatch_slab(self, st: dict) -> None:
+        """Dispatch one slab's flush program (async) and record its
+        fetchable refs in dispatch order."""
+        i = st["next"]
+        st["next"] = i + 1
+        need = min(st["n"] - i * self.slab_rows, self.slab_rows)
+        # want_digest=False also skips the device-side cast+write of
+        # the drained planes, not just the host fetch; a retired
+        # generation additionally skips allocating fresh slabs (its
+        # donated planes free outright, slab by slab)
+        with obs_kernels.scope("flush.digest.slab"):
+            (st["new_digests"][i], st["new_temps"][i], mean, weight,
+             dmin, dmax, pcts, count, vsum, vmin, vmax, recip) = \
+                _flush_slab(
+                    self.digests[i], self.temps[i], st["qs"],
+                    self.slab_rows, self.compression,
+                    bool(st["want_digests"]), not self._retired,
+                    st["use_pallas"])
+            if need <= 0:
+                st["refs"].append(None)
+                return
+            k = self.k
+            planes = ()
+            pk_refs = None
+            if st["packed"]:
+                pk_refs = _pack_slab(mean, weight, dmin, dmax,
+                                     self.slab_rows, k)
+                planes = (dmin[:need], dmax[:need])
+            elif st["want_digests"]:
+                planes = (
+                    mean.reshape(self.slab_rows, k)[:need]
+                        .astype(jnp.float32),
+                    weight.reshape(self.slab_rows, k)[:need]
+                          .astype(jnp.float32),
+                    dmin[:need], dmax[:need])
+            stats = {"pcts": pcts, "count": count, "sum": vsum,
+                     "min": vmin, "max": vmax, "recip": recip}
+            st["refs"].append(
+                (need, pk_refs,
+                 planes + tuple(stats[nm][:need] for nm in st["sel"])))
+
+    def _flush_collect(self, st: dict, n: int, percentiles,
+                       want_digests) -> dict:
+        """Blocking half: fetch each dispatched slab's interned prefix
+        in order, dispatching slab j+window while slab j's fetch
+        blocks — device execution overlaps the host transfer instead
+        of idling behind it (the sum-vs-max gap the `6_egress_1m`
+        timeline exposed)."""
+        window = max(1, getattr(self, "_pipeline_window", 1))
         parts = []
         pk_counts, pk_means, pk_wts = [], [], []
-        new_digests = list(self.digests)
-        new_temps = list(self.temps)
-        with obs_kernels.scope("flush.digest.slab"):
-            for i in range(len(self.digests)):
-                need = min(n - i * self.slab_rows, self.slab_rows)
-                # want_digest=False also skips the device-side cast+write
-                # of the drained planes, not just the host fetch; a
-                # retired generation additionally skips allocating fresh
-                # slabs (its donated planes free outright, slab by slab)
-                (new_digests[i], new_temps[i], mean, weight, dmin, dmax,
-                 pcts, count, vsum, vmin, vmax, recip) = _flush_slab(
-                    self.digests[i], self.temps[i], qs, self.slab_rows,
-                    self.compression, bool(want_digests),
-                    not self._retired, use_pallas)
-                if need <= 0:
-                    continue
-                k = self.k
-                # fetch this slab's interned prefix NOW so the device
-                # buffers free before the next slab's program runs
-                planes = ()
-                if packed:
-                    cts, pm, pw = _pack_slab(mean, weight, dmin, dmax,
-                                             self.slab_rows, k)
-                    c_h, pm_h, pw_h = _fetch_packed(cts, pm, pw, need)
+        for j in range(st["nslabs"]):
+            while st["next"] < st["nslabs"] and st["next"] - j < window:
+                self._dispatch_slab(st)
+            ref = st["refs"][j]
+            if ref is None:
+                continue
+            need, pk_refs, refs = ref
+            st["refs"][j] = None  # drop the fetched slab's refs promptly
+            with obs_rec.maybe_stage("fetch"):
+                if st["packed"]:
+                    c_h, pm_h, pw_h = _fetch_packed(*pk_refs, need)
                     pk_counts.append(c_h)
                     pk_means.append(pm_h)
                     pk_wts.append(pw_h)
-                    planes = (dmin[:need], dmax[:need])
-                elif want_digests:
-                    planes = (
-                        mean.reshape(self.slab_rows, k)[:need]
-                            .astype(jnp.float32),
-                        weight.reshape(self.slab_rows, k)[:need]
-                              .astype(jnp.float32),
-                        dmin[:need], dmax[:need])
-                stats = {"pcts": pcts, "count": count, "sum": vsum,
-                         "min": vmin, "max": vmax, "recip": recip}
-                with obs_rec.maybe_stage("fetch"):
-                    parts.append(jax.device_get(
-                        planes + tuple(stats[nm][:need] for nm in sel)))
+                parts.append(jax.device_get(refs))
         cols = [np.concatenate(c, axis=0) for c in zip(*parts)]
         # every slab's program + fetch succeeded: commit the fresh planes
-        self.digests, self.temps = new_digests, new_temps
+        self.digests, self.temps = st["new_digests"], st["new_temps"]
         out = {}
-        if packed:
+        if st["packed"]:
             out["digest_min"], out["digest_max"] = cols[:2]
             cols = cols[2:]
             out["packed_counts"] = np.concatenate(pk_counts)
@@ -1040,7 +1120,7 @@ class SlabDigestGroup(OverloadLimited):
             (out["digest_mean"], out["digest_weight"], out["digest_min"],
              out["digest_max"]) = cols[:4]
             cols = cols[4:]
-        return _fill_stat_results(sel, cols, n, percentiles, out)
+        return _fill_stat_results(st["sel"], cols, n, percentiles, out)
 
     # -- checkpoint snapshot / restore (veneur_tpu/persist/) --------------
 

@@ -309,6 +309,58 @@ def run_compute_ladder(compute, attempt):
     return out
 
 
+def begin_compute_ladder(compute, dispatch, collect):
+    """Two-phase twin of :func:`run_compute_ladder` for the pipelined
+    flush: ``dispatch(use_pallas)`` (async device-program enqueue) runs
+    NOW on the first viable rung, and the returned ``finish()`` runs
+    ``collect(pending, use_pallas)`` — the blocking device→host fetch —
+    later, so the caller can dispatch every group before blocking on
+    any. Failure semantics are identical rung for rung: a dispatch or
+    collect failure on the Pallas rung records the breaker failure and
+    re-runs the COMPLETE attempt (dispatch + collect) on the XLA rung
+    inside ``finish``; only a double failure raises (the store's
+    re-merge rung follows). Same donation caveat as the one-phase
+    ladder."""
+    pending = None
+    pallas = False
+    if compute is None:
+        pending = dispatch(True)
+        pallas = True
+    elif compute.probe():
+        try:
+            compute.preflight()
+            pending = dispatch(True)
+            pallas = True
+        except Exception:
+            compute.record_failure()
+            log.warning("digest flush kernel failed at dispatch; this "
+                        "interval will run on the XLA fallback path",
+                        exc_info=True)
+
+    def finish():
+        if pallas:
+            if compute is None:
+                out = collect(pending, True)
+                obs_rec.note(rung="pallas")
+                return out
+            try:
+                out = collect(pending, True)
+                compute.record_success()
+                obs_rec.note(rung="pallas")
+                return out
+            except Exception:
+                compute.record_failure()
+                log.warning("digest flush kernel failed; re-running "
+                            "this interval on the XLA fallback path",
+                            exc_info=True)
+        out = collect(dispatch(False), False)
+        compute.count_fallback()
+        obs_rec.note(rung="xla")
+        return out
+
+    return finish
+
+
 # ---------------------------------------------------------------------------
 # Host-side scalar groups
 # ---------------------------------------------------------------------------
@@ -436,6 +488,15 @@ class ScalarGroup(OverloadLimited):
             messages, self.messages = self.messages, []
             hostnames, self.hostnames = self.hostnames, []
         return interner, values, messages, hostnames
+
+    def flush_begin(self):
+        """Two-phase flush slot: scalar state is host numpy, so the
+        snapshot IS the whole flush — it runs eagerly and ``finish()``
+        just hands it back. Every group exposes the same begin/finish
+        surface; the store's scalar drain (``_flush_scalars``) goes
+        through it like the device groups go through theirs."""
+        res = self.snapshot_and_reset()
+        return lambda: res
 
     @requires_lock("store")
     def snapshot_begin(self):
@@ -901,22 +962,57 @@ class DigestGroup(OverloadLimited):
         self._drain_staging()
         n = len(self.interner)
         if n == 0:
-            interner, self.interner = self.interner, Interner()
-            if self._retired:
-                self._drop_device()
-            elif self._device_dirty:
-                # bulk paths can stage data without interning; never let
-                # it leak into the next interval's rows
-                self._init_device()
-                self._init_staging()
-            # device state is pristine: skip the flush program AND the
-            # device->host fetches (each fetch is a full round trip when
-            # the chip sits behind a network tunnel)
-            return interner, {}
-        out = self._flush_compute(n, percentiles, want_digests, want_stats)
-        # the interner swap and device reset happen only AFTER the
-        # device programs + fetches succeeded: on a ladder failure the
-        # group still holds its state for the store's re-merge rung
+            return self._flush_empty()
+        out = run_compute_ladder(
+            self._compute,
+            lambda use_pallas: self._flush_fetch(
+                n, percentiles, want_digests, want_stats, use_pallas))
+        return self._flush_commit(out)
+
+    def flush_begin(self, percentiles: List[float], want_digests=True,
+                    want_stats=None):
+        """Two-phase flush for the pipelined egress (the overlapped
+        twin of :meth:`flush`, same contract once finished): drain
+        staging and DISPATCH the flush program asynchronously NOW, and
+        return a ``finish()`` whose blocking ``jax.device_get`` runs
+        later — so the store can dispatch every retired group before
+        any fetch blocks, and group k+1's device execution overlaps
+        group k's host transfer. ``finish()`` returns ``(interner,
+        out)`` and only then resets the group; the compute-breaker
+        ladder retries inside ``finish`` per group
+        (:func:`begin_compute_ladder`), and a double failure raises
+        with the group state intact for the store's re-merge rung."""
+        self._drain_staging()
+        n = len(self.interner)
+        if n == 0:
+            res = self._flush_empty()
+            return lambda: res
+        fin = begin_compute_ladder(
+            self._compute,
+            lambda use_pallas: self._flush_dispatch(
+                n, percentiles, want_digests, want_stats, use_pallas),
+            lambda pending, use_pallas: self._flush_collect(
+                pending, n, percentiles, want_digests))
+        return lambda: self._flush_commit(fin())
+
+    def _flush_empty(self):
+        """The n==0 flush path: skip the flush program AND the
+        device->host fetches (each fetch is a full round trip when the
+        chip sits behind a network tunnel)."""
+        interner, self.interner = self.interner, Interner()
+        if self._retired:
+            self._drop_device()
+        elif self._device_dirty:
+            # bulk paths can stage data without interning; never let
+            # it leak into the next interval's rows
+            self._init_device()
+            self._init_staging()
+        return interner, {}
+
+    def _flush_commit(self, out: dict):
+        """Interner swap + device reset, only AFTER the device programs
+        + fetches succeeded: on a ladder failure the group still holds
+        its state for the store's re-merge rung."""
         interner, self.interner = self.interner, Interner()
         if self._retired:
             self._drop_device()
@@ -925,24 +1021,25 @@ class DigestGroup(OverloadLimited):
             self._init_staging()
         return interner, out
 
-    def _flush_compute(self, n: int, percentiles, want_digests,
-                       want_stats) -> dict:
-        """The flush's device programs behind the per-kernel breaker;
-        see :func:`run_compute_ladder` (incl. the donation caveat on
-        what rung 2 can and cannot recover)."""
-        return run_compute_ladder(
-            self._compute,
-            lambda use_pallas: self._flush_fetch(
-                n, percentiles, want_digests, want_stats, use_pallas))
-
     def _flush_fetch(self, n: int, percentiles, want_digests, want_stats,
                      use_pallas: bool) -> dict:
         """One complete flush attempt: device program + host fetch into
-        the result dict. No group state besides the (donated) device
-        planes is touched, so an attempt that failed before execution
-        can be retried."""
+        the result dict (dispatch and collect composed back to back —
+        the one-phase shape the ladder and the tiered dense bank call).
+        No group state besides the (donated) device planes is touched,
+        so an attempt that failed before execution can be retried."""
+        pending = self._flush_dispatch(n, percentiles, want_digests,
+                                       want_stats, use_pallas)
+        return self._flush_collect(pending, n, percentiles, want_digests)
+
+    def _flush_dispatch(self, n: int, percentiles, want_digests,
+                        want_stats, use_pallas: bool):
+        """Async half of one flush attempt: enqueue the flush program
+        (plus the on-device pack when forwarding packed) and slice out
+        the device refs the collect phase fetches. Nothing here blocks
+        on device execution."""
         packed = want_digests == "packed"
-        from veneur_tpu.core.slab import _fill_stat_results, _select_stats
+        from veneur_tpu.core.slab import _select_stats
 
         sel = _select_stats(want_stats)
         qs = jnp.asarray(list(percentiles) + [0.5], jnp.float32)
@@ -954,26 +1051,36 @@ class DigestGroup(OverloadLimited):
                 obs_kernels.scope("flush.digest.dense"):
             digest, pcts, count, vsum, vmin, vmax, recip = self._run_flush(
                 qs, use_pallas)
-            # one batched transfer instead of eleven round trips
             planes = ()
-            out = {}
+            packed_refs = None
             if packed:
-                from veneur_tpu.core.slab import _fetch_packed, _pack_slab
+                from veneur_tpu.core.slab import _pack_slab
 
-                cts, pm, pw = _pack_slab(
+                packed_refs = _pack_slab(
                     digest.mean.reshape(-1), digest.weight.reshape(-1),
                     digest.min, digest.max, self.capacity, self.k)
-                (out["packed_counts"], out["packed_means"],
-                 out["packed_weights"]) = _fetch_packed(cts, pm, pw, n)
                 planes = (digest.min[:n], digest.max[:n])
             elif want_digests:
                 planes = (digest.mean[:n], digest.weight[:n],
                           digest.min[:n], digest.max[:n])
             stats = {"pcts": pcts, "count": count, "sum": vsum,
                      "min": vmin, "max": vmax, "recip": recip}
+            refs = planes + tuple(stats[nm][:n] for nm in sel)
+        return (sel, packed, packed_refs, refs)
+
+    def _flush_collect(self, pending, n: int, percentiles,
+                       want_digests) -> dict:
+        """Blocking half of one flush attempt: one batched device->host
+        transfer instead of eleven round trips."""
+        from veneur_tpu.core.slab import _fetch_packed, _fill_stat_results
+
+        sel, packed, packed_refs, refs = pending
+        out = {}
         with obs_rec.maybe_stage("fetch"):
-            fetched = jax.device_get(
-                planes + tuple(stats[nm][:n] for nm in sel))
+            if packed:
+                (out["packed_counts"], out["packed_means"],
+                 out["packed_weights"]) = _fetch_packed(*packed_refs, n)
+            fetched = jax.device_get(refs)
         if packed:
             out["digest_min"], out["digest_max"] = fetched[:2]
             fetched = fetched[2:]
@@ -1261,6 +1368,16 @@ class SetGroup(OverloadLimited):
         """Estimate/export only what the caller will consume: a local
         instance forwards registers without estimating; a discarding flush
         (no sinks, no forwarding) skips both device passes."""
+        return SetGroup.flush_begin(self, want_estimates, want_registers)()
+
+    def flush_begin(self, want_estimates: bool = True,
+                    want_registers: bool = True):
+        """Two-phase flush for the pipelined egress: the estimate
+        program and the live-row register slice DISPATCH now (op
+        outputs own fresh buffers, so the device reset below cannot
+        touch them — the snapshot_begin pattern), and the returned
+        ``finish()`` runs the blocking fetch; a later group's device
+        execution overlaps it."""
         self._drain_staging()
         n = len(self.interner)
         interner, self.interner = self.interner, Interner()
@@ -1271,31 +1388,42 @@ class SetGroup(OverloadLimited):
             elif self._device_dirty:
                 self._reset_registers()
                 self._init_staging()
-            return interner, None, None
-        estimates = self._live_estimates(n) if want_estimates else None
-        registers = self._live_registers(n) if want_registers else None
+            return lambda: (interner, None, None)
+        est_ref = self._estimate_refs(n) if want_estimates else None
+        reg_ref = self._register_refs(n) if want_registers else None
         if self._retired:
-            # retired generation: free the [S, 2^p] plane now instead of
-            # allocating a third one (16 KiB/series at p=14)
+            # retired generation: drop the [S, 2^p] plane now instead
+            # of allocating a third one (16 KiB/series at p=14); the
+            # sliced op outputs above keep the live rows alive until
+            # the fetch lands
             self.registers = None
             self._device_dirty = False
         else:
             self._reset_registers()
             self._init_staging()
-        return interner, estimates, registers
+
+        def finish():
+            with obs_rec.maybe_stage("fetch"):
+                estimates = (np.asarray(jax.device_get(est_ref))
+                             if want_estimates else None)
+                registers = (np.asarray(jax.device_get(reg_ref), np.uint8)
+                             if want_registers else None)
+            return interner, estimates, registers
+
+        return finish
 
     def _estimates(self):
         """Batched cardinality estimates (override point for the mesh store)."""
         return _estimate_all(self.registers)
 
-    def _live_estimates(self, n: int) -> np.ndarray:
-        """Host estimates of the live rows, in interner order (the mesh
-        store gathers its shard-placed physical rows here)."""
-        return np.asarray(self._estimates()[:n])
+    def _estimate_refs(self, n: int):
+        """Device refs of the live rows' estimates, interner order (the
+        mesh store gathers its shard-placed physical rows here)."""
+        return self._estimates()[:n]
 
-    def _live_registers(self, n: int) -> np.ndarray:
-        """Host registers of the live rows, in interner order."""
-        return np.asarray(self.registers[:n], np.uint8)
+    def _register_refs(self, n: int):
+        """Device refs of the live rows' registers, interner order."""
+        return self.registers[:n]
 
     def _snapshot_refs(self, n: int):
         """Device refs of the live rows for the two-phase snapshot
@@ -1547,39 +1675,23 @@ class HeavyHitterGroup(OverloadLimited):
         """Returns (interner, [(row, member, count), ...], forwardable)
         and resets. forwardable is None unless want_forward: then it is
         (table ndarray, [(name, tags, [(hi, lo)...], [member...])])."""
+        return HeavyHitterGroup.flush_begin(self, want_forward)()
+
+    def flush_begin(self, want_forward: bool = False):
+        """Two-phase flush for the pipelined egress: the live top-k
+        plane slices (and the count-min table ref when forwarding)
+        dispatch now, the group resets immediately, and ``finish()``
+        runs the blocking fetch plus the host-side member/emission
+        assembly later."""
         self._drain_samples()
         n = len(self.interner)
         interner, self.interner = self.interner, Interner()
         if n == 0 and not self._device_dirty:
             # pristine sketch: skip the device reallocation entirely
-            return interner, [], None
-        out = []
-        fwd = None
-        if n:
-            hi, lo, ct = jax.device_get(self._live_topk(n))
-            # one pass builds both the emission rows and (when asked)
-            # the per-row forwardable candidate lists
-            by_row = {} if want_forward else None
-            for row in range(n):
-                for j in range(self.k):
-                    c = float(ct[row, j])
-                    if c <= 0:
-                        continue
-                    pair = (int(hi[row, j]), int(lo[row, j]))
-                    h = (pair[0] << 32) | pair[1]
-                    member = self._members.get(h)
-                    out.append((row, member or f"0x{h:016x}", c))
-                    if by_row is not None:
-                        keys, members = by_row.setdefault(row, ([], []))
-                        keys.append(pair)
-                        members.append(member)
-            if want_forward:
-                table = np.asarray(jax.device_get(self.sketch.table))
-                series = [
-                    (key.name, interner.tags[row]) + by_row[row]
-                    for key, row in interner.rows.items()
-                    if row in by_row]
-                fwd = (table, series)
+            return lambda: (interner, [], None)
+        refs = self._live_topk(n) if n else None
+        table_ref = self.sketch.table if (n and want_forward) else None
+        members, self._members = self._members, {}
         if self._retired:
             self.sketch = None  # free the table now, never reused
         else:
@@ -1587,8 +1699,40 @@ class HeavyHitterGroup(OverloadLimited):
             self._sids_np = np.zeros(self.capacity + 1, np.uint32)
             self._new_sample_buffers()
         self._device_dirty = False
-        self._members.clear()
-        return interner, out, fwd
+
+        def finish():
+            out = []
+            fwd = None
+            if n:
+                with obs_rec.maybe_stage("fetch"):
+                    hi, lo, ct = jax.device_get(refs)
+                # one pass builds both the emission rows and (when
+                # asked) the per-row forwardable candidate lists
+                by_row = {} if want_forward else None
+                for row in range(n):
+                    for j in range(self.k):
+                        c = float(ct[row, j])
+                        if c <= 0:
+                            continue
+                        pair = (int(hi[row, j]), int(lo[row, j]))
+                        h = (pair[0] << 32) | pair[1]
+                        member = members.get(h)
+                        out.append((row, member or f"0x{h:016x}", c))
+                        if by_row is not None:
+                            keys, mems = by_row.setdefault(row, ([], []))
+                            keys.append(pair)
+                            mems.append(member)
+                if want_forward:
+                    with obs_rec.maybe_stage("fetch"):
+                        table = np.asarray(jax.device_get(table_ref))
+                    series = [
+                        (key.name, interner.tags[row]) + by_row[row]
+                        for key, row in interner.rows.items()
+                        if row in by_row]
+                    fwd = (table, series)
+            return interner, out, fwd
+
+        return finish
 
     def _live_topk(self, n: int):
         """Device refs of the live rows' top-k planes, interner order
@@ -1824,6 +1968,34 @@ _DIGEST_GROUPS = ("histograms", "timers", "local_histograms", "local_timers")
 _SET_GROUPS = ("sets", "local_sets")
 
 
+def _digest_want(percentiles, aggregates: HistogramAggregates,
+                 forwarding: bool, digest_format: str):
+    """(want_digests, want_stats) for one digest group's flush: fetch
+    only the per-row stat arrays this aggregate config reads (each is
+    4 MB/1M rows of device->host transfer); the zero-fill for unfetched
+    ones is never emitted because the same mask gates the emissions and
+    columnar.digest_block."""
+    want = forwarding
+    if forwarding and digest_format == "packed":
+        want = "packed"
+    agg = aggregates.value
+    want_stats = set()
+    if agg & (Aggregate.COUNT | Aggregate.AVERAGE
+              | Aggregate.HARMONIC_MEAN):
+        want_stats.add("count")
+    if agg & Aggregate.MIN:
+        want_stats.add("min")
+    if agg & Aggregate.MAX:
+        want_stats.add("max")
+    if agg & (Aggregate.SUM | Aggregate.AVERAGE):
+        want_stats.add("sum")
+    if agg & Aggregate.HARMONIC_MEAN:
+        want_stats.add("recip")
+    if (agg & Aggregate.MEDIAN) or percentiles:
+        want_stats.add("pcts")
+    return want, want_stats
+
+
 class _Generation:
     """The retired group set a flush drains off-lock (swap-on-flush)."""
 
@@ -1872,11 +2044,18 @@ class MetricStore:
                  tier_pool_centroids: int = 16,
                  tier_promote_samples: int = 64,
                  tier_promote_intervals: int = 2,
-                 tier_demote_intervals: int = 3):
+                 tier_demote_intervals: int = 3,
+                 flush_pipeline_depth: int = 2):
         self._lock = threading.RLock()
         # serializes whole flush() calls (the store lock itself is held
         # only for the generation swap — see flush())
         self._flush_gate = threading.Lock()
+        # overlapped flush egress (docs/internals.md "Life of a
+        # flush"): 0 = fully sequential drain; N > 0 = dispatch-all-
+        # then-fetch with at most N fetched-but-unserialized chunks
+        # resident (and an N-slab dispatch-ahead window inside the
+        # slab-backed digest groups)
+        self.flush_pipeline_depth = max(0, int(flush_pipeline_depth))
         self.mesh = mesh
         self.shard_router = None
         if mesh is not None and digest_storage == "slab":
@@ -2076,6 +2255,9 @@ class MetricStore:
         g._overload = None if name == "self_timers" else self._overload
         g._quarantine = self.quarantine
         g._compute = self.compute
+        # the slab-backed groups' per-slab dispatch-ahead window rides
+        # the same knob as the store-level pipeline
+        g._pipeline_window = max(1, self.flush_pipeline_depth)
 
     def _truncate_tags(self, joined: str) -> str:
         """Hard per-series tag-length cap: cut the joined tag string at
@@ -2910,7 +3092,7 @@ class MetricStore:
     def flush(self, percentiles: List[float], aggregates: HistogramAggregates,
               is_local: bool, now: int, forward: bool = True,
               forward_topk: bool = True, columnar: bool = False,
-              digest_format: str = "dense"):
+              digest_format: str = "dense", stream=None):
         """Drain everything: returns (final metrics for sinks, forwardable
         sketch state, tallies) and resets all groups.
 
@@ -2941,6 +3123,18 @@ class MetricStore:
         flusher.go:134-184) — which the round-3 build inverted.
         ``_flush_gate`` serializes overlapping flush() calls so retired
         generations drain in order.
+
+        ``stream`` (optional, a :class:`veneur_tpu.core.pipeline
+        .ChunkStream`-shaped object) enables STREAMING egress: each
+        completed group's emission blocks are handed over as a chunk
+        the moment they exist — serialized and POSTed by the stream's
+        workers while later groups are still computing/fetching —
+        instead of batching the whole interval (docs/internals.md
+        "Life of a flush"). With ``flush_pipeline_depth > 0`` the
+        retired groups' device programs all DISPATCH before any
+        blocking fetch runs, so device execution, device→host
+        transfer, serialization and POST overlap as four pipeline
+        lanes.
         """
         # the gate's entire job is to hold across the retired drain:
         # it serializes overlapping flush() calls (only the flusher and
@@ -2951,7 +3145,7 @@ class MetricStore:
                     gen = self._swap_generation()
             return self._flush_generation(
                 gen, percentiles, aggregates, is_local, now, forward,
-                forward_topk, columnar, digest_format)
+                forward_topk, columnar, digest_format, stream)
 
     # every group swapped per flush, in flush order (self_timers is the
     # dedicated self-telemetry group — the server's own stage durations,
@@ -2998,9 +3192,16 @@ class MetricStore:
 
     def _flush_generation(self, g: "_Generation", percentiles, aggregates,
                           is_local, now, forward, forward_topk, columnar,
-                          digest_format):
+                          digest_format, stream=None):
         """Drain a retired generation into emissions + forwardable state.
-        Runs off-lock: ``g``'s groups are exclusively owned here."""
+        Runs off-lock: ``g``'s groups are exclusively owned here.
+
+        The drain is a PLAN of per-group flush units executed by
+        :meth:`_run_flush_units` — sequentially when
+        ``flush_pipeline_depth`` is 0 (the pre-pipeline shape), or as
+        the overlapped dispatch→fetch→serialize pipeline otherwise,
+        with each completed group streamed out through ``stream`` as
+        its own egress chunk."""
         ms = _summarize(g)
         ms.processed = g.processed
         ms.imported = g.imported
@@ -3014,54 +3215,81 @@ class MetricStore:
             final = []
         fwd = ForwardableState()
 
-        # counters & gauges (mixed scope) always flush locally
+        # counters & gauges (mixed scope) always flush locally; host
+        # numpy, so they run — and stream as the interval's first
+        # chunk — before any device fetch can block
+        mark = len(col.blocks) if col is not None else 0
         with obs_rec.maybe_stage("scalars"):
             self._flush_scalars(g.counters, MetricType.COUNTER, final,
                                 now, col)
             self._flush_scalars(g.gauges, MetricType.GAUGE, final, now,
                                 col)
+        if stream is not None and col is not None \
+                and len(col.blocks) > mark:
+            blocks = col.blocks[mark:]
+            stream.emit("scalars", blocks, sum(len(b) for b in blocks))
 
         # mixed histograms/timers: no percentiles on a local instance
         mixed_pcts = [] if is_local else list(percentiles)
         fwd_digests = is_local and forward
-        self._flush_digest_group(
-            g.histograms, mixed_pcts, aggregates, final, now,
-            fwd_list=fwd.histograms if fwd_digests else None,
-            col=col, fwd_state=fwd if fwd_digests else None,
-            fwd_attr="histograms_columnar", digest_format=digest_format,
-            gen_name="histograms")
-        self._flush_digest_group(
-            g.timers, mixed_pcts, aggregates, final, now,
-            fwd_list=fwd.timers if fwd_digests else None,
-            col=col, fwd_state=fwd if fwd_digests else None,
-            fwd_attr="timers_columnar", digest_format=digest_format,
-            gen_name="timers")
+        units: List[tuple] = []
 
+        def digest_unit(gen_name, group, pcts, fwd_list, fwd_state,
+                        fwd_attr):
+            forwarding = fwd_list is not None or fwd_state is not None
+            want, want_stats = _digest_want(pcts, aggregates, forwarding,
+                                            digest_format)
+
+            def begin():
+                return group.flush_begin(pcts, want_digests=want,
+                                         want_stats=want_stats)
+
+            def emit(res):
+                interner, r = res
+                self._emit_digest_result(
+                    gen_name, interner, r, pcts, aggregates, final, now,
+                    fwd_list, col, fwd_state, fwd_attr, stream)
+
+            units.append((gen_name, len(group), begin, emit, group))
+
+        digest_unit("histograms", g.histograms, mixed_pcts,
+                    fwd.histograms if fwd_digests else None,
+                    fwd if fwd_digests else None, "histograms_columnar")
+        digest_unit("timers", g.timers, mixed_pcts,
+                    fwd.timers if fwd_digests else None,
+                    fwd if fwd_digests else None, "timers_columnar")
         # local-only histograms/timers: full flush with percentiles
-        self._flush_digest_group(g.local_histograms, list(percentiles),
-                                 aggregates, final, now, fwd_list=None,
-                                 col=col, gen_name="local_histograms")
-        self._flush_digest_group(g.local_timers, list(percentiles),
-                                 aggregates, final, now, fwd_list=None,
-                                 col=col, gen_name="local_timers")
-
+        digest_unit("local_histograms", g.local_histograms,
+                    list(percentiles), None, None, "")
+        digest_unit("local_timers", g.local_timers, list(percentiles),
+                    None, None, "")
         # the dedicated self-telemetry group: the server's own stage
         # durations (sample_self_timing), always local, full
         # percentiles — the server reports exact p50/p99 of its own
         # flush stages through the same sketches it sells
-        self._flush_digest_group(g.self_timers, list(percentiles),
-                                 aggregates, final, now, fwd_list=None,
-                                 col=col, gen_name="self_timers")
+        digest_unit("self_timers", g.self_timers, list(percentiles),
+                    None, None, "")
 
         # local sets always flush; mixed sets flush only on a global
         # instance (they are forwarded from locals)
-        with obs_rec.maybe_stage("sets"):
-            self._flush_set_group(g.local_sets, final, now,
-                                  fwd_list=None, col=col)
-            self._flush_set_group(
-                g.sets, final if not is_local else None, now,
-                fwd_list=fwd.sets if (is_local and forward) else None,
-                col=col if not is_local else None)
+        def set_unit(name, group, out_list, fwd_list, set_col):
+            def begin():
+                return group.flush_begin(
+                    want_estimates=out_list is not None,
+                    want_registers=fwd_list is not None)
+
+            def emit(res):
+                interner, estimates, registers = res
+                self._emit_set_result(name, interner, estimates,
+                                      registers, out_list, now,
+                                      fwd_list, set_col, stream)
+
+            units.append((name, len(group), begin, emit, None))
+
+        set_unit("local_sets", g.local_sets, final, None, col)
+        set_unit("sets", g.sets, final if not is_local else None,
+                 fwd.sets if (is_local and forward) else None,
+                 col if not is_local else None)
 
         # heavy hitters follow the mixed-SET rule (flusher.go:231-249):
         # a forwarding local ships its sketch upstream and does NOT
@@ -3071,18 +3299,25 @@ class MetricStore:
         # sketch (gRPC: forward_topk=False), the local emits its own
         # view instead so the data is never silently dropped.
         want_hh_fwd = is_local and forward and forward_topk
-        with obs_rec.maybe_stage("topk"):
-            hh_interner, hh, hh_fwd = g.heavy_hitters.flush(
-                want_forward=want_hh_fwd)
-        fwd.topk = hh_fwd
-        if want_hh_fwd:
-            hh = []
-        for row, member, count in hh:
-            tags = hh_interner.tags[row]
-            final.append(InterMetric(
-                name=f"{hh_interner.names[row]}.topk", timestamp=now,
-                value=count, tags=list(tags) + [f"key:{member}"],
-                type=MetricType.COUNTER, sinks=route_info(tags)))
+
+        def topk_emit(res):
+            hh_interner, hh, hh_fwd = res
+            fwd.topk = hh_fwd
+            if want_hh_fwd:
+                hh = []
+            for row, member, count in hh:
+                tags = hh_interner.tags[row]
+                final.append(InterMetric(
+                    name=f"{hh_interner.names[row]}.topk", timestamp=now,
+                    value=count, tags=list(tags) + [f"key:{member}"],
+                    type=MetricType.COUNTER, sinks=route_info(tags)))
+
+        units.append((
+            "topk", len(g.heavy_hitters),
+            lambda: g.heavy_hitters.flush_begin(want_forward=want_hh_fwd),
+            topk_emit, None))
+
+        self._run_flush_units(units)
 
         # status checks are always local
         self._flush_status(g.local_status_checks, final, now)
@@ -3113,7 +3348,7 @@ class MetricStore:
 
     def _flush_scalars(self, group: ScalarGroup, mtype: MetricType,
                        out: List[InterMetric], now: int, col=None):
-        interner, values, _, _ = group.snapshot_and_reset()
+        interner, values, _, _ = group.flush_begin()()
         if col is not None and len(interner):
             from veneur_tpu.core import columnar as cb
 
@@ -3142,70 +3377,96 @@ class MetricStore:
                 message=messages[row], hostname=hostnames[row],
                 sinks=route_info(tags)))
 
-    def _flush_digest_group(self, group: DigestGroup, percentiles: List[float],
+    def _run_flush_units(self, units: List[tuple]):
+        """Execute the generation's flush plan.
+
+        Sequential (``flush_pipeline_depth == 0``): begin + finish +
+        emit per unit, in plan order — the pre-pipeline shape, one
+        group fully drained before the next dispatches.
+
+        Pipelined (the default): every unit's device program DISPATCHES
+        first (async — the ``dispatch.<group>`` stages), then the
+        fetches run in plan order on this thread while ONE serializer
+        thread (core/pipeline.py SerializerLane) builds and streams
+        each completed group's emission chunk — so group k+1's device
+        execution overlaps group k's device→host fetch, and group k's
+        serialization/POST overlaps group k+1's fetch. The lane's
+        bounded handoff queue (``flush_pipeline_depth`` chunks) keeps
+        host memory flat, and emission ORDER stays deterministic.
+
+        Failure ladder per unit is unchanged: a digest unit (``group``
+        set) that fails dispatch or fetch past the compute ladder
+        re-merges into the live store (:meth:`_requeue_group`) while
+        every other unit keeps streaming; non-digest units propagate."""
+        depth = getattr(self, "flush_pipeline_depth", 0)
+        if depth <= 0:
+            for name, series, begin, emit, group in units:
+                with obs_rec.maybe_stage(name, series=series):
+                    try:
+                        res = begin()()
+                    except Exception:
+                        if not self._unit_failed(name, group, "flush"):
+                            raise
+                        continue
+                    emit(res)
+            return
+        from veneur_tpu.core.pipeline import SerializerLane
+
+        plan = []
+        with obs_rec.maybe_stage("dispatch"):
+            for name, series, begin, emit, group in units:
+                with obs_rec.maybe_stage(name):
+                    try:
+                        fin = begin()
+                    except Exception:
+                        if not self._unit_failed(name, group,
+                                                 "dispatch"):
+                            raise
+                        fin = None
+                plan.append((name, series, fin, emit, group))
+        lane = SerializerLane(depth, obs_rec.current())
+        try:
+            for name, series, fin, emit, group in plan:
+                if fin is None:
+                    continue
+                with obs_rec.maybe_stage(name, series=series):
+                    try:
+                        res = fin()
+                    except Exception:
+                        if not self._unit_failed(name, group, "fetch"):
+                            raise
+                        continue
+                lane.submit(name, emit, res)
+        finally:
+            # joins the serializer; re-raises the first emit error
+            lane.close()
+
+    def _unit_failed(self, name: str, group, phase: str) -> bool:
+        """The flush plan's shared failure edge (call from an except
+        block): a digest unit that failed past the compute ladder
+        re-merges into the live store — late, never lost — and the
+        plan continues (True); anything else propagates (False)."""
+        if group is None:
+            return False
+        log.exception("digest flush for %s failed at %s past the "
+                      "fallback ladder; re-merging the interval into "
+                      "the live store", name, phase)
+        self._requeue_group(name, group)
+        return True
+
+    def _emit_digest_result(self, gen_name: str, interner, r: dict,
+                            percentiles: List[float],
                             aggregates: HistogramAggregates,
                             out: List[InterMetric], now: int,
                             fwd_list: Optional[list], col=None,
                             fwd_state=None, fwd_attr: str = "",
-                            digest_format: str = "dense",
-                            gen_name: str = ""):
-        """Stage-traced wrapper: the interval timeline shows one stage
-        per digest group (series count, breaker rung, compute/fetch
-        children from the group internals)."""
-        with obs_rec.maybe_stage(gen_name or "digests",
-                                 series=len(group)):
-            return self._flush_digest_group_inner(
-                group, percentiles, aggregates, out, now, fwd_list,
-                col=col, fwd_state=fwd_state, fwd_attr=fwd_attr,
-                digest_format=digest_format, gen_name=gen_name)
-
-    def _flush_digest_group_inner(self, group: DigestGroup,
-                                  percentiles: List[float],
-                                  aggregates: HistogramAggregates,
-                                  out: List[InterMetric], now: int,
-                                  fwd_list: Optional[list], col=None,
-                                  fwd_state=None, fwd_attr: str = "",
-                                  digest_format: str = "dense",
-                                  gen_name: str = ""):
-        forwarding = fwd_list is not None or fwd_state is not None
-        want = forwarding
-        if forwarding and digest_format == "packed":
-            want = "packed"
+                            stream=None):
+        """Emission half of one digest group's flush: build the
+        columnar block (or the per-row fallback), capture the
+        forwardable planes, and hand the chunk to the egress stream.
+        Runs on the serializer lane in pipelined mode — everything here
+        is host-side work on the already-fetched result."""
         agg = aggregates.value
-        # fetch only the per-row stat arrays this aggregate config reads
-        # (each is 4 MB/1M rows of device->host transfer); the zero-fill
-        # for unfetched ones is never emitted because the same mask
-        # gates the emissions below and in columnar.digest_block
-        want_stats = set()
-        if agg & (Aggregate.COUNT | Aggregate.AVERAGE
-                  | Aggregate.HARMONIC_MEAN):
-            want_stats.add("count")
-        if agg & Aggregate.MIN:
-            want_stats.add("min")
-        if agg & Aggregate.MAX:
-            want_stats.add("max")
-        if agg & (Aggregate.SUM | Aggregate.AVERAGE):
-            want_stats.add("sum")
-        if agg & Aggregate.HARMONIC_MEAN:
-            want_stats.add("recip")
-        if (agg & Aggregate.MEDIAN) or percentiles:
-            want_stats.add("pcts")
-        try:
-            interner, r = group.flush(percentiles, want_digests=want,
-                                      want_stats=want_stats)
-        except Exception:
-            # the compute ladder's last rung: both the Pallas and the
-            # XLA flush attempts failed (resilience/compute.py). The
-            # group still holds its interval — re-merge it into the
-            # LIVE store with import semantics, so the data emits LATE
-            # next flush (and PR 2's checkpointer persists it on its
-            # normal cadence) instead of being lost with the retired
-            # generation.
-            log.exception("digest flush for %s failed past the fallback "
-                          "ladder; re-merging the interval into the "
-                          "live store", gen_name or "digest group")
-            self._requeue_group(gen_name, group)
-            return
         packed = ("packed_counts" in r) if r else False
         if col is not None and len(interner):
             from veneur_tpu.core import columnar as cb
@@ -3213,19 +3474,31 @@ class MetricStore:
             names = cb.build_arenas(interner.names)
             tags = cb.build_arenas(interner.joined)
             if not cb.has_sink_routing(tags[0]):
-                col.add_block(cb.digest_block(names, tags, r, agg,
-                                              percentiles))
+                block = cb.digest_block(names, tags, r, agg, percentiles)
+                col.add_block(block)
                 if fwd_state is not None:
                     if packed:
-                        setattr(fwd_state, fwd_attr,
-                                (names, tags, _packed_planes_from_result(r)))
+                        part = (names, tags,
+                                _packed_planes_from_result(r))
                     else:
-                        setattr(fwd_state, fwd_attr, (
+                        part = (
                             names, tags,
                             np.asarray(r["digest_mean"], np.float32),
                             np.asarray(r["digest_weight"], np.float32),
                             np.asarray(r["digest_min"], np.float32),
-                            np.asarray(r["digest_max"], np.float32)))
+                            np.asarray(r["digest_max"], np.float32))
+                    if stream is not None and stream.forward_streaming:
+                        # streamed forward: this shard's planes POST
+                        # upstream NOW, overlapping the next group's
+                        # fetch; a terminal failure re-merges into the
+                        # live store (late, never lost) instead of
+                        # riding fwd_state
+                        stream.emit_forward(gen_name, fwd_attr, part,
+                                            len(interner))
+                    else:
+                        setattr(fwd_state, fwd_attr, part)
+                if stream is not None:
+                    stream.emit(gen_name, [block], len(block))
                 return
             # sink-routed rows present (rare): per-row path keeps routing
         if packed and fwd_list is not None:
@@ -3312,11 +3585,12 @@ class MetricStore:
                           "failure; its interval is lost (the last "
                           "checkpoint bounds the damage)", gen_name)
 
-    def _flush_set_group(self, group: SetGroup,
+    def _emit_set_result(self, name: str, interner, estimates, registers,
                          out: Optional[List[InterMetric]], now: int,
-                         fwd_list: Optional[list], col=None):
-        interner, estimates, registers = group.flush(
-            want_estimates=out is not None, want_registers=fwd_list is not None)
+                         fwd_list: Optional[list], col=None, stream=None):
+        """Emission half of one set group's flush (host-side; runs on
+        the serializer lane in pipelined mode). ``hll_precision`` rides
+        the store — the retired group already dropped its plane."""
         if out is None and fwd_list is None:
             return
         if (col is not None and fwd_list is None and out is not None
@@ -3326,6 +3600,8 @@ class MetricStore:
             block = cb.scalar_block(interner, estimates, cb.TYPE_GAUGE)
             if not cb.has_sink_routing(block.tags[0]):
                 col.add_block(block)
+                if stream is not None:
+                    stream.emit(name, [block], len(block))
                 return
         for key, row in interner.rows.items():
             tags = interner.tags[row]
@@ -3336,4 +3612,4 @@ class MetricStore:
                     type=MetricType.GAUGE, sinks=route_info(tags)))
             if fwd_list is not None:
                 fwd_list.append((key.name, tags, registers[row],
-                                 group.precision))
+                                 self.hll_precision))

@@ -1055,6 +1055,22 @@ class TieredDigestGroup(OverloadLimited):
         # callers (tests, benches) own their group outright
         self._drain_staging()  # lint: ok(unlocked-call)
         n = len(self.interner)
+        return self._flush_tiers(n, percentiles, want_digests, want_stats)
+
+    def flush_begin(self, percentiles: List[float], want_digests=True,
+                    want_stats=None):
+        """Two-phase slot for the pipelined egress: the staged-chunk
+        drains (pool binning + dense-bank ingest programs) DISPATCH
+        asynchronously now, and the two-tier flush itself runs in
+        ``finish()`` — the tiered group overlaps at the STORE level
+        (other groups serialize/POST while this one computes and
+        fetches); its internal per-slab fetch loop stays one phase."""
+        self._drain_staging()  # lint: ok(unlocked-call)
+        n = len(self.interner)
+        return lambda: self._flush_tiers(n, percentiles, want_digests,
+                                         want_stats)
+
+    def _flush_tiers(self, n: int, percentiles, want_digests, want_stats):
         if n == 0:
             interner, self.interner = self.interner, Interner()
             if self._retired:

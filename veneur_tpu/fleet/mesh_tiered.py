@@ -451,13 +451,30 @@ class MeshTieredDigestGroup(TieredDigestGroup):
     def flush(self, percentiles, want_digests=True, want_stats=None):
         interner, out = super().flush(percentiles, want_digests,
                                       want_stats)
+        self._reset_mesh_plumbing()
+        return interner, out
+
+    def flush_begin(self, percentiles, want_digests=True,
+                    want_stats=None):
+        """Two-phase slot (see ``TieredDigestGroup.flush_begin``): the
+        sharded staged-chunk drains dispatch now; the two-tier flush
+        and the placement reset run in ``finish``."""
+        fin = super().flush_begin(percentiles, want_digests, want_stats)
+
+        def finish():
+            out = fin()
+            self._reset_mesh_plumbing()
+            return out
+
+        return finish
+
+    def _reset_mesh_plumbing(self):
         if not self._retired:
             self.placement = PoolPlacement(self.shards, self.slab_rows,
                                            slabs=len(self.pools))
             self._logical = np.full(len(self._slot), -1, np.int64)
             self._bank_fills[:] = 0
         self._dense_shard, self._dense_idx, self._dense_slots = [], [], []
-        return interner, out
 
     def _end_interval(self, n: int):
         # gather the LIVE rows' activity through the permutation (the

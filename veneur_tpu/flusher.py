@@ -57,6 +57,17 @@ def flush_once(server: "Server"):
         server.last_flush_ok = False
         raise
     finally:
+        # the interval's ChunkStream must be joined on EVERY unwind
+        # path (an exception between the store drain and the post
+        # barrier would otherwise leak its workers); close() is
+        # idempotent, so the normal path's barrier already ran
+        stream = getattr(server, "_active_stream", None)
+        if stream is not None:
+            server._active_stream = None
+            try:
+                stream.close()
+            except Exception:
+                log.exception("stream close failed")
         if rec is not None:
             try:
                 _publish_interval(server, span, rec, timeline)
@@ -136,6 +147,12 @@ def _publish_interval(server, span, rec, timeline):
             # ingest stamp -> global sink 2xx, the true e2e freshness
             e2e_ns = age_ns
             entry["e2e_age_ns"] = e2e_ns
+    # the egress-pipeline overlap measures (obs/timeline.py): lanes,
+    # egress_wall_ns, overlap_ratio, sum_vs_max_gap_ns — what the
+    # `6_egress_1m` bench gate reads straight off this endpoint
+    from veneur_tpu.obs.timeline import annotate_overlap
+
+    annotate_overlap(entry)
     timeline.publish(entry)
     _record_stage_spans(server, span, entry)
     store = getattr(server, "store", None)
@@ -163,6 +180,11 @@ def _publish_interval(server, span, rec, timeline):
     # live device observability: coverage of the interval's stages plus
     # compile/dispatch deltas per kernel scope (what the recompile lint
     # pass proves statically, observed at runtime)
+    if entry.get("overlap_ratio") is not None:
+        # the egress pipeline's sum-vs-max health in one gauge: ~1.0 =
+        # sequential, max(lane)/Σlanes = perfectly overlapped
+        span.add(ssf_samples.gauge("veneur.obs.overlap_ratio",
+                                   float(entry["overlap_ratio"]), None))
     span.add(
         ssf_samples.gauge("veneur.obs.stage_coverage_ratio",
                           float(entry["coverage_ratio"]), None),
@@ -362,12 +384,24 @@ def _flush_once(server: "Server", span, rec=None):
                            or hop_oldest < oldest_ingest):
             oldest_ingest = hop_oldest
     server._interval_oldest_ingest_ns = oldest_ingest
+    # streaming egress (docs/internals.md "Life of a flush"): with the
+    # pipeline on, every sink that can take chunked bodies gets each
+    # completed group's blocks POSTed WHILE later groups still compute/
+    # fetch, and (when the forwarder takes chunks) forwardable digest
+    # shards ship upstream the same way — behind the same retry/
+    # breaker/deadline ladder, with per-chunk requeue accounting
+    stream, stream_sinks = _build_stream(server, now, deadline, rec,
+                                         use_columnar, forwarding, span)
+    # flush_once's finally closes this on every unwind path; the happy
+    # path's post barrier below closes it first (close is idempotent)
+    server._active_stream = stream
     t0 = time.perf_counter()
     with obs.maybe_stage("store"):
         final_metrics, forwardable, ms = server.store.flush(
-            percentiles, server.histogram_aggregates, is_local=is_local,
-            now=now, forward=forwarding, forward_topk=topk_ok,
-            columnar=use_columnar, digest_format=digest_format)
+            percentiles, server.histogram_aggregates,
+            is_local=is_local, now=now, forward=forwarding,
+            forward_topk=topk_ok, columnar=use_columnar,
+            digest_format=digest_format, stream=stream)
     flush_elapsed = time.perf_counter() - t0
     log.debug("store flush took %.1f ms (%s)", flush_elapsed * 1e3, ms)
     # the store just drained: any existing checkpoint captured state
@@ -452,13 +486,20 @@ def _flush_once(server: "Server", span, rec=None):
         threading.Thread(target=fwd, daemon=True).start()
 
     if not final_metrics:
+        if stream is not None:
+            stream.close()
         with obs.maybe_stage("span_join"):
             span_flusher.join(timeout=10.0)
         return
 
-    # one thread per metric sink (flusher.go:82-93)
+    # one thread per metric sink (flusher.go:82-93). post_t0 starts
+    # BEFORE the stream barrier so the ``post`` stage covers the
+    # streamed chunks' tail as well as the batch fan-out; by the time
+    # the overrun check runs every chunk is acked or requeued.
     t0 = time.perf_counter()
     post_t0 = time.monotonic_ns()
+    if stream is not None:
+        stream.close()
     threads = []
     sink_elapsed: dict = {}
 
@@ -482,7 +523,15 @@ def _flush_once(server: "Server", span, rec=None):
         # loop (set before the thread starts; sinks only read it)
         if hasattr(sink, "set_flush_deadline"):
             sink.set_flush_deadline(deadline)
-        if use_columnar and hasattr(sink, "flush_columnar"):
+        if sink in stream_sinks:
+            # the emission blocks already streamed out chunk by chunk;
+            # only the extras (status checks, routed rows, per-row
+            # fallbacks) remain for this sink
+            t = threading.Thread(
+                target=timed(_flush_sink, sink,
+                             list(final_metrics.extras)),
+                daemon=True)
+        elif use_columnar and hasattr(sink, "flush_columnar"):
             t = threading.Thread(
                 target=timed(_flush_sink_columnar, sink, final_metrics),
                 daemon=True)
@@ -522,6 +571,84 @@ def _flush_once(server: "Server", span, rec=None):
 
     with obs.maybe_stage("span_join"):
         span_flusher.join(timeout=10.0)
+
+
+def _build_stream(server, now, deadline, rec, use_columnar, forwarding,
+                  span):
+    """The interval's :class:`veneur_tpu.core.pipeline.ChunkStream`
+    when streaming egress is on (``flush_streaming`` +
+    ``flush_pipeline_depth > 0``): every chunk-capable sink POSTs each
+    completed group the moment it exists, and — when the forwarder
+    takes parts — forwardable digest shards ship upstream the same
+    way, with a terminally-failed part re-merged into the live store
+    (late, never lost). Returns ``(stream-or-None, streaming sinks)``;
+    the flusher later hands those sinks only the extras."""
+    cfg = server.config
+    if not use_columnar or not getattr(cfg, "flush_streaming", False) \
+            or getattr(server.store, "flush_pipeline_depth", 0) <= 0:
+        return None, []
+    sinks = [s for s in server.metric_sinks if hasattr(s, "flush_chunk")]
+    for sink in sinks:
+        # the shared egress budget must be on the sink BEFORE its first
+        # chunk arrives (the batch fan-out re-stamps it harmlessly)
+        if hasattr(sink, "set_flush_deadline"):
+            sink.set_flush_deadline(deadline)
+    fwd_fn = fwd_requeue = None
+    fwder = server._forwarder
+    if forwarding and fwder is not None and \
+            getattr(fwder, "supports_chunked_forward", False):
+        from veneur_tpu.core.store import ForwardableState
+        from veneur_tpu.obs import TraceContext
+
+        def fwd_fn(attr, part):
+            mini = ForwardableState()
+            setattr(mini, attr, part)
+            # the fleet trace plane's hop baggage rides every streamed
+            # part exactly like the batch forward (the PR-13 contract):
+            # this flush's span ids + the oldest ingest-era stamp,
+            # stashed at the swap boundary before any chunk flows
+            ingest_ns = (getattr(server, "_interval_oldest_ingest_ns",
+                                 None) or int(now * 1e9))
+            return fwder.forward(
+                mini, parent_span=span, deadline=deadline,
+                trace_ctx=TraceContext(span.trace_id, span.span_id,
+                                       ingest_ns))
+
+        def fwd_requeue(attr, part):
+            _requeue_forward_part(server.store, attr, part)
+    if not sinks and fwd_fn is None:
+        return None, []
+    from veneur_tpu.core.pipeline import ChunkStream
+
+    return ChunkStream(sinks, now,
+                       depth=getattr(server.store,
+                                     "flush_pipeline_depth", 2),
+                       rec=rec, forward_fn=fwd_fn,
+                       forward_requeue=fwd_requeue), sinks
+
+
+def _requeue_forward_part(store, attr, part):
+    """Conservation for a terminally-failed streamed forward part:
+    re-merge the digest shard into the LIVE store with import
+    semantics — the compute ladder's rung-3 contract (late, never
+    lost); it forwards again with the next interval."""
+    from veneur_tpu.core.store import ForwardableState
+    from veneur_tpu.samplers.parser import MetricKey
+
+    mini = ForwardableState()
+    setattr(mini, attr, part)
+    mini.materialize_digests()
+    mtype = "histogram" if attr.startswith("histogram") else "timer"
+    rows = mini.histograms if mtype == "histogram" else mini.timers
+    entries = [
+        (MetricKey(name=name, type=mtype, joined_tags=",".join(tags)),
+         tags, means, weights, dmin, dmax)
+        for name, tags, means, weights, dmin, dmax in rows]
+    if entries:
+        store.import_digests_bulk(entries)
+        log.warning("re-merged %d forwarded %s series into the live "
+                    "store after a streamed-forward failure; they ship "
+                    "with the next flush", len(entries), mtype)
 
 
 def _check_flush_overrun(server, deadline, budget: float,
@@ -898,6 +1025,14 @@ def _sink_samples(server, sink_elapsed: dict):
                                  sink.retries)
             out.append(ssf_samples.count(
                 f"veneur.sink.{name}.retries_total", float(delta), None))
+        if hasattr(sink, "chunks_requeued_total"):
+            # streamed-chunk bodies that got their one next-interval
+            # retry (docs/internals.md "Life of a flush")
+            delta = _delta_since(sink, "_last_reported_chunk_requeues",
+                                 sink.chunks_requeued_total)
+            out.append(ssf_samples.count(
+                f"veneur.sink.{name}.chunks_requeued_total",
+                float(delta), None))
         breaker = getattr(sink, "breaker", None)
         if breaker is not None:
             out.append(ssf_samples.gauge(
@@ -922,6 +1057,16 @@ def _sink_samples(server, sink_elapsed: dict):
                     if rec is not None:
                         rec.amend(f"post.{name}",
                                   post_ns=int(value * 1e9))
+                elif kind in ("chunk_marshal_s", "chunk_post_s"):
+                    # streamed chunks: same part-tagged self-metric, but
+                    # no stage amend — the chunk's own
+                    # post.<sink>.serialize/.post stages already carry
+                    # the timeline lanes (obs/timeline.py)
+                    out.append(ssf_samples.timing(
+                        "veneur.flush.duration_ns", value,
+                        {"sink": name,
+                         "part": "marshal" if kind == "chunk_marshal_s"
+                         else "post"}))
                 elif kind == "content_length_bytes":
                     out.append(ssf_samples.histogram(
                         "veneur.flush.content_length_bytes", float(value),

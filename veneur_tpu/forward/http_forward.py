@@ -79,6 +79,11 @@ class HTTPForwarder:
         # has the local emit its own top-k instead)
         self.reference_compat = reference_compat
         self.supports_topk = not reference_compat
+        # streaming egress (core/pipeline.py ChunkStream): /import
+        # merges partial bodies, so a ForwardableState carrying one
+        # digest group's shard is a valid POST on its own — the flusher
+        # streams shards as the pipelined flush completes them
+        self.supports_chunked_forward = True
         # resilience: shared retry/backoff within the flush deadline,
         # optional destination breaker, optional fault injection
         self.retry_policy = retry_policy or RetryPolicy()
@@ -122,9 +127,13 @@ class HTTPForwarder:
         return rejected
 
     def forward(self, state, parent_span=None, deadline=None,
-                trace_ctx=None):
+                trace_ctx=None) -> bool:
+        """POST one ForwardableState (whole interval or a streamed
+        part). Returns True once the body got a 2xx — the streaming
+        forward lane requeues a part on False so the conservation
+        invariant (forwarded == received + requeued) holds."""
         if self._rejected_by_breaker(consume_probe=False):
-            return
+            return False
         # the JSON wire is per-row; columnar digest planes (a columnar
         # flush with gRPC-style planes) materialize to tuples first
         state.materialize_digests()
@@ -135,7 +144,7 @@ class HTTPForwarder:
             metrics = json_metrics_from_state(
                 state, self.compression, include_topk=self.supports_topk)
         if not metrics:
-            return
+            return True
         url = self.base + "/import"
         headers = None
         if parent_span is not None:
@@ -158,7 +167,8 @@ class HTTPForwarder:
         if deadline is None:
             deadline = Deadline.after(self.timeout)
         if self._rejected_by_breaker(consume_probe=True):
-            return
+            return False
+        ok = False
         try:
             status = post_with_retry(
                 lambda: self._post(url, metrics,
@@ -167,6 +177,7 @@ class HTTPForwarder:
                 self.retry_policy, deadline=deadline,
                 on_retry=self._count_retry)
             if 200 <= status < 300:
+                ok = True
                 if self.breaker is not None:
                     self.breaker.record_success()
                 with self._lock:
@@ -194,3 +205,4 @@ class HTTPForwarder:
                 self.post_durations.append(time.perf_counter() - t0)
                 if "content_length" in info:
                     self.post_content_lengths.append(info["content_length"])
+        return ok

@@ -19,10 +19,16 @@ deadlock detector):
 2. **``lock-across-blocking``** — a lock held across a blocking
    operation (``jax.device_get`` / ``.block_until_ready()`` — a full
    device sync, multi-second on a busy chip —, ``os.fsync``, socket
-   send/recv verbs, ``time.sleep``) turns every waiter on that lock
-   into a waiter on the slow operation. The flush/ingest SLO rides on
-   the store lock being held only for host-memory work, so any
-   annotated region that transitively reaches a blocking op is flagged.
+   send/recv verbs, ``urllib.request.urlopen`` — the streamed-POST
+   path every sink chunk and forward part rides —, ``time.sleep``)
+   turns every waiter on that lock into a waiter on the slow
+   operation. The flush/ingest SLO rides on the store lock being held
+   only for host-memory work, so any annotated region that
+   transitively reaches a blocking op is flagged. The ``urlopen``
+   verb is what machine-checks the egress pipeline's off-lock
+   guarantee: the chunk-stream workers (core/pipeline.py) POST while
+   the store keeps ingesting, and a lock held into their call graph
+   would re-serialize flush behind the network.
 
 3. **``hot-path-lock``** — the inverse assertion: a function declared
    ``@lockfree_hot_path`` (core/locking.py) must reach NO lock through
@@ -124,6 +130,11 @@ def _blocking_op(node: ast.Call, jax_names: Set[str]) -> Optional[str]:
         return "time.sleep()"
     if attr in _SOCKET_VERBS:
         return f"socket .{attr}()"
+    if attr == "urlopen":
+        # the streamed-POST verb (PostHelper / sink chunk workers /
+        # forward parts): an HTTP round trip under a lock re-serializes
+        # the egress pipeline behind the network
+        return "urllib urlopen()"
     return None
 
 

@@ -295,6 +295,7 @@ class Server:
             max_tag_length=config.max_tag_length,
             compute=rcompute.from_config(config),
             overload=self.overload,
+            flush_pipeline_depth=config.flush_pipeline_depth,
         )
         self.quarantine = self.store.quarantine
         self.event_worker = EventWorker()
@@ -1159,6 +1160,12 @@ class Server:
                       "native_import_address", "tls_certificate",
                       "tls_key", "tls_authority_certificate",
                       "digest_storage", "digest_dtype", "slab_rows",
+                      # the pipeline depth is stamped onto the store and
+                      # re-stamped onto every generation twin at swap;
+                      # streaming off mid-run would also strand sinks'
+                      # parked chunk-requeue bodies (their one retry
+                      # fires from the stream workers)
+                      "flush_pipeline_depth", "flush_streaming",
                       "tier_pool_centroids", "tier_promote_samples",
                       "tier_promote_intervals", "tier_demote_intervals",
                       "tdigest_compression", "hll_precision",

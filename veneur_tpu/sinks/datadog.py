@@ -58,6 +58,18 @@ def _ok(status: int) -> bool:
     return status in (200, 202)
 
 
+def _body_rows(n: int, max_per_body: int, n_bodies: int) -> list:
+    """Per-body emission counts for one block's serialized bodies: the
+    native serializer (veneur_egress.cpp vt_dd_series_json) closes a
+    body at exactly ``max_per_body`` emissions, so every body holds
+    max_per_body rows except the last — the split the per-chunk
+    conservation accounting relies on."""
+    if n_bodies <= 1:
+        return [n]
+    return [max_per_body] * (n_bodies - 1) + \
+        [n - max_per_body * (n_bodies - 1)]
+
+
 class DatadogMetricSink(MetricSink):
     """Flushes InterMetrics to the Datadog v1 series API
     (datadog.go:34-357)."""
@@ -93,6 +105,19 @@ class DatadogMetricSink(MetricSink):
         self._common_json: Optional[bytes] = None
         # _flush_part runs on one thread per chunk; guard the counter
         self._err_lock = threading.Lock()
+        # streaming egress (core/pipeline.py ChunkStream): serialized-
+        # but-unacked chunk bodies wait here for exactly ONE retry on
+        # the next interval — per-chunk conservation: every emission
+        # row is acked, pending requeue, or (after the retry also
+        # fails / past the bound) counted dropped
+        self._requeued: deque = deque()
+        self.requeue_max_bodies = 256
+        self._last_repost_ts = None
+        self.chunks_flushed = 0
+        self.chunks_requeued_total = 0
+        self.chunk_rows_acked = 0
+        self.chunk_rows_requeued = 0
+        self.chunk_rows_dropped = 0
         # ("marshal_s"|"post_s"|"content_length_bytes", value) pairs the
         # flusher drains into the canonical veneur.flush.* self-metrics
         # (duration_ns part tags + content_length_bytes, README.md:260-264)
@@ -147,26 +172,11 @@ class DatadogMetricSink(MetricSink):
         finalize_metrics + chunked POST, datadog.go:245-330) and POST
         them in parallel. Extras (status checks, routed metrics) take
         the per-row path."""
-        from veneur_tpu.core.columnar import TYPE_COUNTER
-        from veneur_tpu.native import egress
-
         bodies: List[bytes] = []
         n_metrics = 0
         t_marshal = time.perf_counter()
         for blk in batch.blocks:
-            values = blk.values
-            if (blk.type_codes == TYPE_COUNTER).any():
-                # counters become rates for Datadog (datadog.go:295-297)
-                values = np.where(blk.type_codes == TYPE_COUNTER,
-                                  values / self.interval, values)
-            bodies.extend(egress.dd_series_bodies(
-                blk.names, blk.tags, blk.suffixes, blk.rows,
-                blk.suffix_idx, values, blk.type_codes,
-                timestamp=batch.timestamp, interval=int(self.interval),
-                default_host=self.hostname,
-                common_tags_json=self._common_tags_json(),
-                max_per_body=self.flush_max_per_body,
-                compress_level=self.compress_level))
+            bodies.extend(self._serialize_block(blk, batch.timestamp))
             n_metrics += len(blk)
         t_marshal = time.perf_counter() - t_marshal
         threads = []
@@ -187,6 +197,138 @@ class DatadogMetricSink(MetricSink):
         self.metrics_flushed += n_metrics
         if batch.extras:
             self.flush(batch.extras)
+
+    def flush_chunk(self, chunk) -> None:
+        """Streaming egress (docs/internals.md "Life of a flush"):
+        serialize + deflate + POST ONE pipeline chunk the moment the
+        store completes it, while later groups still compute/fetch.
+        Runs on the interval's stream worker behind the same retry/
+        breaker/deadline ladder as the batch path.
+
+        Per-chunk conservation: every emission row either reaches a
+        2xx body (``chunk_rows_acked``) or its serialized body parks
+        for exactly one retry next interval (``chunk_rows_requeued``,
+        late never lost); a requeued body failing again — or the
+        requeue buffer's bound — drops it (``chunk_rows_dropped``), so
+        memory stays bounded."""
+        from veneur_tpu import obs
+
+        # normally a no-op: the stream worker already reposted for this
+        # interval before any chunk flowed (core/pipeline.py); kept for
+        # direct flush_chunk callers
+        self.repost_requeued(chunk.timestamp)
+        rec = obs.current()
+        t0_ns = time.monotonic_ns()
+        t_marshal = time.perf_counter()
+        bodies = []
+        for blk in chunk.blocks:
+            blk_bodies = self._serialize_block(blk, chunk.timestamp)
+            bodies.extend(zip(blk_bodies,
+                              _body_rows(len(blk), self.flush_max_per_body,
+                                         len(blk_bodies))))
+        t_marshal = time.perf_counter() - t_marshal
+        if rec is not None:
+            rec.record_abs(f"post.{self.name}.serialize", t0_ns,
+                           time.monotonic_ns(), chunk=chunk.seq)
+        t0_ns = time.monotonic_ns()
+        t_post = time.perf_counter()
+        for body, nrows in bodies:
+            self._post_chunk_body(body, nrows)
+        t_post = time.perf_counter() - t_post
+        if rec is not None:
+            rec.record_abs(f"post.{self.name}.post", t0_ns,
+                           time.monotonic_ns(), chunk=chunk.seq,
+                           rows=chunk.rows,
+                           bytes=sum(len(b) for b, _ in bodies))
+        with self._err_lock:
+            # chunk_* kinds: same part-tagged duration self-metrics as
+            # the batch path, but NOT amended onto the post.<sink>
+            # stage — the chunk's own post.<sink>.serialize/.post
+            # stages already carry the lanes, and an amend on top
+            # would double-bill annotate_overlap
+            self._telemetry.append(("chunk_marshal_s", t_marshal))
+            self._telemetry.append(("chunk_post_s", t_post))
+            self._telemetry.extend(("content_length_bytes", len(b))
+                                   for b, _ in bodies)
+            self.chunks_flushed += 1
+        self.metrics_flushed += chunk.rows
+
+    def _serialize_block(self, blk, timestamp: int) -> List[bytes]:
+        """One emission block → deflated series bodies: the
+        counter-to-rate finalization (datadog.go:295-297) + the native
+        serializer call, shared by the batch and streamed paths so the
+        wire format can never diverge between them."""
+        from veneur_tpu.core.columnar import TYPE_COUNTER
+        from veneur_tpu.native import egress
+
+        values = blk.values
+        if (blk.type_codes == TYPE_COUNTER).any():
+            values = np.where(blk.type_codes == TYPE_COUNTER,
+                              values / self.interval, values)
+        return egress.dd_series_bodies(
+            blk.names, blk.tags, blk.suffixes, blk.rows,
+            blk.suffix_idx, values, blk.type_codes,
+            timestamp=timestamp, interval=int(self.interval),
+            default_host=self.hostname,
+            common_tags_json=self._common_tags_json(),
+            max_per_body=self.flush_max_per_body,
+            compress_level=self.compress_level)
+
+    def _post_chunk_body(self, body: bytes, nrows: int,
+                         requeued: bool = False) -> bool:
+        """POST one serialized chunk body; terminal failure parks it
+        for one retry (first attempt) or drops it (retry / over the
+        requeue bound). The catch is deliberately broad — transport
+        OSErrors AND protocol-level HTTPExceptions (BadStatusLine from
+        a garbage proxy is not an OSError) — because ANY escape here
+        would leave the body's rows neither acked, requeued, nor
+        dropped, silently breaking the conservation invariant."""
+        import http.client
+
+        try:
+            status = self._resilient_post(lambda: self.post(
+                f"{self.dd_hostname}/api/v1/series"
+                f"?api_key={self.api_key}", body, precompressed=True))
+            if _ok(status):
+                with self._err_lock:
+                    self.chunk_rows_acked += nrows
+                return True
+            log.warning("Datadog chunk POST returned HTTP %d", status)
+            self._count_error()
+        except (OSError, http.client.HTTPException):
+            log.warning("error POSTing chunk body to Datadog",
+                        exc_info=True)
+            self._count_error()
+        with self._err_lock:
+            if requeued or len(self._requeued) >= self.requeue_max_bodies:
+                self.chunk_rows_dropped += nrows
+            else:
+                self._requeued.append((body, nrows))
+                self.chunk_rows_requeued += nrows
+        return False
+
+    def repost_requeued(self, timestamp: int) -> None:
+        """Unacked bodies from the previous interval get exactly one
+        more POST, once per interval (``timestamp`` is the interval
+        key). The stream worker fires this at interval start — even
+        when the interval produces no chunks for this sink — so parked
+        bodies can never strand un-retried."""
+        with self._err_lock:
+            if timestamp == self._last_repost_ts:
+                return
+            self._last_repost_ts = timestamp
+            if not self._requeued:
+                return
+            pending, self._requeued = list(self._requeued), deque()
+            self.chunks_requeued_total += len(pending)
+        for body, nrows in pending:
+            self._post_chunk_body(body, nrows, requeued=True)
+
+    def chunk_rows_pending(self) -> int:
+        """Rows currently parked for the next-interval retry (the
+        conservation tests' requeued term)."""
+        with self._err_lock:
+            return sum(n for _b, n in self._requeued)
 
     def _common_tags_json(self) -> bytes:
         """The sink's fixed tags as a pre-escaped JSON fragment
