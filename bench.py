@@ -3045,6 +3045,64 @@ def bench_e2e_trace(intervals: int = 8, counters: int = 512,
     }
 
 
+def bench_soak(intervals: int = 200, kills: int = 3):
+    """Config #14: the production soak plane end to end (PR 16,
+    ``veneur_tpu/soak/``) — a REAL multi-process fleet (local UDP →
+    proxy → global, each its own OS process) driven through a seeded
+    200-interval chaos schedule: every role SIGKILLed at least once
+    (checkpoint-epoch folding keeps the ledger exact across the
+    restarts), sink black-hole/5xx/slow windows, injected
+    disk-full (ENOSPC) and flush-deadline-pressure faults. The record
+    is the full machine-checked gate vector — exact end-to-end
+    conservation, post-warmup RSS slope, post-chaos compile drift,
+    timeline coverage, e2e freshness p99, recovery, bounded requeue —
+    plus the drive rate. ``all_gates_ok`` is the acceptance bit."""
+    import shutil
+    import tempfile
+
+    from veneur_tpu.soak import (GateThresholds, ProcessFleet,
+                                 SoakScenario, run_soak)
+
+    thr = GateThresholds(warmup_intervals=20,
+                         rss_slope_pct_per_100=5.0,
+                         recovery_intervals=5)
+    sc = SoakScenario.generate(seed=1608, intervals=intervals,
+                               kills=kills, thresholds=thr)
+    root = tempfile.mkdtemp(prefix="veneur-soak-")
+    t0 = time.perf_counter()
+    try:
+        report = run_soak(sc, ProcessFleet(sc, root),
+                          enforce_gates=False)
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+    took = time.perf_counter() - t0
+    vec = report.vector()
+    led = report.ledger
+    g = vec["gates"]
+    return {
+        "intervals": intervals, "kills": len(sc.kills), "seed": sc.seed,
+        "sink_windows": [(w.mode, w.start, w.end)
+                         for w in sc.sink_windows],
+        "elapsed_s": round(took, 1),
+        "intervals_per_s": round(intervals / took, 2),
+        "all_gates_ok": vec["all_ok"],
+        "gates_ok": {k: v["ok"] for k, v in g.items()},
+        "rss_slope_pct_per_100": g["rss_slope"]["value"],
+        "compile_drift": g["compile_drift"]["value"],
+        "coverage_median": g["coverage"]["value"],
+        "e2e_age_p99_s": g["e2e_age_p99"]["value"],
+        "sent_global": led.sent_global,
+        "emitted_global": led.emitted_global,
+        "shed": led.shed,
+        "dd_offered": led.dd_offered, "dd_acked": led.dd_acked,
+        "dd_dropped": led.dd_dropped,
+        "dd_crash_lost": led.dd_crash_lost,
+        "restarts": dict(led.restarts),
+        "ckpt_write_errors": led.ckpt_write_errors,
+        "spool_errors": led.spool_errors,
+    }
+
+
 def run_tpu_smoke(timeout: float = 560.0) -> dict:
     """Run the @pytest.mark.tpu hardware subset in the bench environment
     (VENEUR_TPU_TESTS=1 → real accelerator) and report pass/fail — each
@@ -3189,6 +3247,13 @@ def _lane_plan(result, guarded):
         # in (obs/tracectx.py, obs/fleet.py)
         ("13_e2e_trace",
          lambda t: run_isolated("bench_e2e_trace", timeout=t), 420),
+        # the production soak plane: a real multi-process fleet through
+        # a seeded 200-interval chaos schedule (SIGKILL every role,
+        # sink outage windows, ENOSPC + deadline-pressure faults) with
+        # the full steady-state gate vector in the record
+        # (veneur_tpu/soak/, docs/resilience.md "Soak & chaos")
+        ("14_soak",
+         lambda t: run_isolated("bench_soak", timeout=t), 540),
     ]
 
 
@@ -3308,6 +3373,9 @@ def _headline(result) -> dict:
             "13_e2e_trace": pick("13_e2e_trace", "e2e_age_ms_p50",
                                  "e2e_age_ms_p99",
                                  "hop_coverage_ratio", "conserved"),
+            "14_soak": pick("14_soak", "all_gates_ok", "intervals",
+                            "restarts", "rss_slope_pct_per_100",
+                            "intervals_per_s"),
         },
         "detail_file": "BENCH_DETAIL.json",
     }

@@ -4,15 +4,24 @@ failure/empty, the single-member degenerate cases, the ``file://``
 peers flavor, and the seeded membership-churn fault kinds
 (``resilience/faults.py``) wired into the refresh.
 
-The Consul/Kubernetes HTTP discoverers are covered in
+The Consul/Kubernetes discoverers' payload parsing is covered in
 ``tests/test_proxy.py`` (fake Consul); this file owns the ring-change
-machinery itself — the layer PR 12's elastic resharding drives.
+machinery itself — the layer PR 12's elastic resharding drives —
+including the Consul-flavor RingWatcher path (fake Consul HTTP server
+→ ConsulDiscoverer → keep-last-good and one-diff-per-transition, the
+handoff trigger contract).
 """
+
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, HTTPServer
 
 import pytest
 
-from veneur_tpu.discovery import (FilePeersDiscoverer, MembershipChange,
-                                  RingWatcher, StaticDiscoverer)
+from veneur_tpu.discovery import (ConsulDiscoverer, FilePeersDiscoverer,
+                                  MembershipChange, RingWatcher,
+                                  StaticDiscoverer)
 from veneur_tpu.fleet import RingTransition, ring_key
 from veneur_tpu.proxy.proxy import metric_ring_key
 from veneur_tpu.resilience import faults as rfaults
@@ -125,6 +134,100 @@ class TestFilePeers:
         change = w.refresh()
         assert change.added == ["b:8127"]
         assert w.refresh() is None
+
+
+class _FakeConsul(BaseHTTPRequestHandler):
+    """GET /v1/health/service/<name>?passing off ``server.payload``:
+    a list renders as Consul health JSON, an int as that HTTP status,
+    ``"hang"`` sleeps past any client timeout."""
+
+    def log_message(self, *a):
+        pass
+
+    def do_GET(self):
+        payload = self.server.payload
+        if payload == "hang":
+            time.sleep(1.0)
+            payload = 500
+        if isinstance(payload, int):
+            self.send_response(payload)
+            self.end_headers()
+            return
+        body = json.dumps([
+            {"Service": {"Address": addr, "Port": port}}
+            for addr, port in payload]).encode()
+        self.send_response(200)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+
+@pytest.fixture()
+def fake_consul():
+    httpd = HTTPServer(("127.0.0.1", 0), _FakeConsul)
+    httpd.payload = []
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    yield httpd
+    httpd.shutdown()
+    httpd.server_close()
+
+
+class TestConsulRingWatcher:
+    """The Consul-backed membership path end to end: a live (fake)
+    Consul HTTP API behind ConsulDiscoverer driving RingWatcher — the
+    exact stack a Consul-discovered global fleet hands the elastic
+    resharding manager."""
+
+    def _watcher(self, fake_consul, timeout=5.0):
+        d = ConsulDiscoverer(
+            f"http://127.0.0.1:{fake_consul.server_address[1]}",
+            timeout=timeout)
+        return RingWatcher(d, "veneur-global")
+
+    def test_healthy_refresh_adopts_passing_instances(self, fake_consul):
+        fake_consul.payload = [("10.0.0.1", 8127), ("10.0.0.2", 8127)]
+        w = self._watcher(fake_consul)
+        change = w.refresh()
+        assert change.new == ["http://10.0.0.1:8127",
+                              "http://10.0.0.2:8127"]
+        assert w.members == change.new
+
+    def test_consul_500_keeps_last_good(self, fake_consul):
+        fake_consul.payload = [("10.0.0.1", 8127)]
+        w = self._watcher(fake_consul)
+        w.refresh()
+        fake_consul.payload = 500
+        assert w.refresh() is None
+        assert w.members == ["http://10.0.0.1:8127"]
+        assert w.failures == 1
+
+    def test_consul_timeout_keeps_last_good(self, fake_consul):
+        fake_consul.payload = [("10.0.0.1", 8127)]
+        w = self._watcher(fake_consul, timeout=0.2)
+        w.refresh()
+        fake_consul.payload = "hang"
+        assert w.refresh() is None  # timed out, nothing adopted
+        assert w.members == ["http://10.0.0.1:8127"]
+        assert w.failures == 1
+
+    def test_change_fires_once_per_transition(self, fake_consul):
+        """The handoff trigger contract: a membership change surfaces
+        as EXACTLY one MembershipChange — the diff the resharding
+        manager acts on — and the diff feeds the moved-range rule."""
+        fake_consul.payload = [("10.0.0.1", 8127)]
+        w = self._watcher(fake_consul)
+        w.refresh()
+        fake_consul.payload = [("10.0.0.1", 8127), ("10.0.0.2", 8127)]
+        change = w.refresh()
+        assert change.added == ["http://10.0.0.2:8127"]
+        assert change.removed == []
+        assert w.refresh() is None  # same fleet: no second trigger
+        assert w.changes == 2  # adoption + the resize, nothing else
+        tr = RingTransition(change.old, change.new)
+        moved = sum(1 for i in range(200)
+                    if tr.moved(f"m{i}", "counter", ""))
+        assert 0 < moved < 200  # ~half the space moves to the joiner
 
 
 class TestRingTransitionRule:

@@ -419,6 +419,43 @@ class TestFailureLadder:
         c, w, _ = flush_totals(store)
         assert c == ctotal and w == pytest.approx(wtotal)
 
+    def test_spool_enospc_degrades_but_handoff_continues(self, tmp_path):
+        """The disk refuses the handoff spool write (injected ENOSPC
+        from the soak fault plane): the handoff must CONTINUE unspooled
+        — crash protection for the moved ranges degrades, counted and
+        named — and the failure ladder still conserves every series."""
+        from veneur_tpu.persist.format import write_atomic
+        from veneur_tpu.resilience import RetryPolicy
+        from veneur_tpu.resilience.faults import FaultInjector
+
+        store = make_store()
+        ctotal, wtotal = fill_store(store)
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        dead = f"127.0.0.1:{s.getsockname()[1]}"
+        s.close()
+        inj = FaultInjector(rate=1.0, seed=5, kinds=("disk_full",))
+        disc = MutableDiscoverer(["self"])
+        mgr = HandoffManager(
+            store, "self", RingWatcher(disc, "t"), timeout=2.0,
+            retry_policy=RetryPolicy(max_attempts=2, base_interval=0.01),
+            spool_prefix=str(tmp_path / "v.ckpt"),
+            spool_write_fn=inj.wrap_write(write_atomic, "handoff.spool"))
+        assert mgr.refresh()["adopted"] == ["self"]
+        disc.members = ["self", dead]
+        summary = mgr.refresh()
+        assert mgr.spool_errors_total == 1
+        assert "disk full" in mgr.last_spool_error
+        assert not list(tmp_path.glob("*.handoff.*"))  # nothing spooled
+        # the transition itself still ran its full ladder: send failed
+        # against the dead port and the ranges re-merged — late, never
+        # lost, with or without the spool's crash protection
+        assert summary["requeued"] == [dead]
+        assert mgr.requeued_series_total == summary["moved_series"]
+        c, w, _ = flush_totals(store)
+        assert c == ctotal and w == pytest.approx(wtotal)
+        assert mgr.snapshot()["spool_errors_total"] == 1
+
     def test_requeued_handoff_retries_on_next_refresh_cadence(self):
         """ROADMAP item 4 REMAINING, closed: a requeued handoff no
         longer waits for the next membership CHANGE. A seeded

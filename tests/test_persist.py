@@ -287,6 +287,34 @@ class TestCheckpointer:
         assert ck.truncate(blocking=False) is True
         assert not os.path.exists(path)
 
+    def test_enospc_commit_never_raises_and_heals(self, tmp_path):
+        # the disk filling up mid-commit (injected ENOSPC via the soak
+        # fault plane) must degrade — counted, named, scratch cleaned —
+        # never crash the flush thread; a recovered disk clears it
+        from veneur_tpu.persist.format import write_atomic
+        from veneur_tpu.resilience.faults import FaultInjector
+
+        path = str(tmp_path / "v.ckpt")
+        store = make_store()
+        populate(store)
+        inj = FaultInjector(rate=1.0, seed=3, kinds=("disk_full",))
+        ck = Checkpointer(store, path, interval_s=1.0, max_age_s=3600,
+                          write_fn=inj.wrap_write(write_atomic,
+                                                  "checkpoint.write"))
+        # a stranded partial scratch file from the failed commit
+        with open(path + ".tmp", "wb") as f:
+            f.write(b"partial")
+        assert ck.write_once() is False  # refused, NOT raised
+        assert ck.write_errors == 1
+        assert "disk full" in ck.last_error
+        assert not os.path.exists(path + ".tmp")  # scratch cleaned
+        assert not os.path.exists(path)
+        # the disk recovers: the next commit lands and clears the flag
+        ck._write_fn = write_atomic
+        assert ck.write_once() is True
+        assert ck.last_error is None
+        assert os.path.exists(path)
+
     def test_write_failure_is_visible(self, tmp_path):
         # bad path: every write fails — the counters and the age gauge
         # must deviate from the healthy baseline, not read 0 forever

@@ -234,8 +234,11 @@ class TestStreamedSinkConservation:
         assert sink.chunk_rows_pending() == 0
         assert sink.chunk_rows_acked == total_first + stream2.rows
 
-    def test_requeued_body_failing_again_drops_bounded(
+    def test_requeued_body_failing_again_reparks_in_budget(
             self, native_egress):
+        """A multi-interval outage holds every unacked body inside the
+        bytes budget (late, never lost) instead of dropping after one
+        retry — the PR 16 bounded-bytes requeue semantics."""
         post = _FaultyPost(fail_calls=set(range(1, 100)))  # always 5xx
         sink = make_dd_sink(post)
         s = make_store(flush_pipeline_depth=2)
@@ -251,10 +254,95 @@ class TestStreamedSinkConservation:
         s.flush([0.5], AGGS, is_local=False, now=8, forward=False,
                 columnar=True, stream=stream2)
         stream2.close()
-        # the retry consumed the parked bodies: dropped, not re-parked
-        assert sink.chunk_rows_dropped == parked
-        assert sink.chunk_rows_pending() == stream2.rows
+        # the retry failed too: bodies re-park (budget allows), so
+        # both intervals stay pending — counted, bounded, recoverable
+        assert sink.chunk_rows_dropped == 0
+        assert sink.chunk_rows_pending() == parked + stream2.rows
+        assert sink.chunk_requeue_bytes() <= sink.requeue_max_bytes
         assert sink.chunk_rows_acked == 0
+
+    def test_requeue_budget_evicts_oldest_counted(self, native_egress):
+        """Past the bytes budget the OLDEST parked bodies drop counted
+        — conservation holds as acked + pending + dropped."""
+        post = _FaultyPost(fail_calls=set(range(1, 1000)))  # always 5xx
+        sink = make_dd_sink(post)
+        s = make_store(flush_pipeline_depth=2)
+        fill(s)
+        stream = ChunkStream([sink], 7, depth=2)
+        s.flush([0.5], AGGS, is_local=False, now=7, forward=False,
+                columnar=True, stream=stream)
+        stream.close()
+        # shrink the budget below what is parked: the next interval's
+        # repost + re-park must evict down to the budget
+        sink.requeue_max_bytes = max(1, sink.chunk_requeue_bytes() // 2)
+        total_first = stream.rows
+        fill(s)
+        stream2 = ChunkStream([sink], 8, depth=2)
+        s.flush([0.5], AGGS, is_local=False, now=8, forward=False,
+                columnar=True, stream=stream2)
+        stream2.close()
+        assert sink.chunk_requeue_bytes() <= sink.requeue_max_bytes
+        assert sink.chunk_rows_dropped > 0
+        # exact conservation across both intervals
+        assert (sink.chunk_rows_acked + sink.chunk_rows_pending()
+                + sink.chunk_rows_dropped) == total_first + stream2.rows
+
+    def test_20_interval_blackhole_conserves_then_drains(
+            self, native_egress):
+        """A 20-interval API black hole (every POST raises): the parked
+        bytes stay inside the budget the whole outage — the oldest
+        bodies drop COUNTED, never silently — and exact conservation
+        (offered == acked + pending + dropped) holds at every interval.
+        When the API heals, one repost drains everything still parked."""
+
+        class _BlackHolePost:
+            healed = False
+            acked_rows = 0
+
+            def __call__(self, url, payload, compress=True,
+                         method="POST", precompressed=False,
+                         out_info=None):
+                if not self.healed:
+                    raise OSError("connection refused (black hole)")
+                if precompressed:
+                    body = json.loads(zlib.decompress(payload))
+                    self.acked_rows += len(body["series"])
+                return 202
+
+        post = _BlackHolePost()
+        sink = make_dd_sink(post)
+        s = make_store(flush_pipeline_depth=2)
+        offered = 0
+        for i in range(20):
+            fill(s)
+            stream = ChunkStream([sink], 100 + i, depth=2)
+            s.flush([0.5], AGGS, is_local=False, now=100 + i,
+                    forward=False, columnar=True, stream=stream)
+            stream.close()
+            offered += stream.rows
+            if i == 0:
+                # a budget ~2 outage intervals wide: drops must start
+                # within a few intervals, never an unbounded park
+                sink.requeue_max_bytes = sink.chunk_requeue_bytes() * 2
+            assert sink.chunk_requeue_bytes() <= sink.requeue_max_bytes
+            assert (sink.chunk_rows_acked + sink.chunk_rows_pending()
+                    + sink.chunk_rows_dropped) == offered, f"interval {i}"
+        assert sink.chunk_rows_acked == 0
+        assert sink.chunk_rows_dropped > 0       # eviction happened...
+        assert sink.chunk_rows_pending() > 0     # ...but the newest wait
+        # the API heals: the next interval's repost drains the park
+        post.healed = True
+        fill(s)
+        stream = ChunkStream([sink], 200, depth=2)
+        s.flush([0.5], AGGS, is_local=False, now=200, forward=False,
+                columnar=True, stream=stream)
+        stream.close()
+        offered += stream.rows
+        assert sink.chunk_rows_pending() == 0
+        assert sink.chunk_requeue_bytes() == 0
+        assert (sink.chunk_rows_acked
+                + sink.chunk_rows_dropped) == offered
+        assert post.acked_rows == sink.chunk_rows_acked
 
 
 class TestStreamedForwardConservation:

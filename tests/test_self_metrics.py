@@ -101,7 +101,9 @@ class TestSinkSamples:
         assert _names(samples2) == [
             "veneur.flush.error_total",
             "veneur.sink.datadog.retries_total",
-            "veneur.sink.datadog.chunks_requeued_total"]
+            "veneur.sink.datadog.chunks_requeued_total",
+            "veneur.sink.datadog.chunk_rows_dropped_total",
+            "veneur.sink.datadog.chunk_requeue_bytes"]
         assert all(s.value == 0 for s in samples2)
 
     def test_datadog_columnar_flush_records_telemetry(self):

@@ -1,0 +1,278 @@
+"""The production soak plane (``veneur_tpu/soak/``): deterministic
+scenario generation, the steady-state monitor math, the gate library's
+loud-failure contract, the injected disk-full degradation surfacing on
+/healthcheck/ready, and one real in-process fleet smoke — local →
+proxy → global with a seeded SIGKILL-twin restart and a sink outage
+window, gated on exact end-to-end conservation.
+
+The multi-process (real SIGKILL) long soak rides the ``slow`` marker;
+the bench ``14_soak`` lane runs the 200-interval acceptance scenario.
+"""
+
+import time
+import urllib.request
+
+import pytest
+
+from veneur_tpu.config import Config
+from veneur_tpu.server import Server
+from veneur_tpu.sinks import ChannelMetricSink
+from veneur_tpu.soak import (GateThresholds, IntervalSample, ProcessFleet,
+                             SoakGateError, SoakLedger, SoakScenario,
+                             SteadyStateMonitor, enforce, gate_vector,
+                             run_gates, run_soak)
+from veneur_tpu.soak.monitor import read_rss_kb
+from veneur_tpu.soak.orchestrator import InProcessFleet
+from veneur_tpu.soak.scenario import KILL_CYCLE, MODE_OK, SINK_MODES
+
+
+class TestScenario:
+    def test_same_seed_same_scenario(self):
+        a = SoakScenario.generate(seed=42, intervals=30, kills=3)
+        b = SoakScenario.generate(seed=42, intervals=30, kills=3)
+        assert a == b
+        c = SoakScenario.generate(seed=43, intervals=30, kills=3)
+        assert (a.kills, a.sink_windows) != (c.kills, c.sink_windows)
+
+    def test_chaos_confined_to_settle_span(self):
+        sc = SoakScenario.generate(seed=7, intervals=30, kills=3)
+        thr = sc.thresholds
+        lo, hi = thr.warmup_intervals, 30 - (thr.recovery_intervals + 1)
+        for at, _role in sc.kills:
+            assert lo <= at < hi
+        for w in sc.sink_windows:
+            assert lo <= w.start < w.end <= hi
+        # warmup head and recovery tail see a clean sink
+        for idx in list(range(lo)) + list(range(hi, 30)):
+            assert sc.sink_mode(idx) == MODE_OK
+            assert sc.kills_at(idx) == ()
+
+    def test_kills_cycle_every_role(self):
+        sc = SoakScenario.generate(seed=3, intervals=40, kills=3)
+        assert tuple(role for _at, role in sc.kills) == KILL_CYCLE
+
+    def test_sink_windows_never_overlap(self):
+        sc = SoakScenario.generate(seed=5, intervals=40, kills=0)
+        covered = []
+        for w in sc.sink_windows:
+            assert w.mode in SINK_MODES
+            covered.extend(range(w.start, w.end))
+        assert len(covered) == len(set(covered))
+
+    def test_repro_names_the_seed(self):
+        sc = SoakScenario.generate(seed=99, intervals=12, kills=2)
+        assert "seed=99" in sc.repro()
+        assert "intervals=12" in sc.repro()
+
+
+class TestMonitor:
+    def _sample(self, idx, rss_kb, generation=0, compiles=0):
+        return IntervalSample(idx=idx, generation=generation,
+                              rss_kb=rss_kb, compiles=compiles,
+                              coverage_ratio=1.0, e2e_age_ns=10**9)
+
+    def test_flat_rss_slope_is_zero(self):
+        mon = SteadyStateMonitor(warmup_intervals=2)
+        for i in range(10):
+            mon.add(self._sample(i, 500_000))
+        assert mon.rss_slope_pct_per_100() == pytest.approx(0.0)
+
+    def test_linear_growth_slope_matches(self):
+        # +1% of the mean per interval -> 100%/100 intervals
+        mon = SteadyStateMonitor(warmup_intervals=0)
+        base = 100_000
+        for i in range(11):
+            mon.add(self._sample(i, base + i * 1000))
+        mean = base + 5 * 1000
+        want = 1000 * 100.0 / mean * 100.0
+        assert mon.rss_slope_pct_per_100() == pytest.approx(want, rel=1e-6)
+
+    def test_warmup_samples_excluded_from_slope(self):
+        mon = SteadyStateMonitor(warmup_intervals=3)
+        # a huge startup ramp, then perfectly flat
+        for i, rss in enumerate([100, 10_000, 300_000, 500_000,
+                                 500_000, 500_000, 500_000]):
+            mon.add(self._sample(i, rss * 1000))
+        assert mon.rss_slope_pct_per_100() == pytest.approx(0.0)
+
+    def test_compile_drift_folds_per_generation(self):
+        mon = SteadyStateMonitor(warmup_intervals=0)
+        # gen 0 compiles nothing new; gen 1 (a restart) pays its own
+        # warmup before its first post-warmup sample -> drift 0
+        for i in range(4):
+            mon.add(self._sample(i, 1000, generation=0, compiles=40))
+        for i in range(4, 8):
+            mon.add(self._sample(i, 1000, generation=1, compiles=40))
+        assert mon.compile_drift() == 0
+        # per-interval recompilation within one generation IS drift
+        mon.add(self._sample(8, 1000, generation=1, compiles=43))
+        assert mon.compile_drift() == 3
+
+    def test_read_rss_kb_reads_this_process(self):
+        rss = read_rss_kb()
+        assert rss > 10_000  # a live CPython+numpy process is >10MB
+
+    def test_e2e_p99_and_coverage_median(self):
+        mon = SteadyStateMonitor(warmup_intervals=0)
+        for i in range(10):
+            mon.add(IntervalSample(idx=i, generation=0,
+                                   coverage_ratio=0.9 + i * 0.01,
+                                   e2e_age_ns=(i + 1) * 10**9))
+        assert mon.coverage_median() == pytest.approx(0.95)
+        assert mon.e2e_age_p99_s() == pytest.approx(9.0)
+
+
+def _clean_monitor(sc):
+    mon = SteadyStateMonitor(sc.thresholds.warmup_intervals)
+    for i in range(sc.intervals):
+        mon.add(IntervalSample(idx=i, generation=0, rss_kb=400_000,
+                               compiles=30, coverage_ratio=0.97,
+                               e2e_age_ns=5 * 10**8))
+    return mon
+
+
+def _clean_ledger():
+    return SoakLedger(sent_global=1000, emitted_global=990, shed=6,
+                      quarantined=4, sent_local=200, emitted_local=200,
+                      dd_offered=5000, dd_acked=4800, dd_dropped=100,
+                      dd_crash_lost=100, dd_pending=0,
+                      restarts={"global": 1, "local": 1, "proxy": 1})
+
+
+class TestGates:
+    def test_clean_run_passes_every_gate(self):
+        sc = SoakScenario.generate(seed=1, intervals=10, kills=0)
+        results = run_gates(sc, _clean_monitor(sc), _clean_ledger())
+        vec = gate_vector(results)
+        assert vec["all_ok"], vec
+        assert set(vec["gates"]) == {
+            "conservation_global", "conservation_local",
+            "dd_rows_conserved", "rss_slope", "compile_drift",
+            "coverage", "e2e_age_p99", "recovery", "requeue_bounded"}
+        enforce(results, sc)  # silent on a clean vector
+
+    def test_lost_rows_fail_loud_with_seed(self):
+        sc = SoakScenario.generate(seed=31337, intervals=10, kills=0)
+        ledger = _clean_ledger()
+        ledger.emitted_global -= 1  # one lost count
+        results = run_gates(sc, _clean_monitor(sc), ledger)
+        with pytest.raises(SoakGateError) as ei:
+            enforce(results, sc)
+        msg = str(ei.value)
+        assert "conservation_global" in msg
+        assert "seed=31337" in msg  # a failed soak is a seed, not a shrug
+
+    def test_unrecovered_breaker_fails_recovery_gate(self):
+        sc = SoakScenario.generate(seed=2, intervals=10, kills=0)
+        mon = _clean_monitor(sc)
+        mon.samples[-1].breaker_gauge = 2.0  # still open at the end
+        mon.samples[-1].requeue_bytes = 4096
+        results = run_gates(sc, mon, _clean_ledger())
+        bad = {r.name for r in results if not r.ok}
+        assert bad == {"recovery"}
+        detail = next(r for r in results if r.name == "recovery").value
+        assert "breaker" in detail and "requeue" in detail
+
+    def test_rss_leak_fails_slope_gate(self):
+        sc = SoakScenario.generate(seed=2, intervals=20, kills=0)
+        mon = SteadyStateMonitor(sc.thresholds.warmup_intervals)
+        for i in range(20):  # +2% of mean per interval: a real leak
+            mon.add(IntervalSample(idx=i, generation=0,
+                                   rss_kb=400_000 + i * 8000,
+                                   coverage_ratio=0.97,
+                                   e2e_age_ns=5 * 10**8))
+        results = run_gates(sc, mon, _clean_ledger())
+        bad = {r.name for r in results if not r.ok}
+        assert "rss_slope" in bad
+
+
+class TestDiskFullDegradation:
+    def test_injected_enospc_rides_the_ready_body(self, tmp_path):
+        """Satellite: a checkpoint commit refused by the disk (injected
+        ``disk_full``, rate 1.0) degrades the instance — counted, named
+        on /healthcheck/ready at HTTP 200 — and never raises."""
+        cfg = Config(statsd_listen_addresses=[],
+                     http_address="127.0.0.1:0", interval="86400s",
+                     store_initial_capacity=32, store_chunk=128,
+                     aggregates=["count"], percentiles=[0.5],
+                     checkpoint_path=str(tmp_path / "v.ckpt"),
+                     checkpoint_interval="3600s",
+                     fault_injection_rate=1.0,
+                     fault_injection_seed=9,
+                     fault_injection_kinds="disk_full")
+        server = Server(cfg, metric_sinks=[ChannelMetricSink()])
+        server.start()
+        try:
+            from veneur_tpu.samplers.parser import parse_metric
+            server.store.process_metric(parse_metric(b"c1:1|c"))
+            assert server.checkpointer.write_once() is False  # no raise
+            assert server.checkpointer.write_errors == 1
+            assert "disk full" in server.checkpointer.last_error
+            port = server.ops_server.port
+            url = f"http://127.0.0.1:{port}/healthcheck/ready"
+            with urllib.request.urlopen(url) as r:
+                assert r.status == 200  # degraded is NOT unready
+                body = r.read().decode()
+            assert "degraded" in body
+            assert "checkpoint writes failing" in body
+            assert "disk full" in body
+        finally:
+            server.shutdown()
+
+
+class TestSoakSmoke:
+    def test_soak_smoke(self, tmp_path):
+        """Tier-1 soak smoke: a real in-process fleet (local UDP →
+        proxy → global), ~10 driven intervals, one scheduled global
+        kill (crash_stop: the SIGKILL twin) inside the chaos span plus
+        seeded sink outage windows and disk-full/deadline-pressure
+        faults — the full gate vector must come back clean, including
+        EXACT end-to-end conservation across the restart. The 1%/100
+        RSS bound needs a long run (startup ramp dominates here), so
+        the smoke carries a loose slope threshold; the strict bound is
+        the bench ``14_soak`` lane's."""
+        thr = GateThresholds(warmup_intervals=2,
+                             rss_slope_pct_per_100=500.0)
+        sc = SoakScenario.generate(seed=7, intervals=10, kills=1,
+                                   thresholds=thr)
+        assert sc.kills and sc.sink_windows  # chaos actually scheduled
+        t0 = time.monotonic()
+        report = run_soak(sc, InProcessFleet(sc, str(tmp_path)))
+        elapsed = time.monotonic() - t0
+        vec = report.vector()
+        assert vec["all_ok"], vec
+        led = report.ledger
+        assert led.restarts == {"global": 1}
+        assert led.sent_global > 0
+        assert led.sent_global == (led.emitted_global + led.shed
+                                   + led.quarantined)
+        assert led.sent_local == led.emitted_local
+        assert led.dd_offered > 0
+        assert led.dd_offered == (led.dd_acked + led.dd_pending
+                                  + led.dd_dropped + led.dd_crash_lost)
+        assert led.dd_pending == 0  # drained by the recovery tail
+        assert elapsed < 60.0, f"soak smoke took {elapsed:.1f}s"
+
+
+@pytest.mark.slow
+class TestProcessSoak:
+    def test_multi_process_soak_survives_real_sigkills(self, tmp_path):
+        """Real OS processes for every role, real SIGKILL for every
+        scheduled kill (all three roles die once), 40 intervals — the
+        gate vector must come back clean."""
+        thr = GateThresholds(warmup_intervals=6,
+                             rss_slope_pct_per_100=60.0,
+                             recovery_intervals=4)
+        sc = SoakScenario.generate(seed=13, intervals=40, kills=3,
+                                   thresholds=thr)
+        assert tuple(r for _a, r in sc.kills) == KILL_CYCLE
+        report = run_soak(sc, ProcessFleet(sc, str(tmp_path)))
+        vec = report.vector()
+        assert vec["all_ok"], vec
+        led = report.ledger
+        assert led.restarts == {"global": 1, "local": 1, "proxy": 1}
+        assert led.sent_global == (led.emitted_global + led.shed
+                                   + led.quarantined)
+        assert led.sent_local == led.emitted_local
+        assert led.dd_offered == (led.dd_acked + led.dd_pending
+                                  + led.dd_dropped + led.dd_crash_lost)

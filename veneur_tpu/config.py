@@ -209,6 +209,14 @@ class Config:
     # exactly once (late, never lost). Needs flush_columnar and
     # flush_pipeline_depth > 0; other sinks keep the batch fan-out.
     flush_streaming: bool = True
+    # bounded-BYTES budget for streamed-chunk requeue: serialized
+    # bodies a sink could not ack park for retry on later intervals
+    # until their total size reaches this budget, then the OLDEST
+    # parked bodies drop (counted) to admit fresher ones — a
+    # multi-interval sink outage degrades by counted drop instead of
+    # either unbounded host growth or losing everything after one
+    # retry. 0 = default (32 MiB); negative rejected.
+    sink_requeue_max_bytes: int = 0
     # POST /import backpressure (the reference's bounded worker
     # channels, http.go:54-142): merge worker threads and the bounded
     # batch queue behind them — past capacity, requests shed with 429
@@ -464,6 +472,11 @@ class Config:
                 f"flush_pipeline_depth must be >= 0 (0 = sequential "
                 f"flush, N = overlapped pipeline bounded at N in-flight "
                 f"chunks), got {self.flush_pipeline_depth}")
+        if self.sink_requeue_max_bytes < 0:
+            raise ValueError(
+                f"sink_requeue_max_bytes must be >= 0 (0 = use the "
+                f"default, 32 MiB; the parked-body budget cannot be "
+                f"unbounded), got {self.sink_requeue_max_bytes}")
         if self.checkpoint_max_age_intervals < 0:
             raise ValueError(
                 f"checkpoint_max_age_intervals must be >= 0 (0 = use "
@@ -496,9 +509,10 @@ class Config:
         if self.fault_injection_kinds:
             from veneur_tpu.resilience.faults import (ALL_KINDS,
                                                       CHURN_KINDS,
-                                                      INGEST_KINDS)
+                                                      INGEST_KINDS,
+                                                      SOAK_KINDS)
 
-            known = ALL_KINDS + INGEST_KINDS + CHURN_KINDS
+            known = ALL_KINDS + INGEST_KINDS + CHURN_KINDS + SOAK_KINDS
             bad = [k.strip()
                    for k in self.fault_injection_kinds.split(",")
                    if k.strip() and k.strip() not in known]
@@ -556,6 +570,8 @@ class Config:
             self.trace_max_length_bytes = 16 * 1024
         if not self.checkpoint_max_age_intervals:
             self.checkpoint_max_age_intervals = 2.0
+        if not self.sink_requeue_max_bytes:
+            self.sink_requeue_max_bytes = 32 * 1048576
         # overload-safety defaults (veneur_tpu/overload.py); the
         # compute-breaker timeout follows the parse-once policy
         if not self.max_series:

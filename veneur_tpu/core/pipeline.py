@@ -32,6 +32,7 @@ graph is machine-checked, like the snapshot path).
 
 from __future__ import annotations
 
+import itertools
 import logging
 import queue
 import threading
@@ -41,6 +42,10 @@ from typing import List, NamedTuple, Optional
 from veneur_tpu.obs import recorder as obs_rec
 
 log = logging.getLogger("veneur.pipeline")
+
+# every ChunkStream (one per flush interval per process) draws a unique
+# cycle id here; itertools.count is GIL-atomic
+_flush_cycles = itertools.count(1)
 
 
 class FlushChunk(NamedTuple):
@@ -52,6 +57,12 @@ class FlushChunk(NamedTuple):
     blocks: list     # core/columnar.py EmissionBlock list
     rows: int        # total emission rows aboard (conservation unit)
     timestamp: int
+    # the owning stream's process-unique flush-cycle id: the requeue
+    # repost dedup key. The integer-second timestamp CANNOT be the key
+    # — sub-second flush cadences (driven soak/bench intervals) collide
+    # on it and parked bodies would strand un-retried. 0 = hand-built
+    # chunk (tests); sinks fall back to the timestamp then.
+    cycle: int = 0
 
 
 class SerializerLane:
@@ -127,6 +138,9 @@ class ChunkStream:
     def __init__(self, sinks, timestamp: int, depth: int = 2, rec=None,
                  forward_fn=None, forward_requeue=None):
         self.timestamp = int(timestamp)
+        # process-unique flush-cycle id: the one-repost-per-interval
+        # key (see FlushChunk.cycle)
+        self.cycle = next(_flush_cycles)
         self._rec = rec
         self._seq = 0
         self.chunks = 0
@@ -168,7 +182,7 @@ class ChunkStream:
         if not blocks or self._closed:
             return
         chunk = FlushChunk(self._seq, name, list(blocks), int(rows),
-                           self.timestamp)
+                           self.timestamp, self.cycle)
         self._seq += 1
         self.chunks += 1
         self.rows += chunk.rows
@@ -196,7 +210,7 @@ class ChunkStream:
                 # worker, so it runs even when this interval produces
                 # no chunks for the sink and never blocks the flusher
                 try:
-                    repost(self.timestamp)
+                    repost(self.cycle)
                 except Exception:
                     log.exception("sink %s requeue repost failed",
                                   sink.name)

@@ -265,7 +265,8 @@ class HandoffManager:
                  timeout: float = 10.0, retry_policy=None, breakers=None,
                  spool_prefix: str = "", checkpointer=None, timeline=None,
                  refresh_interval: float = 10.0, injector=None,
-                 replicas: int = 20, hop_log=None):
+                 replicas: int = 20, hop_log=None,
+                 spool_write_fn=None):
         from veneur_tpu.resilience import BreakerRegistry, RetryPolicy
 
         self.store = store
@@ -322,6 +323,15 @@ class HandoffManager:
         self.short_merges_total = 0
         self.spool_resent_total = 0
         self.spool_recovered_total = 0
+        # spool writes the disk refused (ENOSPC, short write): the
+        # handoff continues unspooled — crash protection for the moved
+        # ranges degrades, counted here (veneur.handoff.
+        # spool_errors_total) and named on the degraded ready body
+        self.spool_errors_total = 0
+        self.last_spool_error = ""
+        # injectable spool commit (soak disk-full faults ride
+        # FaultInjector.wrap_write here)
+        self._spool_write = spool_write_fn or ckpt_format.write_atomic
         self.retries_total = 0
         self.last_duration_ns = 0
         self.last_error = ""
@@ -375,7 +385,12 @@ class HandoffManager:
             timeline=getattr(server, "obs_timeline", None),
             refresh_interval=cfg.handoff_refresh_interval_seconds,
             injector=injector,
-            hop_log=getattr(server, "obs_hops", None))
+            hop_log=getattr(server, "obs_hops", None),
+            spool_write_fn=(
+                server.soak_injector.wrap_write(
+                    ckpt_format.write_atomic, "handoff.spool")
+                if getattr(server, "soak_injector", None) is not None
+                else None))
 
     # -- sender: refresh loop ----------------------------------------------
 
@@ -567,8 +582,11 @@ class HandoffManager:
                     spool = (f"{self.spool_prefix}.handoff."
                              f"{epoch}.{len(pending)}")
                     try:
-                        ckpt_format.write_atomic(spool, blob)
-                    except OSError:
+                        self._spool_write(spool, blob)
+                        self.last_spool_error = ""
+                    except OSError as e:
+                        self.spool_errors_total += 1
+                        self.last_spool_error = str(e)
                         log.exception("could not spool handoff %s; "
                                       "continuing unspooled", handoff_id)
                         spool = ""
@@ -939,6 +957,7 @@ class HandoffManager:
             "short_merges_total": self.short_merges_total,
             "spool_recovered_total": self.spool_recovered_total,
             "spool_resent_total": self.spool_resent_total,
+            "spool_errors_total": self.spool_errors_total,
             "retries_total": self.retries_total,
             "requeue_retries_total": self.requeue_retries_total,
             "retry_pending": self.retry_pending,

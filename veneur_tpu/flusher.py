@@ -328,6 +328,13 @@ def _flush_once(server: "Server", span, rec=None):
 
     budget = min(server.interval,
                  getattr(server.config, "forward_timeout_seconds", 10.0))
+    # seeded deadline-pressure faults (resilience/faults.py SOAK_KINDS)
+    # shrink one interval's budget: the retry ladder gives up early and
+    # the requeue paths must absorb the interval — one schedule draw
+    # per flush keeps the fault cadence aligned with the interval
+    soak_inj = getattr(server, "soak_injector", None)
+    if soak_inj is not None:
+        budget = soak_inj.scale_deadline("flush.deadline", budget)
     deadline = Deadline.after(budget)
 
     is_local = server.is_local()
@@ -828,6 +835,12 @@ def _handoff_samples(server):
             "veneur.handoff.requeue_retries_total",
             float(_delta_since(mgr, "_last_requeue_retries",
                                mgr.requeue_retries_total)), None),
+        # spool commits the disk refused (ENOSPC): the handoff went
+        # out unspooled — crash protection degraded, counted
+        ssf_samples.count(
+            "veneur.handoff.spool_errors_total",
+            float(_delta_since(mgr, "_last_spool_errors",
+                               mgr.spool_errors_total)), None),
         ssf_samples.gauge("veneur.handoff.epoch", float(mgr.epoch),
                           None),
     ]
@@ -1033,6 +1046,20 @@ def _sink_samples(server, sink_elapsed: dict):
             out.append(ssf_samples.count(
                 f"veneur.sink.{name}.chunks_requeued_total",
                 float(delta), None))
+        if hasattr(sink, "chunk_rows_dropped"):
+            # rows the bounded requeue budget gave up on (counted
+            # loss under a long sink outage — docs/resilience.md)
+            delta = _delta_since(sink, "_last_reported_chunk_drops",
+                                 sink.chunk_rows_dropped)
+            out.append(ssf_samples.count(
+                f"veneur.sink.{name}.chunk_rows_dropped_total",
+                float(delta), None))
+        if hasattr(sink, "chunk_requeue_bytes"):
+            # host memory parked for retry, bounded by
+            # sink_requeue_max_bytes — the soak's no-pileup gate
+            out.append(ssf_samples.gauge(
+                f"veneur.sink.{name}.chunk_requeue_bytes",
+                float(sink.chunk_requeue_bytes()), None))
         breaker = getattr(sink, "breaker", None)
         if breaker is not None:
             out.append(ssf_samples.gauge(

@@ -46,12 +46,17 @@ class Checkpointer:
     never held across IO."""
 
     def __init__(self, store, path: str, interval_s: float,
-                 max_age_s: float, hostname: str = ""):
+                 max_age_s: float, hostname: str = "",
+                 write_fn=None):
         self.store = store
         self.path = path
         self.interval_s = interval_s
         self.max_age_s = max_age_s
         self.hostname = hostname
+        # injectable commit (soak disk-full faults ride
+        # FaultInjector.wrap_write here); None = the atomic
+        # temp+fsync+rename writer, resolved at call time
+        self._write_fn = write_fn
         self._io_lock = threading.Lock()
         # telemetry (read by flusher._checkpoint_samples)
         self.writes = 0
@@ -64,6 +69,9 @@ class Checkpointer:
         self.last_write_duration_s = 0.0
         self.last_write_bytes = 0
         self.last_write_at: Optional[float] = None
+        # the last commit's disk error, None while writes succeed —
+        # rides the degraded /healthcheck/ready body (Server.degradation)
+        self.last_error: Optional[str] = None
         self._created_at = time.time()
         self._restored = False
 
@@ -72,7 +80,11 @@ class Checkpointer:
     def write_once(self) -> bool:
         """Snapshot → serialize → atomic commit. False when the commit
         was discarded because a flush drained the snapshotted state
-        first (persisting it would double-count on restore)."""
+        first (persisting it would double-count on restore), or when
+        the disk refused the write (ENOSPC, short write, read-only
+        volume) — counted (``write_errors``) and named
+        (``last_error``), NEVER raised: a full disk must degrade the
+        instance, not crash the flush thread or any direct caller."""
         t0 = time.perf_counter()
         groups, epoch = self.store.snapshot_state()  # store lock inside
         blob = ckpt_format.serialize(
@@ -85,7 +97,27 @@ class Checkpointer:
             if self.store.flush_epoch != epoch:
                 self.discarded_writes += 1
                 return False
-            n = ckpt_format.write_atomic(self.path, blob)
+            try:
+                # the direct default call keeps the fsync-under-lock
+                # hold statically visible to the lock-order pass
+                if self._write_fn is None:
+                    n = ckpt_format.write_atomic(self.path, blob)
+                else:
+                    n = self._write_fn(self.path, blob)
+            except OSError as e:
+                self.write_errors += 1
+                self.last_error = str(e)
+                # an ENOSPC mid-write can strand a partial .tmp; the
+                # stale previous checkpoint (if any) stays — still the
+                # best recovery anchor the disk will hold
+                try:
+                    os.unlink(self.path + ".tmp")
+                except OSError:
+                    pass
+                log.warning("checkpoint write to %s failed (%s); "
+                            "degraded, retrying next interval",
+                            self.path, e)
+                return False
             if self.store.flush_epoch != epoch:
                 # a flush drained (and is emitting) the snapshotted
                 # state while the bytes were in flight; the flush-path
@@ -98,6 +130,9 @@ class Checkpointer:
         self.last_write_bytes = n
         self.last_write_at = time.time()
         self.writes += 1
+        # single writer thread; readers (degradation()) tolerate a
+        # stale value for one interval
+        self.last_error = None  # lint: ok(inconsistent-lockset)
         return True
 
     def run(self, stop: threading.Event):
@@ -107,7 +142,8 @@ class Checkpointer:
             try:
                 self.write_once()
             except Exception:
-                self.write_errors += 1
+                # single writer thread; monotonic introspection counter
+                self.write_errors += 1  # lint: ok(inconsistent-lockset)
                 log.exception("checkpoint write failed; retrying next "
                               "interval")
 

@@ -51,6 +51,20 @@ CHURN_KINDS = (KIND_MEMBER_ADD, KIND_MEMBER_REMOVE, KIND_PARTITION)
 # how many refresh intervals (mangle_members calls) a partition
 # black-holes its destination before healing
 PARTITION_INTERVALS = 3
+# soak-plane faults (veneur_tpu/soak/): the two host-resource failures
+# the egress/churn kinds cannot express — the checkpoint/spool disk
+# filling up (wrap_write raises ENOSPC) and an interval whose egress
+# deadline collapses (scale_deadline shrinks the flush budget, forcing
+# the retry ladder to give up and the requeue paths to absorb the
+# interval). A SEPARATE vocabulary, same reason as INGEST/CHURN: the
+# seeded schedules existing soaks reproduce must not shift.
+KIND_DISK_FULL = "disk_full"
+KIND_DEADLINE_PRESSURE = "deadline_pressure"
+SOAK_KINDS = (KIND_DISK_FULL, KIND_DEADLINE_PRESSURE)
+# an interval under deadline_pressure keeps this fraction of its
+# egress budget — small enough that any real POST's retry backoff
+# blows it, large enough that the flush path itself completes
+DEADLINE_PRESSURE_FACTOR = 0.05
 
 # the status wrap_post returns for an injected 5xx
 INJECTED_STATUS = 503
@@ -79,7 +93,7 @@ class FaultInjector:
                  kinds: Sequence[str] = ALL_KINDS, scope: str = ""):
         if not 0.0 <= rate <= 1.0:
             raise ValueError(f"fault rate must be in [0, 1], got {rate}")
-        known = ALL_KINDS + INGEST_KINDS + CHURN_KINDS
+        known = ALL_KINDS + INGEST_KINDS + CHURN_KINDS + SOAK_KINDS
         bad = [k for k in kinds if k not in known]
         if bad:
             raise ValueError(f"unknown fault kinds {bad}; known: "
@@ -121,7 +135,8 @@ class FaultInjector:
         egress hooks must not turn a scheduled packet mangle into a
         transport error the operator never configured."""
         kind = self.should_fail(op)
-        if kind is None or kind in INGEST_KINDS or kind in CHURN_KINDS:
+        if kind is None or kind in INGEST_KINDS or kind in CHURN_KINDS \
+                or kind in SOAK_KINDS:
             return
         if kind == KIND_CONNECT:
             raise InjectedConnectError(f"injected connect error ({op})")
@@ -213,6 +228,37 @@ class FaultInjector:
                 idx = self._rng.randrange(len(members))
                 self._partitions[members[idx]] = PARTITION_INTERVALS
         return list(members)
+
+    def wrap_write(self, write: Callable[..., int], op: str) -> Callable[..., int]:
+        """Wrap a ``write_atomic``-style callable (persist/format.py):
+        a scheduled ``disk_full`` raises ENOSPC before any bytes touch
+        the real filesystem — the injected twin of the volume filling
+        up mid-commit. Non-disk scheduled kinds pass through untouched
+        so one injector can drive transport and disk faults off one
+        seed."""
+        import errno
+
+        def wrapped(*args, **kwargs) -> int:
+            if self.should_fail(op) == KIND_DISK_FULL:
+                raise OSError(errno.ENOSPC,
+                              f"injected disk full ({op})")
+            return write(*args, **kwargs)
+
+        return wrapped
+
+    def scale_deadline(self, op: str, budget: float) -> float:
+        """Apply a scheduled ``deadline_pressure`` fault to one
+        interval's egress budget: the returned budget is the configured
+        one, or ``DEADLINE_PRESSURE_FACTOR`` of it when the fault fires
+        — the injected twin of a slow-device interval eating the flush
+        window. One call per interval keeps the schedule aligned with
+        the flush cadence."""
+        if self.should_fail(op) == KIND_DEADLINE_PRESSURE:
+            log.warning("deadline pressure injected: flush budget "
+                        "%.2fs -> %.2fs (%s)", budget,
+                        budget * DEADLINE_PRESSURE_FACTOR, op)
+            return budget * DEADLINE_PRESSURE_FACTOR
+        return budget
 
     def is_partitioned(self, dest: str) -> bool:
         """Whether a scheduled ``partition`` fault currently black-holes

@@ -321,6 +321,17 @@ class Server:
                 rate=config.fault_injection_rate,
                 seed=config.fault_injection_seed,
                 kinds=tuple(cfg_kinds), scope=config.fault_injection_scope)
+        # soak-plane faults (resilience/faults.py SOAK_KINDS): disk-full
+        # on the checkpoint/spool commits and deadline pressure on the
+        # flush budget — armed only when the configured kind set
+        # includes one, like the ingest injector above
+        self.soak_injector = None
+        if config.fault_injection_rate > 0 and \
+                any(k in rfaults.SOAK_KINDS for k in cfg_kinds):
+            self.soak_injector = rfaults.FaultInjector(
+                rate=config.fault_injection_rate,
+                seed=config.fault_injection_seed,
+                kinds=tuple(cfg_kinds), scope=config.fault_injection_scope)
 
         # config-driven backends (server.go:350-519) plus any injected ones
         from veneur_tpu.sinks.factory import create_sinks
@@ -417,12 +428,19 @@ class Server:
 
             ckpt_interval = (config.checkpoint_interval_seconds
                              or self.interval / 4.0)
+            ckpt_write_fn = None
+            if self.soak_injector is not None:
+                from veneur_tpu.persist import format as ckpt_format
+
+                ckpt_write_fn = self.soak_injector.wrap_write(
+                    ckpt_format.write_atomic, "checkpoint.write")
             self.checkpointer = Checkpointer(
                 self.store, config.checkpoint_path,
                 interval_s=ckpt_interval,
                 max_age_s=(config.checkpoint_max_age_intervals
                            * self.interval),
-                hostname=self.hostname)
+                hostname=self.hostname,
+                write_fn=ckpt_write_fn)
 
         # elastic fleet resharding (veneur_tpu/fleet/handoff.py,
         # docs/resilience.md "Elastic resharding"): membership watcher
@@ -1150,6 +1168,17 @@ class Server:
                     state = "half-open" if gauge == 1.0 else "open"
                     out.append(f"compute breaker {kernel} {state} "
                                f"(flush on XLA fallback)")
+        # disk-refused persistence: the instance keeps aggregating and
+        # flushing (degraded, NOT unready — killing it would lose the
+        # very state the disk can no longer protect), but operators
+        # must see crash protection is gone and why
+        ckpt = self.checkpointer
+        if ckpt is not None and ckpt.last_error:
+            out.append(f"checkpoint writes failing ({ckpt.last_error})")
+        mgr = self.handoff_manager
+        if mgr is not None and mgr.last_spool_error:
+            out.append(f"handoff spool writes failing "
+                       f"({mgr.last_spool_error})")
         return out
 
     # keys whose change a live reload cannot honor: sockets stay bound
@@ -1360,6 +1389,51 @@ class Server:
                      path, len(self._thread_profiles))
             self._profiler = None
             self._thread_profiles = []
+        if self.ops_server is not None:
+            self.ops_server.stop()
+        if self.import_server is not None:
+            self.import_server.stop()
+        if self.native_import_server is not None:
+            self.native_import_server.stop()
+        if self._forwarder is not None and hasattr(self._forwarder, "close"):
+            self._forwarder.close()
+        self._close_retired_sinks()
+        self.trace_client.close()
+
+    def crash_stop(self):
+        """Abandon the process state WITHOUT the graceful drain: no
+        final flush, no checkpoint truncation, no handoff quiesce —
+        the in-process twin of SIGKILL for the soak plane
+        (veneur_tpu/soak/), where a restart on the same
+        ``checkpoint_path`` must recover exactly what the last
+        checkpoint/spool committed and nothing else. Threads are still
+        joined and sockets closed (a soak restarts hundreds of times
+        in one process; leaking them would measure the harness, not
+        the server), but none of the data-saving steps run: whatever
+        only lived in this store dies here, like a real kill."""
+        self._stop.set()
+        deadline = time.time() + 30.0
+        pumps_dead = True
+        for t in self._native_pumps:
+            t.join(timeout=max(0.0, deadline - time.time()))
+            if t.is_alive():
+                pumps_dead = False
+        if pumps_dead:
+            for reader in self._native_readers:
+                reader.stop()
+        else:  # pragma: no cover - wedged-pump path
+            for reader in self._native_readers:
+                reader.leak()
+        for fleet in self._ingest_fleets:
+            try:
+                fleet.shutdown()
+            except Exception:
+                log.exception("ingest fleet shutdown failed in "
+                              "crash_stop")
+        for t in (self._flush_thread, self._ckpt_thread,
+                  getattr(self, "_handoff_thread", None)):
+            if t is not None:
+                t.join(timeout=10.0)
         if self.ops_server is not None:
             self.ops_server.stop()
         if self.import_server is not None:

@@ -79,7 +79,8 @@ class DatadogMetricSink(MetricSink):
                  api_key: str, post: Optional[PostFn] = None,
                  compress_level: int = 1,
                  retry_policy: Optional[RetryPolicy] = None,
-                 breaker=None, fault_injector=None):
+                 breaker=None, fault_injector=None,
+                 requeue_max_bytes: int = 32 * 1048576):
         self.interval = interval
         self.flush_max_per_body = max(1, flush_max_per_body)
         self.hostname = hostname
@@ -106,12 +107,17 @@ class DatadogMetricSink(MetricSink):
         # _flush_part runs on one thread per chunk; guard the counter
         self._err_lock = threading.Lock()
         # streaming egress (core/pipeline.py ChunkStream): serialized-
-        # but-unacked chunk bodies wait here for exactly ONE retry on
-        # the next interval — per-chunk conservation: every emission
-        # row is acked, pending requeue, or (after the retry also
-        # fails / past the bound) counted dropped
+        # but-unacked chunk bodies park here and retry once per
+        # interval until acked, bounded by a BYTES budget
+        # (config sink_requeue_max_bytes) — per-chunk conservation:
+        # every emission row is acked, pending requeue, or (evicted
+        # past the budget) counted dropped. The budget evicts OLDEST
+        # first: under a long outage the buffer stays fresh and the
+        # loss is the counted old tail, never unbounded host growth.
         self._requeued: deque = deque()
-        self.requeue_max_bodies = 256
+        self.requeue_max_bytes = max(0, requeue_max_bytes)
+        self.requeue_max_bodies = 256  # belt-and-braces count bound
+        self._requeued_bytes = 0
         self._last_repost_ts = None
         self.chunks_flushed = 0
         self.chunks_requeued_total = 0
@@ -207,16 +213,20 @@ class DatadogMetricSink(MetricSink):
 
         Per-chunk conservation: every emission row either reaches a
         2xx body (``chunk_rows_acked``) or its serialized body parks
-        for exactly one retry next interval (``chunk_rows_requeued``,
-        late never lost); a requeued body failing again — or the
-        requeue buffer's bound — drops it (``chunk_rows_dropped``), so
-        memory stays bounded."""
+        for retry on later intervals (``chunk_rows_requeued``, late
+        never lost) inside the ``requeue_max_bytes`` budget; past the
+        budget the OLDEST parked bodies drop counted
+        (``chunk_rows_dropped``), so memory stays bounded and a long
+        outage degrades by counted drop."""
         from veneur_tpu import obs
 
         # normally a no-op: the stream worker already reposted for this
         # interval before any chunk flowed (core/pipeline.py); kept for
-        # direct flush_chunk callers
-        self.repost_requeued(chunk.timestamp)
+        # direct flush_chunk callers. The flush-cycle id is the dedup
+        # key — the integer-second timestamp collides across sub-second
+        # driven intervals (hand-built test chunks carry cycle 0 and
+        # fall back to it)
+        self.repost_requeued(getattr(chunk, "cycle", 0) or chunk.timestamp)
         rec = obs.current()
         t0_ns = time.monotonic_ns()
         t_marshal = time.perf_counter()
@@ -277,12 +287,12 @@ class DatadogMetricSink(MetricSink):
     def _post_chunk_body(self, body: bytes, nrows: int,
                          requeued: bool = False) -> bool:
         """POST one serialized chunk body; terminal failure parks it
-        for one retry (first attempt) or drops it (retry / over the
-        requeue bound). The catch is deliberately broad — transport
-        OSErrors AND protocol-level HTTPExceptions (BadStatusLine from
-        a garbage proxy is not an OSError) — because ANY escape here
-        would leave the body's rows neither acked, requeued, nor
-        dropped, silently breaking the conservation invariant."""
+        for retry on later intervals inside the requeue budget. The
+        catch is deliberately broad — transport OSErrors AND
+        protocol-level HTTPExceptions (BadStatusLine from a garbage
+        proxy is not an OSError) — because ANY escape here would leave
+        the body's rows neither acked, requeued, nor dropped, silently
+        breaking the conservation invariant."""
         import http.client
 
         try:
@@ -300,19 +310,39 @@ class DatadogMetricSink(MetricSink):
                         exc_info=True)
             self._count_error()
         with self._err_lock:
-            if requeued or len(self._requeued) >= self.requeue_max_bodies:
-                self.chunk_rows_dropped += nrows
-            else:
-                self._requeued.append((body, nrows))
-                self.chunk_rows_requeued += nrows
+            self._park_locked(body, nrows)
         return False
 
+    def _park_locked(self, body: bytes, nrows: int) -> None:
+        """Park one unacked body for the next interval's repost,
+        evicting OLDEST parked bodies (counted ``chunk_rows_dropped``)
+        until the bytes budget and the body-count bound admit it; a
+        body alone past the whole budget drops outright. Caller holds
+        ``_err_lock``."""
+        if len(body) > self.requeue_max_bytes:
+            self.chunk_rows_dropped += nrows
+            return
+        while self._requeued and (
+                self._requeued_bytes + len(body) > self.requeue_max_bytes
+                or len(self._requeued) >= self.requeue_max_bodies):
+            old_body, old_rows = self._requeued.popleft()
+            # caller holds _err_lock (see docstring)
+            self._requeued_bytes -= len(old_body)  # lint: ok(inconsistent-lockset)
+            self.chunk_rows_dropped += old_rows
+        self._requeued.append((body, nrows))
+        self._requeued_bytes += len(body)  # lint: ok(inconsistent-lockset)
+        self.chunk_rows_requeued += nrows
+
     def repost_requeued(self, timestamp: int) -> None:
-        """Unacked bodies from the previous interval get exactly one
-        more POST, once per interval (``timestamp`` is the interval
-        key). The stream worker fires this at interval start — even
-        when the interval produces no chunks for this sink — so parked
-        bodies can never strand un-retried."""
+        """Unacked bodies from previous intervals get one more POST
+        per interval (``timestamp`` is the interval's dedup key — the
+        stream's flush-cycle id, or the chunk timestamp for hand-built
+        chunks); a body that
+        fails again re-parks through the same bytes-budgeted path, so
+        a multi-interval outage holds the freshest budget's worth and
+        drops (counted) only past it. The stream worker fires this at
+        interval start — even when the interval produces no chunks for
+        this sink — so parked bodies can never strand un-retried."""
         with self._err_lock:
             if timestamp == self._last_repost_ts:
                 return
@@ -320,6 +350,7 @@ class DatadogMetricSink(MetricSink):
             if not self._requeued:
                 return
             pending, self._requeued = list(self._requeued), deque()
+            self._requeued_bytes = 0
             self.chunks_requeued_total += len(pending)
         for body, nrows in pending:
             self._post_chunk_body(body, nrows, requeued=True)
@@ -329,6 +360,12 @@ class DatadogMetricSink(MetricSink):
         conservation tests' requeued term)."""
         with self._err_lock:
             return sum(n for _b, n in self._requeued)
+
+    def chunk_requeue_bytes(self) -> int:
+        """Serialized bytes currently parked — the host-memory cost of
+        the requeue buffer, bounded by ``requeue_max_bytes``."""
+        with self._err_lock:
+            return self._requeued_bytes
 
     def _common_tags_json(self) -> bytes:
         """The sink's fixed tags as a pre-escaped JSON fragment

@@ -94,7 +94,8 @@ def create_sinks(config: Config) -> Tuple[List[MetricSink], List[SpanSink],
             api_key=config.datadog_api_key,
             retry_policy=retry_policy,
             breaker=destination_breaker(config.datadog_api_hostname),
-            fault_injector=fault_injector))
+            fault_injector=fault_injector,
+            requeue_max_bytes=config.sink_requeue_max_bytes))
     if config.datadog_trace_api_address:
         span_sinks.append(DatadogSpanSink(
             trace_address=config.datadog_trace_api_address,

@@ -1,0 +1,28 @@
+"""Production soak plane: deterministic multi-process chaos soak with
+steady-state invariant gates (``docs/resilience.md`` "Soak & chaos").
+
+One seed fully determines the chaos schedule
+(:class:`~veneur_tpu.soak.scenario.SoakScenario`); the orchestrator
+drives a real fleet (local → proxy → global) through it while the
+:class:`~veneur_tpu.soak.monitor.SteadyStateMonitor` samples every
+interval, and :mod:`veneur_tpu.soak.gates` machine-checks the
+invariants at the end — exact conservation across kills, bounded RSS
+slope, zero compile drift, timeline coverage, e2e freshness, full
+recovery, bounded requeue memory."""
+
+from veneur_tpu.soak.gates import (GateResult, SoakGateError, SoakLedger,
+                                   enforce, gate_vector, run_gates)
+from veneur_tpu.soak.monitor import IntervalSample, SteadyStateMonitor
+from veneur_tpu.soak.orchestrator import (ChaosPost, FleetSpec,
+                                          InProcessFleet, ProcessFleet,
+                                          SoakReport, run_soak)
+from veneur_tpu.soak.scenario import (FaultWindow, GateThresholds,
+                                      SoakScenario)
+
+__all__ = [
+    "ChaosPost", "FaultWindow", "FleetSpec", "GateResult",
+    "GateThresholds", "InProcessFleet", "IntervalSample", "ProcessFleet",
+    "SoakGateError", "SoakLedger", "SoakReport", "SoakScenario",
+    "SteadyStateMonitor", "enforce", "gate_vector", "run_gates",
+    "run_soak",
+]

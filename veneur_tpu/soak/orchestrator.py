@@ -1,0 +1,693 @@
+"""The soak orchestrator: a chaos-driven fleet behind one driver loop.
+
+Boots a production-shaped fleet — a LOCAL instance (UDP statsd ingest,
+checkpointed, forwarding) → an HTTP PROXY (consistent-hash fan-out,
+peers-file discovery) → a GLOBAL aggregator (checkpointed, handoff
+plane armed, Datadog streamed egress + an exact-accounting channel
+sink) — then drives the scenario's intervals: mixed traffic in, driven
+flushes through, the seeded chaos schedule on top (role kills, sink
+black-hole/5xx/latency windows, injected disk-full and
+flush-deadline-pressure faults), a steady-state sample per interval,
+and the full gate vector at the end (``soak.gates``).
+
+Two interchangeable fleet backends share the driver:
+
+* :class:`InProcessFleet` — all three roles in this process; kills are
+  ``Server.crash_stop()`` (the SIGKILL twin: no final flush, no
+  checkpoint truncation, no handoff quiesce). Fast enough for the
+  tier-1 smoke test.
+* :class:`ProcessFleet` — each role is a real child process
+  (``python -m veneur_tpu.soak.child``) on fixed ports; kills are real
+  ``SIGKILL``. The bench ``14_soak`` lane runs this one.
+
+Conservation across a kill is exact because a kill is scheduled
+BETWEEN intervals: the driver settles ingest, commits a checkpoint
+(retried through injected ENOSPC until the disk admits it), folds the
+dying generation's monotone counters into the run ledger (parked sink
+rows become counted ``dd_crash_lost``), and only then kills. The
+restarted process restores from the checkpoint epoch and the ledger
+closes end to end. Mid-flush kill atomicity is separately covered by
+``tests/test_persist_e2e.py`` / ``tests/test_handoff_e2e.py``.
+"""
+
+from __future__ import annotations
+
+import logging
+import random
+import socket
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Tuple
+
+from veneur_tpu.soak.gates import (GateResult, SoakLedger, enforce,
+                                   gate_vector, run_gates)
+from veneur_tpu.soak.monitor import (IntervalSample, SteadyStateMonitor,
+                                     read_rss_kb)
+from veneur_tpu.soak.scenario import (MODE_BLACKHOLE, MODE_HTTP_5XX,
+                                      MODE_OK, MODE_SLOW, ROLE_GLOBAL,
+                                      ROLE_LOCAL, ROLE_PROXY, SoakScenario)
+
+log = logging.getLogger("veneur.soak")
+
+GLOBAL_PREFIX = "soak.c"   # counters tagged veneurglobalonly (the ledger)
+LOCAL_PREFIX = "soak.l"    # counters aggregated at the local instance
+
+
+class ChaosPost:
+    """The global's Datadog POST transport under scenario control:
+    ``ok`` → 202, ``http_5xx`` → 503, ``blackhole`` → raises (the
+    refused-connection twin), ``slow`` → latency then 202. One
+    instance survives sink generations so an outage window spans a
+    global restart."""
+
+    def __init__(self, slow_s: float = 0.05):
+        self.mode = MODE_OK
+        self.slow_s = slow_s
+        self.posts = 0
+        self.failures = 0
+
+    def __call__(self, url, body, **kwargs) -> int:
+        self.posts += 1
+        if self.mode == MODE_BLACKHOLE:
+            self.failures += 1
+            raise OSError("soak: injected sink black hole")
+        if self.mode == MODE_HTTP_5XX:
+            self.failures += 1
+            return 503
+        if self.mode == MODE_SLOW:
+            time.sleep(self.slow_s)
+        return 202
+
+
+def pick_port(kind: int = socket.SOCK_DGRAM) -> int:
+    """A fixed port the fleet keeps across restarts (bind-0, read,
+    close). TCP listeners here use SO_REUSEPORT (OpsServer does too)
+    so the address survives kill/rebind cycles."""
+    s = socket.socket(socket.AF_INET, kind)
+    s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEPORT, 1)
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+@dataclass
+class FleetSpec:
+    """Everything needed to (re)build any role — JSON-serializable so
+    the subprocess children build byte-identical servers."""
+
+    root: str                  # scratch dir: checkpoints, spool, peers
+    udp_port: int
+    proxy_port: int
+    global_port: int
+    fault_rate: float
+    fault_kinds: str
+    seed: int
+    requeue_max_bytes: int
+    breaker_reset_s: float = 0.75
+
+    def to_json(self) -> dict:
+        return dict(self.__dict__)
+
+    @classmethod
+    def from_json(cls, d: dict) -> "FleetSpec":
+        return cls(**d)
+
+    @classmethod
+    def for_scenario(cls, scenario: SoakScenario, root: str) -> "FleetSpec":
+        return cls(root=root, udp_port=pick_port(),
+                   proxy_port=pick_port(socket.SOCK_STREAM),
+                   global_port=pick_port(socket.SOCK_STREAM),
+                   fault_rate=scenario.fault_rate,
+                   fault_kinds=scenario.fault_kinds,
+                   seed=scenario.seed,
+                   requeue_max_bytes=scenario.thresholds.requeue_max_bytes)
+
+
+# -- role construction (shared by InProcessFleet and soak.child) -----------
+
+def build_local_server(spec: FleetSpec):
+    """The local role: UDP statsd ingest on the fixed port, driven
+    cadence, checkpointed, forwarding to the proxy."""
+    from veneur_tpu.config import Config
+    from veneur_tpu.server import Server
+    from veneur_tpu.sinks import ChannelMetricSink
+
+    cfg = Config(
+        statsd_listen_addresses=[f"udp://127.0.0.1:{spec.udp_port}"],
+        interval="86400s",  # driven cadence: the loop never self-fires
+        forward_address=f"http://127.0.0.1:{spec.proxy_port}",
+        aggregates=["count"], percentiles=[0.5], num_readers=2,
+        store_initial_capacity=64, store_chunk=128,
+        checkpoint_path=f"{spec.root}/local.ckpt",
+        checkpoint_interval="3600s",
+        fault_injection_rate=spec.fault_rate,
+        fault_injection_seed=spec.seed + 1,
+        fault_injection_kinds="disk_full,deadline_pressure")
+    sink = ChannelMetricSink()
+    server = Server(cfg, metric_sinks=[sink])
+    server.start()
+    return server, sink
+
+
+def build_global_server(spec: FleetSpec, chaos_post: ChaosPost):
+    """The global role: /import ingest on the fixed ops port, handoff
+    plane armed over the peers file, checkpointed, channel sink for
+    exact value accounting + Datadog streamed egress through the
+    scenario's :class:`ChaosPost`. Returns
+    ``(server, channel_sink, dd_sink, offered_counter)`` where
+    ``offered_counter`` is a one-slot list counting rows offered to
+    the chunk path this generation."""
+    from veneur_tpu.config import Config
+    from veneur_tpu.resilience import CircuitBreaker, RetryPolicy
+    from veneur_tpu.server import Server
+    from veneur_tpu.sinks import ChannelMetricSink
+    from veneur_tpu.sinks.datadog import DatadogMetricSink
+
+    peers = f"{spec.root}/peers.txt"
+    with open(peers, "w") as f:
+        f.write(f"http://127.0.0.1:{spec.global_port}\n")
+    cfg = Config(
+        statsd_listen_addresses=[], interval="86400s",
+        http_address=f"127.0.0.1:{spec.global_port}",
+        aggregates=["count"], percentiles=[0.5],
+        store_initial_capacity=64, store_chunk=128,
+        checkpoint_path=f"{spec.root}/global.ckpt",
+        checkpoint_interval="3600s",
+        handoff_enabled=True,
+        handoff_self=f"http://127.0.0.1:{spec.global_port}",
+        handoff_peers=f"file://{peers}",
+        fault_injection_rate=spec.fault_rate,
+        fault_injection_seed=spec.seed + 2,
+        fault_injection_kinds=spec.fault_kinds,
+        sink_requeue_max_bytes=spec.requeue_max_bytes)
+    channel = ChannelMetricSink()
+    dd = DatadogMetricSink(
+        interval=10.0, flush_max_per_body=100, hostname="soak-global",
+        tags=["soak:1"], dd_hostname="http://dd.soak.invalid",
+        api_key="soak", post=chaos_post,
+        retry_policy=RetryPolicy(max_attempts=1),
+        breaker=CircuitBreaker(failure_threshold=3,
+                               reset_timeout=spec.breaker_reset_s,
+                               name="datadog"),
+        requeue_max_bytes=spec.requeue_max_bytes)
+    offered = [0]
+    orig_chunk = dd.flush_chunk
+
+    def counting_flush_chunk(chunk):
+        offered[0] += chunk.rows
+        orig_chunk(chunk)
+
+    dd.flush_chunk = counting_flush_chunk
+    server = Server(cfg, metric_sinks=[channel, dd])
+    server.start()
+    return server, channel, dd, offered
+
+
+def build_proxy(spec: FleetSpec):
+    """The proxy role: HTTP /import fan-out over the peers-file ring."""
+    from veneur_tpu.config import ProxyConfig
+    from veneur_tpu.discovery import FilePeersDiscoverer
+    from veneur_tpu.proxy.proxy import Proxy
+
+    peers = f"{spec.root}/peers.txt"
+    with open(peers, "w") as f:
+        f.write(f"http://127.0.0.1:{spec.global_port}\n")
+    proxy = Proxy(
+        ProxyConfig(http_address=f"127.0.0.1:{spec.proxy_port}",
+                    forward_timeout="5s"),
+        discoverer=FilePeersDiscoverer(peers))
+    proxy.start()
+    return proxy
+
+
+def drain_channel(sink, prefix: str) -> float:
+    """Drain every queued flush batch; return the summed value of
+    metrics whose name starts with ``prefix`` (counters flush raw
+    counts, so the sum is the exact ingested value)."""
+    import queue
+
+    total = 0.0
+    while True:
+        try:
+            batch = sink.queue.get_nowait()
+        except queue.Empty:
+            return total
+        for m in batch:
+            if m.name.startswith(prefix):
+                total += m.value
+
+
+def global_sample_fields(server, dd, pid: int = 0) -> dict:
+    """One interval's steady-state reading of a global server (shared
+    by the in-process fleet and the subprocess child)."""
+    from veneur_tpu.obs import kernels
+
+    entry = {}
+    if server.obs_timeline is not None:
+        entries = server.obs_timeline.entries(1)
+        entry = entries[-1] if entries else {}
+    ckpt = server.checkpointer
+    mgr = server.handoff_manager
+    return {
+        "rss_kb": read_rss_kb(pid),
+        "compiles": kernels.compiles_total(),
+        "coverage_ratio": entry.get("coverage_ratio"),
+        "e2e_age_ns": entry.get("e2e_age_ns"),
+        "overload_level": server.overload.level_nowait(),
+        "breaker_gauge": (dd.breaker.state_gauge()
+                          if dd.breaker is not None else 0.0),
+        "requeue_bytes": dd.chunk_requeue_bytes(),
+        "rows_pending": dd.chunk_rows_pending(),
+        "ckpt_write_errors": ckpt.write_errors if ckpt else 0,
+        "spool_errors": mgr.spool_errors_total if mgr else 0,
+        "degradations": tuple(server.degradation()),
+    }
+
+
+def global_counters(server, dd, offered) -> Dict[str, int]:
+    """The global generation's monotone counters, read just before a
+    kill (folded with parked rows → crash_lost) and once at the end."""
+    mgr = server.handoff_manager
+    return {
+        "dd_offered": offered[0],
+        "dd_acked": dd.chunk_rows_acked,
+        "dd_dropped": dd.chunk_rows_dropped,
+        "dd_pending": dd.chunk_rows_pending(),
+        "shed": server.overload.shed_total(),
+        "quarantined": server.quarantine.total(),
+        "ckpt_write_errors": (server.checkpointer.write_errors
+                              if server.checkpointer else 0),
+        "spool_errors": mgr.spool_errors_total if mgr else 0,
+    }
+
+
+def local_counters(server) -> Dict[str, int]:
+    return {
+        "shed": server.overload.shed_total(),
+        "quarantined": server.quarantine.total(),
+        "ckpt_write_errors": (server.checkpointer.write_errors
+                              if server.checkpointer else 0),
+    }
+
+
+def checkpoint_with_retry(server, attempts: int = 400,
+                          pause_s: float = 0.005) -> int:
+    """Commit a checkpoint, riding through injected/real ENOSPC (the
+    write path never raises; it counts and returns False). Returns the
+    attempt count; raises only if the disk never admits the write."""
+    ckpt = server.checkpointer
+    if ckpt is None:
+        return 0
+    for i in range(attempts):
+        if ckpt.write_once():
+            return i + 1
+        time.sleep(pause_s)
+    raise RuntimeError(
+        f"checkpoint to {ckpt.path} failed {attempts} times "
+        f"(last error: {ckpt.last_error})")
+
+
+# -- the in-process fleet ---------------------------------------------------
+
+class InProcessFleet:
+    """All three roles in this process. Kills use
+    ``Server.crash_stop()`` — the in-process SIGKILL twin."""
+
+    def __init__(self, scenario: SoakScenario, root: str):
+        self.spec = FleetSpec.for_scenario(scenario, root)
+        self.chaos = ChaosPost()
+        self._sender: Optional[socket.socket] = None
+        self.local = self.local_sink = None
+        self.glob = self.g_channel = self.g_dd = None
+        self._g_offered = [0]
+        self.proxy = None
+
+    def start(self) -> None:
+        self.glob, self.g_channel, self.g_dd, self._g_offered = \
+            build_global_server(self.spec, self.chaos)
+        self.proxy = build_proxy(self.spec)
+        self.local, self.local_sink = build_local_server(self.spec)
+        self._sender = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        self._sender.connect(("127.0.0.1", self.spec.udp_port))
+
+    def stop(self) -> None:
+        for closer in (
+                lambda: self._sender and self._sender.close(),
+                lambda: self.local and self.local.shutdown(),
+                lambda: self.proxy and self.proxy.shutdown(),
+                lambda: self.glob and self.glob.shutdown()):
+            try:
+                closer()
+            except Exception:
+                log.exception("soak fleet stop")
+
+    def send(self, lines: List[bytes]) -> None:
+        for line in lines:
+            self._sender.send(line)
+
+    def local_processed(self) -> int:
+        return self.local.store.processed
+
+    def global_imported(self) -> int:
+        return self.glob.store.imported
+
+    def set_sink_mode(self, mode: str) -> None:
+        self.chaos.mode = mode
+
+    def flush_local(self) -> float:
+        self.local.flush()
+        return drain_channel(self.local_sink, LOCAL_PREFIX)
+
+    def flush_global(self) -> Tuple[float, dict]:
+        self.glob.flush()
+        emitted = drain_channel(self.g_channel, GLOBAL_PREFIX)
+        return emitted, global_sample_fields(self.glob, self.g_dd)
+
+    def checkpoint(self, role: str) -> int:
+        if role == ROLE_LOCAL:
+            return checkpoint_with_retry(self.local)
+        if role == ROLE_GLOBAL:
+            return checkpoint_with_retry(self.glob)
+        return 0
+
+    def counters(self, role: str) -> Dict[str, int]:
+        if role == ROLE_GLOBAL:
+            return global_counters(self.glob, self.g_dd, self._g_offered)
+        if role == ROLE_LOCAL:
+            return local_counters(self.local)
+        return {}
+
+    def kill_restart(self, role: str) -> None:
+        if role == ROLE_LOCAL:
+            self.local.crash_stop()
+            self.local, self.local_sink = build_local_server(self.spec)
+        elif role == ROLE_GLOBAL:
+            self.glob.crash_stop()
+            self.glob, self.g_channel, self.g_dd, self._g_offered = \
+                build_global_server(self.spec, self.chaos)
+        elif role == ROLE_PROXY:
+            # the proxy is stateless; its crash twin is an immediate
+            # teardown + rebind on the same fixed port
+            try:
+                self.proxy.shutdown()
+            except Exception:
+                pass
+            self.proxy = build_proxy(self.spec)
+
+
+# -- the multi-process fleet ------------------------------------------------
+
+class _Child:
+    """One role as a real child process speaking the line protocol of
+    ``veneur_tpu.soak.child`` (commands on stdin, one JSON ack per
+    command on stdout, logs on stderr)."""
+
+    def __init__(self, role: str, spec: FleetSpec):
+        self.role = role
+        self.spec = spec
+        self.proc = None
+        self.ready: dict = {}
+
+    def spawn(self) -> None:
+        import json
+        import subprocess
+        import sys
+
+        spec_path = f"{self.spec.root}/{self.role}.spec.json"
+        with open(spec_path, "w") as f:
+            json.dump(self.spec.to_json(), f)
+        self.proc = subprocess.Popen(
+            [sys.executable, "-m", "veneur_tpu.soak.child",
+             self.role, spec_path],
+            stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+            text=True, bufsize=1)
+        self.ready = self._read_line(timeout_s=120.0)
+        if not self.ready.get("ready"):
+            raise RuntimeError(f"soak {self.role} child failed to boot: "
+                               f"{self.ready}")
+
+    def _read_line(self, timeout_s: float = 60.0) -> dict:
+        import json
+        import select
+
+        deadline = time.monotonic() + timeout_s
+        buf = ""
+        while True:
+            left = deadline - time.monotonic()
+            if left <= 0:
+                raise RuntimeError(
+                    f"soak {self.role} child unresponsive "
+                    f"(rc={self.proc.poll()})")
+            r, _, _ = select.select([self.proc.stdout], [], [], left)
+            if not r:
+                continue
+            buf = self.proc.stdout.readline()
+            if buf == "":
+                raise RuntimeError(
+                    f"soak {self.role} child died "
+                    f"(rc={self.proc.poll()})")
+            buf = buf.strip()
+            if buf:
+                return json.loads(buf)
+
+    def command(self, cmd: str, timeout_s: float = 60.0) -> dict:
+        self.proc.stdin.write(cmd + "\n")
+        self.proc.stdin.flush()
+        return self._read_line(timeout_s)
+
+    def kill(self) -> None:
+        self.proc.kill()
+        self.proc.wait(timeout=30)
+
+    def quit(self) -> None:
+        try:
+            self.command("quit", timeout_s=30.0)
+        except Exception:
+            pass
+        try:
+            self.proc.wait(timeout=30)
+        except Exception:
+            self.proc.kill()
+
+
+class ProcessFleet:
+    """Each role a real OS process on fixed ports; kills are real
+    SIGKILL. The driver's view is identical to the in-process fleet —
+    the children self-report their samples and counters."""
+
+    def __init__(self, scenario: SoakScenario, root: str):
+        self.spec = FleetSpec.for_scenario(scenario, root)
+        self.children: Dict[str, _Child] = {}
+        self._sender: Optional[socket.socket] = None
+        self._mode = MODE_OK
+
+    def start(self) -> None:
+        for role in (ROLE_GLOBAL, ROLE_PROXY, ROLE_LOCAL):
+            child = _Child(role, self.spec)
+            child.spawn()
+            self.children[role] = child
+        self._sender = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        self._sender.connect(("127.0.0.1", self.spec.udp_port))
+
+    def stop(self) -> None:
+        if self._sender is not None:
+            self._sender.close()
+        for child in self.children.values():
+            child.quit()
+
+    def send(self, lines: List[bytes]) -> None:
+        for line in lines:
+            self._sender.send(line)
+
+    def local_processed(self) -> int:
+        return self.children[ROLE_LOCAL].command("processed")["v"]
+
+    def global_imported(self) -> int:
+        return self.children[ROLE_GLOBAL].command("imported")["v"]
+
+    def set_sink_mode(self, mode: str) -> None:
+        self._mode = mode
+        self.children[ROLE_GLOBAL].command(f"mode {mode}")
+
+    def flush_local(self) -> float:
+        return self.children[ROLE_LOCAL].command(
+            "flush", timeout_s=120.0)["emitted"]
+
+    def flush_global(self) -> Tuple[float, dict]:
+        ack = self.children[ROLE_GLOBAL].command("flush", timeout_s=120.0)
+        sample = ack["sample"]
+        sample["degradations"] = tuple(sample.get("degradations", ()))
+        return ack["emitted"], sample
+
+    def checkpoint(self, role: str) -> int:
+        if role == ROLE_PROXY:
+            return 0
+        ack = self.children[role].command("ckpt", timeout_s=120.0)
+        if not ack.get("ok"):
+            raise RuntimeError(f"soak {role} child checkpoint failed: {ack}")
+        return ack.get("attempts", 1)
+
+    def counters(self, role: str) -> Dict[str, int]:
+        if role == ROLE_PROXY:
+            return {}
+        return self.children[role].command("counters")["counters"]
+
+    def kill_restart(self, role: str) -> None:
+        self.children[role].kill()
+        child = _Child(role, self.spec)
+        child.spawn()
+        self.children[role] = child
+        if role == ROLE_GLOBAL and self._mode != MODE_OK:
+            # the outage window outlives the process it was imposed on
+            child.command(f"mode {self._mode}")
+
+
+# -- the driver -------------------------------------------------------------
+
+@dataclass
+class SoakReport:
+    scenario: SoakScenario
+    ledger: SoakLedger
+    monitor: SteadyStateMonitor
+    results: List[GateResult] = field(default_factory=list)
+
+    def vector(self) -> dict:
+        return gate_vector(self.results)
+
+
+def interval_traffic(scenario: SoakScenario,
+                     idx: int) -> Tuple[List[bytes], int, int, int]:
+    """The interval's deterministic production-shaped mix: global-only
+    counters (the exact ledger), global-only timers (digest
+    forwarding), local counters and a gauge. Returns
+    ``(datagrams, global_counter_value, local_counter_value,
+    distinct_global_series)``."""
+    rng = random.Random(scenario.seed * 1000003 + idx)
+    lines: List[bytes] = []
+    names = set()
+    sent_c = 0
+    for i in range(scenario.counters_per_interval):
+        name = f"soak.c{i % 8}"
+        v = rng.randint(1, 5)
+        lines.append(f"{name}:{v}|c|#veneurglobalonly".encode())
+        names.add(name)
+        sent_c += v
+    for i in range(scenario.timers_per_interval):
+        name = f"soak.t{i % 4}"
+        lines.append(f"{name}:{rng.uniform(0.5, 20.0):.3f}|ms"
+                     f"|#veneurglobalonly".encode())
+        names.add(name)
+    sent_l = 0
+    for i in range(8):
+        lines.append(f"soak.l{i % 4}:1|c".encode())
+        sent_l += 1
+    lines.append(b"soak.g:42|g")
+    rng.shuffle(lines)
+    return lines, sent_c, sent_l, len(names)
+
+
+def _settle(read: Callable[[], int], target: int, timeout_s: float = 15.0,
+            stable_s: float = 0.15) -> int:
+    """Poll until ``read()`` reaches ``target`` AND holds still for
+    ``stable_s`` (self-telemetry re-enters the stores asynchronously,
+    so >= alone can fire early)."""
+    deadline = time.monotonic() + timeout_s
+    last, last_change = read(), time.monotonic()
+    while time.monotonic() < deadline:
+        cur = read()
+        if cur != last:
+            last, last_change = cur, time.monotonic()
+        elif cur >= target and time.monotonic() - last_change >= stable_s:
+            return cur
+        time.sleep(0.01)
+    return last
+
+
+def _fold(ledger: SoakLedger, counters: Dict[str, int],
+          crash: bool) -> None:
+    ledger.shed += counters.get("shed", 0)
+    ledger.quarantined += counters.get("quarantined", 0)
+    ledger.ckpt_write_errors += counters.get("ckpt_write_errors", 0)
+    ledger.spool_errors += counters.get("spool_errors", 0)
+    ledger.dd_offered += counters.get("dd_offered", 0)
+    ledger.dd_acked += counters.get("dd_acked", 0)
+    ledger.dd_dropped += counters.get("dd_dropped", 0)
+    pending = counters.get("dd_pending", 0)
+    if crash:
+        ledger.dd_crash_lost += pending
+    else:
+        ledger.dd_pending += pending
+
+
+def run_soak(scenario: SoakScenario, fleet,
+             enforce_gates: bool = True,
+             progress: Optional[Callable[[str], None]] = None
+             ) -> SoakReport:
+    """Drive the scenario over ``fleet`` (InProcessFleet or
+    ProcessFleet): per interval — scheduled kills (checkpoint → fold →
+    kill → restart), the sink outage mode, deterministic traffic,
+    settled driven flushes local→global, one steady-state sample. Then
+    terminal settlement (flush rounds until the pipeline drains), the
+    end-of-run counter fold, and the gate vector. Raises
+    :class:`~veneur_tpu.soak.gates.SoakGateError` on any violated gate
+    unless ``enforce_gates=False``."""
+    say = progress or (lambda s: log.info("%s", s))
+    monitor = SteadyStateMonitor(scenario.thresholds.warmup_intervals)
+    ledger = SoakLedger()
+    generation = 0  # restarts of the GLOBAL role (compile-drift folds)
+    fleet.start()
+    try:
+        for idx in range(scenario.intervals):
+            for role in scenario.kills_at(idx):
+                attempts = fleet.checkpoint(role)
+                ledger.ckpt_retries += max(0, attempts - 1)
+                _fold(ledger, fleet.counters(role), crash=True)
+                fleet.kill_restart(role)
+                ledger.restarts[role] = ledger.restarts.get(role, 0) + 1
+                if role == ROLE_GLOBAL:
+                    generation += 1
+                say(f"interval {idx}: killed+restarted {role} "
+                    f"(checkpoint attempts={attempts})")
+            mode = scenario.sink_mode(idx)
+            fleet.set_sink_mode(mode)
+            lines, sent_c, sent_l, n_series = interval_traffic(
+                scenario, idx)
+            p0 = fleet.local_processed()
+            fleet.send(lines)
+            ledger.sent_global += sent_c
+            ledger.sent_local += sent_l
+            _settle(fleet.local_processed, p0 + len(lines))
+            i0 = fleet.global_imported()
+            ledger.emitted_local += fleet.flush_local()
+            _settle(fleet.global_imported, i0 + n_series)
+            emitted, sample = fleet.flush_global()
+            ledger.emitted_global += emitted
+            monitor.add(IntervalSample(idx=idx, generation=generation,
+                                       **sample))
+            if mode != MODE_OK or scenario.kills_at(idx):
+                say(f"interval {idx}: mode={mode} "
+                    f"emitted={emitted:.0f}/{ledger.sent_global}")
+        # terminal settlement: clean egress, then flush rounds until
+        # nothing new emits and the requeue is drained — late, never
+        # lost, and the ledger closes exactly
+        fleet.set_sink_mode(MODE_OK)
+        for _ in range(12):
+            moved = fleet.flush_local()
+            time.sleep(0.2)
+            emitted, _sample = fleet.flush_global()
+            ledger.emitted_local += moved
+            ledger.emitted_global += emitted
+            if (not moved and not emitted
+                    and fleet.counters(ROLE_GLOBAL).get("dd_pending", 0)
+                    == 0):
+                break
+        for role in (ROLE_GLOBAL, ROLE_LOCAL):
+            _fold(ledger, fleet.counters(role), crash=False)
+    finally:
+        fleet.stop()
+    report = SoakReport(scenario=scenario, ledger=ledger, monitor=monitor)
+    report.results = run_gates(scenario, monitor, ledger)
+    if enforce_gates:
+        enforce(report.results, scenario)
+    return report
