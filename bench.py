@@ -3103,6 +3103,62 @@ def bench_soak(intervals: int = 200, kills: int = 3):
     }
 
 
+def bench_ha_takeover(intervals: int = 30):
+    """Config #15: the global-aggregator HA takeover end to end (PR 17,
+    ``veneur_tpu/fleet/standby.py`` + ``veneur_tpu/discovery/lease.py``)
+    — a REAL multi-process fleet where the active global replicates
+    each retired flush snapshot to a warm standby and holds a file
+    lease. Mid-run the active is SIGKILLed and NEVER restarted: the
+    standby's elector wins the lapsed lease, promotes the merged shadow
+    (non-counter groups), the proxy re-routes through the
+    lease-follower discoverer, and the drive keeps going. The record is
+    the takeover wall clock (kill → leader, kill → first standby-served
+    flush), the exact bounded-loss accounting (the un-flushed counter
+    tail of the dead active, ``accounted_lost <= loss_bound`` = one
+    interval's send), and the full gate vector including the
+    ``takeover`` gate. ``all_gates_ok`` is the acceptance bit."""
+    import shutil
+    import tempfile
+
+    from veneur_tpu.soak import (KIND_KILL_FOREVER, GateThresholds,
+                                 ProcessFleet, SoakScenario, run_soak)
+
+    thr = GateThresholds(warmup_intervals=5, rss_slope_pct_per_100=50.0,
+                         recovery_intervals=3)
+    sc = SoakScenario.generate(seed=1709, intervals=intervals,
+                               thresholds=thr, kind=KIND_KILL_FOREVER)
+    root = tempfile.mkdtemp(prefix="veneur-ha-")
+    t0 = time.perf_counter()
+    try:
+        report = run_soak(sc, ProcessFleet(sc, root),
+                          enforce_gates=False)
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+    took = time.perf_counter() - t0
+    vec = report.vector()
+    led = report.ledger
+    g = vec["gates"]
+    return {
+        "intervals": intervals, "seed": sc.seed,
+        "kill_at": sc.kills[0][0],
+        "elapsed_s": round(took, 1),
+        "intervals_per_s": round(intervals / took, 2),
+        "all_gates_ok": vec["all_ok"],
+        "gates_ok": {k: v["ok"] for k, v in g.items()},
+        "promotions": led.promotions,
+        "takeover_detect_s": round(led.takeover_detect_s, 2),
+        "takeover_first_flush_s": round(led.takeover_first_flush_s, 2),
+        "accounted_lost": led.accounted_lost,
+        "loss_bound": led.takeover_loss_bound,
+        "loss_within_bound":
+            0 <= led.accounted_lost <= led.takeover_loss_bound,
+        "sent_global": led.sent_global,
+        "emitted_global": led.emitted_global,
+        "shed": led.shed,
+        "restarts": dict(led.restarts),
+    }
+
+
 def run_tpu_smoke(timeout: float = 560.0) -> dict:
     """Run the @pytest.mark.tpu hardware subset in the bench environment
     (VENEUR_TPU_TESTS=1 → real accelerator) and report pass/fail — each
@@ -3254,6 +3310,13 @@ def _lane_plan(result, guarded):
         # (veneur_tpu/soak/, docs/resilience.md "Soak & chaos")
         ("14_soak",
          lambda t: run_isolated("bench_soak", timeout=t), 540),
+        # global-aggregator HA: active global SIGKILLed forever
+        # mid-run, warm standby wins the lapsed file lease, promotes
+        # its replicated shadow and serves the rest of the drive —
+        # records takeover wall clock + exact bounded-loss accounting
+        # (veneur_tpu/fleet/standby.py, docs/resilience.md "Global HA")
+        ("15_ha_takeover",
+         lambda t: run_isolated("bench_ha_takeover", timeout=t), 240),
     ]
 
 
@@ -3376,6 +3439,10 @@ def _headline(result) -> dict:
             "14_soak": pick("14_soak", "all_gates_ok", "intervals",
                             "restarts", "rss_slope_pct_per_100",
                             "intervals_per_s"),
+            "15_ha": pick("15_ha_takeover", "all_gates_ok",
+                          "promotions", "takeover_detect_s",
+                          "takeover_first_flush_s", "accounted_lost",
+                          "loss_within_bound"),
         },
         "detail_file": "BENCH_DETAIL.json",
     }

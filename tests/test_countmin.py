@@ -189,6 +189,50 @@ class TestHeavyHitterStore:
             assert m.value == 1.0
 
 
+class TestHeavyHitterMerge:
+    """Satellite: heavy-hitter state MOVES on a handoff/replication
+    merge — ``restore_state`` adds the count-min tables element-wise
+    and re-enters each series' top-k candidates, so a resized peer or
+    a promoted standby keeps serving fleet top-k. Estimates stay
+    upward-biased only, with the merged overcount bounded by
+    ``e/w · ΣN`` (docs/tiered.md "Merging count-min tables")."""
+
+    def test_merge_matches_merged_oracle_within_cm_bound(self):
+        import math
+
+        rng = np.random.default_rng(11)
+        exact = collections.Counter()
+        stores = []
+        users = [f"u{i}" for i in range(30)]
+        weights = np.linspace(50, 2, 30)
+        for _ in range(2):
+            store = MetricStore(initial_capacity=16, chunk=256)
+            draws = rng.choice(30, 3000, p=weights / weights.sum())
+            for d in draws:
+                exact[users[d]] += 1
+                store.process_metric(p.parse_metric(
+                    f"api.hh:{users[d]}|s|#veneurtopk".encode()))
+            stores.append(store)
+        a, b = stores
+        # the exact group snapshot the handoff wire / the standby's
+        # replication stream carries
+        groups = {"heavy_hitters": a.heavy_hitters.snapshot_state()}
+        from veneur_tpu.fleet.standby import PROMOTABLE_GROUPS
+        assert "heavy_hitters" in PROMOTABLE_GROUPS
+        assert b.restore_state(groups) > 0
+        final, _, _ = b.flush([], AGG, is_local=True, now=1,
+                              forward=False)
+        topk = {m.tags[-1].split(":", 1)[1]: m.value for m in final
+                if m.name == "api.hh.topk"}
+        width = np.asarray(groups["heavy_hitters"]["table"]).shape[-1]
+        total = sum(exact.values())
+        slack = math.e / width * total + 1.0
+        for user, cnt in exact.most_common(10):
+            assert user in topk
+            # upward-biased only, within the merged-table CM bound
+            assert cnt <= topk[user] <= cnt + slack
+
+
 class TestTopkForwarding:
     """Fleet aggregation of heavy hitters: two locals forward their
     sketches (count-min table + top-k candidates) through the JSON wire;

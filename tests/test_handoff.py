@@ -22,8 +22,8 @@ from veneur_tpu.config import Config
 from veneur_tpu.core.store import MetricStore
 from veneur_tpu.discovery import RingWatcher
 from veneur_tpu.fleet import RingTransition, ring_key
-from veneur_tpu.fleet.handoff import (HandoffManager, decode_handoff,
-                                      encode_handoff,
+from veneur_tpu.fleet.handoff import (HandoffManager, HybridEpoch,
+                                      decode_handoff, encode_handoff,
                                       pack_digest_snapshot,
                                       split_group_snapshot,
                                       unpack_digest_snapshot)
@@ -677,6 +677,52 @@ class TestFailureLadder:
                               RingWatcher(MutableDiscoverer(["s"]), "t"))
         # the new incarnation catches up within seconds of wall clock
         assert mgr2.epoch >= old_epoch - 5
+
+    def test_hybrid_epoch_monotone_under_backwards_clock(self):
+        """The (wall, ctr) hybrid: a clock stepping BACKWARDS mid-life
+        can never lower the wall high-water mark, and the counter alone
+        already totally orders the life's transitions."""
+        t = [50_000.0]
+        ep = HybridEpoch(clock=lambda: t[0])
+        seen = []
+        for skew in (10.0, -3000.0, 5.0, -1.0, 2.0):
+            t[0] += skew
+            seen.append(ep.advance())
+        assert seen == sorted(seen) and len(set(seen)) == len(seen)
+        walls = [w for w, _c in seen]
+        assert walls == sorted(walls)  # high-water, never lowered
+
+    def test_restart_onto_skewed_backwards_clock_not_stale(self):
+        """Satellite regression: life A hands off at wall T several
+        transitions in; the process restarts onto a clock skewed back
+        1000s. The receiver keys its (wall, ctr) high-water per
+        (sender, incarnation), so life B's FIRST handoff — wall
+        T-1000, counter reset — must merge (200), never 409-stale
+        against life A's mark. A replay from life A's own past still
+        fails against life A's remembered mark."""
+        recv = HandoffManager(make_store(), "r",
+                              RingWatcher(MutableDiscoverer(["r"]), "t"))
+        donor = make_store()
+        fill_store(donor, n=3)
+        groups = {"global_counters":
+                  donor.global_counters.snapshot_state()}
+        t = int(time.time())
+        status, _, _ = recv.handle_handoff(encode_handoff(
+            groups, {"id": "life-a-7", "sender": "s", "epoch": t,
+                     "epoch_ctr": 7, "incarnation": "aaaa"}, 0.0))
+        assert status == 200
+        # life B: wall clock 1000s in the past, fresh incarnation
+        status, _, _ = recv.handle_handoff(encode_handoff(
+            groups, {"id": "life-b-1", "sender": "s", "epoch": t - 1000,
+                     "epoch_ctr": 1, "incarnation": "bbbb"}, 0.0))
+        assert status == 200
+        assert recv.stale_total == 0
+        # an actually-stale replay WITHIN life A still 409s
+        status, body, _ = recv.handle_handoff(encode_handoff(
+            groups, {"id": "life-a-3", "sender": "s", "epoch": t,
+                     "epoch_ctr": 3, "incarnation": "aaaa"}, 0.0))
+        assert status == 409 and "stale" in body
+        assert recv.stale_total == 1
 
     def test_kept_remerge_prefers_live_gauge(self):
         """A gauge sampled DURING the extraction window is newer than

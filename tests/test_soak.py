@@ -23,7 +23,8 @@ from veneur_tpu.soak import (GateThresholds, IntervalSample, ProcessFleet,
                              run_gates, run_soak)
 from veneur_tpu.soak.monitor import read_rss_kb
 from veneur_tpu.soak.orchestrator import InProcessFleet
-from veneur_tpu.soak.scenario import KILL_CYCLE, MODE_OK, SINK_MODES
+from veneur_tpu.soak.scenario import (KILL_CYCLE, KIND_KILL_FOREVER,
+                                      MODE_OK, ROLE_GLOBAL, SINK_MODES)
 
 
 class TestScenario:
@@ -63,6 +64,24 @@ class TestScenario:
         sc = SoakScenario.generate(seed=99, intervals=12, kills=2)
         assert "seed=99" in sc.repro()
         assert "intervals=12" in sc.repro()
+
+    def test_kill_forever_schedule(self):
+        """The HA scenario: exactly one kill — the active global, dead
+        forever — inside the chaos span, no sink-outage windows, and a
+        repro() that names the kind."""
+        sc = SoakScenario.generate(seed=21, intervals=12,
+                                   kind=KIND_KILL_FOREVER)
+        assert sc.kind == KIND_KILL_FOREVER
+        assert len(sc.kills) == 1
+        (at, role), = sc.kills
+        assert role == ROLE_GLOBAL
+        thr = sc.thresholds
+        assert thr.warmup_intervals <= at < 12 - (thr.recovery_intervals
+                                                  + 1)
+        assert sc.sink_windows == ()
+        assert "kind='kill_forever'" in sc.repro()
+        assert sc == SoakScenario.generate(seed=21, intervals=12,
+                                           kind=KIND_KILL_FOREVER)
 
 
 class TestMonitor:
@@ -185,6 +204,59 @@ class TestGates:
         bad = {r.name for r in results if not r.ok}
         assert "rss_slope" in bad
 
+    def _ha_ledger(self):
+        """A clean kill_forever ledger: the active's un-flushed tail
+        (23) is accounted — and conservation MUST fold it."""
+        led = SoakLedger(sent_global=1000, emitted_global=967, shed=6,
+                         quarantined=4, sent_local=200,
+                         emitted_local=200, dd_offered=5000,
+                         dd_acked=4900, dd_dropped=50, dd_crash_lost=50,
+                         accounted_lost=23, takeover_loss_bound=30,
+                         promotions=1, takeover_detect_s=2.1,
+                         takeover_first_flush_s=3.4)
+        return led
+
+    def test_kill_forever_adds_takeover_gate(self):
+        sc = SoakScenario.generate(seed=4, intervals=10,
+                                   kind=KIND_KILL_FOREVER)
+        results = run_gates(sc, _clean_monitor(sc), self._ha_ledger())
+        vec = gate_vector(results)
+        assert vec["all_ok"], vec
+        # the 9 classic gates PLUS the takeover gate — only here
+        assert "takeover" in vec["gates"]
+        assert vec["gates"]["takeover"]["value"]["accounted_lost"] == 23
+        enforce(results, sc)
+
+    def test_unaccounted_takeover_loss_fails_conservation(self):
+        """accounted_lost is the ONLY licence for sent != emitted:
+        zero it out and the conservation gate must fail loud."""
+        sc = SoakScenario.generate(seed=4, intervals=10,
+                                   kind=KIND_KILL_FOREVER)
+        led = self._ha_ledger()
+        led.accounted_lost = 0
+        results = run_gates(sc, _clean_monitor(sc), led)
+        bad = {r.name for r in results if not r.ok}
+        assert "conservation_global" in bad
+
+    def test_takeover_gate_fails_on_each_violation(self):
+        sc = SoakScenario.generate(seed=4, intervals=10,
+                                   kind=KIND_KILL_FOREVER)
+        for mutate in (
+                lambda led: setattr(led, "promotions", 0),
+                lambda led: setattr(led, "takeover_detect_s", -1.0),
+                lambda led: setattr(led, "takeover_detect_s", 99.0),
+                lambda led: setattr(led, "takeover_loss_bound", 22)):
+            led = self._ha_ledger()
+            mutate(led)
+            results = run_gates(sc, _clean_monitor(sc), led)
+            bad = {r.name for r in results if not r.ok}
+            assert "takeover" in bad, mutate
+
+    def test_default_scenarios_have_no_takeover_gate(self):
+        sc = SoakScenario.generate(seed=4, intervals=10, kills=1)
+        results = run_gates(sc, _clean_monitor(sc), _clean_ledger())
+        assert "takeover" not in {r.name for r in results}
+
 
 class TestDiskFullDegradation:
     def test_injected_enospc_rides_the_ready_body(self, tmp_path):
@@ -254,6 +326,81 @@ class TestSoakSmoke:
         assert elapsed < 60.0, f"soak smoke took {elapsed:.1f}s"
 
 
+class TestHATakeoverSmoke:
+    def test_kill_forever_promotes_standby(self, tmp_path):
+        """The HA acceptance smoke (docs/resilience.md "Global HA"):
+        active + warm standby globals behind a file lease, replication
+        after every flush; mid-run the active is crash-stopped with NO
+        restart. The standby must take the lease, merge its replicated
+        shadow, and the proxy must re-route — with the loss bounded to
+        the active's one un-flushed interval and folded EXACTLY into
+        conservation as ``accounted_lost``."""
+        thr = GateThresholds(warmup_intervals=2,
+                             rss_slope_pct_per_100=500.0)
+        sc = SoakScenario.generate(seed=21, intervals=8,
+                                   kind=KIND_KILL_FOREVER,
+                                   thresholds=thr)
+        t0 = time.monotonic()
+        report = run_soak(sc, InProcessFleet(sc, str(tmp_path)))
+        elapsed = time.monotonic() - t0
+        vec = report.vector()
+        assert vec["all_ok"], vec
+        led = report.ledger
+        assert led.promotions == 1
+        assert led.restarts == {}  # dead forever — nothing respawned
+        assert 0.0 <= led.takeover_detect_s <= thr.takeover_detect_max_s
+        assert led.takeover_first_flush_s >= led.takeover_detect_s
+        # loss bounded by the one un-flushed interval, and the ledger
+        # closes exactly WITH it — never a silent shortfall
+        assert 0 <= led.accounted_lost <= led.takeover_loss_bound
+        assert led.sent_global == (led.emitted_global + led.shed
+                                   + led.quarantined + led.accounted_lost)
+        assert led.sent_local == led.emitted_local
+        assert elapsed < 90.0, f"HA takeover smoke took {elapsed:.1f}s"
+
+
+class TestBindRetry:
+    """Satellite: SIGKILL-respawn onto the same fixed port must not
+    flap on the predecessor's lingering listener (httpserv
+    ``ReuseportHTTPServer.server_bind`` bounded retry)."""
+
+    def test_rebind_storm_same_port(self):
+        from veneur_tpu.httpserv import OpsServer
+        ops = OpsServer("127.0.0.1:0")
+        ops.start()
+        port = ops.port
+        try:
+            for _ in range(5):
+                ops.stop()
+                ops = OpsServer(f"127.0.0.1:{port}")  # no pause: storm
+                ops.start()
+                assert ops.port == port
+        finally:
+            ops.stop()
+
+    def test_bind_retries_through_transient_eaddrinuse(self):
+        import socket
+        import threading
+
+        from veneur_tpu.httpserv import ReuseportHTTPServer, _Handler
+
+        # a blocker WITHOUT SO_REUSEPORT denies the port outright …
+        blocker = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        blocker.bind(("127.0.0.1", 0))
+        blocker.listen(1)
+        port = blocker.getsockname()[1]
+        # … until it dies mid-retry-window, like a SIGKILLed listener
+        threading.Timer(0.3, blocker.close).start()
+        t0 = time.monotonic()
+        httpd = ReuseportHTTPServer(("127.0.0.1", port), _Handler)
+        waited = time.monotonic() - t0
+        try:
+            assert httpd.server_address[1] == port
+            assert waited >= 0.2, "bind should have waited out the blocker"
+        finally:
+            httpd.server_close()
+
+
 @pytest.mark.slow
 class TestProcessSoak:
     def test_multi_process_soak_survives_real_sigkills(self, tmp_path):
@@ -276,3 +423,44 @@ class TestProcessSoak:
         assert led.sent_local == led.emitted_local
         assert led.dd_offered == (led.dd_acked + led.dd_pending
                                   + led.dd_dropped + led.dd_crash_lost)
+
+    def test_restart_storm_rebinds_same_port(self, tmp_path):
+        """Three consecutive-interval SIGKILLs of the global — each
+        respawn re-binds the SAME fixed HTTP port immediately (the
+        ``ReuseportHTTPServer`` retry-bind satellite, exercised with
+        real processes). Conservation must stay exact across the
+        storm."""
+        thr = GateThresholds(warmup_intervals=3,
+                             rss_slope_pct_per_100=500.0)
+        base = SoakScenario.generate(seed=17, intervals=12, kills=0,
+                                     thresholds=thr)
+        sc = SoakScenario(seed=17, intervals=12,
+                          kills=((3, ROLE_GLOBAL), (4, ROLE_GLOBAL),
+                                 (5, ROLE_GLOBAL)),
+                          sink_windows=base.sink_windows,
+                          fault_rate=base.fault_rate,
+                          fault_kinds=base.fault_kinds, thresholds=thr)
+        report = run_soak(sc, ProcessFleet(sc, str(tmp_path)))
+        vec = report.vector()
+        assert vec["all_ok"], vec
+        led = report.ledger
+        assert led.restarts == {"global": 3}
+        assert led.sent_global == (led.emitted_global + led.shed
+                                   + led.quarantined)
+
+    def test_multi_process_kill_forever_takeover(self, tmp_path):
+        """The full HA acceptance with real OS processes: a real
+        SIGKILL of the active global, never respawned — the standby
+        child must promote and serve, bounded-loss."""
+        thr = GateThresholds(warmup_intervals=2,
+                             rss_slope_pct_per_100=500.0)
+        sc = SoakScenario.generate(seed=22, intervals=8,
+                                   kind=KIND_KILL_FOREVER,
+                                   thresholds=thr)
+        report = run_soak(sc, ProcessFleet(sc, str(tmp_path)))
+        vec = report.vector()
+        assert vec["all_ok"], vec
+        led = report.ledger
+        assert led.promotions == 1 and led.restarts == {}
+        assert led.sent_global == (led.emitted_global + led.shed
+                                   + led.quarantined + led.accounted_lost)

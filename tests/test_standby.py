@@ -1,0 +1,422 @@
+"""Global-aggregator HA (veneur_tpu/fleet/standby.py +
+veneur_tpu/discovery/lease.py): the lease state machine (fencing epoch
+per holding life, keep-last-good renewal, clean release), the
+replication stream's idempotency and split-brain guards (id duplicate,
+stale flush epoch, deposed active's lease-epoch fence, config skew),
+the non-counter promotion merge, and the failover routing satellite —
+forwarders and the lease-backed discoverer re-pointing at a promoted
+standby within one membership refresh. The end-to-end SIGKILL takeover
+acceptance lives in tests/test_soak.py (kill_forever scenarios).
+"""
+
+import json
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from veneur_tpu.config import Config
+from veneur_tpu.core.store import MetricStore
+from veneur_tpu.discovery import (LeaderDiscoverer, LeaseElector,
+                                  lease_backend_from_url)
+from veneur_tpu.discovery.lease import FileLease
+from veneur_tpu.fleet.standby import PROMOTABLE_GROUPS, StandbyManager
+from veneur_tpu.forward import GRPCForwarder, HTTPForwarder, ImportServer
+from veneur_tpu.samplers.intermetric import HistogramAggregates
+from veneur_tpu.samplers.parser import MetricKey
+from veneur_tpu.server import Server
+from veneur_tpu.sinks import ChannelMetricSink
+
+AGG = HistogramAggregates.from_names(["min", "max", "count"])
+
+
+class FakeClock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+def make_store(**kw):
+    kw.setdefault("initial_capacity", 32)
+    kw.setdefault("chunk", 128)
+    return MetricStore(**kw)
+
+
+def fill_store(store, n=10):
+    """Counters + timer digests + sets: every replication-relevant
+    shape. Returns (counter_total, digest_weight_total)."""
+    rng = np.random.default_rng(7)
+    ctotal, wtotal = 0, 0.0
+    for i in range(n):
+        store.import_counter(
+            MetricKey(name=f"m{i}", type="counter", joined_tags=""),
+            [], 10 + i)
+        ctotal += 10 + i
+        vals = np.sort(rng.normal(100.0, 10.0, 20))
+        store.import_digest(
+            MetricKey(name=f"t{i}", type="timer", joined_tags=""),
+            [], vals, np.ones(20), float(vals[0]), float(vals[-1]))
+        wtotal += 20.0
+        regs = np.zeros(1 << store.sets.precision, np.uint8)
+        regs[i % 50] = 3
+        store.import_set(
+            MetricKey(name=f"s{i}", type="set", joined_tags=""), [], regs)
+    return ctotal, wtotal
+
+
+# ---------------------------------------------------------------------------
+# the lease
+# ---------------------------------------------------------------------------
+
+
+class TestFileLease:
+    def test_epoch_bumps_per_holding_life_not_renewal(self, tmp_path):
+        clk = FakeClock()
+        lease = FileLease(str(tmp_path / "lease"), clock=clk)
+        a = lease.acquire_or_renew("A", ttl=10.0)
+        assert a is not None and a.epoch == 1
+        clk.t += 5.0
+        assert lease.acquire_or_renew("A", ttl=10.0).epoch == 1  # renewal
+        # A's own expiry: a NEW life of the same holder must fence its
+        # old replication stream
+        clk.t += 20.0
+        assert lease.acquire_or_renew("A", ttl=10.0).epoch == 2
+        clk.t += 20.0
+        assert lease.acquire_or_renew("B", ttl=10.0).epoch == 3
+
+    def test_live_lease_rejects_other_holders(self, tmp_path):
+        clk = FakeClock()
+        lease = FileLease(str(tmp_path / "lease"), clock=clk)
+        assert lease.acquire_or_renew("A", ttl=10.0) is not None
+        assert lease.acquire_or_renew("B", ttl=10.0) is None
+        clk.t += 11.0  # ttl lapses -> up for grabs
+        assert lease.acquire_or_renew("B", ttl=10.0) is not None
+
+    def test_release_expires_now_but_keeps_epoch(self, tmp_path):
+        clk = FakeClock()
+        lease = FileLease(str(tmp_path / "lease"), clock=clk)
+        lease.acquire_or_renew("A", ttl=300.0)
+        lease.release("A")
+        st = lease.read()
+        assert st.expired(clk())  # no ttl wait for the standby
+        assert st.epoch == 1
+        assert lease.acquire_or_renew("B", ttl=10.0).epoch == 2
+
+    def test_corrupt_record_is_expired_not_fatal(self, tmp_path):
+        path = tmp_path / "lease"
+        path.write_bytes(b"\x00garbage{{{")
+        clk = FakeClock()
+        lease = FileLease(str(path), clock=clk)
+        assert lease.read() is None
+        assert lease.acquire_or_renew("A", ttl=10.0) is not None
+
+    def test_backend_url_parsing(self, tmp_path):
+        b = lease_backend_from_url(f"file://{tmp_path}/l")
+        assert isinstance(b, FileLease)
+        with pytest.raises(ValueError):
+            lease_backend_from_url("zk://nope")
+
+
+class TestLeaseElector:
+    def _pair(self, tmp_path, clk):
+        lease = FileLease(str(tmp_path / "lease"), clock=clk)
+        events = []
+
+        def elector(name):
+            return LeaseElector(
+                lease, holder=name, ttl=10.0, renew_interval=3.0,
+                on_promote=lambda ep: events.append((name, "promote", ep)),
+                on_demote=lambda why: events.append((name, "demote", why)),
+                clock=clk)
+        return elector("A"), elector("B"), events
+
+    def test_promote_on_acquire_demote_on_loss(self, tmp_path):
+        clk = FakeClock()
+        a, b, events = self._pair(tmp_path, clk)
+        assert a.poll() is True and b.poll() is False
+        assert events == [("A", "promote", 1)]
+        # A dies silently; ttl lapses; B's next poll takes over
+        clk.t += 11.0
+        assert b.poll() is True
+        assert ("B", "promote", 2) in events
+        # the deposed A discovers the loss on ITS next poll
+        assert a.poll() is False
+        assert a.demotions_total == 1
+        assert events[-1][0:2] == ("A", "demote")
+
+    def test_keep_last_good_across_backend_errors(self, tmp_path):
+        clk = FakeClock()
+        a, _b, _events = self._pair(tmp_path, clk)
+        assert a.poll() is True
+
+        class Flaky:
+            def acquire_or_renew(self, holder, ttl):
+                raise OSError("shared disk blip")
+        a.backend = Flaky()
+        clk.t += 5.0  # mid-ttl: the holder already paid for this window
+        assert a.poll() is True
+        assert a.renew_failures_total == 1 and a.demotions_total == 0
+        clk.t += 6.0  # ttl truly lapsed during the outage
+        assert a.poll() is False
+        assert a.demotions_total == 1
+
+
+class TestLeaderDiscoverer:
+    def test_routes_follow_the_lease(self, tmp_path):
+        """Satellite: a lease transition re-routes the discoverer's
+        consumers (the proxy ring, the locals' forwarders) in ONE
+        refresh — the promoted standby IS the membership."""
+        clk = FakeClock()
+        lease = FileLease(str(tmp_path / "lease"), clock=clk)
+        disc = LeaderDiscoverer(lease, clock=clk)
+        with pytest.raises(RuntimeError):  # keep-last-good upstream
+            disc.get_destinations_for_service("veneur-global")
+        lease.acquire_or_renew("http://a:8100", ttl=10.0)
+        assert disc.get_destinations_for_service("x") == ["http://a:8100"]
+        lease.release("http://a:8100")
+        with pytest.raises(RuntimeError):
+            disc.get_destinations_for_service("x")
+        lease.acquire_or_renew("http://b:8100", ttl=10.0)
+        assert disc.get_destinations_for_service("x") == ["http://b:8100"]
+
+
+# ---------------------------------------------------------------------------
+# replication: capture -> dispatch -> handle_replicate -> promote
+# ---------------------------------------------------------------------------
+
+
+def wire_pair(monkeypatch, sby, active):
+    """Route the active's per-peer send straight into the standby's
+    receiver (the real encode/decode wire, no sockets)."""
+    statuses = []
+
+    def fake_send(dest, blob, rid):
+        status, _body, _ct = sby.handle_replicate(blob)
+        statuses.append(status)
+        return status == 200
+    monkeypatch.setattr(active, "_send", fake_send)
+    return statuses
+
+
+class TestReplication:
+    def _pair(self, monkeypatch):
+        store_a, store_b = make_store(), make_store()
+        active = StandbyManager(store_a, "http://a", ["http://b"])
+        active.is_leader, active.lease_epoch = True, 1
+        sby = StandbyManager(store_b, "http://b", [])
+        return store_a, store_b, active, sby, \
+            wire_pair(monkeypatch, sby, active)
+
+    def test_round_trip_lands_in_shadow_not_store(self, monkeypatch):
+        store_a, store_b, active, sby, statuses = self._pair(monkeypatch)
+        ctotal, wtotal = fill_store(store_a)
+        groups, epoch = store_a.snapshot_state()
+        active.capture(groups, epoch)
+        summary = active.dispatch()
+        assert statuses == [200]
+        assert summary["sent"] == ["http://b"]
+        assert sby.receives_total == 1
+        assert sby.shadow.series_held() == summary["series"] > 0
+        # shadowed, NOT merged: the standby's own flush stays empty
+        final, fwd, _ = store_b.flush([0.5], AGG, is_local=True, now=0,
+                                      forward=True)
+        assert not fwd.counters and not fwd.timers
+
+    def test_duplicate_id_acked_once(self, monkeypatch):
+        store_a, _store_b, active, sby, _ = self._pair(monkeypatch)
+        fill_store(store_a)
+        groups, epoch = store_a.snapshot_state()
+        active.capture(groups, epoch)
+        active.dispatch()
+        # a retry replaying the exact stream: 200, no double shadow
+        from veneur_tpu.fleet.handoff import encode_handoff
+        held = sby.shadow.series_held()
+        ring = sby.shadow._epochs["http://a"]
+        meta = dict(ring[-1][2])
+        blob = encode_handoff(ring[-1][1], meta, time.time())
+        status, body, _ = sby.handle_replicate(blob)
+        assert status == 200 and json.loads(body)["duplicate"] is True
+        assert sby.duplicates_total == 1
+        assert sby.shadow.series_held() == held
+
+    def test_stale_flush_epoch_rejected(self, monkeypatch):
+        store_a, _store_b, active, sby, statuses = self._pair(monkeypatch)
+        fill_store(store_a)
+        groups, _epoch = store_a.snapshot_state()
+        active.capture(groups, 5)
+        active.dispatch()
+        active.capture(groups, 5)  # same epoch, NEW replicate id
+        active.dispatch()
+        assert statuses == [200, 409]
+        assert sby.stale_total == 1
+        assert active.replicate_failures_total == 1
+
+    def test_first_epoch_zero_is_not_stale(self, monkeypatch):
+        """Regression: a fresh sender's first flush carries epoch 0 —
+        the receiver's high-water sentinel must sit BELOW it."""
+        store_a, _store_b, active, sby, statuses = self._pair(monkeypatch)
+        fill_store(store_a, n=2)
+        groups, _ = store_a.snapshot_state()
+        active.capture(groups, 0)
+        active.dispatch()
+        assert statuses == [200]
+        assert sby.stale_total == 0 and sby.receives_total == 1
+
+    def test_deposed_active_fenced_by_lease_epoch(self, monkeypatch):
+        """The split-brain guard (satellite 4): once the standby has
+        witnessed lease epoch N, a late stream from the old active's
+        life (epoch N-1) is rejected whole — 409, nothing shadows."""
+        store_a, _store_b, active, sby, statuses = self._pair(monkeypatch)
+        fill_store(store_a)
+        groups, _ = store_a.snapshot_state()
+        active.lease_epoch = 2  # the NEW active's life
+        active.capture(groups, 1)
+        active.dispatch()
+        old = StandbyManager(make_store(), "http://old", ["http://b"])
+        old.is_leader, old.lease_epoch = True, 1  # deposed life
+        wire_pair(monkeypatch, sby, old)
+        fill_store(old.store, n=3)
+        g2, _ = old.store.snapshot_state()
+        old.capture(g2, 99)
+        old.dispatch()
+        assert sby.fenced_total == 1
+        assert sby.shadow.latest().keys() == {"http://a"}
+
+    def test_drop_oldest_capture_never_backpressures(self, monkeypatch):
+        store_a, _store_b, active, sby, _ = self._pair(monkeypatch)
+        fill_store(store_a, n=2)
+        groups, _ = store_a.snapshot_state()
+        active.capture(groups, 1)
+        active.capture(groups, 2)  # replicator busy: oldest dropped
+        assert active.dropped_epochs_total == 1
+        active.dispatch()
+        ring = sby.shadow._epochs["http://a"]
+        assert [e for e, *_rest in ring] == [2]
+
+    def test_promote_merges_non_counter_groups_only(self, monkeypatch):
+        store_a, store_b, active, sby, _ = self._pair(monkeypatch)
+        ctotal, wtotal = fill_store(store_a)
+        groups, epoch = store_a.snapshot_state()
+        active.capture(groups, epoch)
+        active.dispatch()
+        merged = sby.promote(lease_epoch=2)
+        assert merged > 0 and sby.promoted
+        final, fwd, _ = store_b.flush([0.5], AGG, is_local=True, now=0,
+                                      forward=True)
+        # replicated counters were already emitted by the dead active —
+        # they must NOT re-emit here (the un-flushed tail is accounted
+        # loss, not a re-merge)
+        assert "global_counters" not in PROMOTABLE_GROUPS
+        assert not [n for n, _t, _v in fwd.counters
+                    if n.startswith("m")]
+        # ... but the percentile state DID move: full digest mass
+        got_w = sum(float(np.sum(w))
+                    for _n, _t, _m, w, _mn, _mx in
+                    fwd.histograms + fwd.timers)
+        assert got_w == pytest.approx(wtotal)
+        assert {n for n, *_ in fwd.sets} == {f"s{i}" for i in range(10)}
+
+    def test_replication_age_gauge(self, monkeypatch):
+        clk = FakeClock()
+        store_a, store_b = make_store(), make_store()
+        active = StandbyManager(store_a, "http://a", ["http://b"])
+        active.is_leader, active.lease_epoch = True, 1
+        sby = StandbyManager(store_b, "http://b", [], clock=clk)
+        wire_pair(monkeypatch, sby, active)
+        assert sby.replication_age_seconds() == -1.0  # never received
+        fill_store(store_a, n=2)
+        groups, epoch = store_a.snapshot_state()
+        active.capture(groups, epoch)
+        active.dispatch()
+        assert sby.replication_age_seconds() == pytest.approx(0.0)
+        clk.t += 7.5
+        assert sby.replication_age_seconds() == pytest.approx(7.5)
+
+    def test_follower_and_peerless_dispatch_no_op(self):
+        mgr = StandbyManager(make_store(), "http://a", ["http://b"])
+        groups = {"global_counters": {"names": ["x"]}}
+        mgr.capture(groups, 1)  # follower: captured but never streamed
+        assert mgr.dispatch() is None
+        lone = StandbyManager(make_store(), "http://a", [])
+        lone.is_leader = True
+        lone.capture(groups, 1)  # no peers: capture itself no-ops
+        assert lone.dispatch() is None
+
+
+# ---------------------------------------------------------------------------
+# the real HTTP wire: a standby Server's /replicate + /ha-status
+# ---------------------------------------------------------------------------
+
+
+class TestReplicateOverHTTP:
+    def test_active_streams_to_a_real_standby_server(self, tmp_path):
+        sby_cfg = Config(statsd_listen_addresses=[],
+                         http_address="127.0.0.1:0", interval="86400s",
+                         store_initial_capacity=32, store_chunk=128,
+                         aggregates=["count"], percentiles=[0.5],
+                         lease_path=f"file://{tmp_path}/lease",
+                         lease_ttl="86400s")
+        standby = Server(sby_cfg, metric_sinks=[ChannelMetricSink()])
+        standby.start()
+        try:
+            port = standby.ops_server.port
+            active = StandbyManager(make_store(), "http://a",
+                                    [f"http://127.0.0.1:{port}"],
+                                    timeout=5.0)
+            active.is_leader, active.lease_epoch = True, 7
+            fill_store(active.store, n=4)
+            groups, epoch = active.store.snapshot_state()
+            active.capture(groups, epoch)
+            summary = active.dispatch()
+            assert summary["failed"] == []
+            assert active.replicated_total == 1
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/ha-status") as r:
+                st = json.loads(r.read())
+            assert st["receives_total"] == 1
+            assert st["received_series_total"] == summary["series"]
+            assert st["shadow_series_held"] == summary["series"]
+        finally:
+            standby.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# failover routing (satellite): forwarders chase the promoted standby
+# ---------------------------------------------------------------------------
+
+
+class TestRetarget:
+    def test_http_forwarder_retarget(self):
+        fwd = HTTPForwarder("127.0.0.1:1")
+        assert fwd.base == "http://127.0.0.1:1"
+        fwd.retarget("127.0.0.1:2/")
+        assert fwd.base == "http://127.0.0.1:2"
+        fwd.retarget("https://standby:8100")
+        assert fwd.base == "https://standby:8100"
+
+    def test_grpc_forwarder_retarget_switches_channel(self):
+        gstore_a, gstore_b = make_store(), make_store()
+        srv_a, srv_b = ImportServer(gstore_a), ImportServer(gstore_b)
+        port_a = srv_a.start("127.0.0.1:0")
+        port_b = srv_b.start("127.0.0.1:0")
+        try:
+            from tests.test_forward import local_store_with_data
+            client = GRPCForwarder(f"127.0.0.1:{port_a}")
+            _, fwd = local_store_with_data().flush(
+                [0.5], AGG, is_local=True, now=0, forward=True)[0:2]
+            client.forward(fwd)
+            assert client.errors == 0
+            # the promoted standby takes over; one retarget re-routes
+            client.retarget(f"http://127.0.0.1:{port_b}")
+            _, fwd2 = local_store_with_data().flush(
+                [0.5], AGG, is_local=True, now=0, forward=True)[0:2]
+            client.forward(fwd2)
+            assert client.errors == 0
+            assert gstore_b.imported > 0
+        finally:
+            srv_a.stop()
+            srv_b.stop()

@@ -345,6 +345,28 @@ class Config:
     # locally; parsed ONCE at load. Empty = forward_timeout
     handoff_timeout: str = ""
 
+    # ---- global HA: warm standby + leased failover (fleet/standby.py) ----
+    # standby peers the active global replicates each flush's retired
+    # snapshot to (POST /replicate): comma-separated addresses, or
+    # "file:///path" (one address per line, re-read each dispatch).
+    # Empty = no replication. Only valid on a global; requires
+    # http_address (the standbys' /replicate lives on theirs).
+    standby_peers: str = ""
+    # replicated epochs each standby retains per sender (the shadow
+    # ring promotion merges the newest of); 0 = default 2
+    standby_shadow_epochs: int = 0
+    # where the leadership lease lives: "file:///path" (flock-serialized
+    # shared file — one host / one shared filesystem) or "consul://key"
+    # (session-TTL'd KV key). Empty = no election (every instance with
+    # standby_peers replicates unconditionally)
+    lease_path: str = ""
+    # how long one acquisition holds the lease without renewal — the
+    # detection bound on active death; parsed ONCE at load. Empty = 15s
+    lease_ttl: str = ""
+    # how often the elector acquires-or-renews; parsed ONCE at load.
+    # Empty = lease_ttl / 3
+    lease_renew_interval: str = ""
+
     # ---- crash-safe aggregation state (veneur_tpu/persist/) --------------
     # where the interval checkpoint lives; empty disables checkpointing.
     # The atomic-write scratch file is checkpoint_path + ".tmp".
@@ -506,6 +528,27 @@ class Config:
                 raise ValueError(
                     "handoff_enabled requires http_address: peers "
                     "stream moved ranges into POST /handoff on it")
+        if self.standby_peers or self.lease_path:
+            if self.forward_address:
+                raise ValueError(
+                    "standby_peers/lease_path require a GLOBAL instance, "
+                    "but forward_address is set (a local has no merged "
+                    "store to replicate). Unset one of them")
+            if self.standby_peers and not self.http_address:
+                raise ValueError(
+                    "standby_peers requires http_address: standbys "
+                    "receive replication on POST /replicate and serve "
+                    "GET /ha-status on it")
+        if self.standby_shadow_epochs < 0:
+            raise ValueError(
+                f"standby_shadow_epochs must be >= 0 (0 = use the "
+                f"default, 2), got {self.standby_shadow_epochs}")
+        if self.lease_path and not (
+                self.lease_path.startswith("file://")
+                or self.lease_path.startswith("consul://")):
+            raise ValueError(
+                f"lease_path must be file:///path or consul://key, got "
+                f"{self.lease_path!r}")
         if self.fault_injection_kinds:
             from veneur_tpu.resilience.faults import (ALL_KINDS,
                                                       CHURN_KINDS,
@@ -617,6 +660,15 @@ class Config:
         self.checkpoint_interval_seconds = (
             parse_duration(self.checkpoint_interval)
             if self.checkpoint_interval else 0.0)
+        # global-HA knobs (fleet/standby.py, discovery/lease.py),
+        # parse-once like every other duration
+        if not self.standby_shadow_epochs:
+            self.standby_shadow_epochs = 2
+        self.lease_ttl_seconds = (
+            parse_duration(self.lease_ttl) if self.lease_ttl else 15.0)
+        self.lease_renew_interval_seconds = (
+            parse_duration(self.lease_renew_interval)
+            if self.lease_renew_interval else self.lease_ttl_seconds / 3.0)
         self.apply_resilience_defaults()
         self.handoff_timeout_seconds = (
             parse_duration(self.handoff_timeout) if self.handoff_timeout

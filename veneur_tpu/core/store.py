@@ -3015,10 +3015,16 @@ class MetricStore:
     # the ring-routed groups: the state the import path feeds, i.e.
     # what locals forward through the proxy ring and what a fleet
     # resize therefore moves. Mixed scalars/locals are this host's own
-    # telemetry and the heavy-hitter count-min table is cross-series
-    # (not partitionable by key) — they always stay.
+    # telemetry and always stay. Heavy hitters move too: the candidate
+    # series split by the ring rule like any set, and the count-min
+    # table — cross-series, not partitionable by key — rides WHOLE with
+    # every part (a linear sketch merges by element-wise add, so the
+    # new owner's estimates stay one-sided upper bounds; the accuracy
+    # cost is the documented e/w · ΣN overcount widening with the
+    # donor's full table weight — docs/tiered.md "Merging count-min
+    # tables").
     _HANDOFF_GROUPS = ("global_counters", "global_gauges", "histograms",
-                       "timers", "sets")
+                       "timers", "sets", "heavy_hitters")
 
     @acquires_lock("store")
     def handoff_extract(self, route_fn,

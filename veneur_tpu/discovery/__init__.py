@@ -4,6 +4,13 @@
 healthy global-veneur destinations, mirroring ``/root/reference/
 discoverer.go:5-7`` with the Consul (``consul.go:16-55``) and Kubernetes
 (``kubernetes.go:14-91``) implementations.
+
+Leadership for the global-aggregator HA pair lives in
+``discovery/lease.py`` (re-exported here): file:// / consul:// lease
+backends, the :class:`LeaseElector` state machine, and
+:class:`LeaderDiscoverer` — the lease holder as a one-member
+``Discoverer`` so existing ring refresh re-routes to a promoted
+standby.
 """
 
 from __future__ import annotations
@@ -14,6 +21,11 @@ import os
 import ssl
 import urllib.request
 from typing import List, Optional, Protocol, Sequence
+
+from veneur_tpu.discovery.lease import (ConsulLease,  # noqa: F401
+                                        FileLease, LeaderDiscoverer,
+                                        LeaseElector, LeaseState,
+                                        lease_backend_from_url)
 
 log = logging.getLogger("veneur.discovery")
 

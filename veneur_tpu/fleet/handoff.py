@@ -67,6 +67,48 @@ log = logging.getLogger("veneur.fleet.handoff")
 SEEN_LIMIT = 512
 
 
+class HybridEpoch:
+    """Hybrid (wall, monotonic-counter) handoff epoch.
+
+    The epoch the receiver guards staleness by used to be the bare
+    wall clock (``int(time.time())`` at construction, ``max(+1, now)``
+    per transition) — monotonic only as long as the clock never ran
+    backwards between process lives. A sender restarted onto a
+    skewed-backwards clock would base BELOW the receiver's remembered
+    high-water mark and see every handoff spuriously 409-stale until
+    real time caught up. The hybrid epoch removes the wall clock from
+    the ordering:
+
+    - ``wall`` is a high-water mark (``max`` of every observation, so
+      a clock stepping backwards mid-life cannot lower it) — it exists
+      for operator legibility (spool filenames, handoff ids, logs),
+      not for ordering;
+    - ``ctr`` increments once per transition and is the actual
+      monotonic component: ``(wall, ctr)`` compares lexicographically
+      and ``ctr`` alone already totally orders one process life;
+    - ``incarnation`` is a per-process-life random id. The receiver
+      keys its high-water mark per (sender, incarnation), so a fresh
+      incarnation starts a fresh order and can never be stale against
+      a previous life's wall clock — replays from an OLD life still
+      check against that life's own remembered mark, and the id guard
+      covers the cross-life retry (spool re-send) case.
+
+    ``clock`` is injectable for the skewed-clock regression test."""
+
+    def __init__(self, clock: Callable[[], float] = time.time):
+        self.clock = clock
+        self.wall = int(clock())
+        self.ctr = 0
+        self.incarnation = uuid.uuid4().hex[:12]
+
+    def advance(self) -> Tuple[int, int]:
+        """One transition's (wall, ctr). Caller serializes (the
+        manager advances under its lock)."""
+        self.wall = max(self.wall, int(self.clock()))
+        self.ctr += 1
+        return self.wall, self.ctr
+
+
 # ---------------------------------------------------------------------------
 # snapshot split: one group snapshot -> per-destination snapshots
 # ---------------------------------------------------------------------------
@@ -109,7 +151,24 @@ def _filter_rows(snap: dict, keep_ix: np.ndarray) -> dict:
                   "recip"):
             out[k] = np.asarray(snap[k])[keep_ix]
         return out
-    # unknown kinds (topk etc.) never split — the caller keeps them whole
+    if kind == "topk":
+        # the candidate series split by row like any set, but the
+        # count-min table is CROSS-series (every sample hashed into the
+        # same [depth, width] counters) — it cannot be partitioned by
+        # key, so every part carries a full copy. Count-min is a linear
+        # sketch: the receiver's element-wise table add keeps every
+        # estimate a one-sided upper bound; the cost is overcount, not
+        # undercount — bounded by e/w · ΣN of the merged table
+        # (docs/tiered.md "Merging count-min tables").
+        for k in ("depth", "width", "k"):
+            if k in snap:
+                out[k] = snap[k]
+        if snap.get("table") is not None:
+            out["table"] = np.array(snap["table"], np.float32, copy=True)
+        series = snap.get("series") or []
+        out["series"] = [series[i] for i in keep_ix]
+        return out
+    # unknown kinds never split — the caller keeps them whole
     return snap
 
 
@@ -251,6 +310,32 @@ def snapshot_counts(groups: Dict[str, dict]) -> Dict[str, int]:
             for name, snap in groups.items()}
 
 
+def config_skew_reason(store, groups: Dict[str, dict]) -> Optional[str]:
+    """A whole-stream rejection reason when any group could not merge
+    completely on ``store``'s config (HLL precision, count-min
+    geometry), or None to accept. Shared by the handoff and
+    replication receivers: ``restore_state`` skips incompatible groups
+    with only a warning, and acking such a merge would silently lose
+    the skipped series — rejecting whole keeps the state at the
+    sender until the skew is fixed."""
+    for name, snap in groups.items():
+        target = getattr(store, name, None)
+        if target is None:
+            return f"unknown group {name!r}"
+        kind = snap.get("kind")
+        if kind == "set":
+            want = getattr(target, "precision", None)
+            if snap.get("precision") != want:
+                return (f"{name}: HLL precision "
+                        f"{snap.get('precision')} != store {want}")
+        elif kind == "topk":
+            geom = (snap.get("depth"), snap.get("width"))
+            if geom != (getattr(target, "depth", None),
+                        getattr(target, "width", None)):
+                return f"{name}: count-min geometry {geom} mismatch"
+    return None
+
+
 # ---------------------------------------------------------------------------
 # the manager: watch -> extract -> spool -> stream -> ack/requeue
 # ---------------------------------------------------------------------------
@@ -266,7 +351,8 @@ class HandoffManager:
                  spool_prefix: str = "", checkpointer=None, timeline=None,
                  refresh_interval: float = 10.0, injector=None,
                  replicas: int = 20, hop_log=None,
-                 spool_write_fn=None):
+                 spool_write_fn=None, clock: Callable[[], float]
+                 = time.time):
         from veneur_tpu.resilience import BreakerRegistry, RetryPolicy
 
         self.store = store
@@ -291,24 +377,26 @@ class HandoffManager:
         self.retry_pending = False
         self._retry_dests: set = set()  # dests whose requeue is owed
         self.requeue_retries_total = 0
-        # sender state. The handoff epoch must be monotonic ACROSS
-        # restarts (receivers remember the last epoch per sender
-        # in-memory; a restart that reset to 0 would see every handoff
-        # rejected 409-stale until the old high-water mark was passed
-        # again), so it bases on the wall clock and transitions take
-        # max(epoch + 1, now) — resizes are rare, clocks only have to
-        # not run backwards between process lives.
-        self.epoch = int(time.time())
+        # sender state: the hybrid (wall, monotonic-counter) epoch —
+        # (wall, ctr) under a per-life incarnation id, so a restart
+        # onto a skewed-backwards clock is never spuriously 409-stale
+        # (see HybridEpoch). self.epoch keeps exposing the wall part
+        # for spool names / handoff ids / snapshots.
+        self._hybrid = HybridEpoch(clock=clock)
+        self.epoch = self._hybrid.wall
+        self.epoch_ctr = 0
+        self.incarnation = self._hybrid.incarnation
         self._seq = 0
         self._lock = threading.Lock()
         # held across one whole transition (extract→stream→requeue);
         # shutdown quiesces on it before the final flush
         self._busy = threading.Lock()
         # receiver state: id -> merged count (registered BEFORE the
-        # merge, the at-most-once anchor) + last epoch per sender
+        # merge, the at-most-once anchor) + the (wall, ctr) high-water
+        # mark per (sender, incarnation)
         self._seen: "Dict[str, int]" = {}
         self._seen_order: List[str] = []
-        self._sender_epochs: Dict[str, int] = {}
+        self._sender_epochs: Dict[Tuple[str, str], Tuple[int, int]] = {}
         # telemetry (read by flusher._handoff_samples and /debug/vars)
         self.resizes_total = 0
         self.moved_series_total = 0
@@ -521,8 +609,8 @@ class HandoffManager:
         if rec is not None and rec.trace_id:
             ctx = TraceContext(rec.trace_id, rec.span_id)
         with self._lock:
-            self.epoch = max(self.epoch + 1, int(time.time()))
-            epoch = self.epoch
+            self.epoch, self.epoch_ctr = self._hybrid.advance()
+            epoch, epoch_ctr = self.epoch, self.epoch_ctr
         with obs.maybe_stage("handoff.extract"):
             moved, moved_series = self.store.handoff_extract(
                 self._route_fn(transition),
@@ -573,7 +661,8 @@ class HandoffManager:
                               f"{uuid.uuid4().hex[:12]}")
                 self._seq += 1
                 meta = {"id": handoff_id, "sender": self.self_addr,
-                        "epoch": epoch, "dest": dest,
+                        "epoch": epoch, "epoch_ctr": epoch_ctr,
+                        "incarnation": self.incarnation, "dest": dest,
                         "series": sum(snapshot_counts(groups).values()),
                         "counts": snapshot_counts(groups)}
                 blob = encode_handoff(groups, meta, time.time())
@@ -776,6 +865,8 @@ class HandoffManager:
         handoff_id = meta.get("id")
         sender = meta.get("sender", "")
         epoch = int(meta.get("epoch", 0) or 0)
+        epoch_ctr = int(meta.get("epoch_ctr", 0) or 0)
+        incarnation = str(meta.get("incarnation", "") or "")
         if not handoff_id:
             return 400, json.dumps({"error": "missing handoff id"}), \
                 "application/json"
@@ -803,13 +894,25 @@ class HandoffManager:
                 return 200, json.dumps(
                     {"id": handoff_id, "duplicate": True,
                      "merged": self._seen[handoff_id]}), "application/json"
-            last = self._sender_epochs.get(sender, 0)
-            if epoch < last:
+            # the stale guard compares the hybrid (wall, ctr) epoch
+            # WITHIN one sender incarnation: a fresh process life (new
+            # incarnation) starts a fresh order, so a sender restarted
+            # onto a skewed-backwards clock is never spuriously stale;
+            # a replay from an OLD life still checks against that
+            # life's own high-water mark, and the id guard covers the
+            # cross-life spool re-send
+            key = (sender, incarnation)
+            last = self._sender_epochs.get(key, (0, 0))
+            if (epoch, epoch_ctr) < last:
                 self.stale_total += 1
                 return 409, json.dumps(
-                    {"error": f"stale handoff epoch {epoch} < {last} "
-                              f"from {sender}"}), "application/json"
-            self._sender_epochs[sender] = epoch
+                    {"error": f"stale handoff epoch {(epoch, epoch_ctr)}"
+                              f" < {last} from {sender}"}), \
+                    "application/json"
+            self._sender_epochs[key] = (epoch, epoch_ctr)
+            while len(self._sender_epochs) > SEEN_LIMIT:
+                self._sender_epochs.pop(
+                    next(iter(self._sender_epochs)))
             self._register_seen(handoff_id, 0)
         # prefer_live_scalars: the proxy re-routes NEW samples here the
         # moment the ring changes, while the old owner's extract+stream
@@ -846,22 +949,7 @@ class HandoffManager:
     def _refuse_reason(self, groups: Dict[str, dict]) -> Optional[str]:
         """A whole-handoff rejection reason when any group could not
         merge completely on this store's config, or None to accept."""
-        for name, snap in groups.items():
-            target = getattr(self.store, name, None)
-            if target is None:
-                return f"unknown group {name!r}"
-            kind = snap.get("kind")
-            if kind == "set":
-                want = getattr(target, "precision", None)
-                if snap.get("precision") != want:
-                    return (f"{name}: HLL precision "
-                            f"{snap.get('precision')} != store {want}")
-            elif kind == "topk":
-                geom = (snap.get("depth"), snap.get("width"))
-                if geom != (getattr(target, "depth", None),
-                            getattr(target, "width", None)):
-                    return f"{name}: count-min geometry {geom} mismatch"
-        return None
+        return config_skew_reason(self.store, groups)
 
     def _register_seen(self, handoff_id: str, merged: int):
         # caller holds self._lock (handle_handoff's guard block)
@@ -944,6 +1032,8 @@ class HandoffManager:
             "self": self.self_addr,
             "members": list(self.watcher.members),
             "epoch": self.epoch,
+            "epoch_ctr": self.epoch_ctr,
+            "incarnation": self.incarnation,
             "resizes_total": self.resizes_total,
             "moved_series_total": self.moved_series_total,
             "sent_total": self.sent_total,

@@ -402,6 +402,26 @@ def _flush_once(server: "Server", span, rec=None):
     # flush_once's finally closes this on every unwind path; the happy
     # path's post barrier below closes it first (close is idempotent)
     server._active_stream = stream
+    # warm-standby replication (fleet/standby.py): capture the state
+    # this flush is about to drain — non-destructively, BEFORE the
+    # generation swap consumes it — and hand it to the replicator only
+    # AFTER the flush lands (post-flush ordering is what makes the
+    # promoted standby's counter exclusion exactly right: everything
+    # replicated was already emitted). Capture only while leading; a
+    # fenced ex-active must stop streaming immediately.
+    ha_snapshot = None
+    sby = getattr(server, "standby_manager", None)
+    if sby is not None and sby.is_leader \
+            and (sby.peers or sby._peers_file):
+        # top-level stage name (no dot): a dotted name would read as a
+        # child of a nonexistent parent and its wall time would fall
+        # out of the timeline's coverage_ratio numerator
+        with obs.maybe_stage("ha_capture"):
+            try:
+                ha_snapshot = server.store.snapshot_state()
+            except Exception:
+                log.exception("HA replication capture failed; this "
+                              "epoch will not replicate")
     t0 = time.perf_counter()
     with obs.maybe_stage("store"):
         final_metrics, forwardable, ms = server.store.flush(
@@ -420,6 +440,11 @@ def _flush_once(server: "Server", span, rec=None):
     ckpt = getattr(server, "checkpointer", None)
     if ckpt is not None:
         ckpt.truncate(blocking=False)
+    if ha_snapshot is not None:
+        # the flush landed: the captured (now-retired) epoch may stream
+        # to the standbys off the flush path (depth-1 drop-oldest)
+        groups, flush_epoch = ha_snapshot
+        sby.capture(groups, flush_epoch)
     # the canonical self-metric set (README.md:248-277) rides on the
     # flush span and re-enters the pipeline through the extraction sink
     span.add(
@@ -445,6 +470,7 @@ def _flush_once(server: "Server", span, rec=None):
         *_overload_samples(server, ms),
         *_fleet_samples(server),
         *_handoff_samples(server),
+        *_ha_samples(server),
         *_forward_samples(server),
         *_import_samples(server),
         *_checkpoint_samples(server),
@@ -849,6 +875,93 @@ def _handoff_samples(server):
             "veneur.handoff.duration_ns",
             mgr.last_duration_ns / 1e9, None))
     for dest, gauge in mgr.breakers.states():
+        out.append(ssf_samples.gauge(
+            "veneur.breaker.state", gauge, {"destination": dest}))
+    return out
+
+
+def _ha_samples(server):
+    """The veneur.ha.* set (docs/resilience.md "Global HA"):
+    replication stream tallies on the active, receive-side guard hits
+    and replication age on the standby, and the lease's leadership
+    gauges — counters as interval deltas like the handoff set. Empty
+    when warm-standby HA is off (one attribute read)."""
+    sby = getattr(server, "standby_manager", None)
+    if sby is None:
+        return []
+    from veneur_tpu.trace import samples as ssf_samples
+
+    out = [
+        ssf_samples.count(
+            "veneur.ha.replicated_total",
+            float(_delta_since(sby, "_last_replicated",
+                               sby.replicated_total)), None),
+        ssf_samples.count(
+            "veneur.ha.replicated_series_total",
+            float(_delta_since(sby, "_last_replicated_series",
+                               sby.replicated_series_total)), None),
+        ssf_samples.count(
+            "veneur.ha.replicate_failures_total",
+            float(_delta_since(sby, "_last_replicate_failures",
+                               sby.replicate_failures_total)), None),
+        # the replicator fell a full flush behind and the older pending
+        # epoch was superseded: widens the loss window past one interval
+        ssf_samples.count(
+            "veneur.ha.dropped_epochs_total",
+            float(_delta_since(sby, "_last_dropped_epochs",
+                               sby.dropped_epochs_total)), None),
+        ssf_samples.count(
+            "veneur.ha.received_series_total",
+            float(_delta_since(sby, "_last_received_series",
+                               sby.received_series_total)), None),
+        ssf_samples.count(
+            "veneur.ha.duplicate_total",
+            float(_delta_since(sby, "_last_duplicates",
+                               sby.duplicates_total)), None),
+        ssf_samples.count(
+            "veneur.ha.stale_total",
+            float(_delta_since(sby, "_last_stale",
+                               sby.stale_total)), None),
+        ssf_samples.count(
+            "veneur.ha.fenced_total",
+            float(_delta_since(sby, "_last_fenced",
+                               sby.fenced_total)), None),
+        ssf_samples.count(
+            "veneur.ha.promotions_total",
+            float(_delta_since(sby, "_last_promotions",
+                               sby.promotions_total)), None),
+        ssf_samples.count(
+            "veneur.ha.promoted_series_total",
+            float(_delta_since(sby, "_last_promoted_series",
+                               sby.promoted_series_total)), None),
+        ssf_samples.count(
+            "veneur.ha.retries_total",
+            float(_delta_since(sby, "_last_retries",
+                               sby.retries_total)), None),
+        ssf_samples.gauge("veneur.ha.is_leader",
+                          1.0 if sby.is_leader else 0.0, None),
+        ssf_samples.gauge("veneur.ha.lease_epoch",
+                          float(sby.lease_epoch), None),
+    ]
+    age = sby.replication_age_seconds()
+    if age >= 0:
+        out.append(ssf_samples.gauge(
+            "veneur.ha.replication_age_seconds", float(age), None))
+    elector = getattr(server, "lease_elector", None)
+    if elector is not None:
+        out.append(ssf_samples.count(
+            "veneur.ha.lease_acquires_total",
+            float(_delta_since(elector, "_last_acquires",
+                               elector.acquires_total)), None))
+        out.append(ssf_samples.count(
+            "veneur.ha.lease_demotions_total",
+            float(_delta_since(elector, "_last_demotions",
+                               elector.demotions_total)), None))
+        out.append(ssf_samples.count(
+            "veneur.ha.lease_renew_failures_total",
+            float(_delta_since(elector, "_last_renew_failures",
+                               elector.renew_failures_total)), None))
+    for dest, gauge in sby.breakers.states():
         out.append(ssf_samples.gauge(
             "veneur.breaker.state", gauge, {"destination": dest}))
     return out

@@ -153,6 +153,31 @@ class GRPCForwarder:
         grpc.StatusCode.UNKNOWN,
     ))
 
+    def retarget(self, addr: str) -> None:
+        """Re-dial a new destination — the membership-refresh hook a
+        :class:`~veneur_tpu.discovery.LeaderDiscoverer` consumer uses
+        to chase a promoted standby. The swap is atomic under the
+        counter lock; the old channel closes after (an in-flight RPC
+        it cancels fails into the ordinary retry/error accounting)."""
+        if addr.startswith(("http://", "grpc://")):
+            addr = addr.split("://", 1)[1]
+        if addr == self.addr:
+            return
+        channel = grpc.insecure_channel(
+            addr,
+            options=[("grpc.max_receive_message_length", _MAX_MESSAGE),
+                     ("grpc.max_send_message_length", _MAX_MESSAGE)])
+        send = channel.unary_unary(
+            _METHOD,
+            request_serializer=lambda b: b,
+            response_deserializer=empty_pb2.Empty.FromString,
+        )
+        with self._lock:
+            old, self._channel = self._channel, channel
+            self._send_raw = send
+            self.addr = addr
+        old.close()
+
     def _retryable_rpc(self, e) -> bool:
         code = e.code() if isinstance(e, grpc.RpcError) else None
         return code in self._RETRYABLE_CODES or isinstance(e, OSError)

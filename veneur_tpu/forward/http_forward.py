@@ -99,6 +99,19 @@ class HTTPForwarder:
         self.post_durations: List[float] = []
         self.post_content_lengths: List[int] = []
 
+    def retarget(self, addr: str) -> None:
+        """Re-point at a new destination — the membership-refresh hook
+        a :class:`~veneur_tpu.discovery.LeaderDiscoverer` consumer uses
+        to chase a promoted standby (docs/resilience.md "Global HA").
+        Takes effect on the next forward; an in-flight POST finishes
+        against the old target and, on failure, rides the ordinary
+        retry ladder at the NEW one next interval."""
+        base = addr.rstrip("/")
+        if not base.startswith(("http://", "https://")):
+            base = "http://" + base
+        with self._lock:
+            self.base = base
+
     def _count_retry(self, retry_index, exc, pause):
         with self._lock:
             self.retries += 1

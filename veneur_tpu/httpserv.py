@@ -282,12 +282,23 @@ class ImportQueuePool:
 
 
 class ReuseportHTTPServer(ThreadingHTTPServer):
-    """ThreadingHTTPServer that binds with SO_REUSEPORT so a SIGUSR2
-    upgrade (cli/upgrade.py) or rolling restart can run two generations
-    on the same port — the role einhorn socket inheritance plays for
-    the reference (server.go:1048-1076)."""
+    """ThreadingHTTPServer that binds with SO_REUSEPORT (and
+    SO_REUSEADDR) so a SIGUSR2 upgrade (cli/upgrade.py), a rolling
+    restart, or a SIGKILL-then-respawn on the same port can run two
+    generations side by side — the role einhorn socket inheritance
+    plays for the reference (server.go:1048-1076).
+
+    The bind itself retries through a bounded window: a SIGKILLed
+    predecessor's listener can linger in late-close states for a few
+    milliseconds, and a supervisor respawning onto the same fixed port
+    (the soak ``ProcessFleet``, any restart storm) must not flap on
+    that transient EADDRINUSE."""
+
+    BIND_ATTEMPTS = 20
+    BIND_RETRY_PAUSE_S = 0.05
 
     def server_bind(self):
+        self.socket.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         if hasattr(socket, "SO_REUSEPORT"):
             self.socket.setsockopt(socket.SOL_SOCKET,
                                    socket.SO_REUSEPORT, 1)
@@ -296,7 +307,20 @@ class ReuseportHTTPServer(ThreadingHTTPServer):
             host, port = self.server_address[:2]
             warn_if_port_already_served(self.address_family,
                                         socket.SOCK_STREAM, host, port)
-        super().server_bind()
+        for attempt in range(self.BIND_ATTEMPTS):
+            try:
+                return super().server_bind()
+            except OSError as e:
+                import errno
+
+                if (e.errno != errno.EADDRINUSE
+                        or attempt == self.BIND_ATTEMPTS - 1):
+                    raise
+                log.warning(
+                    "bind to %s transiently refused (%s); retry %d/%d",
+                    self.server_address, e, attempt + 1,
+                    self.BIND_ATTEMPTS)
+                time.sleep(self.BIND_RETRY_PAUSE_S)
 
 
 class OpsServer:

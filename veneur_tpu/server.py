@@ -460,6 +460,40 @@ class Server:
 
             self.handoff_manager = HandoffManager.for_server(self)
 
+        # global HA: warm-standby replication + leased failover
+        # (fleet/standby.py, discovery/lease.py, docs/resilience.md
+        # "Global HA"). The standby manager exists whenever either side
+        # of the plane is configured: standby_peers (this instance
+        # replicates out) or lease_path (this instance contends for
+        # leadership / receives replication).
+        self.standby_manager = None
+        self.lease_elector = None
+        if config.standby_peers or config.lease_path:
+            if config.forward_address:
+                # mirrors config.validate for directly-built Configs
+                raise ValueError(
+                    "standby_peers/lease_path require a GLOBAL "
+                    "instance, but forward_address is set "
+                    "(config.validate rejects this combination at load)")
+            from veneur_tpu.fleet.standby import StandbyManager
+
+            self.standby_manager = StandbyManager.for_server(self)
+            if config.lease_path:
+                from veneur_tpu.discovery import (LeaseElector,
+                                                  lease_backend_from_url)
+
+                backend = lease_backend_from_url(config.lease_path)
+                self.lease_elector = LeaseElector(
+                    backend,
+                    holder=config.handoff_self or config.http_address,
+                    ttl=config.lease_ttl_seconds,
+                    renew_interval=config.lease_renew_interval_seconds,
+                    on_promote=self.standby_manager.on_promote,
+                    on_demote=self.standby_manager.on_demote)
+            else:
+                # no election configured: replicate unconditionally
+                self.standby_manager.is_leader = True
+
         # ingest error/telemetry counters. packet_errors/spans_dropped
         # are SHARDED (veneur_tpu/ingest/counters.py): the hot paths —
         # every reader thread on every bad packet, every span shed —
@@ -771,6 +805,15 @@ class Server:
                         body, headers=headers))
                 self.ops_server.add_route("/handoff-status",
                                           mgr.status_route)
+            if self.standby_manager is not None:
+                # the standby half: the active's retired flush
+                # snapshots shadow here until promotion merges them
+                sby = self.standby_manager
+                self.ops_server.add_post_route(
+                    "/replicate",
+                    lambda headers, body: sby.handle_replicate(
+                        body, headers=headers))
+                self.ops_server.add_route("/ha-status", sby.status_route)
             self.ops_server.start()
         # gRPC import ingest (server.go:536-546, importsrv/)
         if cfg.grpc_address:
@@ -800,6 +843,20 @@ class Server:
                 name="handoff-refresh", daemon=True)
             self._handoff_thread.start()
             self._threads.append(self._handoff_thread)
+        if self.standby_manager is not None:
+            self._replicator_thread = threading.Thread(
+                target=self._guard(
+                    lambda: self.standby_manager.run(self._stop)),
+                name="ha-replicator", daemon=True)
+            self._replicator_thread.start()
+            self._threads.append(self._replicator_thread)
+        if self.lease_elector is not None:
+            self._elector_thread = threading.Thread(
+                target=self._guard(
+                    lambda: self.lease_elector.run(self._stop)),
+                name="lease-elector", daemon=True)
+            self._elector_thread.start()
+            self._threads.append(self._elector_thread)
         self._flush_thread = threading.Thread(
             target=self._guard(self._flush_loop), name="flush-ticker",
             daemon=True)
@@ -1179,6 +1236,15 @@ class Server:
         if mgr is not None and mgr.last_spool_error:
             out.append(f"handoff spool writes failing "
                        f"({mgr.last_spool_error})")
+        # HA replication failing means the standby's takeover window is
+        # widening past one flush interval — degraded, not unready (the
+        # active still aggregates and flushes)
+        sby = self.standby_manager
+        if sby is not None and sby.is_leader and sby.last_error:
+            out.append(f"standby replication failing ({sby.last_error})")
+        elector = self.lease_elector
+        if elector is not None and elector.last_error:
+            out.append(f"lease renewal failing ({elector.last_error})")
         return out
 
     # keys whose change a live reload cannot honor: sockets stay bound
@@ -1206,6 +1272,12 @@ class Server:
                       # construction (its thread is already running)
                       "checkpoint_path", "checkpoint_interval",
                       "checkpoint_max_age_intervals",
+                      # the standby manager and lease elector bind their
+                      # peers/backend at construction (threads running);
+                      # a file:// standby_peers list IS live-reloadable
+                      # through the file itself
+                      "standby_peers", "standby_shadow_epochs",
+                      "lease_path", "lease_ttl", "lease_renew_interval",
                       # overload plumbing is stamped onto live groups and
                       # the attached controller at construction
                       "max_series", "max_tag_length",
@@ -1371,6 +1443,15 @@ class Server:
                     not self.handoff_manager.quiesce(timeout=30.0):
                 log.warning("handoff still in flight at shutdown; its "
                             "spool will recover on the next start")
+        # hand the lease back BEFORE the final flush: a standby promotes
+        # on its next poll instead of waiting out the ttl (a CRASH skips
+        # this by definition — crash_stop never releases)
+        for t in (getattr(self, "_elector_thread", None),
+                  getattr(self, "_replicator_thread", None)):
+            if t is not None:
+                t.join(timeout=10.0)
+        if self.lease_elector is not None:
+            self.lease_elector.release()
         try:
             self.flush()
         except Exception:
@@ -1430,8 +1511,12 @@ class Server:
             except Exception:
                 log.exception("ingest fleet shutdown failed in "
                               "crash_stop")
+        # the lease is deliberately NOT released: a crash must make the
+        # standby wait out the ttl, exactly like a real SIGKILL
         for t in (self._flush_thread, self._ckpt_thread,
-                  getattr(self, "_handoff_thread", None)):
+                  getattr(self, "_handoff_thread", None),
+                  getattr(self, "_replicator_thread", None),
+                  getattr(self, "_elector_thread", None)):
             if t is not None:
                 t.join(timeout=10.0)
         if self.ops_server is not None:

@@ -16,12 +16,14 @@ from veneur_tpu.soak.monitor import IntervalSample, SteadyStateMonitor
 from veneur_tpu.soak.orchestrator import (ChaosPost, FleetSpec,
                                           InProcessFleet, ProcessFleet,
                                           SoakReport, run_soak)
-from veneur_tpu.soak.scenario import (FaultWindow, GateThresholds,
-                                      SoakScenario)
+from veneur_tpu.soak.scenario import (KIND_KILL_FOREVER,
+                                      KIND_KILL_RESTART, FaultWindow,
+                                      GateThresholds, SoakScenario)
 
 __all__ = [
     "ChaosPost", "FaultWindow", "FleetSpec", "GateResult",
-    "GateThresholds", "InProcessFleet", "IntervalSample", "ProcessFleet",
+    "GateThresholds", "InProcessFleet", "IntervalSample",
+    "KIND_KILL_FOREVER", "KIND_KILL_RESTART", "ProcessFleet",
     "SoakGateError", "SoakLedger", "SoakReport", "SoakScenario",
     "SteadyStateMonitor", "enforce", "gate_vector", "run_gates",
     "run_soak",

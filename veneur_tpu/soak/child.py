@@ -1,7 +1,7 @@
 """One soak fleet role as a real OS process.
 
 ``python -m veneur_tpu.soak.child <role> <spec.json>`` boots the role
-(local | proxy | global) from the shared
+(local | proxy | global | standby) from the shared
 :class:`~veneur_tpu.soak.orchestrator.FleetSpec`, prints one READY
 JSON line on stdout, then serves the driver's line protocol: one
 command per stdin line, exactly one JSON ack per command on stdout
@@ -13,7 +13,10 @@ Commands: ``flush`` (driven interval; global acks its emitted ledger
 value and steady-state sample), ``ckpt`` (checkpoint commit, retried
 through injected ENOSPC), ``processed`` / ``imported`` (settle
 reads), ``mode <m>`` (sink outage mode, global only), ``counters``
-(monotone generation counters, read before a kill), ``quit``."""
+(monotone generation counters, read before a kill), ``hastatus``
+(the StandbyManager snapshot — lease/replication state, global and
+standby roles), ``ring`` (the proxy's live destination list, read by
+the driver's re-route wait), ``quit``."""
 
 from __future__ import annotations
 
@@ -55,6 +58,9 @@ def _serve(role: str, spec_path: str) -> int:
         server, sink = build_local_server(spec)
     elif role == "global":
         server, sink, dd, offered = build_global_server(spec, chaos)
+    elif role == "standby":
+        server, sink, dd, offered = build_global_server(
+            spec, chaos, role="standby")
     elif role == "proxy":
         proxy = build_proxy(spec)
     else:
@@ -72,7 +78,7 @@ def _serve(role: str, spec_path: str) -> int:
                 break
             elif cmd == "flush" and server is not None:
                 server.flush()
-                if role == "global":
+                if role in ("global", "standby"):
                     emitted = drain_channel(sink, GLOBAL_PREFIX)
                     sample = global_sample_fields(server, dd)
                     sample["rss_kb"] = read_rss_kb()
@@ -88,16 +94,21 @@ def _serve(role: str, spec_path: str) -> int:
                 ack({"v": server.store.processed})
             elif cmd == "imported" and server is not None:
                 ack({"v": server.store.imported})
-            elif cmd.startswith("mode ") and role == "global":
+            elif cmd.startswith("mode ") and role in ("global", "standby"):
                 chaos.mode = cmd.split(None, 1)[1]
                 ack({"ok": True, "mode": chaos.mode})
             elif cmd == "counters":
-                if role == "global":
+                if role in ("global", "standby"):
                     ack({"counters": global_counters(server, dd, offered)})
                 elif role == "local":
                     ack({"counters": local_counters(server)})
                 else:
                     ack({"counters": {}})
+            elif cmd == "hastatus":
+                sby = getattr(server, "standby_manager", None)
+                ack({"ha": sby.snapshot() if sby is not None else {}})
+            elif cmd == "ring" and proxy is not None:
+                ack({"members": list(proxy.ring.members())})
             else:
                 ack({"ok": False, "error": f"bad command {cmd!r}"})
         except Exception as e:  # the ack keeps the protocol in sync
@@ -117,7 +128,7 @@ def _serve(role: str, spec_path: str) -> int:
 def main(argv) -> int:
     if len(argv) != 3:
         print("usage: python -m veneur_tpu.soak.child "
-              "<local|proxy|global> <spec.json>", file=sys.stderr)
+              "<local|proxy|global|standby> <spec.json>", file=sys.stderr)
         return 2
     return _serve(argv[1], argv[2])
 

@@ -12,8 +12,10 @@ gate                invariant
 ==================  ====================================================
 conservation_global sent global-only counter value == value emitted by
                     the global's accounting sink + shed + quarantined
-                    (exact, across every kill/restart via checkpoint
-                    epochs)
+                    + accounted_lost (exact, across every kill/restart
+                    via checkpoint epochs; ``accounted_lost`` is only
+                    ever non-zero in a kill_forever scenario — the
+                    active's un-flushed tail, measured at the kill)
 conservation_local  same for local-only counters at the local instance
 dd_rows_conserved   every Datadog emission row is acked, parked
                     (pending), dropped counted, or crash-lost counted —
@@ -26,6 +28,11 @@ e2e_age_p99         p99 of veneur.fleet.e2e_age_ns ≤ threshold
 recovery            final samples: overload level 0, breaker closed,
                     requeue drained, nothing pending, no degradations
 requeue_bounded     max parked sink bytes ≤ the configured budget
+takeover            kill_forever only: the standby promoted, held the
+                    lease within ``takeover_detect_max_s`` of the
+                    active's SIGKILL, and the accounted loss is
+                    bounded by the un-replicated tail (≤ 1 flush
+                    interval's sent value)
 ==================  ====================================================
 """
 
@@ -35,7 +42,7 @@ from dataclasses import dataclass, field
 from typing import Dict, List
 
 from veneur_tpu.soak.monitor import SteadyStateMonitor
-from veneur_tpu.soak.scenario import SoakScenario
+from veneur_tpu.soak.scenario import KIND_KILL_FOREVER, SoakScenario
 
 
 @dataclass
@@ -61,6 +68,15 @@ class SoakLedger:
     spool_errors: int = 0       # handoff spool writes the disk refused
     ckpt_retries: int = 0       # kill-time checkpoint attempts past one
     restarts: Dict[str, int] = field(default_factory=dict)
+    # kill_forever (HA takeover) accounting — all stay zero/-1 in a
+    # kill_restart run. accounted_lost is the active's un-flushed tail
+    # at the SIGKILL, measured exactly from the settled ledger;
+    # takeover_loss_bound is the ≤-1-interval bound it must respect.
+    accounted_lost: int = 0
+    takeover_loss_bound: int = 0
+    promotions: int = 0              # standby promotions observed
+    takeover_detect_s: float = -1.0  # SIGKILL → standby holds the lease
+    takeover_first_flush_s: float = -1.0  # SIGKILL → first good flush
 
     def restart_total(self) -> int:
         return sum(self.restarts.values())
@@ -85,12 +101,17 @@ def run_gates(scenario: SoakScenario, monitor: SteadyStateMonitor,
     thr = scenario.thresholds
     out: List[GateResult] = []
 
-    want = ledger.emitted_global + ledger.shed + ledger.quarantined
+    # accounted_lost folds EXPLICITLY: a kill_forever run loses the
+    # active's un-flushed tail by design, and conservation stays exact
+    # only because that loss is measured and named, never shrugged
+    want = (ledger.emitted_global + ledger.shed + ledger.quarantined
+            + ledger.accounted_lost)
     out.append(GateResult(
         "conservation_global", ledger.sent_global == want,
         ledger.sent_global, want,
         f"sent={ledger.sent_global} emitted={ledger.emitted_global} "
         f"shed={ledger.shed} quarantined={ledger.quarantined} "
+        f"accounted_lost={ledger.accounted_lost} "
         f"restarts={ledger.restart_total()}"))
 
     out.append(GateResult(
@@ -160,6 +181,22 @@ def run_gates(scenario: SoakScenario, monitor: SteadyStateMonitor,
     out.append(GateResult(
         "requeue_bounded", mx <= thr.requeue_max_bytes,
         mx, thr.requeue_max_bytes, "max parked sink bytes ever sampled"))
+
+    if scenario.kind == KIND_KILL_FOREVER:
+        promoted = ledger.promotions >= 1
+        detected = (0.0 <= ledger.takeover_detect_s
+                    <= thr.takeover_detect_max_s)
+        bounded = ledger.accounted_lost <= ledger.takeover_loss_bound
+        out.append(GateResult(
+            "takeover", promoted and detected and bounded,
+            {"detect_s": round(ledger.takeover_detect_s, 3),
+             "first_flush_s": round(ledger.takeover_first_flush_s, 3),
+             "accounted_lost": ledger.accounted_lost,
+             "promotions": ledger.promotions},
+            {"detect_max_s": thr.takeover_detect_max_s,
+             "loss_bound": ledger.takeover_loss_bound},
+            "standby promoted, lease held within the detect bound, "
+            "loss ≤ the un-replicated tail (1 flush interval)"))
     return out
 
 

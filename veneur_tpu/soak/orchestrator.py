@@ -43,9 +43,10 @@ from veneur_tpu.soak.gates import (GateResult, SoakLedger, enforce,
                                    gate_vector, run_gates)
 from veneur_tpu.soak.monitor import (IntervalSample, SteadyStateMonitor,
                                      read_rss_kb)
-from veneur_tpu.soak.scenario import (MODE_BLACKHOLE, MODE_HTTP_5XX,
-                                      MODE_OK, MODE_SLOW, ROLE_GLOBAL,
-                                      ROLE_LOCAL, ROLE_PROXY, SoakScenario)
+from veneur_tpu.soak.scenario import (KIND_KILL_FOREVER, MODE_BLACKHOLE,
+                                      MODE_HTTP_5XX, MODE_OK, MODE_SLOW,
+                                      ROLE_GLOBAL, ROLE_LOCAL, ROLE_PROXY,
+                                      ROLE_STANDBY, SoakScenario)
 
 log = logging.getLogger("veneur.soak")
 
@@ -105,6 +106,10 @@ class FleetSpec:
     seed: int
     requeue_max_bytes: int
     breaker_reset_s: float = 0.75
+    # HA (kill_forever scenarios): a warm-standby global on its own
+    # port plus a file:// lease; lease_ttl_s == 0 means HA off
+    standby_port: int = 0
+    lease_ttl_s: float = 0.0
 
     def to_json(self) -> dict:
         return dict(self.__dict__)
@@ -115,13 +120,16 @@ class FleetSpec:
 
     @classmethod
     def for_scenario(cls, scenario: SoakScenario, root: str) -> "FleetSpec":
+        ha = scenario.kind == KIND_KILL_FOREVER
         return cls(root=root, udp_port=pick_port(),
                    proxy_port=pick_port(socket.SOCK_STREAM),
                    global_port=pick_port(socket.SOCK_STREAM),
                    fault_rate=scenario.fault_rate,
                    fault_kinds=scenario.fault_kinds,
                    seed=scenario.seed,
-                   requeue_max_bytes=scenario.thresholds.requeue_max_bytes)
+                   requeue_max_bytes=scenario.thresholds.requeue_max_bytes,
+                   standby_port=pick_port(socket.SOCK_STREAM) if ha else 0,
+                   lease_ttl_s=1.5 if ha else 0.0)
 
 
 # -- role construction (shared by InProcessFleet and soak.child) -----------
@@ -150,11 +158,14 @@ def build_local_server(spec: FleetSpec):
     return server, sink
 
 
-def build_global_server(spec: FleetSpec, chaos_post: ChaosPost):
+def build_global_server(spec: FleetSpec, chaos_post: ChaosPost,
+                        role: str = ROLE_GLOBAL):
     """The global role: /import ingest on the fixed ops port, handoff
     plane armed over the peers file, checkpointed, channel sink for
     exact value accounting + Datadog streamed egress through the
-    scenario's :class:`ChaosPost`. Returns
+    scenario's :class:`ChaosPost`. ``role`` may be ``standby`` (HA
+    scenarios): same shape on ``spec.standby_port``, contending for
+    the shared file lease but replicating to nobody. Returns
     ``(server, channel_sink, dd_sink, offered_counter)`` where
     ``offered_counter`` is a one-slot list counting rows offered to
     the chunk path this generation."""
@@ -164,23 +175,42 @@ def build_global_server(spec: FleetSpec, chaos_post: ChaosPost):
     from veneur_tpu.sinks import ChannelMetricSink
     from veneur_tpu.sinks.datadog import DatadogMetricSink
 
-    peers = f"{spec.root}/peers.txt"
+    port = spec.standby_port if role == ROLE_STANDBY else spec.global_port
+    self_addr = f"http://127.0.0.1:{port}"
+    # each global life gets its OWN handoff ring (itself); the proxy's
+    # routing is lease-driven in HA mode, peers.txt-driven otherwise
+    peers = (f"{spec.root}/standby_peers.txt" if role == ROLE_STANDBY
+             else f"{spec.root}/peers.txt")
     with open(peers, "w") as f:
-        f.write(f"http://127.0.0.1:{spec.global_port}\n")
+        f.write(self_addr + "\n")
+    ha_keys = {}
+    if spec.lease_ttl_s > 0:
+        ha_keys = dict(
+            lease_path=f"file://{spec.root}/lease",
+            lease_ttl=f"{spec.lease_ttl_s}s",
+            lease_renew_interval=f"{spec.lease_ttl_s / 3.0:.3f}s")
+        if role == ROLE_GLOBAL:
+            # the active streams its retired flush epochs to the
+            # standby; the standby replicates to nobody (its shadow is
+            # the receiving end)
+            ha_keys["standby_peers"] = \
+                f"http://127.0.0.1:{spec.standby_port}"
     cfg = Config(
         statsd_listen_addresses=[], interval="86400s",
-        http_address=f"127.0.0.1:{spec.global_port}",
+        http_address=f"127.0.0.1:{port}",
         aggregates=["count"], percentiles=[0.5],
         store_initial_capacity=64, store_chunk=128,
-        checkpoint_path=f"{spec.root}/global.ckpt",
+        checkpoint_path=f"{spec.root}/{role}.ckpt",
         checkpoint_interval="3600s",
         handoff_enabled=True,
-        handoff_self=f"http://127.0.0.1:{spec.global_port}",
+        handoff_self=self_addr,
         handoff_peers=f"file://{peers}",
         fault_injection_rate=spec.fault_rate,
-        fault_injection_seed=spec.seed + 2,
+        fault_injection_seed=spec.seed + (2 if role == ROLE_GLOBAL
+                                          else 4),
         fault_injection_kinds=spec.fault_kinds,
-        sink_requeue_max_bytes=spec.requeue_max_bytes)
+        sink_requeue_max_bytes=spec.requeue_max_bytes,
+        **ha_keys)
     channel = ChannelMetricSink()
     dd = DatadogMetricSink(
         interval=10.0, flush_max_per_body=100, hostname="soak-global",
@@ -205,18 +235,34 @@ def build_global_server(spec: FleetSpec, chaos_post: ChaosPost):
 
 
 def build_proxy(spec: FleetSpec):
-    """The proxy role: HTTP /import fan-out over the peers-file ring."""
+    """The proxy role: HTTP /import fan-out over the peers-file ring —
+    or, in HA mode, over the lease (:class:`LeaderDiscoverer`: the
+    holder IS the membership, so a takeover re-routes the fan-out
+    within one ordinary refresh, no new routing machinery)."""
     from veneur_tpu.config import ProxyConfig
-    from veneur_tpu.discovery import FilePeersDiscoverer
     from veneur_tpu.proxy.proxy import Proxy
 
-    peers = f"{spec.root}/peers.txt"
-    with open(peers, "w") as f:
-        f.write(f"http://127.0.0.1:{spec.global_port}\n")
-    proxy = Proxy(
-        ProxyConfig(http_address=f"127.0.0.1:{spec.proxy_port}",
-                    forward_timeout="5s"),
-        discoverer=FilePeersDiscoverer(peers))
+    if spec.lease_ttl_s > 0:
+        from veneur_tpu.discovery import (LeaderDiscoverer,
+                                          lease_backend_from_url)
+
+        disc = LeaderDiscoverer(
+            lease_backend_from_url(f"file://{spec.root}/lease"))
+        # chase a lease transition quickly: the refresh cadence bounds
+        # detect→re-route, and the active already holds at proxy boot
+        cfg = ProxyConfig(http_address=f"127.0.0.1:{spec.proxy_port}",
+                          forward_timeout="5s",
+                          consul_refresh_interval="250ms")
+    else:
+        from veneur_tpu.discovery import FilePeersDiscoverer
+
+        peers = f"{spec.root}/peers.txt"
+        with open(peers, "w") as f:
+            f.write(f"http://127.0.0.1:{spec.global_port}\n")
+        disc = FilePeersDiscoverer(peers)
+        cfg = ProxyConfig(http_address=f"127.0.0.1:{spec.proxy_port}",
+                          forward_timeout="5s")
+    proxy = Proxy(cfg, discoverer=disc)
     proxy.start()
     return proxy
 
@@ -311,8 +357,9 @@ def checkpoint_with_retry(server, attempts: int = 400,
 # -- the in-process fleet ---------------------------------------------------
 
 class InProcessFleet:
-    """All three roles in this process. Kills use
-    ``Server.crash_stop()`` — the in-process SIGKILL twin."""
+    """All three roles in this process (plus the warm standby in HA
+    scenarios). Kills use ``Server.crash_stop()`` — the in-process
+    SIGKILL twin (no final flush, no checkpoint, no lease release)."""
 
     def __init__(self, scenario: SoakScenario, root: str):
         self.spec = FleetSpec.for_scenario(scenario, root)
@@ -322,20 +369,41 @@ class InProcessFleet:
         self.glob = self.g_channel = self.g_dd = None
         self._g_offered = [0]
         self.proxy = None
+        self.sby = self.s_channel = self.s_dd = None
+        self._s_offered = [0]
 
     def start(self) -> None:
         self.glob, self.g_channel, self.g_dd, self._g_offered = \
             build_global_server(self.spec, self.chaos)
+        if self.spec.lease_ttl_s > 0:
+            # the active must hold the lease before the standby's
+            # elector (or the proxy's first refresh) can observe it —
+            # boot order is the determinism of who is active
+            self._wait_leader()
+            self.s_chaos = ChaosPost()
+            self.sby, self.s_channel, self.s_dd, self._s_offered = \
+                build_global_server(self.spec, self.s_chaos,
+                                    role=ROLE_STANDBY)
         self.proxy = build_proxy(self.spec)
         self.local, self.local_sink = build_local_server(self.spec)
         self._sender = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
         self._sender.connect(("127.0.0.1", self.spec.udp_port))
+
+    def _wait_leader(self, timeout_s: float = 30.0) -> None:
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            sm = getattr(self.glob, "standby_manager", None)
+            if sm is not None and sm.is_leader:
+                return
+            time.sleep(0.02)
+        raise RuntimeError("active global never acquired the boot lease")
 
     def stop(self) -> None:
         for closer in (
                 lambda: self._sender and self._sender.close(),
                 lambda: self.local and self.local.shutdown(),
                 lambda: self.proxy and self.proxy.shutdown(),
+                lambda: self.sby and self.sby.shutdown(),
                 lambda: self.glob and self.glob.shutdown()):
             try:
                 closer()
@@ -394,6 +462,34 @@ class InProcessFleet:
             except Exception:
                 pass
             self.proxy = build_proxy(self.spec)
+
+    # -- HA takeover (kill_forever scenarios) --------------------------------
+
+    def ha_status(self) -> dict:
+        server = self.sby if self.sby is not None else self.glob
+        sm = getattr(server, "standby_manager", None)
+        return sm.snapshot() if sm is not None else {}
+
+    def kill_forever(self) -> None:
+        """SIGKILL-twin the active with NO restart: the standby becomes
+        the fleet's global (its lease poll promotes it; the driver's
+        view swaps immediately so flush/counters target the survivor)."""
+        self.glob.crash_stop()
+        self.glob, self.g_channel, self.g_dd, self._g_offered = \
+            (self.sby, self.s_channel, self.s_dd, self._s_offered)
+        self.chaos = self.s_chaos
+        self.sby = self.s_channel = self.s_dd = None
+
+    def await_reroute(self, timeout_s: float = 15.0) -> bool:
+        """Wait for the proxy ring to chase the lease onto the promoted
+        standby (one ordinary membership refresh)."""
+        want = [f"http://127.0.0.1:{self.spec.standby_port}"]
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            if list(self.proxy.ring.members()) == want:
+                return True
+            time.sleep(0.05)
+        return False
 
 
 # -- the multi-process fleet ------------------------------------------------
@@ -483,12 +579,29 @@ class ProcessFleet:
         self._mode = MODE_OK
 
     def start(self) -> None:
-        for role in (ROLE_GLOBAL, ROLE_PROXY, ROLE_LOCAL):
+        ha = self.spec.lease_ttl_s > 0
+        roles = ((ROLE_GLOBAL, ROLE_STANDBY, ROLE_PROXY, ROLE_LOCAL)
+                 if ha else (ROLE_GLOBAL, ROLE_PROXY, ROLE_LOCAL))
+        for role in roles:
             child = _Child(role, self.spec)
             child.spawn()
             self.children[role] = child
+            if ha and role == ROLE_GLOBAL:
+                # boot order is the determinism of who is active: the
+                # first global must hold the lease before the standby
+                # (or the proxy's fatal-on-empty first refresh) looks
+                self._wait_leader(child)
         self._sender = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
         self._sender.connect(("127.0.0.1", self.spec.udp_port))
+
+    @staticmethod
+    def _wait_leader(child: "_Child", timeout_s: float = 30.0) -> None:
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            if child.command("hastatus").get("ha", {}).get("is_leader"):
+                return
+            time.sleep(0.05)
+        raise RuntimeError("active global never acquired the boot lease")
 
     def stop(self) -> None:
         if self._sender is not None:
@@ -541,6 +654,33 @@ class ProcessFleet:
         if role == ROLE_GLOBAL and self._mode != MODE_OK:
             # the outage window outlives the process it was imposed on
             child.command(f"mode {self._mode}")
+
+    # -- HA takeover (kill_forever scenarios) --------------------------------
+
+    def ha_status(self) -> dict:
+        child = self.children.get(ROLE_STANDBY) \
+            or self.children[ROLE_GLOBAL]
+        return child.command("hastatus").get("ha", {})
+
+    def kill_forever(self) -> None:
+        """Real SIGKILL of the active global, NO respawn: the standby
+        child becomes the fleet's global for every later command."""
+        self.children[ROLE_GLOBAL].kill()
+        self.children[ROLE_GLOBAL] = self.children.pop(ROLE_STANDBY)
+
+    def await_reroute(self, timeout_s: float = 15.0) -> bool:
+        want = [f"http://127.0.0.1:{self.spec.standby_port}"]
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            try:
+                members = self.children[ROLE_PROXY].command(
+                    "ring").get("members")
+            except Exception:
+                members = None
+            if members == want:
+                return True
+            time.sleep(0.1)
+        return False
 
 
 # -- the driver -------------------------------------------------------------
@@ -620,6 +760,57 @@ def _fold(ledger: SoakLedger, counters: Dict[str, int],
         ledger.dd_pending += pending
 
 
+def _takeover(scenario: SoakScenario, fleet, ledger: SoakLedger,
+              idx: int, sent_c: int,
+              say: Callable[[str], None]) -> Tuple[float, dict]:
+    """The kill_forever pivot. The interval's traffic is settled into
+    the active but deliberately NOT flushed — that un-flushed tail is
+    the bounded, accounted loss. Wait until replication is current
+    (every PRIOR interval's flush reached the standby), measure the
+    exact loss from the settled ledger, SIGKILL the active with no
+    restart, time the standby's lease takeover, wait for the proxy to
+    re-route, and take the first good flush from the survivor."""
+    thr = scenario.thresholds
+    # replication currency: the active flushed (and so replicated)
+    # once per prior interval; insist the standby has received them
+    # all, so the loss stays bounded by THIS interval's tail
+    deadline = time.monotonic() + 10.0
+    while (fleet.ha_status().get("receives_total", 0) < idx
+           and time.monotonic() < deadline):
+        time.sleep(0.05)
+    # fold the active's monotone counters now — it dies next, and its
+    # parked sink rows die with it (crash fold)
+    _fold(ledger, fleet.counters(ROLE_GLOBAL), crash=True)
+    # PEEK the local's shed/quarantine tallies without folding (the
+    # end-of-run fold still owns them): accounted_lost must exclude
+    # value the pipeline already accounted upstream of the active
+    lc = fleet.counters(ROLE_LOCAL)
+    ledger.accounted_lost = int(round(
+        ledger.sent_global - ledger.emitted_global - ledger.shed
+        - ledger.quarantined - lc.get("shed", 0)
+        - lc.get("quarantined", 0)))
+    ledger.takeover_loss_bound = sent_c
+    t_kill = time.monotonic()
+    fleet.kill_forever()
+    say(f"interval {idx}: SIGKILL active global, no restart "
+        f"(un-flushed tail value {ledger.accounted_lost})")
+    deadline = time.monotonic() + thr.takeover_detect_max_s + 5.0
+    st = fleet.ha_status()
+    while not st.get("is_leader") and time.monotonic() < deadline:
+        time.sleep(0.05)
+        st = fleet.ha_status()
+    if st.get("is_leader"):
+        ledger.takeover_detect_s = time.monotonic() - t_kill
+    ledger.promotions = 1 if st.get("promoted") else 0
+    fleet.await_reroute()
+    emitted, sample = fleet.flush_global()
+    ledger.takeover_first_flush_s = time.monotonic() - t_kill
+    say(f"interval {idx}: standby promoted in "
+        f"{ledger.takeover_detect_s:.2f}s, first flush at "
+        f"+{ledger.takeover_first_flush_s:.2f}s")
+    return emitted, sample
+
+
 def run_soak(scenario: SoakScenario, fleet,
              enforce_gates: bool = True,
              progress: Optional[Callable[[str], None]] = None
@@ -639,16 +830,20 @@ def run_soak(scenario: SoakScenario, fleet,
     fleet.start()
     try:
         for idx in range(scenario.intervals):
-            for role in scenario.kills_at(idx):
-                attempts = fleet.checkpoint(role)
-                ledger.ckpt_retries += max(0, attempts - 1)
-                _fold(ledger, fleet.counters(role), crash=True)
-                fleet.kill_restart(role)
-                ledger.restarts[role] = ledger.restarts.get(role, 0) + 1
-                if role == ROLE_GLOBAL:
-                    generation += 1
-                say(f"interval {idx}: killed+restarted {role} "
-                    f"(checkpoint attempts={attempts})")
+            takeover = (scenario.kind == KIND_KILL_FOREVER
+                        and ROLE_GLOBAL in scenario.kills_at(idx))
+            if not takeover:
+                for role in scenario.kills_at(idx):
+                    attempts = fleet.checkpoint(role)
+                    ledger.ckpt_retries += max(0, attempts - 1)
+                    _fold(ledger, fleet.counters(role), crash=True)
+                    fleet.kill_restart(role)
+                    ledger.restarts[role] = \
+                        ledger.restarts.get(role, 0) + 1
+                    if role == ROLE_GLOBAL:
+                        generation += 1
+                    say(f"interval {idx}: killed+restarted {role} "
+                        f"(checkpoint attempts={attempts})")
             mode = scenario.sink_mode(idx)
             fleet.set_sink_mode(mode)
             lines, sent_c, sent_l, n_series = interval_traffic(
@@ -661,7 +856,12 @@ def run_soak(scenario: SoakScenario, fleet,
             i0 = fleet.global_imported()
             ledger.emitted_local += fleet.flush_local()
             _settle(fleet.global_imported, i0 + n_series)
-            emitted, sample = fleet.flush_global()
+            if takeover:
+                emitted, sample = _takeover(scenario, fleet, ledger,
+                                            idx, sent_c, say)
+                generation += 1  # the standby is a different process
+            else:
+                emitted, sample = fleet.flush_global()
             ledger.emitted_global += emitted
             monitor.add(IntervalSample(idx=idx, generation=generation,
                                        **sample))

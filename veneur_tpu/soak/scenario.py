@@ -44,6 +44,17 @@ ROLE_GLOBAL = "global"
 ROLE_LOCAL = "local"
 ROLE_PROXY = "proxy"
 KILL_CYCLE = (ROLE_GLOBAL, ROLE_LOCAL, ROLE_PROXY)
+# the warm-standby global (fleet/standby.py): only present in
+# kill_forever scenarios, promoted when the active dies
+ROLE_STANDBY = "standby"
+
+# scenario kinds: kill_restart is the classic soak (SIGKILL →
+# same-port respawn → checkpoint restore); kill_forever is the HA
+# acceptance (SIGKILL the active global with NO restart — the
+# warm standby must take the lease, merge its replicated shadow, and
+# serve, with loss bounded to the active's un-flushed tail)
+KIND_KILL_RESTART = "kill_restart"
+KIND_KILL_FOREVER = "kill_forever"
 
 # seeded fault kinds the servers arm (resilience/faults.py SOAK_KINDS)
 DEFAULT_FAULT_KINDS = "disk_full,deadline_pressure"
@@ -67,6 +78,9 @@ class GateThresholds:
     recovery_intervals: int = 3
     max_compile_drift: int = 0
     requeue_max_bytes: int = 32 * 1048576
+    # kill_forever only: wall-clock bound on active-death →
+    # standby-holds-the-lease (the lease ttl plus election slack)
+    takeover_detect_max_s: float = 15.0
 
 
 @dataclass(frozen=True)
@@ -99,6 +113,7 @@ class SoakScenario:
     counters_per_interval: int = 24
     timers_per_interval: int = 8
     thresholds: GateThresholds = field(default_factory=GateThresholds)
+    kind: str = KIND_KILL_RESTART
 
     def sink_mode(self, idx: int) -> str:
         for w in self.sink_windows:
@@ -110,14 +125,18 @@ class SoakScenario:
         return tuple(role for at, role in self.kills if at == idx)
 
     def repro(self) -> str:
+        kind = ("" if self.kind == KIND_KILL_RESTART
+                else f", kind={self.kind!r}")
         return (f"SoakScenario.generate(seed={self.seed}, "
-                f"intervals={self.intervals}, kills={len(self.kills)})")
+                f"intervals={self.intervals}, kills={len(self.kills)}"
+                f"{kind})")
 
     @classmethod
     def generate(cls, seed: int, intervals: int = 8, kills: int = 1,
                  thresholds: GateThresholds = None,
                  fault_rate: float = 0.05,
-                 fault_kinds: str = DEFAULT_FAULT_KINDS) -> "SoakScenario":
+                 fault_kinds: str = DEFAULT_FAULT_KINDS,
+                 kind: str = KIND_KILL_RESTART) -> "SoakScenario":
         """Derive the full chaos schedule from ``seed``. Same
         arguments → identical scenario, byte for byte."""
         thr = thresholds or GateThresholds()
@@ -126,6 +145,18 @@ class SoakScenario:
         lo = thr.warmup_intervals
         hi = max(lo + 1, intervals - (thr.recovery_intervals + 1))
         span = range(lo, hi)
+        if kind == KIND_KILL_FOREVER:
+            # the HA takeover scenario: exactly ONE kill — the active
+            # global, dead forever — and no sink-outage windows (the
+            # outage transport is per-process; a window spanning the
+            # takeover would impose chaos on a sink generation that no
+            # longer exists — orthogonal coverage already owned by the
+            # kill_restart scenarios)
+            kill_at = rng.choice(list(span))
+            return cls(seed=seed, intervals=intervals,
+                       kills=((kill_at, ROLE_GLOBAL),), sink_windows=(),
+                       fault_rate=fault_rate, fault_kinds=fault_kinds,
+                       thresholds=thr, kind=kind)
         n_kills = min(kills, len(span))
         kill_at = sorted(
             # random.Random.sample, not the store's locked sample()
