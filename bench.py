@@ -3100,6 +3100,13 @@ def bench_soak(intervals: int = 200, kills: int = 3):
         "restarts": dict(led.restarts),
         "ckpt_write_errors": led.ckpt_write_errors,
         "spool_errors": led.spool_errors,
+        # the LedgerAudit runtime twin (lint/ledger_audit.py) rides
+        # every soak: per-interval conservation timeline, asserted at
+        # terminal settlement — the smoke proof the drop-flow static
+        # pass's invariant holds with live traffic and real SIGKILLs
+        "ledger_audit_snapshots": len(report.ledger_timeline),
+        "ledger_audit_settled_ok": all(
+            s["ok"] for s in report.ledger_timeline if s["settled"]),
     }
 
 
@@ -3156,6 +3163,42 @@ def bench_ha_takeover(intervals: int = 30):
         "emitted_global": led.emitted_global,
         "shed": led.shed,
         "restarts": dict(led.restarts),
+    }
+
+
+def bench_lint(budget_s: float = 60.0):
+    """Config #16: the static-analysis plane itself (PR 18,
+    ``veneur_tpu/lint/``) — all fifteen passes over the live package
+    with the shared parsed-Project cache, recording per-pass wall
+    clock, the finding count (must be 0 against the empty baseline),
+    and the hot-set size the conservation passes analyze. The lint
+    suite runs inside every tier-1 invocation AND as the pre-commit
+    gate, so its cost is a direct tax on iteration speed; this lane
+    makes a pathologically-slowed pass a visible regression, the same
+    way 14_soak pins the runtime ledger."""
+    from veneur_tpu.lint import PASSES, Project, run_passes
+    from veneur_tpu.lint.dropflow import iter_hot_functions
+
+    t0 = time.perf_counter()
+    project = Project(_HERE)
+    parse_s = time.perf_counter() - t0
+    timings = {}
+    findings = run_passes(project, timings=timings)
+    total_s = time.perf_counter() - t0
+    slowest = max(timings, key=timings.get) if timings else None
+    return {
+        "passes": len(PASSES),
+        "files_analyzed": len(project.files),
+        "hot_set_functions": sum(1 for _ in iter_hot_functions(project)),
+        "findings": len(findings),
+        "parse_s": round(parse_s, 3),
+        "total_s": round(total_s, 3),
+        "under_budget": total_s < budget_s,
+        "slowest_pass": slowest,
+        "slowest_pass_s": round(timings[slowest], 3) if slowest else None,
+        "timings_s": {k: round(v, 3)
+                      for k, v in sorted(timings.items(),
+                                         key=lambda kv: -kv[1])},
     }
 
 
@@ -3317,6 +3360,10 @@ def _lane_plan(result, guarded):
         # (veneur_tpu/fleet/standby.py, docs/resilience.md "Global HA")
         ("15_ha_takeover",
          lambda t: run_isolated("bench_ha_takeover", timeout=t), 240),
+        # the static-analysis plane itself: all fifteen passes over the
+        # live package (shared parse, per-pass wall clock, 0 findings
+        # against the empty baseline) — pure AST, no jax, runs inline
+        ("16_lint", guarded(bench_lint), 120),
     ]
 
 
@@ -3443,6 +3490,9 @@ def _headline(result) -> dict:
                           "promotions", "takeover_detect_s",
                           "takeover_first_flush_s", "accounted_lost",
                           "loss_within_bound"),
+            "16_lint": pick("16_lint", "passes", "findings", "total_s",
+                            "slowest_pass", "slowest_pass_s",
+                            "under_budget"),
         },
         "detail_file": "BENCH_DETAIL.json",
     }

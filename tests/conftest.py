@@ -109,6 +109,40 @@ def tsan_lite():
         rec.disarm()
 
 
+@pytest.fixture
+def ledger_audit():
+    """LedgerAudit (veneur_tpu/lint/ledger_audit.py): the drop-flow
+    pass's runtime twin. Arm an audit over an IngestFleet, a
+    SoakLedger, or a custom term set; every armed audit's violations
+    are asserted at teardown (like ``tsan_lite``), so a test that
+    forgets its own ``assert_clean()`` still fails on an uncredited
+    drop. Usage::
+
+        audit = ledger_audit(fleet=fleet)        # standard lane terms
+        audit = ledger_audit(soak_ledger=ledger) # soak identity
+        audit = ledger_audit()                   # .register() your own
+        ... drive traffic ...
+        audit.snapshot(settled=True)             # drained boundary
+    """
+    from veneur_tpu.lint import ledger_audit as la
+
+    audits = []
+
+    def arm(fleet=None, soak_ledger=None, name="ledger"):
+        if fleet is not None:
+            audit = la.for_fleet(fleet)
+        elif soak_ledger is not None:
+            audit = la.for_soak_ledger(soak_ledger)
+        else:
+            audit = la.LedgerAudit(name)
+        audits.append(audit)
+        return audit
+
+    yield arm
+    for audit in audits:
+        audit.assert_clean()
+
+
 def pytest_collection_modifyitems(config, items):
     if RUN_TPU:
         skip = pytest.mark.skip(

@@ -323,6 +323,16 @@ class TestSoakSmoke:
         assert led.dd_offered == (led.dd_acked + led.dd_pending
                                   + led.dd_dropped + led.dd_crash_lost)
         assert led.dd_pending == 0  # drained by the recovery tail
+        # the LedgerAudit runtime twin (lint/ledger_audit.py) is armed
+        # on every soak: per-interval un-settled snapshots build the
+        # timeline, the terminal-settlement snapshot asserts the exact
+        # conservation identity — across the kill
+        tl = report.ledger_timeline
+        assert len(tl) >= 10  # one per driven interval + settlement
+        assert all(s["ok"] is None for s in tl if not s["settled"])
+        terminal = tl[-1]
+        assert terminal["settled"] and terminal["ok"] is True
+        assert terminal["values"]["sent_global"] == led.sent_global
         assert elapsed < 60.0, f"soak smoke took {elapsed:.1f}s"
 
 

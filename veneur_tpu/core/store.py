@@ -2537,7 +2537,7 @@ class MetricStore:
                 sel = [e for e in entries
                        if (e[0].type == "timer") == want_timer]
                 if not sel:
-                    continue
+                    continue  # lint: ok(silent-drop) emptiness guard: zero entries selected for this group, nothing in flight to credit
                 if not hasattr(group, "import_centroids_bulk"):
                     for key, tags, means, weights, dmin, dmax in sel:
                         group.import_centroids(key, tags, means, weights,
@@ -2620,7 +2620,7 @@ class MetricStore:
                     except ValueError:
                         # unknown type enum / empty oneof: rows stays
                         # MISS and the apply phase counts it
-                        continue
+                        continue  # lint: ok(silent-drop, swallowed-exception) deferred credit: the row stays MISS and the apply phase folds the miss mask into n_err below
                     name = name_b.decode("utf-8", "replace")
                     joined = self._truncate_tags(
                         tags_b.decode("utf-8", "replace"))
@@ -3055,7 +3055,7 @@ class MetricStore:
         # the snapshot's blocking device fetches run under it by design
         # — a flush racing a resize would interleave two generation
         # drains otherwise
-        with self._flush_gate:  # lint: ok(lock-across-blocking)
+        with self._flush_gate:  # lint: ok(lock-across-blocking) the gate exists to hold across the blocking snapshot: it serializes swap+drain against a concurrent flush while ingest proceeds on _lock
             with self._lock:
                 gen = self._swap_generation()
             snaps: Dict[str, dict] = {}
@@ -3063,7 +3063,7 @@ class MetricStore:
                 # retired generation: this thread is the sole owner,
                 # the store lock is not required (cf. _requeue_group)
                 group = getattr(gen, name)
-                snaps[name] = group.snapshot_state()  # lint: ok(unlocked-call)
+                snaps[name] = group.snapshot_state()  # lint: ok(unlocked-call) retired generation — this thread is the sole owner, the store lock is not required
         moved: Dict[str, Dict[str, dict]] = {}
         kept: Dict[str, dict] = {}
         moved_series = 0
@@ -3145,7 +3145,7 @@ class MetricStore:
         # the gate's entire job is to hold across the retired drain:
         # it serializes overlapping flush() calls (only the flusher and
         # shutdown ever contend) while ingest proceeds on _lock
-        with self._flush_gate:  # lint: ok(lock-across-blocking)
+        with self._flush_gate:  # lint: ok(lock-across-blocking) the gate's entire job is to hold across the multi-second retired drain; ingest never waits on it (it proceeds on _lock)
             with obs_rec.maybe_stage("swap"):
                 with self._lock:
                     gen = self._swap_generation()
@@ -3578,7 +3578,7 @@ class MetricStore:
         try:
             # retired generation: this thread is the sole owner, the
             # store lock is not required (cf. _flush_generation)
-            snap = group.snapshot_state()  # lint: ok(unlocked-call)
+            snap = group.snapshot_state()  # lint: ok(unlocked-call) retired generation — this thread is the sole owner, the store lock is not required
             with self._lock:
                 self._restore_group(gen_name, self._GROUP_TYPES[gen_name],
                                     getattr(self, gen_name), snap)

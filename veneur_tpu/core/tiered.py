@@ -1053,7 +1053,7 @@ class TieredDigestGroup(OverloadLimited):
         # flush runs on the RETIRED generation, which this thread
         # exclusively owns (cf. MetricStore._flush_generation); direct
         # callers (tests, benches) own their group outright
-        self._drain_staging()  # lint: ok(unlocked-call)
+        self._drain_staging()  # lint: ok(unlocked-call) flush runs on the RETIRED generation this thread exclusively owns; direct callers own their group outright
         n = len(self.interner)
         return self._flush_tiers(n, percentiles, want_digests, want_stats)
 
@@ -1065,7 +1065,7 @@ class TieredDigestGroup(OverloadLimited):
         ``finish()`` — the tiered group overlaps at the STORE level
         (other groups serialize/POST while this one computes and
         fetches); its internal per-slab fetch loop stays one phase."""
-        self._drain_staging()  # lint: ok(unlocked-call)
+        self._drain_staging()  # lint: ok(unlocked-call) two-phase flush slot still runs on the RETIRED generation this thread exclusively owns
         n = len(self.interner)
         return lambda: self._flush_tiers(n, percentiles, want_digests,
                                          want_stats)

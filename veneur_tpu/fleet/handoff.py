@@ -581,7 +581,7 @@ class HandoffManager:
         # fsync and the stream: it is the shutdown quiesce barrier, not
         # a data lock — its only other user is quiesce(), which exists
         # to wait on exactly these blocking ops
-        with self._busy, obs.activate(rec):  # lint: ok(lock-across-blocking)
+        with self._busy, obs.activate(rec):  # lint: ok(lock-across-blocking) _busy is the shutdown quiesce barrier, not a data lock — it exists to span exactly these blocking ops
             summary = self._run_handoff_staged(transition)
         self.last_duration_ns = time.monotonic_ns() - t0
         if rec is not None:
@@ -700,7 +700,7 @@ class HandoffManager:
                     try:
                         os.unlink(spool)
                     except OSError:
-                        pass
+                        pass  # lint: ok(swallowed-exception) best-effort unlink of a DUPLICATE on-disk copy — the requeue below owns the samples
                     spool = ""
                 self._requeue(groups, dest, handoff_id)
                 summary["requeued"].append(dest)
@@ -721,7 +721,7 @@ class HandoffManager:
                 try:
                     os.unlink(spool)
                 except OSError:
-                    pass
+                    pass  # lint: ok(swallowed-exception) best-effort spool cleanup — the handoff was acked, samples live at the destination
         return summary
 
     def _requeue(self, groups: Dict[str, dict], dest: str,
@@ -953,7 +953,7 @@ class HandoffManager:
 
     def _register_seen(self, handoff_id: str, merged: int):
         # caller holds self._lock (handle_handoff's guard block)
-        self._seen[handoff_id] = merged  # lint: ok(inconsistent-lockset)
+        self._seen[handoff_id] = merged  # lint: ok(inconsistent-lockset) caller holds self._lock (handle_handoff's guard block) — the pass cannot see through the call boundary
         self._seen_order.append(handoff_id)
         while len(self._seen_order) > SEEN_LIMIT:
             old = self._seen_order.pop(0)
@@ -992,7 +992,7 @@ class HandoffManager:
                 try:
                     os.unlink(path)
                 except OSError:
-                    pass
+                    pass  # lint: ok(swallowed-exception) aborted partial write — its handoff stayed live in the sender's store when the spool write failed
                 continue
             try:
                 blob = ckpt_format.read_file(path)
@@ -1020,7 +1020,7 @@ class HandoffManager:
             try:
                 os.unlink(path)
             except OSError:
-                pass
+                pass  # lint: ok(swallowed-exception) best-effort unlink after recovery — the samples were re-delivered or restored into the live store above
         self.spool_recovered_total += recovered
         return recovered
 

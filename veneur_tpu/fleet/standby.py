@@ -474,7 +474,7 @@ class StandbyManager:
             mergeable = {name: snap for name, snap in groups.items()
                          if name in PROMOTABLE_GROUPS}
             if not mergeable:
-                continue
+                continue  # lint: ok(silent-drop) counter-only shadow: replicated counters were already emitted by the dead active; the un-flushed counter tail is the ACCOUNTED loss (docs/resilience.md "Global HA")
             try:
                 # prefer_live_scalars: a gauge this instance sampled
                 # after the takeover is newer than the replicated value

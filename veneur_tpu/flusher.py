@@ -486,7 +486,7 @@ def _flush_once(server: "Server", span, rec=None):
         try:
             fwd_params = inspect.signature(server.forward_fn).parameters
         except (TypeError, ValueError):
-            fwd_params = {}
+            fwd_params = {}  # lint: ok(swallowed-exception) introspection fallback: the forward below still runs, just without optional kwargs
         kwargs = {}
         if "parent_span" in fwd_params:
             kwargs["parent_span"] = span

@@ -503,7 +503,7 @@ class IngestLane:
         for d in datagrams:
             for line in p.split_lines(d):
                 if not line:
-                    continue
+                    continue  # lint: ok(silent-drop) empty split artifact (trailing newline), not a sample
                 if line.startswith(b"_e{") or line.startswith(b"_sc"):
                     self._raws.append(bytes(line))
                     self.raws_staged += 1
@@ -819,7 +819,7 @@ class IngestFleet:
                     try:
                         chunk = lane.sealed.popleft()
                     except IndexError:
-                        break
+                        break  # lint: ok(swallowed-exception) empty-deque sentinel: the lane's sealed queue is drained, nothing in flight
                     merged += self._merge_chunk(lane, chunk)
                 self._fold_ledger(lane)
         return merged

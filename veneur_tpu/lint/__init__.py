@@ -1,8 +1,8 @@
 """veneur_tpu.lint — project-native static analysis.
 
 The Python/JAX substitute for the toolchain the reference leans on
-(``go vet``, the race detector, "imported and not used"). Nine passes,
-all AST-based, no third-party lint dependency:
+(``go vet``, the race detector, "imported and not used"). Fifteen
+passes, all AST-based, no third-party lint dependency:
 
 - ``lock-discipline``  — ``@requires_lock`` call sites hold the store
   lock (``lint/locks.py``; runtime twin in ``lint/tsan.py``)
@@ -26,10 +26,25 @@ all AST-based, no third-party lint dependency:
   docs/observability.md (``lint/stagenames.py``)
 - ``dead-code``        — unused module-level imports, unreachable
   statements (``lint/deadcode.py``)
+- ``drop-flow``        — conservation flow: every discard edge in the
+  pipeline hot set credits a ledger counter (``lint/dropflow.py``;
+  runtime twin in ``lint/ledger_audit.py``)
+- ``ledger-registry``  — the credit-API registry table in
+  docs/static-analysis.md matches the code (``--credit-table``)
+- ``except-safety``    — no hot-set ``except`` swallows in-flight
+  samples without credit/log/re-raise (``lint/exceptsafety.py``)
+- ``swap-restore``     — no raise strands a retired generation between
+  swap and restore/requeue (``lint/exceptsafety.py``)
+- ``pragma-justify``   — every ``# lint: ok(...)`` pragma carries a
+  written justification and a known code (``lint/pragmas.py``)
+- ``ledger-coverage``  — the drop-flow hot set and credit registry
+  resolve to live code, so the pass can't silently go vacuous
+  (``lint/ledgercov.py``)
 
 Run ``python -m veneur_tpu.lint`` (non-zero exit on findings); tier-1
 CI runs the same passes over the real package via tests/test_lint.py.
-See docs/static-analysis.md.
+``--changed`` scopes per-file passes to git-modified files for the
+pre-commit fast path. See docs/static-analysis.md.
 """
 
 from veneur_tpu.lint.framework import (Baseline, Finding, Project, PASSES,
@@ -44,5 +59,9 @@ from veneur_tpu.lint import configdrift as _configdrift  # noqa: F401
 from veneur_tpu.lint import metricnames as _metricnames  # noqa: F401
 from veneur_tpu.lint import stagenames as _stagenames  # noqa: F401
 from veneur_tpu.lint import deadcode as _deadcode      # noqa: F401
+from veneur_tpu.lint import dropflow as _dropflow      # noqa: F401
+from veneur_tpu.lint import exceptsafety as _exceptsafety  # noqa: F401
+from veneur_tpu.lint import pragmas as _pragmas        # noqa: F401
+from veneur_tpu.lint import ledgercov as _ledgercov    # noqa: F401
 
 __all__ = ["Baseline", "Finding", "Project", "PASSES", "run_passes"]

@@ -9,10 +9,15 @@ entries) exist, 2 on usage errors.
                                                  # the lock-order graph)
     python -m veneur_tpu.lint --passes lock-order,recompile-hazard
     python -m veneur_tpu.lint --update-baseline  # grandfather current set
+    python -m veneur_tpu.lint --changed          # pre-commit fast path:
+                                                 # per-file passes scoped
+                                                 # to git-modified files
     python -m veneur_tpu.lint --metrics-table    # self-metrics registry md
     python -m veneur_tpu.lint --config-table     # config-key reference md
     python -m veneur_tpu.lint --programs-table   # compiled-program
                                                  # inventory md
+    python -m veneur_tpu.lint --credit-table     # drop-flow credit-API
+                                                 # registry md
 """
 
 from __future__ import annotations
@@ -24,15 +29,49 @@ import sys
 
 from veneur_tpu.lint import PASSES, Baseline, Project, run_passes
 from veneur_tpu.lint.configdrift import config_table
+from veneur_tpu.lint.dropflow import credit_table
 from veneur_tpu.lint.lockorder import lock_graph
 from veneur_tpu.lint.metricnames import metrics_table
 from veneur_tpu.lint.recompile import programs_table
+
+#: Passes whose findings are a whole-program property — a registry
+#: drift, a cross-file cycle — and therefore never scoped by
+#: ``--changed``: the finding is real no matter which file the commit
+#: touches. Everything else anchors its findings to the offending file
+#: and filters cleanly.
+WHOLE_PROGRAM_PASSES = frozenset({
+    "config-drift", "metric-registry", "stage-registry",
+    "recompile-hazard", "lock-order", "ledger-registry",
+    "ledger-coverage",
+})
 
 
 def _default_root() -> str:
     # the repo root is the parent of the installed package directory
     here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     return os.path.dirname(here)
+
+
+def _git_changed_files(root: str):
+    """Repo-relative paths modified vs. HEAD (worktree + index) plus
+    untracked files, or None when git is unavailable — the caller
+    falls back to the full run (scoping is an optimization, never a
+    correctness gate)."""
+    import subprocess
+
+    changed = set()
+    for cmd in (["git", "diff", "--name-only", "HEAD"],
+                ["git", "ls-files", "--others", "--exclude-standard"]):
+        try:
+            res = subprocess.run(cmd, cwd=root, capture_output=True,
+                                 text=True, timeout=15)
+        except (OSError, subprocess.TimeoutExpired):
+            return None
+        if res.returncode != 0:
+            return None
+        changed.update(line.strip() for line in res.stdout.splitlines()
+                       if line.strip())
+    return changed
 
 
 def main(argv=None) -> int:
@@ -57,6 +96,13 @@ def main(argv=None) -> int:
     ap.add_argument("--programs-table", action="store_true",
                     help="print the compiled-program inventory markdown "
                          "(docs/static-analysis.md section) and exit")
+    ap.add_argument("--credit-table", action="store_true",
+                    help="print the drop-flow credit-API registry markdown "
+                         "(docs/static-analysis.md section) and exit")
+    ap.add_argument("--changed", action="store_true",
+                    help="scope per-file passes to git-modified files "
+                         "(whole-program passes still run in full); the "
+                         "pre-commit fast path")
     args = ap.parse_args(argv)
 
     project = Project(args.root)
@@ -69,13 +115,33 @@ def main(argv=None) -> int:
     if args.programs_table:
         print(programs_table(project))
         return 0
+    if args.credit_table:
+        print(credit_table(project))
+        return 0
+
+    changed = None
+    if args.changed:
+        changed = _git_changed_files(args.root)
+        if changed is None:
+            print("--changed: git unavailable, running the full set",
+                  file=sys.stderr)
 
     only = [p.strip() for p in args.passes.split(",") if p.strip()] or None
+    timings: dict = {}
     try:
-        findings = run_passes(project, only)
+        findings = run_passes(project, only, timings=timings)
     except KeyError as e:
         print(e.args[0], file=sys.stderr)
         return 2
+
+    if changed is not None:
+        # Analysis stays whole-program (cross-file resolution needs the
+        # full parse set — which the shared Project cache makes cheap);
+        # only the *reporting* narrows, so a pre-commit run surfaces
+        # exactly the findings this commit could have introduced.
+        findings = [f for f in findings
+                    if f.pass_name in WHOLE_PROGRAM_PASSES
+                    or f.file in changed]
 
     baseline_path = args.baseline or os.path.join(args.root,
                                                   "lint_baseline.json")
@@ -94,13 +160,25 @@ def main(argv=None) -> int:
             "findings": [f.as_json() for f in new],
             "grandfathered": [f.as_json() for f in grandfathered],
             "stale_baseline": stale,
+            # per-pass wall-clock seconds — the <60s budget test
+            # (tests/test_lint.py) and the 16_lint bench lane read these
+            "timings": {k: round(v, 4) for k, v in timings.items()},
         }
+        if changed is not None:
+            payload["changed_scope"] = sorted(
+                f for f in changed if f in set(project.files))
         if only is None or "lock-order" in only:
             # the acquisition graph rides along so tooling can diff the
             # lock order per PR (docs/static-analysis.md)
             payload["lock_graph"] = lock_graph(project)
         print(json.dumps(payload, indent=2))
     else:
+        if changed is not None:
+            in_scope = sorted(f for f in changed if f in set(project.files))
+            print(f"--changed: {len(in_scope)} lintable file(s) in scope"
+                  + (f" ({', '.join(in_scope[:6])}"
+                     + (", ..." if len(in_scope) > 6 else "") + ")"
+                     if in_scope else ""))
         for f in new:
             print(f.render())
         for key in stale:

@@ -39,7 +39,7 @@ def dataclass_fields(project: Project, cls_name: str) -> Dict[str, Tuple[int, bo
     marked deprecated/rejected, so example/doc presence is not required."""
     sf = project.files[CONFIG_FILE]
     out: Dict[str, Tuple[int, bool]] = {}
-    for node in ast.walk(sf.tree):
+    for node in sf.nodes:
         if not isinstance(node, ast.ClassDef) or node.name != cls_name:
             continue
         for stmt in node.body:
@@ -141,7 +141,7 @@ def config_table(project: Project) -> str:
         fields = dataclass_fields(project, cls_name)
         lines += [f"## {title}", "", "| key | default | notes |",
                   "|---|---|---|"]
-        for node in ast.walk(sf.tree):
+        for node in sf.nodes:
             if not isinstance(node, ast.ClassDef) or node.name != cls_name:
                 continue
             for stmt in node.body:

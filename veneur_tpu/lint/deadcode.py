@@ -90,7 +90,7 @@ def run(project: Project) -> List[Finding]:
                     message=f"module-level import `{name}` is never used"))
 
         parents = sf.parents
-        for node in ast.walk(sf.tree):
+        for node in sf.nodes:
             body_lists = []
             for attr in ("body", "orelse", "finalbody"):
                 block = getattr(node, attr, None)

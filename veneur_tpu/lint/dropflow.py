@@ -1,0 +1,520 @@
+"""Conservation-flow pass: statically proven "no silent drop".
+
+The system's defining invariant is exact end-to-end sample conservation
+(docs/resilience.md: ``ingested == emitted + shed + quarantined +
+requeued + accounted_lost``). Until this pass, the invariant was
+enforced only by whichever e2e/soak test happened to exercise a given
+drop path — PR 16's parked-repost bug and PR 9's checkpoint
+staging-drain bug were both silent-drop instances found *late* by e2e.
+This pass makes "every discarded sample is credited to a ledger
+counter" a machine-checked property of the pipeline hot set, the same
+static+runtime pairing as lock-discipline/TSan-lite (the runtime twin
+is ``lint/ledger_audit.py``).
+
+Model
+-----
+A **sample-flow graph** over the pipeline hot set (:data:`HOT_SET`):
+functions that hold in-flight sample state, from the intake points
+(:data:`SOURCES` — parse, ``import_*``, ``sample_many``,
+``merge_sealed``, ``handle_handoff``, ``/replicate``) through the store
+groups, the flusher, and the sinks/forwarders/handoff/standby egress.
+Within each hot function, every **discard edge** — a ``continue``, a
+bare in-loop ``return``, or a truncating same-name slice — must be
+*discharged* on its path by one of:
+
+- a **credit API** (:data:`CREDIT_CALLS` /
+  :data:`CREDIT_COUNTER_TOKENS` / :data:`CREDIT_METRIC_TOKENS`):
+  LaneLedger/Quarantine ``.count()``, ``account_shed``,
+  ``_requeue_group`` / ``_requeue_forward_part``, a
+  ``*_dropped_total`` / ``*_requeued_total`` counter bump, …
+- a **forward API** (:data:`FORWARD_CALLS`): the state was handed
+  onward (staged, merged, emitted, posted, parked) before the edge, or
+- a ``raise`` (accounting responsibility propagates to the caller).
+
+The path test is lexical-per-branch: the statements preceding the edge
+in each enclosing block down from the function body (an ``else`` branch
+never inherits credit from its ``if`` body, and an ``except`` handler
+never inherits credit from its partially-executed ``try`` body). That
+is exact for the straight-line+guard shape the pipeline is written in,
+and errs toward flagging — a deliberate benign edge carries
+``# lint: ok(silent-drop) <written justification>`` (the pragma-justify
+pass refuses an empty reason; baseline policy stays empty).
+
+Exception edges are the sibling pass (``lint/exceptsafety.py``); the
+credit-API registry below is generated into docs/static-analysis.md
+(``--credit-table``) and drift-checked by the ``ledger-registry`` pass;
+registry liveness (every entry resolves to real code — the pass cannot
+silently go vacuous) is the ``ledger-coverage`` pass
+(``lint/ledgercov.py``).
+"""
+
+from __future__ import annotations
+
+import ast
+import fnmatch
+from typing import Dict, Iterator, List, Optional, Tuple
+
+from veneur_tpu.lint.framework import (Finding, Project, SourceFile,
+                                       dotted, qualname, register)
+
+# ---------------------------------------------------------------------------
+# registries (drift-checked: ledger-registry + ledger-coverage)
+# ---------------------------------------------------------------------------
+
+#: The pipeline hot set: relpath -> qualname patterns (fnmatch) of the
+#: functions that hold in-flight sample state. Parser/ingest lanes ->
+#: store groups -> flusher -> sinks/forwarders/handoff/standby.
+HOT_SET: Dict[str, List[str]] = {
+    "veneur_tpu/samplers/parser.py": [
+        "parse_metric_ssf", "convert_metrics", "convert_indicator_metrics",
+    ],
+    "veneur_tpu/ingest/lanes.py": [
+        "IngestLane._ingest_once", "IngestLane._stage_native",
+        "IngestLane._stage_python", "IngestLane._stage_one_metric",
+        "IngestLane._seal",
+        "IngestFleet.merge_sealed", "IngestFleet._merge_chunk",
+        "IngestFleet._fold_ledger",
+    ],
+    "veneur_tpu/core/store.py": [
+        "MetricStore.process_metric", "MetricStore.process_batch",
+        "MetricStore.import_*", "MetricStore.handoff_extract",
+        "MetricStore._lane_remap", "MetricStore._requeue_group",
+        "MetricStore._run_flush_units", "MetricStore._unit_failed",
+        "MetricStore._flush_generation", "MetricStore._flush_scalars",
+        "MetricStore._emit_digest_result", "MetricStore._emit_set_result",
+        "ScalarGroup.sample", "ScalarGroup.combine",
+        "DigestGroup.sample", "DigestGroup.sample_many",
+        "DigestGroup.import_centroids", "DigestGroup.import_centroids_bulk",
+        "SetGroup.sample", "SetGroup.sample_many",
+        "SetGroup.import_registers", "SetGroup.import_registers_row",
+        "HeavyHitterGroup.sample", "HeavyHitterGroup.sample_many",
+        "HeavyHitterGroup.import_sketch",
+        "bulk_stage_import_centroids",
+    ],
+    "veneur_tpu/core/tiered.py": [
+        "*.sample", "*.sample_many", "*.import_*", "*promote*",
+        "*._drain_samples", "*._drain_imports", "*._drain_staging",
+    ],
+    "veneur_tpu/fleet/mesh_tiered.py": [
+        "*._pool_drain_samples", "*._pool_drain_imports",
+        "*._maybe_promote", "MeshTieredDigestGroup.flush*",
+    ],
+    "veneur_tpu/flusher.py": [
+        "flush_once", "_flush_once", "_build_stream",
+        "_requeue_forward_part",
+    ],
+    "veneur_tpu/sinks/datadog.py": [
+        "DatadogMetricSink.flush_columnar", "DatadogMetricSink.flush_chunk",
+        "DatadogMetricSink._post_chunk_body",
+        "DatadogMetricSink._park_locked",
+        "DatadogMetricSink.repost_requeued",
+    ],
+    "veneur_tpu/sinks/channel.py": ["*.flush", "*.ingest"],
+    "veneur_tpu/forward/convert.py": ["*"],
+    "veneur_tpu/forward/http_forward.py": ["*.forward*", "*._post*",
+                                           "post_helper"],
+    "veneur_tpu/forward/grpc_forward.py": ["*.forward*", "*.send*"],
+    "veneur_tpu/fleet/handoff.py": [
+        "HandoffManager._run_handoff*", "HandoffManager.refresh",
+        "HandoffManager._send*", "HandoffManager._post_blob",
+        "HandoffManager._requeue", "HandoffManager.handle_handoff",
+        "HandoffManager.recover_spool",
+        "split_group_snapshot", "_filter_rows",
+    ],
+    "veneur_tpu/fleet/standby.py": [
+        "StandbyManager.capture", "StandbyManager.dispatch",
+        "StandbyManager._send", "StandbyManager.handle_replicate",
+        "StandbyManager.promote", "ReplicaShadow.*",
+    ],
+    "veneur_tpu/server.py": [
+        "Server.handle_metric_packet", "Server.handle_packet",
+        "Server.handle_ssf_packet", "Server.handle_ssf",
+        "Server.handle_ssf_batch", "Server.handle_ssf_stream",
+        "Server._shed_spans", "Server._native_ssf_pump",
+        "Server._native_pump",
+        "SpanWorker.work", "SpanWorker.flush",
+        "_SinkIngestor.offer", "_SinkIngestor.offer_batch",
+        "_SinkIngestor._work", "_SinkIngestor.drain",
+        "EventWorker.add", "EventWorker.flush",
+    ],
+    "veneur_tpu/proxy/proxy.py": [
+        "Proxy.proxy_metrics", "Proxy.proxy_traces", "Proxy._fan_out",
+        "Proxy._post_batch", "Proxy._post_batch_inner",
+    ],
+    "veneur_tpu/proxy/grpc_proxy.py": ["*.send_metrics", "*._forward"],
+}
+
+#: Intake points: a call to one of these introduces in-flight sample
+#: state (documented in the registry table; liveness pinned by
+#: ledger-coverage).
+SOURCES = (
+    "parse_metric", "parse_metric_ssf", "convert_metrics",
+    "import_columnar", "import_lane_chunk", "import_digests_bulk",
+    "sample_many", "merge_sealed", "handle_handoff", "handle_replicate",
+)
+
+#: Callee base names whose invocation credits a ledger counter.
+CREDIT_CALLS = frozenset({
+    "account_shed", "_quarantine_samples",
+    "_scrub_counter_batch", "_scrub_float_batch",
+    "_requeue_group", "_requeue_forward_part", "count_requeued",
+    "_park_locked", "_fold_ledger", "_shed_spans",
+})
+
+#: ``.count(...)`` receivers that ARE ledgers: any dotted-path segment
+#: matching one of these tokens (``self.ledger.count``,
+#: ``quarantine.count``, ``q.count``).
+CREDIT_RECEIVER_TOKENS = ("ledger", "quarantine", "quar")
+_CREDIT_RECEIVER_EXACT = frozenset({"q"})
+
+#: Counter-attribute tokens: an augmented assignment onto an attribute
+#: containing one of these is ledger accounting (``chunk_rows_dropped
+#: += n``, ``shed_records += n``, ``parse_errors += 1``).
+CREDIT_COUNTER_TOKENS = (
+    "dropped", "requeued", "shed", "quarantin", "lost", "spill",
+    "errors", "scrubbed", "skipped", "timeout",
+)
+
+#: Self-metric name fragments: emitting one of these strings is ledger
+#: accounting (``*_requeued_total`` / ``*_dropped_total`` emissions,
+#: ``accounted_lost`` folds).
+CREDIT_METRIC_TOKENS = (
+    "dropped_total", "requeued_total", "accounted_lost", "shed_total",
+    "lost_total", "errors_total", ".shed", ".quarantined",
+)
+
+#: Callee base names that hand in-flight state ONWARD (staged, merged,
+#: emitted, posted, parked, spooled) — the path is not a drop.
+FORWARD_CALLS = frozenset({
+    "append", "extend", "appendleft", "put", "put_nowait", "put_one",
+    "_put_one", "_stage_span", "_stage_one_metric", "_memoize",
+    "sample", "sample_many", "combine", "merge", "merge_sealed",
+    "add", "add_many", "set_many", "offer", "offer_batch",
+    "emit", "send", "send_metrics", "post", "write",
+    "handle_ssf", "handle_ssf_batch", "process_metric", "process_batch",
+    "proxy_metrics", "proxy_traces",
+})
+#: Prefixes with the same meaning (``import_*``, ``_emit_*``, …).
+FORWARD_PREFIXES = ("import_", "_emit", "emit_", "flush", "_flush",
+                    "forward", "_forward", "_post", "stage_", "_stage",
+                    "_drain", "restore", "_restore", "capture",
+                    "replicate")
+
+
+# ---------------------------------------------------------------------------
+# discharge tests
+# ---------------------------------------------------------------------------
+
+def _base_name(func: ast.AST) -> Optional[str]:
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    if isinstance(func, ast.Name):
+        return func.id
+    return None
+
+
+def _is_credit_node(node: ast.AST) -> bool:
+    """True when this single AST node is a ledger credit."""
+    if isinstance(node, ast.Call):
+        name = _base_name(node.func)
+        if name in CREDIT_CALLS:
+            return True
+        if name == "count":
+            path = dotted(node.func) or ""
+            segs = path.lower().split(".")
+            recv = segs[:-1]
+            if any(t in seg for seg in recv for t in
+                   CREDIT_RECEIVER_TOKENS) \
+                    or (recv and recv[-1] in _CREDIT_RECEIVER_EXACT):
+                return True
+        for arg in node.args:
+            if isinstance(arg, ast.Constant) and isinstance(arg.value, str) \
+                    and any(t in arg.value for t in CREDIT_METRIC_TOKENS):
+                return True
+        return False
+    if isinstance(node, ast.AugAssign):
+        target = dotted(node.target)
+        if target:
+            leaf = target.split(".")[-1].lower()
+            if any(t in leaf for t in CREDIT_COUNTER_TOKENS):
+                return True
+            # un-counting an intake tally (``self.parsed -= n``) keeps
+            # the identity exact without a drop-side credit
+            if isinstance(node.op, ast.Sub) and "parsed" in leaf:
+                return True
+    return False
+
+
+def _is_forward_node(node: ast.AST) -> bool:
+    """True when this single AST node hands sample state onward."""
+    if isinstance(node, ast.Call):
+        name = _base_name(node.func)
+        if name is None:
+            return False
+        if name in FORWARD_CALLS:
+            return True
+        return any(name.startswith(p) for p in FORWARD_PREFIXES)
+    if isinstance(node, (ast.Yield, ast.YieldFrom, ast.Raise)):
+        return True
+    if isinstance(node, ast.Return) and node.value is not None:
+        return True
+    if isinstance(node, ast.Assign):
+        # container store: out[k] = v
+        return any(isinstance(t, ast.Subscript) for t in node.targets)
+    return False
+
+
+def _stmt_discharges(stmt: ast.AST) -> bool:
+    """Does any node under ``stmt`` credit a ledger or forward state?
+    Nested function/class bodies don't execute here — skipped."""
+    stack = [stmt]
+    while stack:
+        node = stack.pop()
+        if node is not stmt and isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                       ast.ClassDef)):
+            continue
+        if _is_credit_node(node) or _is_forward_node(node):
+            return True
+        stack.extend(ast.iter_child_nodes(node))
+    return False
+
+
+_BLOCK_FIELDS = ("body", "orelse", "finalbody")
+
+
+def _path_stmts(node: ast.AST, fn: ast.AST,
+                parents: Dict[ast.AST, ast.AST],
+                stop_at: Optional[ast.AST] = None) -> Iterator[ast.AST]:
+    """Statements lexically preceding ``node`` on its branch path, from
+    its own block up through every enclosing block to ``fn``'s body
+    (or ``stop_at``).  Path-accurate for straight-line + if/else
+    nesting: an ``else`` branch never sees the ``if`` body, and a
+    handler never sees its try body (partially executed on the
+    exception edge)."""
+    cur = node
+    while cur is not fn and cur is not stop_at:
+        parent = parents.get(cur)
+        if parent is None:
+            return
+        if isinstance(parent, ast.ExceptHandler):
+            if cur in parent.body:
+                for s in parent.body[:parent.body.index(cur)]:
+                    yield s
+            # skip OVER the try: its body may have run only partially
+            # before the exception, so its credits don't count; the
+            # try's own preceding siblings still do
+            tr = parents.get(parent)
+            if tr is not None:
+                cur = tr
+                continue
+        else:
+            for field in _BLOCK_FIELDS:
+                block = getattr(parent, field, None)
+                if isinstance(block, list) and cur in block:
+                    for s in block[:block.index(cur)]:
+                        yield s
+                    break
+        cur = parent
+
+
+def _discharged(node: ast.AST, fn: ast.AST,
+                parents: Dict[ast.AST, ast.AST]) -> bool:
+    return any(_stmt_discharges(s) for s in _path_stmts(node, fn, parents))
+
+
+def _enclosing_loop(node: ast.AST, fn: ast.AST,
+                    parents: Dict[ast.AST, ast.AST]):
+    cur = parents.get(node)
+    while cur is not None and cur is not fn:
+        if isinstance(cur, (ast.For, ast.While)):
+            return cur
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return None
+        cur = parents.get(cur)
+    return None
+
+
+def _is_trunc_slice(stmt: ast.AST) -> Optional[str]:
+    """``x = x[...bounded slice...]`` (or ``del x[n:]``): the dropped
+    half vanishes unless credited. Returns the variable name."""
+    if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1:
+        t, v = stmt.targets[0], stmt.value
+        if isinstance(t, ast.Name) and isinstance(v, ast.Subscript) \
+                and isinstance(v.value, ast.Name) \
+                and v.value.id == t.id \
+                and isinstance(v.slice, ast.Slice) \
+                and (v.slice.upper is not None
+                     or v.slice.lower is not None):
+            return t.id
+    if isinstance(stmt, ast.Delete):
+        for t in stmt.targets:
+            if isinstance(t, ast.Subscript) \
+                    and isinstance(t.value, ast.Name) \
+                    and isinstance(t.slice, ast.Slice):
+                return t.value.id
+    return None
+
+
+# ---------------------------------------------------------------------------
+# the pass
+# ---------------------------------------------------------------------------
+
+def iter_hot_functions(project: Project
+                       ) -> Iterator[Tuple[SourceFile, ast.AST, str]]:
+    """(file, function node, qualname) for every hot-set function.
+    Shared with exceptsafety/ledgercov so the three passes agree on the
+    analyzed surface."""
+    for relpath in sorted(HOT_SET):
+        sf = project.files.get(relpath)
+        if sf is None:
+            continue
+        patterns = HOT_SET[relpath]
+        for node in sf.nodes:
+            if not isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                continue
+            qn = qualname(node, sf.parents)
+            if any(fnmatch.fnmatchcase(qn, pat) for pat in patterns):
+                yield sf, node, qn
+
+
+def _check_function(sf: SourceFile, fn: ast.AST,
+                    qn: str) -> List[Finding]:
+    parents = sf.parents
+    out: List[Finding] = []
+
+    def flag(node: ast.AST, what: str):
+        if sf.suppressed(node.lineno, "silent-drop"):
+            return
+        out.append(Finding(
+            pass_name="drop-flow", code="silent-drop",
+            file=sf.relpath, line=node.lineno,
+            anchor=f"{qn}:{what}",
+            message=(
+                f"{what} in pipeline hot-set function `{qn}` discards "
+                f"in-flight sample state with no ledger credit or "
+                f"forward on its path — credit a counter "
+                f"(LaneLedger/Quarantine, *_dropped_total, requeue) or "
+                f"annotate `# lint: ok(silent-drop) <why>`")))
+
+    seen_trunc = 0
+    for node in ast.walk(fn):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and node is not fn:
+            continue  # nested defs get their own hot-set entry if hot
+        if isinstance(node, ast.Continue):
+            if not _discharged(node, fn, parents):
+                flag(node, "continue")
+        elif isinstance(node, ast.Return) and (
+                node.value is None
+                or (isinstance(node.value, ast.Constant)
+                    and node.value.value is None)):
+            # a bare return INSIDE a loop abandons the current item and
+            # the unprocessed remainder; a pre-loop guard return is not
+            # yet holding per-item state
+            if _enclosing_loop(node, fn, parents) is not None \
+                    and not _discharged(node, fn, parents):
+                flag(node, "bare return inside loop")
+        else:
+            name = _is_trunc_slice(node)
+            if name is not None and seen_trunc < 50:
+                seen_trunc += 1
+                # truncation is usually credited right next to the
+                # slice — accept a credit in the preceding path OR in
+                # the same block's following statements
+                if not _discharged(node, fn, parents):
+                    parent = parents.get(node)
+                    after = []
+                    for field in _BLOCK_FIELDS:
+                        block = getattr(parent, field, None) \
+                            if parent is not None else None
+                        if isinstance(block, list) and node in block:
+                            after = block[block.index(node) + 1:]
+                            break
+                    if not any(_stmt_discharges(s) for s in after):
+                        flag(node, f"truncating slice of `{name}`")
+    return out
+
+
+@register("drop-flow")
+def run(project: Project) -> List[Finding]:
+    findings: List[Finding] = []
+    for sf, fn, qn in iter_hot_functions(project):
+        findings.extend(_check_function(sf, fn, qn))
+    findings.sort(key=lambda f: (f.file, f.line, f.code))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# the credit-API registry table (docs/static-analysis.md; drift-checked
+# by the ledger-registry pass)
+# ---------------------------------------------------------------------------
+
+_MARKER_BEGIN = "<!-- generated: credit-registry begin -->"
+_MARKER_END = "<!-- generated: credit-registry end -->"
+
+
+def _call_sites(project: Project, test) -> int:
+    n = 0
+    for sf in project.files.values():
+        for node in sf.nodes:
+            if test(node):
+                n += 1
+    return n
+
+
+def credit_table(project: Project) -> str:
+    """Markdown registry: every credit/forward/source API the drop-flow
+    pass recognizes, with live call-site counts (regen with
+    ``--credit-table``)."""
+    lines = ["| kind | API | recognized as | call sites |",
+             "|---|---|---|---|"]
+
+    def count_call(name):
+        return _call_sites(project, lambda n: isinstance(n, ast.Call)
+                           and _base_name(n.func) == name)
+
+    for name in sorted(SOURCES):
+        lines.append(f"| source | `{name}` | intake point "
+                     f"| {count_call(name)} |")
+    for name in sorted(CREDIT_CALLS):
+        lines.append(f"| credit | `{name}()` | ledger credit call "
+                     f"| {count_call(name)} |")
+    for tok in CREDIT_RECEIVER_TOKENS:
+        lines.append(f"| credit | `*{tok}*.count()` | ledger receiver "
+                     f"| — |")
+    for tok in CREDIT_COUNTER_TOKENS:
+        lines.append(f"| credit | `*{tok}* +=` | counter attribute "
+                     f"| — |")
+    for tok in CREDIT_METRIC_TOKENS:
+        lines.append(f"| credit | `\"*{tok}*\"` | self-metric emission "
+                     f"| — |")
+    hot = sum(1 for _ in iter_hot_functions(project))
+    lines.append(f"| hot set | {len(HOT_SET)} files | "
+                 f"{hot} analyzed functions | — |")
+    return "\n".join(lines)
+
+
+@register("ledger-registry")
+def run_registry(project: Project) -> List[Finding]:
+    """The credit-API registry table in docs/static-analysis.md must
+    match the generated one (same shape as the compiled-program
+    inventory drift check)."""
+    docs_rel = "docs/static-analysis.md"
+    docs = project.read(docs_rel)
+    table = credit_table(project)
+    current = None
+    if docs and _MARKER_BEGIN in docs and _MARKER_END in docs:
+        current = docs.split(_MARKER_BEGIN, 1)[1] \
+            .split(_MARKER_END, 1)[0].strip()
+    if current is None or current != table.strip():
+        return [Finding(
+            pass_name="ledger-registry", code="credit-registry-drift",
+            file=docs_rel, line=1, anchor="credit-registry",
+            message=(
+                f"the credit-API registry in {docs_rel} is "
+                f"{'missing' if current is None else 'stale'}: regenerate "
+                f"with `python -m veneur_tpu.lint --credit-table` and "
+                f"paste between the credit-registry markers"))]
+    return []

@@ -1,0 +1,187 @@
+"""Exception-edge audit for the pipeline hot set.
+
+The drop-flow pass (``lint/dropflow.py``) proves the *explicit* discard
+edges — ``continue``, bare in-loop ``return``, truncating slice — are
+credited. This module covers the two *exception*-shaped ways in-flight
+sample state can vanish:
+
+``except-safety`` (code ``swallowed-exception``)
+    An ``except`` handler in a hot-set function that swallows the
+    exception — no re-raise, no ledger credit, no forward/requeue, and
+    not even a log line — makes the samples that were mid-flight in the
+    ``try`` body disappear with zero evidence. Every handler must
+    re-raise, credit a counter (same registry as drop-flow), hand the
+    state onward, or at minimum log; a deliberate silent swallow
+    carries ``# lint: ok(swallowed-exception) <why>`` on the ``except``
+    line (or its first body statement).
+
+``swap-restore`` (code ``raise-between-swap``)
+    Swap-on-flush retires a whole generation behind
+    ``_swap_generation()``; until ``_flush_generation`` /
+    ``restore_state`` / ``_requeue_group`` disposes of it, the retired
+    groups are in-flight state owned by exactly one stack frame. An
+    explicit ``raise`` on the path between the swap and its disposal
+    strands the entire interval — the PR 9 checkpoint bug shape. The
+    check is lexical within the function: any ``raise`` after a swap
+    call with no restore/requeue call in between (and no enclosing
+    ``finally`` that restores) is flagged.
+
+Both passes share drop-flow's hot set and credit registry
+(:func:`veneur_tpu.lint.dropflow.iter_hot_functions`,
+:func:`~veneur_tpu.lint.dropflow._is_credit_node`) so the three passes
+agree on the analyzed surface; the ledger-coverage pass pins that
+surface against silent vacuity.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from veneur_tpu.lint.dropflow import (_base_name, _is_credit_node,
+                                      _stmt_discharges, iter_hot_functions)
+from veneur_tpu.lint.framework import Finding, Project, SourceFile, dotted, \
+    register
+
+# -- except-safety ---------------------------------------------------------
+
+#: ``<something log-ish>.<method>(...)`` counts as evidence the swallow
+#: was deliberate and observable.
+LOG_METHODS = frozenset({
+    "debug", "info", "warning", "error", "exception", "critical", "log",
+})
+
+
+def _is_log_node(node: ast.AST) -> bool:
+    if not (isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr in LOG_METHODS):
+        return False
+    path = dotted(node.func)
+    if not path:
+        return False
+    recv = path.lower().split(".")[:-1]
+    return any("log" in seg for seg in recv)
+
+
+def _stmt_evidences(stmt: ast.AST) -> bool:
+    """Credit / forward / raise / log anywhere under ``stmt``."""
+    if _stmt_discharges(stmt):
+        return True
+    for node in ast.walk(stmt):
+        if _is_log_node(node):
+            return True
+    return False
+
+
+def _handler_suppressed(sf: SourceFile, handler: ast.excepthandler) -> bool:
+    if sf.suppressed(handler.lineno, "swallowed-exception"):
+        return True
+    return bool(handler.body) and sf.suppressed(
+        handler.body[0].lineno, "swallowed-exception")
+
+
+@register("except-safety")
+def run(project: Project) -> List[Finding]:
+    findings: List[Finding] = []
+    for sf, fn, qn in iter_hot_functions(project):
+        for node in ast.walk(fn):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and node is not fn:
+                continue
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            if any(_stmt_evidences(s) for s in node.body):
+                continue
+            if _handler_suppressed(sf, node):
+                continue
+            if isinstance(node.type, ast.Tuple):
+                exc = ", ".join(dotted(e) or "?" for e in node.type.elts)
+            else:
+                exc = (dotted(node.type) or "Exception") \
+                    if node.type is not None else "Exception"
+            findings.append(Finding(
+                pass_name="except-safety", code="swallowed-exception",
+                file=sf.relpath, line=node.lineno,
+                anchor=f"{qn}:except {exc}",
+                message=(
+                    f"`except {exc}` in pipeline hot-set function `{qn}` "
+                    f"swallows the exception with no re-raise, ledger "
+                    f"credit, forward, or log — samples mid-flight in the "
+                    f"try body vanish without evidence; credit/requeue, "
+                    f"log, or annotate "
+                    f"`# lint: ok(swallowed-exception) <why>`")))
+    findings.sort(key=lambda f: (f.file, f.line, f.code))
+    return findings
+
+
+# -- swap-restore ----------------------------------------------------------
+
+#: Retiring calls: after one of these the caller owns a detached
+#: generation of sample state.
+SWAP_CALLS = frozenset({"_swap_generation"})
+
+#: Disposal calls: the retired generation has been drained, restored,
+#: or requeued — ownership discharged.
+RESTORE_CALLS = frozenset({
+    "_flush_generation", "restore_state", "_restore_group",
+    "_requeue_group", "_requeue_forward_part",
+})
+
+
+def _call_lines(fn: ast.AST, names: frozenset) -> List[int]:
+    return sorted(
+        node.lineno for node in ast.walk(fn)
+        if isinstance(node, ast.Call) and _base_name(node.func) in names)
+
+
+def _finally_restores(node: ast.AST, fn: ast.AST, parents) -> bool:
+    """An enclosing try/finally whose finalbody restores covers any
+    raise inside the try."""
+    cur = parents.get(node)
+    while cur is not None and cur is not fn:
+        if isinstance(cur, ast.Try) and cur.finalbody:
+            for s in cur.finalbody:
+                for sub in ast.walk(s):
+                    if isinstance(sub, ast.Call) \
+                            and _base_name(sub.func) in RESTORE_CALLS:
+                        return True
+        cur = parents.get(cur)
+    return False
+
+
+@register("swap-restore")
+def run_swap(project: Project) -> List[Finding]:
+    findings: List[Finding] = []
+    for sf, fn, qn in iter_hot_functions(project):
+        swaps = _call_lines(fn, SWAP_CALLS)
+        if not swaps:
+            continue
+        restores = _call_lines(fn, RESTORE_CALLS)
+        nth = 0
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Raise):
+                continue
+            if sf.suppressed(node.lineno, "raise-between-swap"):
+                continue
+            live_swaps = [s for s in swaps if s < node.lineno]
+            if not live_swaps:
+                continue
+            last_swap = max(live_swaps)
+            if any(last_swap < r < node.lineno for r in restores):
+                continue
+            if _finally_restores(node, fn, sf.parents):
+                continue
+            nth += 1
+            findings.append(Finding(
+                pass_name="swap-restore", code="raise-between-swap",
+                file=sf.relpath, line=node.lineno,
+                anchor=f"{qn}:raise-after-swap#{nth}",
+                message=(
+                    f"explicit raise in `{qn}` after the generation swap "
+                    f"(line {last_swap}) with no restore/requeue in "
+                    f"between — the retired generation's entire interval "
+                    f"strands; requeue it first, restore in a `finally`, "
+                    f"or annotate `# lint: ok(raise-between-swap) <why>`")))
+    findings.sort(key=lambda f: (f.file, f.line, f.code))
+    return findings

@@ -60,9 +60,14 @@ class SourceFile:
         self.lines = text.splitlines()
         self.tree = ast.parse(text, filename=path)
         self._parents: Optional[Dict[ast.AST, ast.AST]] = None
+        self._nodes: Optional[List[ast.AST]] = None
+        self._aliases: Optional[Dict[str, str]] = None
         # pragmas live in actual COMMENT tokens only — pragma-shaped
-        # text inside a string/docstring must not become a suppression
+        # text inside a string/docstring must not become a suppression.
+        # The text AFTER the closing paren is the written justification
+        # the pragma-justify pass insists on.
         self._pragmas: Dict[int, set] = {}
+        self._pragma_reasons: Dict[int, str] = {}
         import io
         import tokenize
 
@@ -74,11 +79,21 @@ class SourceFile:
                 if m:
                     self._pragmas.setdefault(tok.start[0], set()).update(
                         c.strip() for c in m.group(1).split(","))
+                    self._pragma_reasons[tok.start[0]] = \
+                        tok.string[m.end():].strip(" -:")
         except tokenize.TokenError:  # pragma: no cover - ast.parse passed
             pass
 
     def suppressed(self, line: int, code: str) -> bool:
         return code in self._pragmas.get(line, ())
+
+    def pragma_lines(self) -> Dict[int, set]:
+        """line -> suppressed codes, for the pragma-justify pass."""
+        return self._pragmas
+
+    def pragma_reason(self, line: int) -> str:
+        """The free-text justification following the pragma, if any."""
+        return self._pragma_reasons.get(line, "")
 
     @property
     def parents(self) -> Dict[ast.AST, ast.AST]:
@@ -86,6 +101,22 @@ class SourceFile:
         if self._parents is None:
             self._parents = parent_map(self.tree)
         return self._parents
+
+    @property
+    def nodes(self) -> List[ast.AST]:
+        """Flat ``ast.walk`` order of this file's tree, built once and
+        shared by every pass (several passes re-walked independently
+        before the per-Project cache landed)."""
+        if self._nodes is None:
+            self._nodes = list(ast.walk(self.tree))
+        return self._nodes
+
+    @property
+    def aliases(self) -> Dict[str, str]:
+        """:func:`import_aliases` of this file, computed once."""
+        if self._aliases is None:
+            self._aliases = import_aliases(self.tree)
+        return self._aliases
 
 
 class Project:
@@ -324,7 +355,16 @@ def register(name: str):
 
 
 def run_passes(project: Project,
-               only: Optional[List[str]] = None) -> List[Finding]:
+               only: Optional[List[str]] = None,
+               timings: Optional[Dict[str, float]] = None) -> List[Finding]:
+    """Run the (selected) passes over one shared parsed project.
+
+    ``timings``, when given, is filled with per-pass wall-clock seconds
+    (the ``--json`` runner and the ``16_lint`` bench lane both ride it,
+    so a pass that goes quadratic shows up as a number, not a hunch).
+    """
+    import time
+
     names = only if only else list(PASSES)
     unknown = [n for n in names if n not in PASSES]
     if unknown:
@@ -332,6 +372,9 @@ def run_passes(project: Project,
                        f"known: {sorted(PASSES)}")
     findings: List[Finding] = []
     for name in names:
+        t0 = time.monotonic()
         findings.extend(PASSES[name](project))
+        if timings is not None:
+            timings[name] = time.monotonic() - t0
     findings.sort(key=lambda f: (f.file, f.line, f.code))
     return findings

@@ -1,0 +1,94 @@
+"""Ledger-coverage: the drop-flow surface cannot silently go vacuous.
+
+Drop-flow and except-safety analyze an *explicit* registry — the
+:data:`~veneur_tpu.lint.dropflow.HOT_SET` patterns and the credit/source
+API names. A registry is only as good as its liveness: rename
+``merge_sealed`` and the hot-set entry matches nothing, the pass checks
+nothing, and every report stays green while the pipeline's core path is
+unanalyzed. (Exactly the failure mode the lock passes hit in PR 12 when
+``_flush_locked`` became ``_flush_generation``.)
+
+This pass pins every registry entry to live code:
+
+- ``dead-hot-file``: a :data:`HOT_SET` file that is not in the analyzed
+  tree — the file moved or was deleted; follow it.
+- ``dead-hot-pattern``: a hot-set qualname pattern matching zero
+  functions in its file — the function was renamed; follow it.
+- ``dead-registry-entry``: a :data:`CREDIT_CALLS` / :data:`SOURCES`
+  name with neither a definition nor a call site anywhere in the tree —
+  the credit API is gone, so the discharge it used to recognize is a
+  phantom.
+
+The companion *count* floors (≥N hot functions, ≥N credit sites) live
+in test_lint's non-vacuity guards — a lint pass should flag structural
+drift exactly, not re-litigate magnitudes.
+"""
+
+from __future__ import annotations
+
+import ast
+import fnmatch
+from typing import List, Set
+
+from veneur_tpu.lint.framework import Finding, Project, qualname, register
+from veneur_tpu.lint.dropflow import (CREDIT_CALLS, HOT_SET, SOURCES,
+                                      _base_name)
+
+
+def _live_names(project: Project) -> Set[str]:
+    """Every function-def name and every callee base name in the tree."""
+    names: Set[str] = set()
+    for sf in project.files.values():
+        for node in sf.nodes:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                names.add(node.name)
+            elif isinstance(node, ast.Call):
+                base = _base_name(node.func)
+                if base:
+                    names.add(base)
+    return names
+
+
+@register("ledger-coverage")
+def run(project: Project) -> List[Finding]:
+    findings: List[Finding] = []
+    for relpath in sorted(HOT_SET):
+        sf = project.files.get(relpath)
+        if sf is None:
+            findings.append(Finding(
+                pass_name="ledger-coverage", code="dead-hot-file",
+                file="veneur_tpu/lint/dropflow.py", line=1,
+                anchor=f"hot-file:{relpath}",
+                message=(
+                    f"HOT_SET names `{relpath}` but the analyzed tree has "
+                    f"no such file — the drop-flow surface silently lost "
+                    f"a whole module; follow the move in HOT_SET")))
+            continue
+        qns = [qualname(node, sf.parents) for node in sf.nodes
+               if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))]
+        for pat in HOT_SET[relpath]:
+            if not any(fnmatch.fnmatchcase(qn, pat) for qn in qns):
+                findings.append(Finding(
+                    pass_name="ledger-coverage", code="dead-hot-pattern",
+                    file=relpath, line=1,
+                    anchor=f"hot-pattern:{pat}",
+                    message=(
+                        f"HOT_SET pattern `{pat}` matches no function in "
+                        f"{relpath} — the function was renamed or removed "
+                        f"and the drop-flow pass silently stopped "
+                        f"analyzing it; follow the rename in HOT_SET")))
+    live = _live_names(project)
+    for kind, names in (("credit", CREDIT_CALLS), ("source", SOURCES)):
+        for name in sorted(names):
+            if name not in live:
+                findings.append(Finding(
+                    pass_name="ledger-coverage", code="dead-registry-entry",
+                    file="veneur_tpu/lint/dropflow.py", line=1,
+                    anchor=f"{kind}:{name}",
+                    message=(
+                        f"registry {kind} API `{name}` has no definition "
+                        f"or call site anywhere in the tree — the "
+                        f"discharge it recognizes is a phantom; remove or "
+                        f"update the registry entry")))
+    findings.sort(key=lambda f: (f.file, f.anchor))
+    return findings

@@ -187,7 +187,7 @@ class _Analysis:
     def _collect_classes(self):
         for sf in self.project.files.values():
             parents = sf.parents
-            for node in ast.walk(sf.tree):
+            for node in sf.nodes:
                 if isinstance(node, ast.FunctionDef):
                     owner = parents.get(node)
                     if isinstance(owner, ast.ClassDef):

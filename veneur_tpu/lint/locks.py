@@ -96,7 +96,7 @@ def _build_registry(project: Project) -> _Registry:
     plain_defs: Set[str] = set()
     for sf in project.files.values():
         parents = sf.parents
-        for node in ast.walk(sf.tree):
+        for node in sf.nodes:
             if not isinstance(node, ast.FunctionDef):
                 continue
             deco = _lock_decoration(node)
@@ -132,7 +132,7 @@ def _class_attr_types(sf: SourceFile) -> Dict[str, Dict[str, Set[str]]]:
             names |= ctor_names(value.orelse)
         return names
 
-    for cls in ast.walk(sf.tree):
+    for cls in sf.nodes:
         if not isinstance(cls, ast.ClassDef):
             continue
         attrs = out.setdefault(cls.name, {})
@@ -253,7 +253,7 @@ def run(project: Project) -> List[Finding]:
                 return local_env_cache[encl].get(recv.id, set())
             return set()
 
-        for node in ast.walk(sf.tree):
+        for node in sf.nodes:
             if not isinstance(node, ast.Call):
                 continue
             lock = None

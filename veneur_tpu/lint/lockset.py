@@ -106,7 +106,7 @@ def run(project: Project) -> List[Finding]:
     findings: List[Finding] = []
     for sf in project.files.values():
         parents = sf.parents
-        for cls in ast.walk(sf.tree):
+        for cls in sf.nodes:
             if not isinstance(cls, ast.ClassDef):
                 continue
             lock_attrs = _class_locks(cls)

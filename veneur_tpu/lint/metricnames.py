@@ -26,8 +26,7 @@ import ast
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Set
 
-from veneur_tpu.lint.framework import (Finding, Project, dotted,
-                                       import_aliases, register)
+from veneur_tpu.lint.framework import Finding, Project, dotted, register
 
 _SAMPLE_FNS = {"count": "counter", "gauge": "gauge", "timing": "timer",
                "histogram": "histogram", "set_sample": "set",
@@ -100,14 +99,14 @@ def _tag_keys(node: Optional[ast.AST]) -> Optional[Set[str]]:
 def collect(project: Project) -> Registry:
     reg = Registry()
     for sf in project.files.values():
-        aliases = import_aliases(sf.tree)
+        aliases = sf.aliases
         sample_aliases = {a for a, target in aliases.items()
                           if target == _SAMPLES_MODULE}
         # `from veneur_tpu.trace.samples import count` style
         fn_aliases = {a: target.rsplit(".", 1)[1]
                       for a, target in aliases.items()
                       if target.startswith(_SAMPLES_MODULE + ".")}
-        for node in ast.walk(sf.tree):
+        for node in sf.nodes:
             if not isinstance(node, ast.Call):
                 continue
             kind = None

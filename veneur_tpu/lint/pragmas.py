@@ -1,0 +1,77 @@
+"""Pragma-justify: every inline suppression must say *why*.
+
+``# lint: ok(<code>)`` is the escape hatch for every pass in this
+suite — which makes a bare pragma the cheapest possible way to make a
+real finding disappear. This pass closes that hole: the text after the
+closing paren is a mandatory written justification (the same policy the
+baseline file enforces for grandfathered findings — justification is
+the price of suppression, everywhere). A pragma whose reason is empty,
+a "TODO", or too short to say anything is itself a finding.
+
+The reason is whatever follows the pragma on the same comment, e.g.::
+
+    x = fetch()  # lint: ok(host-sync) one scalar at interval end
+
+Codes must also be *known*: a typo'd code (``ok(silent-drp)``)
+suppresses nothing today and rots into a confusing no-op — flagged as
+``unknown-pragma-code``.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from veneur_tpu.lint.framework import Finding, Project, register
+
+#: Every suppression code any pass can emit. Keep in lockstep with the
+#: passes (test_lint pins this against the codes used in the tree).
+KNOWN_CODES = frozenset({
+    # locks.py / lockorder.py / lockset.py
+    "unlocked-call", "lock-across-blocking", "inconsistent-lockset",
+    "lock-cycle", "hot-path-lock",
+    # purity.py
+    "host-sync", "traced-branch", "unbounded-static-arg",
+    "unbounded-shape",
+    # deadcode.py
+    "dead-code",
+    # dropflow.py / exceptsafety.py
+    "silent-drop", "swallowed-exception", "raise-between-swap",
+})
+
+_MIN_REASON = 8  # chars; "why not" is not a justification
+
+
+@register("pragma-justify")
+def run(project: Project) -> List[Finding]:
+    findings: List[Finding] = []
+    for rel in sorted(project.files):
+        sf = project.files[rel]
+        nth = 0
+        for line in sorted(sf.pragma_lines()):
+            codes = sorted(sf.pragma_lines()[line])
+            reason = sf.pragma_reason(line)
+            unknown = [c for c in codes if c not in KNOWN_CODES]
+            if unknown:
+                findings.append(Finding(
+                    pass_name="pragma-justify", code="unknown-pragma-code",
+                    file=rel, line=line,
+                    anchor=f"unknown:{','.join(unknown)}",
+                    message=(
+                        f"pragma suppresses unknown code(s) "
+                        f"{unknown} — no pass emits these, so the "
+                        f"suppression is a typo'd no-op; known codes: "
+                        f"{sorted(KNOWN_CODES)}")))
+            if len(reason) < _MIN_REASON or reason.upper().startswith("TODO"):
+                nth += 1
+                findings.append(Finding(
+                    pass_name="pragma-justify", code="unjustified-pragma",
+                    file=rel, line=line,
+                    anchor=f"bare:{','.join(codes)}#{nth}",
+                    message=(
+                        f"`# lint: ok({', '.join(codes)})` carries no "
+                        f"written justification — append WHY the "
+                        f"suppression is sound (same policy as baseline "
+                        f"entries: justification is the price of "
+                        f"suppression)")))
+    findings.sort(key=lambda f: (f.file, f.line, f.code))
+    return findings

@@ -36,9 +36,8 @@ from __future__ import annotations
 import ast
 from typing import Dict, List, Optional, Set, Tuple
 
-from veneur_tpu.lint.framework import (Finding, Project, SourceFile, dotted,
-                                       import_aliases, qualname,
-                                       register)
+from veneur_tpu.lint.framework import (Finding, Project, SourceFile,
+                                       dotted, qualname, register)
 
 # attribute reads that are static under tracing (shapes are compile-time)
 _STATIC_ATTRS = {"shape", "ndim", "dtype", "size", "capacity", "batch_shape",
@@ -92,7 +91,7 @@ def _collect_functions(project: Project) -> Dict[FnKey, _FnInfo]:
     fns: Dict[FnKey, _FnInfo] = {}
     for sf in project.files.values():
         parents = sf.parents
-        for node in ast.walk(sf.tree):
+        for node in sf.nodes:
             if isinstance(node, ast.FunctionDef):
                 owner = parents.get(node)
                 cls = owner.name if isinstance(owner, ast.ClassDef) else None
@@ -102,12 +101,12 @@ def _collect_functions(project: Project) -> Dict[FnKey, _FnInfo]:
 
 
 def _np_aliases(sf: SourceFile) -> Set[str]:
-    return {alias for alias, target in import_aliases(sf.tree).items()
+    return {alias for alias, target in sf.aliases.items()
             if target == "numpy" or target.startswith("numpy.")}
 
 
 def _jax_aliases(sf: SourceFile) -> Set[str]:
-    return {alias for alias, target in import_aliases(sf.tree).items()
+    return {alias for alias, target in sf.aliases.items()
             if target == "jax"}
 
 
@@ -175,7 +174,7 @@ class _Resolver:
 
     def aliases(self, sf: SourceFile) -> Dict[str, str]:
         if sf.relpath not in self._alias_cache:
-            self._alias_cache[sf.relpath] = import_aliases(sf.tree)
+            self._alias_cache[sf.relpath] = sf.aliases
         return self._alias_cache[sf.relpath]
 
     def resolve(self, ref: ast.AST, sf: SourceFile, cls: Optional[str],
@@ -354,7 +353,7 @@ def _find_hot_roots(project: Project, fns: Dict[FnKey, _FnInfo],
     for sf in project.files.values():
         jax_names = _jax_aliases(sf)
         parents = sf.parents
-        for node in ast.walk(sf.tree):
+        for node in sf.nodes:
             if isinstance(node, ast.FunctionDef):
                 kwargs = _jit_decoration(node)
                 if kwargs is not None:

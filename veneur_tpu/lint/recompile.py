@@ -145,7 +145,7 @@ class _Pass:
         for sf in self.project.files.values():
             parents = sf.parents
             jax_names = self._jax_names(sf)
-            for node in ast.walk(sf.tree):
+            for node in sf.nodes:
                 if isinstance(node, ast.FunctionDef):
                     for dec in node.decorator_list:
                         name = dotted(dec) if not isinstance(dec, ast.Call) \

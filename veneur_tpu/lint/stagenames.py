@@ -78,7 +78,7 @@ def collect_stages(project: Project) -> List[StageSite]:
     for sf in project.files.values():
         if sf.relpath.startswith("veneur_tpu/lint/"):
             continue  # this pass's own fixtures/docstrings don't count
-        for node in ast.walk(sf.tree):
+        for node in sf.nodes:
             if not isinstance(node, ast.Call) or not node.args:
                 continue
             fn = _call_fn_name(node)
@@ -99,7 +99,7 @@ def collect_traced_routes(project: Project) -> List[StageSite]:
     if sf is None:
         return []
     out: List[StageSite] = []
-    for node in ast.walk(sf.tree):
+    for node in sf.nodes:
         if not isinstance(node, ast.Assign):
             continue
         targets = [t.id for t in node.targets if isinstance(t, ast.Name)]

@@ -93,7 +93,7 @@ class Checkpointer:
         # the IO lock's entire job is to serialize this write+fsync
         # against truncation; the flush path never waits behind it
         # (truncate(blocking=False)) and the store lock is not held
-        with self._io_lock:  # lint: ok(lock-across-blocking)
+        with self._io_lock:  # lint: ok(lock-across-blocking) the IO lock's entire job is to serialize this write+fsync against truncation; the flush path never waits behind it
             if self.store.flush_epoch != epoch:
                 self.discarded_writes += 1
                 return False
@@ -132,7 +132,7 @@ class Checkpointer:
         self.writes += 1
         # single writer thread; readers (degradation()) tolerate a
         # stale value for one interval
-        self.last_error = None  # lint: ok(inconsistent-lockset)
+        self.last_error = None  # lint: ok(inconsistent-lockset) single writer thread; readers (degradation()) tolerate a stale value for one interval
         return True
 
     def run(self, stop: threading.Event):
@@ -143,7 +143,7 @@ class Checkpointer:
                 self.write_once()
             except Exception:
                 # single writer thread; monotonic introspection counter
-                self.write_errors += 1  # lint: ok(inconsistent-lockset)
+                self.write_errors += 1  # lint: ok(inconsistent-lockset) single writer thread; a monotonic introspection counter needs no lock
                 log.exception("checkpoint write failed; retrying next "
                               "interval")
 

@@ -117,13 +117,13 @@ class _SinkIngestor:
         while True:
             try:
                 item = self.queue.get(timeout=0.5)
-            except queue.Empty:
+            except queue.Empty:  # lint: ok(swallowed-exception) empty-queue poll sentinel — nothing was dequeued, nothing in flight
                 # exit only once stopped AND drained, so shutdown's final
                 # flush never abandons spans already accepted off the
                 # channel (the "at most one interval lost" contract)
                 if self.stop.is_set():
-                    return
-                continue
+                    return  # lint: ok(silent-drop) clean shutdown: stop is set AND the queue is drained, nothing in flight
+                continue  # lint: ok(silent-drop) idle poll: the queue was empty, nothing in flight
             try:
                 if type(item) is list:
                     for span in item:
@@ -205,8 +205,8 @@ class SpanWorker:
         while not self.stop.is_set():
             try:
                 item = self.chan.get(timeout=0.5)
-            except queue.Empty:
-                continue
+            except queue.Empty:  # lint: ok(swallowed-exception) empty-channel poll sentinel — nothing was dequeued, nothing in flight
+                continue  # lint: ok(silent-drop) idle poll: the channel was empty, nothing in flight
             if type(item) is list:
                 # a decoded native-lane batch: one channel hop for the
                 # whole batch, one lane hop per sink
@@ -672,6 +672,7 @@ class Server:
                 try:
                     span = wire.read_ssf(stream)
                 except wire.FramingError as e:
+                    self._packet_errors.add(1)
                     log.warning("SSF framing error, closing stream: %s", e)
                     return
                 except Exception as e:
@@ -681,13 +682,13 @@ class Server:
                     log.debug("bad SSF message: %s", e)
                     continue
                 if span is None:
-                    return
+                    return  # lint: ok(silent-drop) clean EOF: read_ssf framed no span, nothing in flight
                 self.handle_ssf(span)
         finally:
             try:
                 conn.close()
             except OSError:
-                pass
+                pass  # lint: ok(swallowed-exception) socket close is cleanup — every framed span was already handed to handle_ssf
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -1126,7 +1127,7 @@ class Server:
                     last_drops = drops
                 if not batches:
                     self._stop.wait(0.005)
-                    continue
+                    continue  # lint: ok(silent-drop) idle poll: the reader decoded no batches, nothing in flight
                 for b in batches:
                     if b.decode_errors or b.invalid_samples:
                         self._packet_errors.add(int(b.decode_errors)
@@ -1168,7 +1169,7 @@ class Server:
                     last_drops = drops
                 if not batches:
                     self._stop.wait(0.005)
-                    continue
+                    continue  # lint: ok(silent-drop) idle poll: the reader decoded no batches, nothing in flight
                 for b in batches:
                     self._packet_errors.add(int(b.parse_errors))
                     for line in self.store.process_batch(b):

@@ -245,7 +245,7 @@ class WireProducer:
         # one socket, one in-flight produce: the lock IS the wire
         # serializer. Only the kafka sink's flush thread contends, and
         # the egress deadline bounds the hold
-        with self._lock:  # lint: ok(lock-across-blocking)
+        with self._lock:  # lint: ok(lock-across-blocking) the lock IS the wire serializer (one socket, one in-flight produce); only the flush thread contends and the egress deadline bounds the hold
             err: Optional[Exception] = None
             for attempt in range(self.retry_max + 1):
                 try:

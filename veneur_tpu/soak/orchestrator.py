@@ -691,6 +691,9 @@ class SoakReport:
     ledger: SoakLedger
     monitor: SteadyStateMonitor
     results: List[GateResult] = field(default_factory=list)
+    # per-interval conservation timeline from the armed LedgerAudit
+    # (lint/ledger_audit.py) — settled only at terminal settlement
+    ledger_timeline: List[dict] = field(default_factory=list)
 
     def vector(self) -> dict:
         return gate_vector(self.results)
@@ -826,6 +829,13 @@ def run_soak(scenario: SoakScenario, fleet,
     say = progress or (lambda s: log.info("%s", s))
     monitor = SteadyStateMonitor(scenario.thresholds.warmup_intervals)
     ledger = SoakLedger()
+    # the drop-flow pass's runtime twin rides every soak run: per-
+    # interval timeline snapshots (un-asserted — requeued state is
+    # legitimately in flight mid-chaos), one SETTLED check after
+    # terminal settlement where the cumulative identity is exact
+    from veneur_tpu.lint.ledger_audit import for_soak_ledger
+
+    audit = for_soak_ledger(ledger)
     generation = 0  # restarts of the GLOBAL role (compile-drift folds)
     fleet.start()
     try:
@@ -863,6 +873,7 @@ def run_soak(scenario: SoakScenario, fleet,
             else:
                 emitted, sample = fleet.flush_global()
             ledger.emitted_global += emitted
+            audit.snapshot(label=f"interval-{idx}", settled=False)
             monitor.add(IntervalSample(idx=idx, generation=generation,
                                        **sample))
             if mode != MODE_OK or scenario.kills_at(idx):
@@ -884,10 +895,15 @@ def run_soak(scenario: SoakScenario, fleet,
                 break
         for role in (ROLE_GLOBAL, ROLE_LOCAL):
             _fold(ledger, fleet.counters(role), crash=False)
+        audit.snapshot(label="terminal-settlement", settled=True)
     finally:
         fleet.stop()
     report = SoakReport(scenario=scenario, ledger=ledger, monitor=monitor)
     report.results = run_gates(scenario, monitor, ledger)
+    report.ledger_timeline = audit.timeline()
     if enforce_gates:
+        # gates first (their failure message carries the scenario's
+        # exact repro call); the audit is the independent backstop
         enforce(report.results, scenario)
+        audit.assert_clean()
     return report

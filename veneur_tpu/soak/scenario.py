@@ -160,7 +160,7 @@ class SoakScenario:
         n_kills = min(kills, len(span))
         kill_at = sorted(
             # random.Random.sample, not the store's locked sample()
-            rng.sample(span, n_kills)  # lint: ok(unlocked-call)
+            rng.sample(span, n_kills)  # lint: ok(unlocked-call) random.Random.sample, not the store's locked sample() — a name collision, not a lock bypass
         ) if n_kills else []
         kill_plan = tuple((at, KILL_CYCLE[i % len(KILL_CYCLE)])
                          for i, at in enumerate(kill_at))
