@@ -3107,6 +3107,14 @@ def bench_soak(intervals: int = 200, kills: int = 3):
         "ledger_audit_snapshots": len(report.ledger_timeline),
         "ledger_audit_settled_ok": all(
             s["ok"] for s in report.ledger_timeline if s["settled"]),
+        # and the BufferCensus twin (lint/buffer_census.py) beside it:
+        # the donation-safety pass's runtime proof that no retired
+        # device plane outlives its generation in the driver process
+        "buffer_census_snapshots": len(report.buffer_timeline),
+        "buffer_census_settled_ok": all(
+            s["ok"] is not False for s in report.buffer_timeline
+            if s["settled"]),
+        "device_buffer_growth_bytes": led.device_buffer_growth_bytes,
     }
 
 
@@ -3168,7 +3176,7 @@ def bench_ha_takeover(intervals: int = 30):
 
 def bench_lint(budget_s: float = 60.0):
     """Config #16: the static-analysis plane itself (PR 18,
-    ``veneur_tpu/lint/``) — all fifteen passes over the live package
+    ``veneur_tpu/lint/``) — all nineteen passes over the live package
     with the shared parsed-Project cache, recording per-pass wall
     clock, the finding count (must be 0 against the empty baseline),
     and the hot-set size the conservation passes analyze. The lint
@@ -3199,6 +3207,59 @@ def bench_lint(budget_s: float = 60.0):
         "timings_s": {k: round(v, 3)
                       for k, v in sorted(timings.items(),
                                          key=lambda kv: -kv[1])},
+    }
+
+
+def bench_devflow(budget_s: float = 60.0):
+    """Config #17: the device-flow plane of the lint suite (PR 20,
+    ``veneur_tpu/lint/deviceflow.py`` / ``meshflow.py`` /
+    ``devregistry.py``) — the four donation/transfer/sharding passes
+    over the live package plus the registry inventories they audit:
+    auto-discovered donating jit programs (decorator- and
+    binding-form), justified per-row transfer choke points, declared
+    shard_map parameter placements, and the resolved-vs-declared
+    sharding table. The registry sizes are non-vacuity floors: a
+    refactor that silently empties the donating-program inventory (so
+    every donation check passes on nothing) shows up here as a count
+    regression even though findings stay 0."""
+    from veneur_tpu.lint import Project, run_passes
+    from veneur_tpu.lint import deviceflow, meshflow
+
+    t0 = time.perf_counter()
+    project = Project(_HERE)
+    parse_s = time.perf_counter() - t0
+    timings = {}
+    findings = run_passes(
+        project, only=["donation-safety", "transfer-budget",
+                       "sharding-soundness", "device-registry"],
+        timings=timings)
+    total_s = time.perf_counter() - t0
+    inv = deviceflow.collect_programs(project)
+    # call sites are tallied by the table generator, not collect_programs
+    table_don = deviceflow.donation_table(project)
+    call_sites = sum(
+        int(ln.rsplit("|", 2)[-2].strip())
+        for ln in table_don.splitlines()
+        if ln.startswith("| `") and ln.rsplit("|", 2)[-2].strip().isdigit())
+    boundaries = meshflow.shard_map_boundaries(project)
+    table = meshflow.shardstate_table(project)
+    return {
+        "findings": len(findings),
+        "parse_s": round(parse_s, 3),
+        "total_s": round(total_s, 3),
+        "under_budget": total_s < budget_s,
+        "timings_s": {k: round(v, 3)
+                      for k, v in sorted(timings.items(),
+                                         key=lambda kv: -kv[1])},
+        # the audited surface — each a floor the test suite also pins
+        "donating_programs": len(inv.programs),
+        "donation_call_sites": call_sites,
+        "choke_points": len(deviceflow.CHOKE_POINTS),
+        "shard_map_boundaries": len(
+            {(rel, name) for rel, name, _c, _s, _f in boundaries}),
+        "shardstate_entries": len(meshflow.SHARD_STATE),
+        "device_placements": len(meshflow.DEVICE_PLACEMENTS),
+        "shardstate_all_resolved": "| \u2014 |" not in table,
     }
 
 
@@ -3360,10 +3421,15 @@ def _lane_plan(result, guarded):
         # (veneur_tpu/fleet/standby.py, docs/resilience.md "Global HA")
         ("15_ha_takeover",
          lambda t: run_isolated("bench_ha_takeover", timeout=t), 240),
-        # the static-analysis plane itself: all fifteen passes over the
+        # the static-analysis plane itself: all nineteen passes over the
         # live package (shared parse, per-pass wall clock, 0 findings
         # against the empty baseline) — pure AST, no jax, runs inline
         ("16_lint", guarded(bench_lint), 120),
+        # the device-flow slice on its own clock: the four
+        # donation/transfer/sharding passes plus the registry-size
+        # non-vacuity floors (donating programs, choke points,
+        # shard-state rows) — pure AST, runs inline
+        ("17_devflow", guarded(bench_devflow), 120),
     ]
 
 
@@ -3493,6 +3559,10 @@ def _headline(result) -> dict:
             "16_lint": pick("16_lint", "passes", "findings", "total_s",
                             "slowest_pass", "slowest_pass_s",
                             "under_budget"),
+            "17_devflow": pick("17_devflow", "findings",
+                               "donating_programs", "choke_points",
+                               "shardstate_entries",
+                               "shardstate_all_resolved", "total_s"),
         },
         "detail_file": "BENCH_DETAIL.json",
     }

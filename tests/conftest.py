@@ -143,6 +143,37 @@ def ledger_audit():
         audit.assert_clean()
 
 
+@pytest.fixture
+def buffer_census():
+    """BufferCensus (veneur_tpu/lint/buffer_census.py): the
+    donation-safety pass's runtime twin. Arm a census over the
+    process's live ``jax.Array`` population; every armed census is
+    settled and asserted at teardown (like ``ledger_audit``), so a
+    test that retains a donated or retired device plane fails even
+    without its own ``assert_clean()``. Usage::
+
+        census = buffer_census()                  # arms the baseline
+        ... drive ingest/flush traffic ...
+        census.sample(programs=("flush",))        # optional attribution
+        census.settle()                           # early settled check
+    """
+    from veneur_tpu.lint.buffer_census import BufferCensus
+
+    censuses = []
+
+    def arm(name="test-device-buffers", tolerance_bytes=1 << 20):
+        census = BufferCensus(name=name, tolerance_bytes=tolerance_bytes)
+        census.arm()
+        censuses.append(census)
+        return census
+
+    yield arm
+    for census in censuses:
+        if not any(s.settled for s in census.samples):
+            census.settle(label="teardown")
+        census.assert_clean()
+
+
 def pytest_collection_modifyitems(config, items):
     if RUN_TPU:
         skip = pytest.mark.skip(

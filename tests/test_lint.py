@@ -20,10 +20,11 @@ import pytest
 
 from veneur_tpu.lint import PASSES, Baseline, Project, run_passes
 from veneur_tpu.lint.framework import Finding, SourceFile
-from veneur_tpu.lint import (configdrift, deadcode, dropflow,
-                             exceptsafety, ledgercov, lockorder, locks,
-                             lockset, metricnames, pragmas, purity,
-                             recompile, stagenames)
+from veneur_tpu.lint import (configdrift, deadcode, deviceflow,
+                             dropflow, exceptsafety, ledgercov,
+                             lockorder, locks, lockset, meshflow,
+                             metricnames, pragmas, purity, recompile,
+                             stagenames)
 
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
@@ -70,13 +71,15 @@ class TestRealCodebase:
                                "stage-registry", "dead-code",
                                "drop-flow", "ledger-registry",
                                "ledger-coverage", "except-safety",
-                               "swap-restore", "pragma-justify"}
+                               "swap-restore", "pragma-justify",
+                               "donation-safety", "transfer-budget",
+                               "sharding-soundness", "device-registry"}
 
     def test_full_run_stays_under_wallclock_budget(self):
         """Runtime-budget guard: the full pass suite over the real
         package runs inside every tier-1 invocation, so its cost is a
         direct tax on CI. Baseline is ~8s on the CI container (one
-        shared parse + all 15 passes — the per-file AST/alias caches
+        shared parse + all 19 passes — the per-file AST/alias caches
         keep the suite sublinear in pass count); 40s stays well inside
         the 60s budget while still catching an accidentally-quadratic
         analysis the PR it lands in. Per-pass wall-clock rides
@@ -1863,3 +1866,642 @@ class TestLedgerAuditPipeline:
             rec.assert_clean()
         finally:
             fleet.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# donation-safety + transfer-budget (lint/deviceflow.py)
+# ---------------------------------------------------------------------------
+
+
+DEVICEFLOW_FIXTURE = '''
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+@partial(jax.jit, donate_argnums=(0, 1))
+def drain(digest, temp, rows):
+    return digest, temp
+
+
+class Owner:
+    def __init__(self, upd):
+        self.digest = jnp.zeros(4)
+        self.temp = jnp.zeros(4)
+        self.table = jnp.zeros(8)
+        self._update = jax.jit(upd, donate_argnums=(0,))
+
+    def bad_stale_read(self, rows):
+        d = self.digest
+        t = self.temp
+        out = drain(d, t, rows)
+        total = d.sum()
+        return out, total
+
+    def good_rebound(self, rows):
+        d = self.digest
+        t = self.temp
+        d, t = drain(d, t, rows)
+        return d.sum(), t
+
+    def good_loop_rebind(self, rows):
+        d, t = self.digest, self.temp
+        for _ in range(3):
+            d, t = drain(d, t, rows)
+        return d
+
+    def bad_loop_stale(self, rows):
+        d, t = self.digest, self.temp
+        acc = 0.0
+        for r in rows:
+            acc = acc + d.sum()
+            out = drain(d, t, r)
+        return out, acc
+
+    def bad_binding_stale(self, deltas):
+        t = self.table
+        self.table = self._update(t, deltas)
+        peek = t[0]
+        return peek
+
+    def good_binding_refresh(self, deltas):
+        t = self.table
+        self.table = self._update(t, deltas)
+        return self.table[0]
+
+
+def bad_escape(digest, temp, rows):
+    out = drain(digest, temp, rows)
+    return out
+
+
+def suppressed_escape(digest, temp, rows):
+    return drain(digest, temp, rows)  # lint: ok(donated-param-escape) test fixture: caller rebinds by documented contract
+
+
+def bad_duplicate(buf, rows):
+    buf = drain(buf, buf, rows)
+    return buf
+
+
+def fresh_temps_ok(rows):
+    return drain(jnp.zeros(4), jnp.zeros(4), rows)
+
+
+class Temp:
+    pass
+
+
+def make_temp_shared(n):
+    z = jnp.zeros(n)
+    count = jnp.zeros(n)
+    return Temp(mean=z, weight=z, count=count)
+
+
+def make_temp_good(n):
+    return Temp(mean=jnp.zeros(n), weight=jnp.zeros(n))
+
+
+def ladder_bad(compute, attempt):
+    out = attempt(True)
+    compute.preflight()
+    return out
+
+
+def ladder_good(compute, attempt):
+    compute.preflight()
+    return attempt(True)
+
+
+class SnapGroup:
+    def __init__(self):
+        self.pools = []
+
+    def snapshot_begin_bad_closure(self):
+        refs = []
+        for i, p in enumerate(self.pools):
+            refs.append(p.mq[:4])
+            raw = p
+
+        def finish():
+            return jax.device_get(raw)
+        return finish
+
+    def snapshot_begin_bad_return(self):
+        return self.pools
+
+    def snapshot_begin_bad_container(self):
+        refs = []
+        for p in self.pools:
+            refs.append(p)
+
+        def finish():
+            return jax.device_get(refs)
+        return finish
+
+    def snapshot_begin_good(self):
+        refs = []
+        for p in self.pools:
+            t = p
+            staged = t.mq.reshape(2, 2)[:1]
+            refs.append(jnp.copy(p.fmin))
+            refs.append(staged)
+
+        def finish():
+            return jax.device_get(refs)
+        return finish
+
+
+def bad_per_row(handles):
+    out = []
+    for h in handles:
+        out.append(jax.device_get(h))
+    return out
+
+
+def good_batched(handles):
+    return jax.device_get(handles)
+
+
+def suppressed_per_row(handles):
+    for h in handles:
+        jax.device_get(h)  # lint: ok(per-row-transfer) test fixture: tiny fixed-size loop
+
+
+class Fetcher:
+    def _flush_collect(self, slabs):
+        out = []
+        for s in slabs:
+            out.append(jax.device_get(s))
+        return out
+'''
+
+
+class TestDonationSafety:
+    REL = "veneur_tpu/synthetic_deviceflow.py"
+
+    @pytest.fixture
+    def df_findings(self, project, monkeypatch):
+        monkeypatch.setitem(deviceflow.DONATION_PRONE_PLANES, self.REL,
+                            {"SnapGroup": ("pools",)})
+        monkeypatch.setitem(deviceflow.DISTINCT_BUFFER_INITS,
+                            (self.REL, "make_temp_shared"),
+                            "each field needs its own zeros")
+        monkeypatch.setitem(deviceflow.PREFLIGHT_CONTRACT,
+                            (self.REL, "ladder_bad"),
+                            ("attempt", "fault must precede dispatch"))
+        monkeypatch.setitem(deviceflow.PREFLIGHT_CONTRACT,
+                            (self.REL, "ladder_good"),
+                            ("attempt", "fault must precede dispatch"))
+        clone = synthetic(project, self.REL, DEVICEFLOW_FIXTURE)
+        return findings_in(run_passes(clone, only=["donation-safety"]),
+                           self.REL)
+
+    def test_flags_stale_reads_after_donation(self, df_findings):
+        anchors = {(f.code, f.anchor) for f in df_findings}
+        assert ("stale-donated-read", "Owner.bad_stale_read:d") in anchors
+        assert ("stale-donated-read", "Owner.bad_loop_stale:d") in anchors
+        assert ("stale-donated-read",
+                "Owner.bad_binding_stale:t") in anchors
+
+    def test_flags_param_escape_and_duplicate(self, df_findings):
+        anchors = {(f.code, f.anchor) for f in df_findings}
+        assert ("donated-param-escape", "bad_escape:digest") in anchors
+        assert ("donated-param-escape", "bad_escape:temp") in anchors
+        assert ("duplicate-donation", "bad_duplicate:buf") in anchors
+
+    def test_flags_raw_snapshot_captures(self, df_findings):
+        raw = {f.anchor for f in df_findings
+               if f.code == "raw-donated-capture"}
+        assert "SnapGroup.snapshot_begin_bad_closure:p" in raw
+        assert "SnapGroup.snapshot_begin_bad_return:self.pools" in raw
+        assert "SnapGroup.snapshot_begin_bad_container:p" in raw
+
+    def test_flags_shared_init_and_preflight_order(self, df_findings):
+        codes = {(f.code, f.anchor) for f in df_findings}
+        assert ("shared-init-buffer", "make_temp_shared:z") in codes
+        assert ("preflight-after-dispatch",
+                "ladder_bad:attempt") in codes
+
+    def test_benign_shapes_not_flagged(self, df_findings):
+        flagged = {f.anchor for f in df_findings}
+        for benign in ("good_rebound", "good_loop_rebind",
+                       "good_binding_refresh", "fresh_temps_ok",
+                       "make_temp_good", "ladder_good:",
+                       "snapshot_begin_good"):
+            assert not any(benign in a for a in flagged), flagged
+
+    def test_pragma_suppresses(self, df_findings):
+        assert not any("suppressed_escape" in f.anchor
+                       for f in df_findings)
+
+    def test_exactly_the_expected_findings(self, df_findings):
+        # over-flagging gets a pass pragma'd into uselessness: pin the
+        # full set (3 stale + 2 escape + 1 dup + 3 raw + 1 shared + 1
+        # preflight)
+        assert len(df_findings) == 11, [f.render() for f in df_findings]
+
+    def test_registry_discovery_is_not_vacuous(self, project):
+        """The donating-program inventory must auto-discover the real
+        hot path, not an empty set — the acceptance floor is >= 8
+        programs and >= 4 live choke points."""
+        inv = deviceflow.collect_programs(project)
+        assert len(inv.programs) >= 8, [p.name for p in inv.programs]
+        names = {p.name for p in inv.programs}
+        assert "_flush_digests" in names
+        assert "GlobalAggregator.__init__::self._step" in names
+        kinds = {p.kind for p in inv.programs}
+        assert kinds == {"decorator", "binding"}
+        assert len(deviceflow.CHOKE_POINTS) >= 4
+        # every choke point pins a live qualname (devregistry's
+        # liveness check must have nothing to say)
+        from veneur_tpu.lint import devregistry
+        dead = [f for f in run_passes(project, only=["device-registry"])
+                if f.code == "dead-choke-point"]
+        assert devregistry is not None and not dead, \
+            [f.render() for f in dead]
+
+
+class TestTransferBudget:
+    REL = "veneur_tpu/synthetic_deviceflow.py"
+
+    @pytest.fixture
+    def tb_findings(self, project, monkeypatch):
+        monkeypatch.setitem(deviceflow.CHOKE_POINTS,
+                            (self.REL, "Fetcher._flush_collect"),
+                            "test fixture: one fetch per slab")
+        clone = synthetic(project, self.REL, DEVICEFLOW_FIXTURE)
+        return findings_in(run_passes(clone, only=["transfer-budget"]),
+                           self.REL)
+
+    def test_flags_per_row_device_get(self, tb_findings):
+        assert any(f.code == "per-row-transfer"
+                   and f.anchor == "bad_per_row" for f in tb_findings)
+
+    def test_choke_point_and_batched_fetch_exempt(self, tb_findings):
+        flagged = {f.anchor for f in tb_findings}
+        assert "Fetcher._flush_collect" not in flagged
+        assert "good_batched" not in flagged
+
+    def test_pragma_suppresses(self, tb_findings):
+        assert not any("suppressed_per_row" in f.anchor
+                       for f in tb_findings)
+
+    def test_exactly_the_expected_findings(self, tb_findings):
+        assert len(tb_findings) == 1, [f.render() for f in tb_findings]
+
+
+# ---------------------------------------------------------------------------
+# sharding-soundness (lint/meshflow.py)
+# ---------------------------------------------------------------------------
+
+
+MESHFLOW_FIXTURE = '''
+import jax
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from veneur_tpu.parallel import collectives
+from veneur_tpu.parallel.mesh import SERIES_AXIS, shard_map
+
+
+def bad_axis(x):
+    return lax.psum(x, "serise")
+
+
+def good_axis(x):
+    return lax.psum(x, SERIES_AXIS)
+
+
+def param_axis(x, axis):
+    return lax.psum(x, axis)
+
+
+def good_helper(x):
+    return collectives.merge_counters(x, SERIES_AXIS)
+
+
+def suppressed_axis(x):
+    return lax.pmax(x, "stage")  # lint: ok(unknown-collective-axis) test fixture: a non-mesh vmap axis
+
+
+def local_prog(state, qs):
+    return state
+
+
+def build(mesh):
+    s = P(SERIES_AXIS)
+    sk = P(SERIES_AXIS, None)
+    return shard_map(local_prog, mesh=mesh, in_specs=(sk, P()),
+                     out_specs=s)
+
+
+class PlGroup:
+    def __init__(self, mesh, table):
+        self._sk = NamedSharding(mesh, P(SERIES_AXIS, None))
+        self.table = table
+        self.table = jax.device_put(self.table, self._sk)
+
+
+def bad_phys(shard, local, placement):
+    return shard * placement.block + local
+
+
+def suppressed_phys(shard, local, placement):
+    return shard * placement.block + local  # lint: ok(phys-bypass) test fixture: mirrors the router math
+'''
+
+
+class TestShardingSoundness:
+    REL = "veneur_tpu/synthetic_meshflow.py"
+
+    @pytest.fixture
+    def ms_findings(self, project, monkeypatch):
+        # declared-vs-actual: `state` is deliberately mis-declared
+        # replicated (the in_specs bind it series-sharded); `qs`
+        # declared correctly must stay silent
+        monkeypatch.setitem(meshflow.SHARD_STATE,
+                            (self.REL, "local_prog", "state"),
+                            meshflow.S_REP)
+        monkeypatch.setitem(meshflow.SHARD_STATE,
+                            (self.REL, "local_prog", "qs"),
+                            meshflow.S_REP)
+        monkeypatch.setattr(
+            meshflow, "DEVICE_PLACEMENTS",
+            meshflow.DEVICE_PLACEMENTS
+            + ((self.REL, "PlGroup", "table", meshflow.S_REP),))
+        clone = synthetic(project, self.REL, MESHFLOW_FIXTURE)
+        return findings_in(
+            run_passes(clone, only=["sharding-soundness"]), self.REL)
+
+    def test_flags_unknown_collective_axis(self, ms_findings):
+        bad = [f for f in ms_findings
+               if f.code == "unknown-collective-axis"]
+        assert len(bad) == 1
+        assert "serise" in bad[0].message
+        assert "bad_axis" in bad[0].anchor
+
+    def test_known_and_param_axes_not_flagged(self, ms_findings):
+        flagged = {f.anchor for f in ms_findings}
+        for benign in ("good_axis", "param_axis", "good_helper"):
+            assert not any(benign in a for a in flagged), flagged
+
+    def test_flags_declared_vs_actual_spec_mismatch(self, ms_findings):
+        mm = [f for f in ms_findings if f.code == "shardstate-mismatch"]
+        anchors = {f.anchor for f in mm}
+        assert "local_prog:state" in anchors   # declared rep, bound series
+        assert "local_prog:qs" not in anchors  # declared correctly
+        assert "PlGroup:table" in anchors      # device_put mismatch
+
+    def test_flags_phys_row_arithmetic_outside_router(self, ms_findings):
+        phys = [f for f in ms_findings if f.code == "phys-bypass"]
+        assert len(phys) == 1
+        assert "bad_phys" in phys[0].anchor
+
+    def test_pragmas_suppress(self, ms_findings):
+        flagged = {f.anchor for f in ms_findings}
+        assert not any("suppressed_axis" in a for a in flagged)
+        assert not any("suppressed_phys" in a for a in flagged)
+
+    def test_exactly_the_expected_findings(self, ms_findings):
+        # 1 axis + 2 mismatches + 1 phys
+        assert len(ms_findings) == 4, [f.render() for f in ms_findings]
+
+    def test_registry_resolution_is_not_vacuous(self, project):
+        """Every declared SHARD_STATE row must RESOLVE against the live
+        in_specs — an unresolvable spec would make the comparison
+        vacuous while reporting green."""
+        assert len(meshflow.SHARD_STATE) >= 12
+        table = meshflow.shardstate_table(project)
+        assert "| — |" not in table, table
+        axes = meshflow.known_axes(project)
+        assert set(axes.values()) == {"series", "hosts"}
+        bounds = meshflow.shard_map_boundaries(project)
+        names = {(rel, name) for rel, name, _c, _s, _f in bounds}
+        assert ("veneur_tpu/parallel/global_agg.py",
+                "_local_step") in names
+        assert len(names) >= 8
+
+
+# ---------------------------------------------------------------------------
+# device-registry (lint/devregistry.py): drift + liveness
+# ---------------------------------------------------------------------------
+
+
+class TestDeviceRegistry:
+    def test_clean_against_real_docs(self, project):
+        assert run_passes(project, only=["device-registry"]) == []
+
+    def test_drift_flags_stale_donation_table(self, project, monkeypatch):
+        monkeypatch.setitem(
+            deviceflow.CHOKE_POINTS,
+            ("veneur_tpu/core/slab.py", "SlabDigestGroup._flush_collect"),
+            "a reworded justification the docs table does not carry")
+        findings = run_passes(project, only=["device-registry"])
+        assert any(f.code == "donation-registry-drift" for f in findings)
+
+    def test_liveness_flags_dead_entries(self, project, monkeypatch):
+        monkeypatch.setitem(
+            deviceflow.CHOKE_POINTS,
+            ("veneur_tpu/core/slab.py", "SlabDigestGroup._gone_fetch"),
+            "renamed away")
+        monkeypatch.setitem(
+            deviceflow.DONATION_PRONE_PLANES, "veneur_tpu/core/store.py",
+            {**deviceflow.DONATION_PRONE_PLANES[
+                "veneur_tpu/core/store.py"], "GoneGroup": ("q",)})
+        monkeypatch.setitem(
+            deviceflow.PREFLIGHT_CONTRACT,
+            ("veneur_tpu/core/store.py", "gone_ladder"),
+            ("attempt", "renamed away"))
+        monkeypatch.setitem(
+            meshflow.SHARD_STATE,
+            ("veneur_tpu/core/mesh_store.py", "local_gone", "x"),
+            meshflow.S_SERIES)
+        findings = run_passes(project, only=["device-registry"])
+        codes = {f.code for f in findings}
+        assert "dead-choke-point" in codes
+        assert "dead-plane-entry" in codes
+        assert "dead-contract-entry" in codes
+        assert "dead-shardstate-entry" in codes
+        # dead entries anchor to the registry modules, so the fix is
+        # always "follow the rename or delete the entry"
+        for f in findings:
+            if f.code.startswith("dead-"):
+                assert f.file in ("veneur_tpu/lint/deviceflow.py",
+                                  "veneur_tpu/lint/meshflow.py")
+
+    def test_runner_cli_donation_table(self):
+        proc = subprocess.run(
+            [sys.executable, "-m", "veneur_tpu.lint", "--donation-table"],
+            cwd=REPO_ROOT, capture_output=True, text=True, timeout=120)
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        assert "| donating program | file | donated args |" in proc.stdout
+        assert "GlobalAggregator.__init__::self._step" in proc.stdout
+        assert "| transfer choke point | file | justification |" \
+            in proc.stdout
+
+    def test_runner_cli_shardstate_table(self):
+        proc = subprocess.run(
+            [sys.executable, "-m", "veneur_tpu.lint",
+             "--shardstate-table"],
+            cwd=REPO_ROOT, capture_output=True, text=True, timeout=120)
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        assert "| shard_map program | file | param | declared |" \
+            in proc.stdout
+        assert "replicated BY DESIGN" in proc.stdout
+
+    def test_runner_cli_changed_classifies_new_passes(self):
+        """--changed must treat donation-safety/transfer-budget as
+        per-file (scoped reporting) and sharding-soundness +
+        device-registry as whole-program (never scoped)."""
+        proc = subprocess.run(
+            [sys.executable, "-m", "veneur_tpu.lint", "--changed",
+             "--passes", "donation-safety,transfer-budget,"
+             "sharding-soundness,device-registry"],
+            cwd=REPO_ROOT, capture_output=True, text=True, timeout=180)
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        assert "clean: 0 findings" in proc.stdout
+
+
+# ---------------------------------------------------------------------------
+# BufferCensus (lint/buffer_census.py): the donation-safety runtime twin
+# ---------------------------------------------------------------------------
+
+
+class TestBufferCensus:
+    def test_unarmed_census_is_vacuously_bounded(self):
+        from veneur_tpu.lint.buffer_census import BufferCensus
+
+        c = BufferCensus()
+        assert not c.armed
+        assert c.settle().ok is None
+        assert c.growth_bytes() == 0
+        assert c.settled_ok()
+        c.assert_clean()
+
+    def test_settled_growth_records_violation_with_suspects(self):
+        import jax.numpy as jnp
+
+        from veneur_tpu.lint.buffer_census import BufferCensus
+
+        c = BufferCensus(tolerance_bytes=0)
+        c.arm()
+        leak = [jnp.zeros((1024,), jnp.float32) for _ in range(4)]
+        c.sample(label="interval-0", programs=("leaky_prog",))
+        snap = c.settle()
+        assert snap.ok is False
+        assert not c.settled_ok()
+        assert c.growth_bytes() >= 4 * 4096
+        assert len(c.violations) == 1
+        msg = str(c.violations[0])
+        assert "leaky_prog" in msg and "retained" in msg
+        with pytest.raises(AssertionError, match="buffer census"):
+            c.assert_clean()
+        del leak
+
+    def test_released_buffers_settle_clean(self):
+        import jax.numpy as jnp
+
+        from veneur_tpu.lint.buffer_census import BufferCensus
+
+        c = BufferCensus(tolerance_bytes=1024)
+        c.arm()
+        tmp = [jnp.ones((2048,), jnp.float32) for _ in range(4)]
+        c.sample(label="interval-0", programs=("scratch",))
+        del tmp
+        snap = c.settle()
+        assert snap.ok is True
+        assert c.settled_ok()
+        c.assert_clean()
+
+    def test_timeline_is_json_shaped(self):
+        from veneur_tpu.lint.buffer_census import BufferCensus
+
+        c = BufferCensus()
+        c.arm(label="baseline")
+        c.sample(label="tick", programs=("p",))
+        c.settle(label="end")
+        tl = c.timeline()
+        assert [s["idx"] for s in tl] == [0, 1, 2]
+        assert tl[1]["label"] == "tick" and tl[1]["programs"] == ["p"]
+        assert tl[2]["settled"] is True and tl[2]["ok"] is True
+        json.dumps(tl)  # must serialize as-is into soak/bench records
+
+    def test_fixture_teardown_settles_armed_censuses(self, buffer_census):
+        import jax.numpy as jnp
+
+        census = buffer_census(tolerance_bytes=1 << 16)
+        tmp = jnp.zeros((64,), jnp.float32)
+        census.sample(label="mid", programs=("alloc",))
+        del tmp
+        # no explicit settle: the fixture settles + asserts at teardown
+
+
+class TestBufferCensusPipeline:
+    """The seeded-bug proof, mirroring TestLedgerAuditPipeline: a
+    retired generation's device planes retained through REAL store
+    flushes — a leak far too small for any host-RSS slope to isolate —
+    must fail the armed census; the identical un-seeded pipeline must
+    settle clean."""
+
+    def _flush_cycle(self, store, now):
+        from veneur_tpu.samplers import HistogramAggregates
+        from veneur_tpu.samplers.parser import parse_metric
+
+        for i in range(32):
+            store.process_metric(
+                parse_metric(f"t{i % 4}:{i}.5|ms".encode()))
+        store.flush([0.5], HistogramAggregates(), is_local=True, now=now)
+
+    def _store(self):
+        from veneur_tpu.core import MetricStore
+
+        store = MetricStore(initial_capacity=256, chunk=128)
+        self._flush_cycle(store, now=1)  # warmup: compiles + planes
+        return store
+
+    def test_seeded_retired_plane_leak_is_caught(self):
+        import resource
+
+        from veneur_tpu.lint.buffer_census import BufferCensus
+
+        store = self._store()
+        census = BufferCensus(tolerance_bytes=1024)
+        census.arm()
+        # the seeded bug: every flush retains the dying generation's
+        # extrema planes (the non-donated dmin/dmax pair) — the PR 9
+        # bug class at runtime, invisible to every static capture check
+        retained = []
+        orig = store.timers._drain_staging
+
+        def leaky_drain():
+            retained.append((store.timers.dmin, store.timers.dmax))
+            return orig()
+
+        store.timers._drain_staging = leaky_drain
+        for k in range(4):
+            self._flush_cycle(store, now=2 + k)
+            census.sample(label=f"interval-{k}",
+                          programs=("timers.flush",))
+        snap = census.settle()
+        assert snap.ok is False
+        assert census.growth_bytes() > 1024
+        assert any("timers.flush" in str(v) for v in census.violations)
+        with pytest.raises(AssertionError, match="buffer census"):
+            census.assert_clean()
+        # the leak is real but host-RSS-invisible: orders of magnitude
+        # below process RSS, exactly why rss_slope cannot own this gate
+        rss = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss * 1024
+        assert census.growth_bytes() < 0.001 * rss
+
+    def test_unseeded_pipeline_settles_clean(self, buffer_census):
+        store = self._store()
+        census = buffer_census(tolerance_bytes=4096)
+        for k in range(4):
+            self._flush_cycle(store, now=2 + k)
+            census.sample(label=f"interval-{k}",
+                          programs=("timers.flush",))
+        snap = census.settle()
+        assert snap.ok is True
+        assert census.growth_bytes() <= 4096

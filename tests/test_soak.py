@@ -167,7 +167,8 @@ class TestGates:
         assert set(vec["gates"]) == {
             "conservation_global", "conservation_local",
             "dd_rows_conserved", "rss_slope", "compile_drift",
-            "coverage", "e2e_age_p99", "recovery", "requeue_bounded"}
+            "coverage", "e2e_age_p99", "recovery", "requeue_bounded",
+            "device_buffers_bounded"}
         enforce(results, sc)  # silent on a clean vector
 
     def test_lost_rows_fail_loud_with_seed(self):
@@ -222,7 +223,7 @@ class TestGates:
         results = run_gates(sc, _clean_monitor(sc), self._ha_ledger())
         vec = gate_vector(results)
         assert vec["all_ok"], vec
-        # the 9 classic gates PLUS the takeover gate — only here
+        # the 10 classic gates PLUS the takeover gate — only here
         assert "takeover" in vec["gates"]
         assert vec["gates"]["takeover"]["value"]["accounted_lost"] == 23
         enforce(results, sc)
@@ -333,6 +334,16 @@ class TestSoakSmoke:
         terminal = tl[-1]
         assert terminal["settled"] and terminal["ok"] is True
         assert terminal["values"]["sent_global"] == led.sent_global
+        # the BufferCensus runtime twin (lint/buffer_census.py) is
+        # armed right beside it: post-warmup baseline, per-interval
+        # samples, and a settled terminal verdict folded into the
+        # device_buffers_bounded gate
+        btl = report.buffer_timeline
+        assert len(btl) >= 2  # baseline + terminal settlement at least
+        assert btl[-1]["settled"] and btl[-1]["ok"] is True
+        assert led.buffer_census_ok
+        assert led.device_buffer_growth_bytes <= \
+            sc.thresholds.device_buffer_growth_max_bytes
         assert elapsed < 60.0, f"soak smoke took {elapsed:.1f}s"
 
 

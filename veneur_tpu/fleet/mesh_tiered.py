@@ -600,9 +600,11 @@ class MeshTieredDigestGroup(TieredDigestGroup):
             # every captured ref must be an OP OUTPUT, never the live
             # buffer: the pool programs donate self.pools[i], so a
             # drain landing between this locked begin and the off-lock
-            # finish() would delete a raw capture under device_get
-            # (the reshapes produce fresh arrays; the flat planes need
-            # an explicit copy)
+            # finish() would delete a raw capture under device_get.
+            # Machine-checked: lint/deviceflow.py DONATION_PRONE_PLANES
+            # registers `pools` and the donation-safety pass flags any
+            # raw capture here (the reshapes produce fresh arrays; the
+            # flat planes need the explicit copy).
             slab_refs.append((i, (
                 p.mq.reshape(R, pk), p.wb.reshape(R, pk),
                 jnp.copy(p.fmin), jnp.copy(p.fmax),

@@ -1,7 +1,7 @@
 """veneur_tpu.lint — project-native static analysis.
 
 The Python/JAX substitute for the toolchain the reference leans on
-(``go vet``, the race detector, "imported and not used"). Fifteen
+(``go vet``, the race detector, "imported and not used"). Nineteen
 passes, all AST-based, no third-party lint dependency:
 
 - ``lock-discipline``  — ``@requires_lock`` call sites hold the store
@@ -40,6 +40,21 @@ passes, all AST-based, no third-party lint dependency:
 - ``ledger-coverage``  — the drop-flow hot set and credit registry
   resolve to live code, so the pass can't silently go vacuous
   (``lint/ledgercov.py``)
+- ``donation-safety``  — no read of a donated buffer survives its
+  dispatch: stale reads, raw snapshot captures, escaping donated
+  params, duplicate donations, the preflight/init-buffer contracts
+  (``lint/deviceflow.py``; runtime twin in ``lint/buffer_census.py``)
+- ``transfer-budget``  — no per-row ``jax.device_get`` inside a loop
+  unless the loop is a registered batched-fetch choke point
+  (``lint/deviceflow.py``)
+- ``sharding-soundness`` — collective axes resolve to declared mesh
+  axes, shard_map in_specs match the declared replicated-vs-sharded
+  state registry, physical-row arithmetic stays in
+  ShardPlacement.to_phys (``lint/meshflow.py``)
+- ``device-registry``  — the donation/choke-point and shard-state
+  registries match their generated docs tables and resolve to live
+  code (``lint/devregistry.py``; ``--donation-table`` /
+  ``--shardstate-table``)
 
 Run ``python -m veneur_tpu.lint`` (non-zero exit on findings); tier-1
 CI runs the same passes over the real package via tests/test_lint.py.
@@ -63,5 +78,8 @@ from veneur_tpu.lint import dropflow as _dropflow      # noqa: F401
 from veneur_tpu.lint import exceptsafety as _exceptsafety  # noqa: F401
 from veneur_tpu.lint import pragmas as _pragmas        # noqa: F401
 from veneur_tpu.lint import ledgercov as _ledgercov    # noqa: F401
+from veneur_tpu.lint import deviceflow as _deviceflow  # noqa: F401
+from veneur_tpu.lint import meshflow as _meshflow      # noqa: F401
+from veneur_tpu.lint import devregistry as _devregistry  # noqa: F401
 
 __all__ = ["Baseline", "Finding", "Project", "PASSES", "run_passes"]

@@ -18,6 +18,10 @@ entries) exist, 2 on usage errors.
                                                  # inventory md
     python -m veneur_tpu.lint --credit-table     # drop-flow credit-API
                                                  # registry md
+    python -m veneur_tpu.lint --donation-table   # donating-program /
+                                                 # choke-point inventory md
+    python -m veneur_tpu.lint --shardstate-table # declared shard-state
+                                                 # registry md
 """
 
 from __future__ import annotations
@@ -29,8 +33,10 @@ import sys
 
 from veneur_tpu.lint import PASSES, Baseline, Project, run_passes
 from veneur_tpu.lint.configdrift import config_table
+from veneur_tpu.lint.deviceflow import donation_table
 from veneur_tpu.lint.dropflow import credit_table
 from veneur_tpu.lint.lockorder import lock_graph
+from veneur_tpu.lint.meshflow import shardstate_table
 from veneur_tpu.lint.metricnames import metrics_table
 from veneur_tpu.lint.recompile import programs_table
 
@@ -42,7 +48,7 @@ from veneur_tpu.lint.recompile import programs_table
 WHOLE_PROGRAM_PASSES = frozenset({
     "config-drift", "metric-registry", "stage-registry",
     "recompile-hazard", "lock-order", "ledger-registry",
-    "ledger-coverage",
+    "ledger-coverage", "sharding-soundness", "device-registry",
 })
 
 
@@ -99,6 +105,13 @@ def main(argv=None) -> int:
     ap.add_argument("--credit-table", action="store_true",
                     help="print the drop-flow credit-API registry markdown "
                          "(docs/static-analysis.md section) and exit")
+    ap.add_argument("--donation-table", action="store_true",
+                    help="print the donating-program / choke-point "
+                         "inventory markdown (docs/static-analysis.md "
+                         "section) and exit")
+    ap.add_argument("--shardstate-table", action="store_true",
+                    help="print the declared shard-state registry markdown "
+                         "(docs/static-analysis.md section) and exit")
     ap.add_argument("--changed", action="store_true",
                     help="scope per-file passes to git-modified files "
                          "(whole-program passes still run in full); the "
@@ -117,6 +130,12 @@ def main(argv=None) -> int:
         return 0
     if args.credit_table:
         print(credit_table(project))
+        return 0
+    if args.donation_table:
+        print(donation_table(project))
+        return 0
+    if args.shardstate_table:
+        print(shardstate_table(project))
         return 0
 
     changed = None

@@ -1,0 +1,201 @@
+"""BufferCensus: runtime twin of the donation-safety pass.
+
+The static pass (``lint/deviceflow.py``) proves every *lexical* capture
+surviving a donating dispatch is a fresh value. What it cannot see — a
+retained reference threaded through a container at runtime, a retired
+plane a bug keeps alive, a donation that silently degraded to a copy —
+this recorder catches with live arrays, the same static+runtime pairing
+as lock-discipline/TSan-lite and drop-flow/LedgerAudit.
+
+A census samples the aggregate of ``jax.live_arrays()`` — total bytes
+and buffer count — per flush interval, attributes each interval's delta
+to the programs dispatched in it, and asserts a **settled zero-growth
+identity** at teardown: once the pipeline has drained and Python GC has
+run, live device bytes must be back within ``tolerance_bytes`` of the
+armed baseline. This is exactly the leak class the soak plane's
+``rss_slope`` gate provably cannot isolate: host RSS noise (arena
+reuse, interned strings, pytest bookkeeping) swamps a slow
+per-interval device-plane leak, but the device buffer census is
+noise-free — nothing but real ``jax.Array`` handles counts.
+
+Wired in three places, mirroring LedgerAudit: the ``buffer_census``
+pytest fixture (tests/conftest.py — auto-asserts at teardown), always
+armed in :func:`veneur_tpu.soak.orchestrator.run_soak` as the 11th
+steady-state gate (``device_buffers_bounded``), and the ``14_soak``
+bench record (``buffer_census_settled_ok``). In the multi-process soak
+(ProcessFleet) the driver owns no device arrays, so the census reads
+zero throughout and the gate passes vacuously — the in-process soak
+and the fixture-armed pipeline tests carry the real coverage.
+"""
+
+from __future__ import annotations
+
+import gc
+import threading
+from dataclasses import dataclass, field
+from typing import List, Optional, Tuple
+
+
+def _measure() -> Tuple[int, int]:
+    """(total bytes, buffer count) over every live jax.Array. Imported
+    lazily so the lint package stays importable without a device
+    runtime (the static passes never touch jax)."""
+    import jax
+
+    total = 0
+    count = 0
+    for a in jax.live_arrays():
+        try:
+            total += int(a.nbytes)
+        except Exception:  # pragma: no cover - deleted mid-iteration
+            continue
+        count += 1
+    return total, count
+
+
+@dataclass
+class CensusSample:
+    idx: int
+    label: str
+    bytes_live: int
+    count_live: int
+    delta_bytes: int
+    delta_count: int
+    programs: Tuple[str, ...]  # dispatches this delta attributes to
+    settled: bool
+    ok: Optional[bool]         # None on un-settled samples
+
+
+@dataclass
+class CensusViolation:
+    """Settled growth above tolerance: a device-plane leak."""
+
+    census: str
+    label: str
+    baseline_bytes: int
+    settled_bytes: int
+    growth_bytes: int
+    tolerance_bytes: int
+    suspects: List[str] = field(default_factory=list)
+
+    def __str__(self):
+        who = (f"; suspect programs (largest attributed growth first): "
+               f"{', '.join(self.suspects)}" if self.suspects else "")
+        return (f"buffer census '{self.census}' [{self.label}]: live "
+                f"device bytes grew {self.growth_bytes:+d} past the "
+                f"armed baseline ({self.baseline_bytes} -> "
+                f"{self.settled_bytes}, tolerance "
+                f"{self.tolerance_bytes}) after settling — a donated "
+                f"or retired plane is being retained{who}")
+
+
+class BufferCensus:
+    """Live-device-buffer recorder with a settled zero-growth gate."""
+
+    def __init__(self, name: str = "device-buffers",
+                 tolerance_bytes: int = 1 << 20):
+        self.name = name
+        self.tolerance_bytes = int(tolerance_bytes)
+        self._lock = threading.Lock()
+        self._baseline: Optional[Tuple[int, int]] = None
+        self.samples: List[CensusSample] = []
+        self.violations: List[CensusViolation] = []
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def arm(self, label: str = "baseline") -> CensusSample:
+        """Record the steady-state baseline every later settled sample
+        is measured against. Call once traffic-independent allocation
+        (store construction, warmup compiles) is done."""
+        with self._lock:
+            b, c = _measure()
+            self._baseline = (b, c)
+            snap = CensusSample(
+                idx=len(self.samples), label=label, bytes_live=b,
+                count_live=c, delta_bytes=0, delta_count=0,
+                programs=(), settled=False, ok=None)
+            self.samples.append(snap)
+            return snap
+
+    @property
+    def armed(self) -> bool:
+        return self._baseline is not None
+
+    def sample(self, label: str = "",
+               programs: Tuple[str, ...] = (),
+               settled: bool = False) -> CensusSample:
+        """Read the live-array aggregate once. ``programs`` names the
+        dispatches since the previous sample, so a growing interval is
+        attributable by inspection. ``settled=True`` additionally runs
+        GC and asserts the zero-growth identity against the armed
+        baseline."""
+        if settled:
+            gc.collect()  # drop dead handles before judging growth
+        with self._lock:
+            b, c = _measure()
+            prev = self.samples[-1] if self.samples else None
+            snap = CensusSample(
+                idx=len(self.samples), label=label, bytes_live=b,
+                count_live=c,
+                delta_bytes=b - (prev.bytes_live if prev else 0),
+                delta_count=c - (prev.count_live if prev else 0),
+                programs=tuple(programs), settled=settled, ok=None)
+            if settled and self._baseline is not None:
+                growth = b - self._baseline[0]
+                snap.ok = growth <= self.tolerance_bytes
+                if not snap.ok:
+                    self.violations.append(CensusViolation(
+                        census=self.name, label=label,
+                        baseline_bytes=self._baseline[0],
+                        settled_bytes=b, growth_bytes=growth,
+                        tolerance_bytes=self.tolerance_bytes,
+                        suspects=self._suspects()))
+            self.samples.append(snap)
+            return snap
+
+    def settle(self, label: str = "settled") -> CensusSample:
+        return self.sample(label=label, settled=True)
+
+    def _suspects(self) -> List[str]:
+        """Programs ranked by total attributed growth, for the
+        violation message (lock already held)."""
+        growth: dict = {}
+        for s in self.samples:
+            if s.delta_bytes <= 0 or not s.programs:
+                continue
+            per = s.delta_bytes / len(s.programs)
+            for p in s.programs:
+                growth[p] = growth.get(p, 0.0) + per
+        ranked = sorted(growth.items(), key=lambda kv: -kv[1])
+        return [f"{p} (+{int(g)}B)" for p, g in ranked[:4]]
+
+    # -- verdicts ----------------------------------------------------------
+
+    def growth_bytes(self) -> int:
+        """Settled growth vs the baseline: max over settled samples (0
+        when un-armed or never settled — vacuously bounded)."""
+        with self._lock:
+            if self._baseline is None:
+                return 0
+            settled = [s.bytes_live - self._baseline[0]
+                       for s in self.samples if s.settled]
+            return max(settled) if settled else 0
+
+    def settled_ok(self) -> bool:
+        return not self.violations
+
+    def assert_clean(self):
+        if self.violations:
+            raise AssertionError(
+                f"{len(self.violations)} device-buffer census "
+                f"violation(s):"
+                + "".join(f"\n  {v}" for v in self.violations))
+
+    def timeline(self) -> List[dict]:
+        """JSON-shaped sample history (soak reports, bench lanes)."""
+        return [{"idx": s.idx, "label": s.label,
+                 "bytes_live": s.bytes_live, "count_live": s.count_live,
+                 "delta_bytes": s.delta_bytes,
+                 "delta_count": s.delta_count,
+                 "programs": list(s.programs), "settled": s.settled,
+                 "ok": s.ok} for s in self.samples]

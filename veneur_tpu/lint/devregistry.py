@@ -1,0 +1,182 @@
+"""Device-flow registry keeper: drift + liveness for deviceflow/meshflow.
+
+The donation-safety and sharding-soundness passes lean on explicit
+registries (donation-prone planes, transfer choke points, the
+preflight/init contracts, the declared shard-state table). A registry
+that quietly outlives the code it describes is worse than none — the
+pass keeps reporting green while analyzing nothing — so this
+whole-program pass (``device-registry``) does two things, mirroring
+ledger-registry/ledger-coverage:
+
+* **drift**: the two generated docs tables (the donating-program +
+  choke-point inventory and the declared shard-state registry) in
+  ``docs/static-analysis.md`` must byte-match the freshly generated
+  ones (``--donation-table`` / ``--shardstate-table`` regenerate).
+* **liveness**: every registry entry must still name live code — a
+  choke-point qualname with no function, a plane attr no class
+  assigns, a preflight contract with no such ladder, a shard-state
+  param its local program no longer takes. Dead entries anchor to the
+  registry module so the fix is always "follow the rename or delete
+  the entry", never "ignore the lint".
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Set
+
+from veneur_tpu.lint import deviceflow, meshflow
+from veneur_tpu.lint.framework import (Finding, Project, qualname,
+                                       register)
+
+_DEVICEFLOW = "veneur_tpu/lint/deviceflow.py"
+_MESHFLOW = "veneur_tpu/lint/meshflow.py"
+
+_DONATION_BEGIN = "<!-- generated: donation-registry begin -->"
+_DONATION_END = "<!-- generated: donation-registry end -->"
+_SHARDSTATE_BEGIN = "<!-- generated: shardstate-registry begin -->"
+_SHARDSTATE_END = "<!-- generated: shardstate-registry end -->"
+
+
+def _drift(project: Project, table: str, begin: str, end: str,
+           anchor: str, flag: str, what: str) -> List[Finding]:
+    docs_rel = "docs/static-analysis.md"
+    docs = project.read(docs_rel)
+    current = None
+    if docs and begin in docs and end in docs:
+        current = docs.split(begin, 1)[1].split(end, 1)[0].strip()
+    if current is None or current != table.strip():
+        return [Finding(
+            pass_name="device-registry", code=f"{anchor}-drift",
+            file=docs_rel, line=1, anchor=anchor,
+            message=(
+                f"the {what} in {docs_rel} is "
+                f"{'missing' if current is None else 'stale'}: "
+                f"regenerate with `python -m veneur_tpu.lint "
+                f"--{flag}` and paste between the {anchor} markers"))]
+    return []
+
+
+def _qualnames(sf) -> Set[str]:
+    return {qualname(node, sf.parents) for node in sf.nodes
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))}
+
+
+@register("device-registry")
+def run(project: Project) -> List[Finding]:
+    findings: List[Finding] = []
+
+    findings.extend(_drift(
+        project, deviceflow.donation_table(project),
+        _DONATION_BEGIN, _DONATION_END, "donation-registry",
+        "donation-table",
+        "donating-program / choke-point inventory"))
+    findings.extend(_drift(
+        project, meshflow.shardstate_table(project),
+        _SHARDSTATE_BEGIN, _SHARDSTATE_END, "shardstate-registry",
+        "shardstate-table", "declared shard-state registry"))
+
+    # -- liveness: deviceflow registries ---------------------------------
+    for (rel, qn), _reason in sorted(deviceflow.CHOKE_POINTS.items()):
+        sf = project.files.get(rel)
+        if sf is None or qn not in _qualnames(sf):
+            findings.append(Finding(
+                pass_name="device-registry", code="dead-choke-point",
+                file=_DEVICEFLOW, line=1, anchor=f"choke:{rel}:{qn}",
+                message=(
+                    f"CHOKE_POINTS entry `{qn}` matches no function in "
+                    f"{rel} — the batched-fetch loop moved or died and "
+                    f"the transfer-budget exemption is now a phantom; "
+                    f"follow the rename or delete the entry")))
+
+    for rel in sorted(deviceflow.DONATION_PRONE_PLANES):
+        sf = project.files.get(rel)
+        classes = deviceflow.DONATION_PRONE_PLANES[rel]
+        live_cls = {} if sf is None else {
+            node.name: node for node in sf.nodes
+            if isinstance(node, ast.ClassDef)}
+        for cls, planes in sorted(classes.items()):
+            node = live_cls.get(cls)
+            if node is None:
+                findings.append(Finding(
+                    pass_name="device-registry",
+                    code="dead-plane-entry", file=_DEVICEFLOW, line=1,
+                    anchor=f"plane:{rel}:{cls}",
+                    message=(
+                        f"DONATION_PRONE_PLANES names class `{cls}` in "
+                        f"{rel} but no such class exists — the snapshot "
+                        f"capture check silently covers nothing; follow "
+                        f"the rename or delete the entry")))
+                continue
+            assigned = {
+                t.attr for n in ast.walk(node)
+                if isinstance(n, (ast.Assign, ast.AugAssign,
+                                  ast.AnnAssign))
+                for t in (n.targets if isinstance(n, ast.Assign)
+                          else [n.target])
+                if isinstance(t, ast.Attribute)
+                and isinstance(t.value, ast.Name)
+                and t.value.id == "self"}
+            for plane in planes:
+                if plane not in assigned:
+                    findings.append(Finding(
+                        pass_name="device-registry",
+                        code="dead-plane-entry", file=_DEVICEFLOW,
+                        line=1, anchor=f"plane:{rel}:{cls}.{plane}",
+                        message=(
+                            f"DONATION_PRONE_PLANES entry "
+                            f"`{cls}.{plane}` ({rel}) is never "
+                            f"assigned by the class — the plane moved "
+                            f"and the capture check lost it")))
+
+    contracts = [
+        ("contract", k) for k in deviceflow.PREFLIGHT_CONTRACT
+    ] + [("contract", k) for k in deviceflow.DISTINCT_BUFFER_INITS]
+    for _kind, (rel, qn) in sorted(contracts):
+        sf = project.files.get(rel)
+        if sf is None or qn not in _qualnames(sf):
+            findings.append(Finding(
+                pass_name="device-registry", code="dead-contract-entry",
+                file=_DEVICEFLOW, line=1, anchor=f"contract:{rel}:{qn}",
+                message=(
+                    f"registered contract `{qn}` matches no function "
+                    f"in {rel} — the checked guard (preflight order / "
+                    f"distinct init buffers) silently stopped applying")))
+
+    # -- liveness: meshflow registries -----------------------------------
+    boundaries = meshflow.shard_map_boundaries(project)
+    bound_names = {(rel, name) for rel, name, _c, _s, _f in boundaries}
+    for (rel, fn_name, param) in sorted(meshflow.SHARD_STATE):
+        sf = project.files.get(rel)
+        dead = sf is None \
+            or meshflow._param_index(sf, fn_name, param) is None \
+            or (rel, fn_name) not in bound_names
+        if dead:
+            findings.append(Finding(
+                pass_name="device-registry", code="dead-shardstate-entry",
+                file=_MESHFLOW, line=1,
+                anchor=f"shardstate:{rel}:{fn_name}:{param}",
+                message=(
+                    f"SHARD_STATE entry `{fn_name}({param})` ({rel}) "
+                    f"matches no shard_map boundary parameter — the "
+                    f"local program or its signature changed; follow "
+                    f"it or delete the entry")))
+    for rel, cls, plane, _declared in meshflow.DEVICE_PLACEMENTS:
+        sf = project.files.get(rel)
+        live = False
+        if sf is not None:
+            for node in sf.nodes:
+                if isinstance(node, ast.ClassDef) and node.name == cls \
+                        and f".{plane}" in ast.unparse(node):
+                    live = True
+        if not live:
+            findings.append(Finding(
+                pass_name="device-registry", code="dead-shardstate-entry",
+                file=_MESHFLOW, line=1,
+                anchor=f"placement:{rel}:{cls}.{plane}",
+                message=(
+                    f"DEVICE_PLACEMENTS entry `{cls}.{plane}` ({rel}) "
+                    f"references a plane the class never touches — the "
+                    f"placement check is a phantom")))
+    findings.sort(key=lambda f: (f.file, f.line, f.code))
+    return findings

@@ -1,0 +1,607 @@
+"""SPMD sharding soundness: collective axes, state specs, stable ids.
+
+The mesh tier expresses the fleet merge as ``shard_map`` programs over
+the two named axes (``series`` × ``hosts``, parallel/mesh.py) with
+``psum``/``pmax`` collectives inside. Three disciplines hold the design
+together and previously lived only in comments; this pass
+(``sharding-soundness``, whole-program) machine-checks them:
+
+* ``unknown-collective-axis`` — every axis named in a collective
+  (``lax.psum``/``pmax``/``pmin``/``ppermute``/``all_gather``/
+  ``axis_index`` and the ``parallel.collectives`` merge helpers) must
+  resolve to a mesh axis actually declared in ``parallel/mesh.py``.
+  Axis arguments that are function parameters are skipped — the caller
+  binds them — but a resolved literal/constant that is not a declared
+  axis is a guaranteed runtime ``NameError``-at-trace on real silicon.
+
+* ``shardstate-mismatch`` — :data:`SHARD_STATE` declares, per
+  ``shard_map`` local-program parameter, whether that state plane is
+  series-sharded, hosts-sharded, or replicated BY DESIGN, and the pass
+  resolves the actual ``in_specs`` pytree at the call boundary
+  (through local spec assignments, spec-factory returns and NamedTuple
+  constructors) and compares. :data:`DEVICE_PLACEMENTS` does the same
+  for ``jax.device_put`` placements that bypass ``shard_map`` — the
+  count-min table is replicated on purpose (sharding it would change
+  the collision population), while the top-k planes ride the series
+  axis.
+
+* ``phys-bypass`` — physical-row arithmetic (``shard * block + local``)
+  belongs to ``ShardPlacement``/``PoolPlacement`` in fleet/router.py
+  alone; any other file multiplying by a ``.block`` stride is
+  reinventing the stable-id contract (PR 9's hardening) and will break
+  the moment a grow() re-blocks the placement.
+
+The declared registry renders as a generated, drift-checked docs table:
+``python -m veneur_tpu.lint --shardstate-table``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Tuple
+
+from veneur_tpu.lint.framework import (Finding, Project, SourceFile,
+                                       dotted, enclosing_function,
+                                       qualname, register)
+
+# ---------------------------------------------------------------------------
+# Declared state registry (devregistry.py pins every entry to live code)
+# ---------------------------------------------------------------------------
+
+S_SERIES = "series-sharded"
+S_HOSTS = "hosts-sharded"
+S_REP = "replicated"
+
+#: (relpath, local-program name, parameter) -> declared placement of
+#: that state plane at the shard_map call boundary.
+SHARD_STATE: Dict[Tuple[str, str, str], str] = {
+    # digest/HLL planes are series-sharded: after ingest, each device
+    # owns its rows outright and no collective touches them
+    ("veneur_tpu/core/mesh_store.py", "local_ingest", "temp"): S_SERIES,
+    ("veneur_tpu/core/mesh_store.py", "local_ingest", "digest"): S_SERIES,
+    ("veneur_tpu/core/mesh_store.py", "local_flush", "digest"): S_SERIES,
+    ("veneur_tpu/core/mesh_store.py", "local_flush", "qs"): S_REP,
+    ("veneur_tpu/core/mesh_store.py", "local_hash", "regs"): S_SERIES,
+    ("veneur_tpu/core/mesh_store.py", "local_hash", "rows"): S_HOSTS,
+    ("veneur_tpu/core/mesh_store.py", "local_merge", "regs"): S_SERIES,
+    ("veneur_tpu/core/mesh_store.py", "local_estimate", "regs"): S_SERIES,
+    # tiered pool slabs ride the series axis end to end
+    ("veneur_tpu/fleet/mesh_tiered.py", "local_ingest", "pool"): S_SERIES,
+    ("veneur_tpu/fleet/mesh_tiered.py", "local_flush", "pool"): S_SERIES,
+    ("veneur_tpu/fleet/mesh_tiered.py", "local_flush", "qs"): S_REP,
+    ("veneur_tpu/fleet/mesh_tiered.py", "local_promote", "pool"): S_SERIES,
+    ("veneur_tpu/fleet/mesh_tiered.py", "local_promote", "slots"): S_REP,
+    # the global-tier step: state series-sharded, per-host batches
+    # hosts-sharded (fan-in), quantile grid replicated
+    ("veneur_tpu/parallel/global_agg.py", "_local_step", "state"): S_SERIES,
+    ("veneur_tpu/parallel/global_agg.py", "_local_step", "batch"): S_HOSTS,
+    ("veneur_tpu/parallel/global_agg.py", "_local_step", "qs"): S_REP,
+}
+
+#: (relpath, class, plane-field, declared) for jax.device_put
+#: placements outside shard_map. The count-min table is replicated BY
+#: DESIGN: every series shard must hash into the SAME table or the
+#: collision population (and so the error bound) changes per shard.
+DEVICE_PLACEMENTS: Tuple[Tuple[str, str, str, str], ...] = (
+    ("veneur_tpu/core/mesh_store.py", "MeshHeavyHitterGroup",
+     "table", S_REP),
+    ("veneur_tpu/core/mesh_store.py", "MeshHeavyHitterGroup",
+     "topk_hi", S_SERIES),
+    ("veneur_tpu/core/mesh_store.py", "MeshHeavyHitterGroup",
+     "topk_lo", S_SERIES),
+    ("veneur_tpu/core/mesh_store.py", "MeshSetGroup",
+     "registers", S_SERIES),
+)
+
+#: file owning the physical-row arithmetic (ShardPlacement.to_phys)
+_PHYS_OWNER = "veneur_tpu/fleet/router.py"
+
+#: collective call name -> (positional index of the axis-name arg,
+#: keyword names that carry it). NB all_gather's ``axis=`` kwarg is the
+#: CONCAT dimension, not the axis name — only axis_name counts there.
+_AXIS_SPEC: Dict[str, Tuple[int, Tuple[str, ...]]] = {
+    "psum": (1, ("axis_name",)),
+    "pmax": (1, ("axis_name",)),
+    "pmin": (1, ("axis_name",)),
+    "ppermute": (1, ("axis_name",)),
+    "psum_scatter": (1, ("axis_name",)),
+    "all_gather": (1, ("axis_name",)),
+    "axis_index": (0, ("axis_name",)),
+    "merge_counters": (1, ("axis",)),
+    "merge_registers": (1, ("axis",)),
+    "merge_temp": (1, ("axis",)),
+    "allmerge_digest": (1, ("axis",)),
+}
+
+_MESH_FILE = "veneur_tpu/parallel/mesh.py"
+
+
+def known_axes(project: Project) -> Dict[str, str]:
+    """``*_AXIS`` constant name -> axis string, parsed from the mesh
+    module (the single source of truth for axis vocabulary)."""
+    out: Dict[str, str] = {}
+    sf = project.files.get(_MESH_FILE)
+    if sf is None:  # pragma: no cover - mesh module always ships
+        return {"SERIES_AXIS": "series", "HOSTS_AXIS": "hosts"}
+    for node in sf.tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name) \
+                and node.targets[0].id.endswith("_AXIS") \
+                and isinstance(node.value, ast.Constant) \
+                and isinstance(node.value.value, str):
+            out[node.targets[0].id] = node.value.value
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Axis-argument resolution
+# ---------------------------------------------------------------------------
+
+
+def _fn_params(fn) -> set:
+    a = fn.args
+    return {p.arg for p in (a.posonlyargs + a.args + a.kwonlyargs)}
+
+
+def _module_consts(sf: SourceFile) -> Dict[str, str]:
+    out = {}
+    for node in sf.tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name) \
+                and isinstance(node.value, ast.Constant) \
+                and isinstance(node.value.value, str):
+            out[node.targets[0].id] = node.value.value
+    return out
+
+
+def _resolve_axes(expr, sf: SourceFile, fn, axes: Dict[str, str]
+                  ) -> List[str]:
+    """Axis strings an axis-name argument resolves to; [] when the
+    value cannot be resolved statically (a parameter, a conditional)."""
+    if isinstance(expr, ast.Constant) and isinstance(expr.value, str):
+        return [expr.value]
+    if isinstance(expr, (ast.Tuple, ast.List)):
+        out: List[str] = []
+        for e in expr.elts:
+            out.extend(_resolve_axes(e, sf, fn, axes))
+        return out
+    if isinstance(expr, ast.Name):
+        if fn is not None and expr.id in _fn_params(fn):
+            return []  # the caller binds it
+        target = sf.aliases.get(expr.id)
+        if target is not None:
+            const = axes.get(target.split(".")[-1])
+            if const is not None:
+                return [const]
+        if expr.id in axes:  # defined in this very file (mesh.py)
+            return [axes[expr.id]]
+        consts = _module_consts(sf)
+        if expr.id in consts:
+            return [consts[expr.id]]
+        # one-hop local constant assignment
+        if fn is not None:
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Assign) \
+                        and len(node.targets) == 1 \
+                        and isinstance(node.targets[0], ast.Name) \
+                        and node.targets[0].id == expr.id \
+                        and isinstance(node.value, ast.Constant) \
+                        and isinstance(node.value.value, str):
+                    return [node.value.value]
+    return []
+
+
+def _collective_calls(sf: SourceFile):
+    for node in sf.nodes:
+        if not isinstance(node, ast.Call):
+            continue
+        name = dotted(node.func)
+        if name is None:
+            continue
+        base = name.split(".")[-1]
+        spec = _AXIS_SPEC.get(base)
+        if spec is None:
+            continue
+        pos, kwnames = spec
+        arg = None
+        if pos < len(node.args):
+            arg = node.args[pos]
+        else:
+            for kw in node.keywords:
+                if kw.arg in kwnames:
+                    arg = kw.value
+        if arg is not None:
+            yield node, base, arg
+
+
+# ---------------------------------------------------------------------------
+# Spec-pytree classification
+# ---------------------------------------------------------------------------
+
+
+def _is_pspec(call: ast.Call, sf: SourceFile) -> bool:
+    name = dotted(call.func)
+    if name is None:
+        return False
+    base = name.split(".")[-1]
+    if base == "PartitionSpec":
+        return True
+    if base == "P":
+        target = sf.aliases.get("P", "")
+        return target.endswith("PartitionSpec") or target == ""
+    return False
+
+
+def _combine(states: List[Optional[str]]) -> Optional[str]:
+    got = {s for s in states if s is not None}
+    if len(got) == 1:
+        return got.pop()
+    if got == {S_SERIES, S_REP}:
+        # a pytree mixing sharded planes with replicated scalars is a
+        # sharded plane overall (the tiered PoolSlab carries a
+        # replicated epoch scalar next to its series-sharded rows)
+        return S_SERIES
+    return None
+
+
+def _local_def(sf: SourceFile, name: str):
+    for node in sf.nodes:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and node.name == name:
+            return node
+    return None
+
+
+def classify_spec(expr, sf: SourceFile, fn, axes: Dict[str, str],
+                  depth: int = 0) -> Optional[str]:
+    """Placement class of a spec expression: replicated /
+    series-sharded / hosts-sharded, or None when unresolvable.
+    Follows local assignments, tuple unpacks, same-file spec-factory
+    returns, and NamedTuple spec constructors."""
+    if depth > 6:
+        return None
+    if isinstance(expr, ast.Starred):
+        return classify_spec(expr.value, sf, fn, axes, depth + 1)
+    if isinstance(expr, (ast.Tuple, ast.List)):
+        return _combine([classify_spec(e, sf, fn, axes, depth + 1)
+                         for e in expr.elts])
+    if isinstance(expr, ast.BinOp) and isinstance(expr.op, ast.Mult):
+        # the `[spec] * 8` replication idiom
+        return _combine([classify_spec(expr.left, sf, fn, axes,
+                                       depth + 1),
+                         classify_spec(expr.right, sf, fn, axes,
+                                       depth + 1)])
+    if isinstance(expr, ast.Constant):
+        return None  # the scalar in `[spec] * 8`, or a None filler
+    if isinstance(expr, ast.Call):
+        if _is_pspec(expr, sf):
+            named = []
+            for a in expr.args:
+                if isinstance(a, ast.Constant) and a.value is None:
+                    continue
+                named.extend(_resolve_axes(a, sf, fn, axes))
+            if not named:
+                non_none = [a for a in expr.args
+                            if not (isinstance(a, ast.Constant)
+                                    and a.value is None)]
+                return S_REP if not non_none else None
+            if "hosts" in named:
+                return S_HOSTS
+            if "series" in named:
+                return S_SERIES
+            return None
+        callee = expr.func
+        if isinstance(callee, ast.Name):
+            local = _local_def(sf, callee.id)
+            if local is not None:
+                # a spec factory: classify its return expression in
+                # the FACTORY's own scope
+                for node in ast.walk(local):
+                    if isinstance(node, ast.Return) \
+                            and node.value is not None:
+                        return classify_spec(node.value, sf, local,
+                                             axes, depth + 1)
+                return None
+        # a NamedTuple spec constructor (AggState/TDigest/HostBatch/
+        # PoolSlab): the pytree's placement is its leaves' placement
+        leaves = list(expr.args) + [kw.value for kw in expr.keywords]
+        if leaves:
+            return _combine([classify_spec(e, sf, fn, axes, depth + 1)
+                             for e in leaves])
+        return None
+    if isinstance(expr, ast.Name):
+        if fn is None:
+            return None
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Assign):
+                continue
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name) and tgt.id == expr.id:
+                    return classify_spec(node.value, sf, fn, axes,
+                                         depth + 1)
+                if isinstance(tgt, ast.Tuple):
+                    for i, e in enumerate(tgt.elts):
+                        if not (isinstance(e, ast.Name)
+                                and e.id == expr.id):
+                            continue
+                        if isinstance(node.value, ast.Tuple) \
+                                and i < len(node.value.elts):
+                            return classify_spec(
+                                node.value.elts[i], sf, fn, axes,
+                                depth + 1)
+                        if isinstance(node.value, ast.Call) \
+                                and isinstance(node.value.func,
+                                               ast.Name):
+                            factory = _local_def(
+                                sf, node.value.func.id)
+                            if factory is None:
+                                return None
+                            for rnode in ast.walk(factory):
+                                if isinstance(rnode, ast.Return) \
+                                        and isinstance(rnode.value,
+                                                       ast.Tuple) \
+                                        and i < len(rnode.value.elts):
+                                    return classify_spec(
+                                        rnode.value.elts[i], sf,
+                                        factory, axes, depth + 1)
+                            return None
+        return None
+    return None
+
+
+# ---------------------------------------------------------------------------
+# shard_map boundary discovery
+# ---------------------------------------------------------------------------
+
+
+def _shard_map_calls(sf: SourceFile):
+    for node in sf.nodes:
+        if not isinstance(node, ast.Call):
+            continue
+        name = dotted(node.func)
+        if name is None or name.split(".")[-1] != "shard_map":
+            continue
+        if not node.args:
+            continue
+        target = node.args[0]
+        if isinstance(target, ast.Name):
+            local_name = target.id
+        elif isinstance(target, ast.Attribute):
+            local_name = target.attr
+        else:
+            continue
+        in_specs = None
+        for kw in node.keywords:
+            if kw.arg == "in_specs":
+                in_specs = kw.value
+        yield node, local_name, in_specs
+
+
+def _param_index(sf: SourceFile, fn_name: str,
+                 param: str) -> Optional[int]:
+    local = _local_def(sf, fn_name)
+    if local is None:
+        return None
+    params = [a.arg for a in (local.args.posonlyargs + local.args.args)
+              if a.arg != "self"]
+    if param in params:
+        return params.index(param)
+    return None
+
+
+def shard_map_boundaries(project: Project):
+    """Every shard_map call boundary: (relpath, local program name,
+    call node, in_specs expr, enclosing fn). Shared with the registry
+    table and the liveness pass."""
+    out = []
+    for rel in sorted(project.files):
+        sf = project.files[rel]
+        for call, local_name, in_specs in _shard_map_calls(sf):
+            fn = enclosing_function(call, sf.parents)
+            out.append((rel, local_name, call, in_specs, fn))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# the pass
+# ---------------------------------------------------------------------------
+
+
+@register("sharding-soundness")
+def run(project: Project) -> List[Finding]:
+    axes = known_axes(project)
+    valid = set(axes.values())
+    findings: List[Finding] = []
+
+    # collective axis vocabulary
+    for rel in sorted(project.files):
+        sf = project.files[rel]
+        for call, base, arg in _collective_calls(sf):
+            fn = enclosing_function(call, sf.parents)
+            for resolved in _resolve_axes(arg, sf, fn, axes):
+                if resolved in valid:
+                    continue
+                if sf.suppressed(call.lineno, "unknown-collective-axis"):
+                    continue
+                findings.append(Finding(
+                    pass_name="sharding-soundness",
+                    code="unknown-collective-axis", file=rel,
+                    line=call.lineno,
+                    anchor=f"{qualname(call, sf.parents)}:{base}",
+                    message=(
+                        f"`{base}` names collective axis "
+                        f"{resolved!r}, which is not a mesh axis "
+                        f"declared in parallel/mesh.py "
+                        f"({sorted(valid)}) — this traces into an "
+                        f"unbound-axis error on silicon")))
+
+    # declared state registry vs actual in_specs
+    boundaries = shard_map_boundaries(project)
+    for (rel, fn_name, param), declared in sorted(SHARD_STATE.items()):
+        sf = project.files.get(rel)
+        if sf is None:
+            continue
+        idx = _param_index(sf, fn_name, param)
+        if idx is None:
+            continue  # devregistry reports the dead entry
+        for brel, bname, call, in_specs, fn in boundaries:
+            if brel != rel or bname != fn_name:
+                continue
+            if not isinstance(in_specs, (ast.Tuple, ast.List)) \
+                    or idx >= len(in_specs.elts):
+                continue
+            actual = classify_spec(in_specs.elts[idx], sf, fn, axes)
+            if actual is None or actual == declared:
+                continue
+            if sf.suppressed(call.lineno, "shardstate-mismatch"):
+                continue
+            findings.append(Finding(
+                pass_name="sharding-soundness",
+                code="shardstate-mismatch", file=rel,
+                line=call.lineno, anchor=f"{fn_name}:{param}",
+                message=(
+                    f"`{fn_name}({param}=...)` is declared "
+                    f"{declared} in lint/meshflow.py SHARD_STATE but "
+                    f"the shard_map in_specs bind it {actual} — fix "
+                    f"the spec or the declaration, never silently")))
+
+    # device_put placements outside shard_map
+    for rel, cls, plane, declared in DEVICE_PLACEMENTS:
+        sf = project.files.get(rel)
+        if sf is None:
+            continue
+        for node in sf.nodes:
+            if not (isinstance(node, ast.ClassDef) and node.name == cls):
+                continue
+            for call in ast.walk(node):
+                if not (isinstance(call, ast.Call)
+                        and dotted(call.func) is not None
+                        and dotted(call.func).split(".")[-1]
+                        == "device_put" and len(call.args) >= 2):
+                    continue
+                try:
+                    src = ast.unparse(call.args[0])
+                except Exception:  # pragma: no cover
+                    continue
+                if not (src == f"self.{plane}"
+                        or src.endswith(f".{plane}")):
+                    continue
+                actual = _classify_placement(call.args[1], sf, node,
+                                             axes)
+                if actual is None or actual == declared:
+                    continue
+                if sf.suppressed(call.lineno, "shardstate-mismatch"):
+                    continue
+                findings.append(Finding(
+                    pass_name="sharding-soundness",
+                    code="shardstate-mismatch", file=rel,
+                    line=call.lineno, anchor=f"{cls}:{plane}",
+                    message=(
+                        f"{cls}.{plane} is declared {declared} "
+                        f"(lint/meshflow.py DEVICE_PLACEMENTS) but "
+                        f"this device_put places it {actual}")))
+
+    # stable-id contract: physical-row arithmetic outside the owner
+    for rel in sorted(project.files):
+        if rel == _PHYS_OWNER:
+            continue
+        sf = project.files[rel]
+        for node in sf.nodes:
+            if not (isinstance(node, ast.BinOp)
+                    and isinstance(node.op, ast.Mult)):
+                continue
+            for side in (node.left, node.right):
+                try:
+                    text = ast.unparse(side)
+                except Exception:  # pragma: no cover
+                    continue
+                if not text.endswith(".block"):
+                    continue
+                if sf.suppressed(node.lineno, "phys-bypass"):
+                    continue
+                findings.append(Finding(
+                    pass_name="sharding-soundness", code="phys-bypass",
+                    file=rel, line=node.lineno,
+                    anchor=f"{qualname(node, sf.parents)}:{text}",
+                    message=(
+                        f"physical-row arithmetic `... * {text}` "
+                        f"outside fleet/router.py — go through "
+                        f"ShardPlacement.to_phys (the stable-id "
+                        f"contract); hand-rolled strides break when "
+                        f"grow() re-blocks the placement")))
+                break
+    findings.sort(key=lambda f: (f.file, f.line, f.code))
+    return findings
+
+
+def _classify_placement(expr, sf: SourceFile, cls_node,
+                        axes: Dict[str, str]) -> Optional[str]:
+    """Placement of a device_put sharding argument: a direct
+    ``NamedSharding(mesh, P(...))`` or a ``self._attr`` bound to one
+    anywhere in the class."""
+    if isinstance(expr, ast.Call):
+        name = dotted(expr.func)
+        if name and name.split(".")[-1] == "NamedSharding" \
+                and len(expr.args) >= 2:
+            return classify_spec(expr.args[1], sf,
+                                 enclosing_function(expr, sf.parents),
+                                 axes)
+        return None
+    if isinstance(expr, ast.Attribute) \
+            and isinstance(expr.value, ast.Name) \
+            and expr.value.id == "self":
+        for node in ast.walk(cls_node):
+            if isinstance(node, ast.Assign):
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Attribute) \
+                            and isinstance(tgt.value, ast.Name) \
+                            and tgt.value.id == "self" \
+                            and tgt.attr == expr.attr:
+                        return _classify_placement(node.value, sf,
+                                                   cls_node, axes)
+    return None
+
+
+# ---------------------------------------------------------------------------
+# The generated registry table
+# ---------------------------------------------------------------------------
+
+
+def shardstate_table(project: Project) -> str:
+    """Markdown render of the declared shard-state registry with the
+    live resolution next to each declaration; regenerate with
+    ``python -m veneur_tpu.lint --shardstate-table``."""
+    axes = known_axes(project)
+    boundaries = shard_map_boundaries(project)
+    lines = [
+        "| shard_map program | file | param | declared | resolved |",
+        "|---|---|---|---|---|",
+    ]
+    for (rel, fn_name, param), declared in sorted(SHARD_STATE.items()):
+        resolved = "—"
+        sf = project.files.get(rel)
+        idx = _param_index(sf, fn_name, param) if sf else None
+        if sf is not None and idx is not None:
+            for brel, bname, call, in_specs, fn in boundaries:
+                if brel == rel and bname == fn_name \
+                        and isinstance(in_specs, (ast.Tuple, ast.List)) \
+                        and idx < len(in_specs.elts):
+                    got = classify_spec(in_specs.elts[idx], sf, fn,
+                                        axes)
+                    if got:
+                        resolved = got
+        lines.append(f"| `{fn_name}` | {rel} | {param} | {declared} "
+                     f"| {resolved} |")
+    lines.append("")
+    lines.append("| device_put plane | class | declared | design note |")
+    lines.append("|---|---|---|---|")
+    notes = {
+        ("MeshHeavyHitterGroup", "table"):
+            "replicated BY DESIGN — sharding the count-min table "
+            "would change the collision population per shard",
+    }
+    for rel, cls, plane, declared in DEVICE_PLACEMENTS:
+        note = notes.get((cls, plane), "series plane, owned per shard")
+        lines.append(f"| `{plane}` | {cls} ({rel}) | {declared} "
+                     f"| {note} |")
+    return "\n".join(lines)

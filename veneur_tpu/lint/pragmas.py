@@ -36,6 +36,12 @@ KNOWN_CODES = frozenset({
     "dead-code",
     # dropflow.py / exceptsafety.py
     "silent-drop", "swallowed-exception", "raise-between-swap",
+    # deviceflow.py
+    "stale-donated-read", "raw-donated-capture", "donated-param-escape",
+    "duplicate-donation", "shared-init-buffer",
+    "preflight-after-dispatch", "per-row-transfer",
+    # meshflow.py
+    "unknown-collective-axis", "shardstate-mismatch", "phys-bypass",
 })
 
 _MIN_REASON = 8  # chars; "why not" is not a justification

@@ -692,7 +692,9 @@ def init_temp(num_series: int, capacity: int | None = None,
               compression: float = DEFAULT_COMPRESSION) -> TempCentroids:
     k = capacity if capacity is not None else size_bound(compression)
     # NB: each field gets its own buffer — ingest donates the whole tuple,
-    # and XLA rejects donating one buffer twice.
+    # and XLA rejects donating one buffer twice. Machine-checked: the
+    # donation-safety pass (lint/deviceflow.py DISTINCT_BUFFER_INITS)
+    # flags any field sharing a buffer name here.
     return TempCentroids(
         sum_w=jnp.zeros((num_series, k), jnp.float32),
         sum_wm=jnp.zeros((num_series, k), jnp.float32),

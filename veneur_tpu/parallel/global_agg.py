@@ -185,8 +185,17 @@ class GlobalAggregator:
 
     def step(self, state: AggState, batch: HostBatch, qs):
         """Run one interval: returns (new_state, percentiles [S, P],
-        set estimates [S], counter totals [S])."""
-        return self._step(state, batch, jnp.asarray(qs, jnp.float32))
+        set estimates [S], counter totals [S]).
+
+        CONSUMES ``state``: the dispatch donates its buffers
+        (``donate_argnums=(0,)``) and they are deleted the moment it
+        lands. The caller MUST rebind — ``state, *rest =
+        agg.step(state, ...)`` — and never touch the old handle again;
+        ``step`` cannot rebind for the caller because the pre-donation
+        pytree is the caller's own local. Reviewed under the
+        donation-safety pass (this was the one call boundary predating
+        every audit)."""
+        return self._step(state, batch, jnp.asarray(qs, jnp.float32))  # lint: ok(donated-param-escape) documented consume-and-rebind contract: the caller rebinds state to the returned pytree, as every call site in tests/test_parallel.py does
 
     def merge_forwarded_digests(self, mean, weight, mins, maxs):
         """All-reduce pre-compressed per-host digests over the hosts axis —

@@ -71,7 +71,10 @@ class ComputeBreaker:
 
     def preflight(self, kernel: str = KERNEL_TDIGEST) -> None:
         """Raise the scheduled injected fault, if an injector is armed —
-        BEFORE dispatch, so donated device buffers survive for rung 2."""
+        BEFORE dispatch, so donated device buffers survive for rung 2.
+        Machine-checked: the donation-safety pass (lint/deviceflow.py
+        PREFLIGHT_CONTRACT) flags any registered compute ladder that
+        dispatches before calling this."""
         inj = self.injector
         if inj is not None:
             inj.maybe_fail(kernel)

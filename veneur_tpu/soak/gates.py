@@ -28,6 +28,11 @@ e2e_age_p99         p99 of veneur.fleet.e2e_age_ns ≤ threshold
 recovery            final samples: overload level 0, breaker closed,
                     requeue drained, nothing pending, no degradations
 requeue_bounded     max parked sink bytes ≤ the configured budget
+device_buffers_bounded settled ``jax.live_arrays()`` growth in the
+                    driver process ≤ the configured byte bound (the
+                    runtime twin of the donation-safety lint pass;
+                    vacuously green when the driver owns no device
+                    arrays)
 takeover            kill_forever only: the standby promoted, held the
                     lease within ``takeover_detect_max_s`` of the
                     active's SIGKILL, and the accounted loss is
@@ -77,6 +82,12 @@ class SoakLedger:
     promotions: int = 0              # standby promotions observed
     takeover_detect_s: float = -1.0  # SIGKILL → standby holds the lease
     takeover_first_flush_s: float = -1.0  # SIGKILL → first good flush
+    # driver-process BufferCensus fold (lint/buffer_census.py): max
+    # settled jax.live_arrays() growth over the baseline, and the
+    # census's own verdict/detail (suspect programs on a violation)
+    device_buffer_growth_bytes: int = 0
+    buffer_census_ok: bool = True
+    buffer_census_detail: str = ""
 
     def restart_total(self) -> int:
         return sum(self.restarts.values())
@@ -181,6 +192,17 @@ def run_gates(scenario: SoakScenario, monitor: SteadyStateMonitor,
     out.append(GateResult(
         "requeue_bounded", mx <= thr.requeue_max_bytes,
         mx, thr.requeue_max_bytes, "max parked sink bytes ever sampled"))
+
+    out.append(GateResult(
+        "device_buffers_bounded",
+        (ledger.buffer_census_ok
+         and ledger.device_buffer_growth_bytes
+         <= thr.device_buffer_growth_max_bytes),
+        ledger.device_buffer_growth_bytes,
+        thr.device_buffer_growth_max_bytes,
+        ledger.buffer_census_detail
+        or "settled jax.live_arrays() growth in the driver process "
+           "(vacuously green when the driver owns no device arrays)"))
 
     if scenario.kind == KIND_KILL_FOREVER:
         promoted = ledger.promotions >= 1

@@ -694,6 +694,10 @@ class SoakReport:
     # per-interval conservation timeline from the armed LedgerAudit
     # (lint/ledger_audit.py) — settled only at terminal settlement
     ledger_timeline: List[dict] = field(default_factory=list)
+    # per-interval live-device-buffer timeline from the armed
+    # BufferCensus (lint/buffer_census.py) — the donation-safety
+    # pass's runtime twin, judged at terminal settlement
+    buffer_timeline: List[dict] = field(default_factory=list)
 
     def vector(self) -> dict:
         return gate_vector(self.results)
@@ -833,9 +837,20 @@ def run_soak(scenario: SoakScenario, fleet,
     # interval timeline snapshots (un-asserted — requeued state is
     # legitimately in flight mid-chaos), one SETTLED check after
     # terminal settlement where the cumulative identity is exact
+    from veneur_tpu.lint.buffer_census import BufferCensus
     from veneur_tpu.lint.ledger_audit import for_soak_ledger
 
     audit = for_soak_ledger(ledger)
+    # the donation-safety pass's runtime twin rides next to it: the
+    # live-device-buffer census arms once warmup allocation (store
+    # planes, first-flush compiles) is done, samples every interval,
+    # and judges settled zero-growth as the device_buffers_bounded
+    # gate. With a ProcessFleet the driver owns no device arrays, so
+    # the census reads zero and the gate passes vacuously — the
+    # in-process soak and the buffer_census fixture carry the teeth.
+    census = BufferCensus(
+        name="soak-device-buffers",
+        tolerance_bytes=scenario.thresholds.device_buffer_growth_max_bytes)
     generation = 0  # restarts of the GLOBAL role (compile-drift folds)
     fleet.start()
     try:
@@ -874,6 +889,12 @@ def run_soak(scenario: SoakScenario, fleet,
                 emitted, sample = fleet.flush_global()
             ledger.emitted_global += emitted
             audit.snapshot(label=f"interval-{idx}", settled=False)
+            if not census.armed and \
+                    idx + 1 >= scenario.thresholds.warmup_intervals:
+                census.arm(label=f"post-warmup-{idx}")
+            else:
+                census.sample(label=f"interval-{idx}",
+                              programs=("flush_local", "flush_global"))
             monitor.add(IntervalSample(idx=idx, generation=generation,
                                        **sample))
             if mode != MODE_OK or scenario.kills_at(idx):
@@ -896,14 +917,22 @@ def run_soak(scenario: SoakScenario, fleet,
         for role in (ROLE_GLOBAL, ROLE_LOCAL):
             _fold(ledger, fleet.counters(role), crash=False)
         audit.snapshot(label="terminal-settlement", settled=True)
+        census.settle(label="terminal-settlement")
     finally:
         fleet.stop()
+    ledger.device_buffer_growth_bytes = census.growth_bytes()
+    ledger.buffer_census_ok = census.settled_ok()
+    if census.violations:
+        ledger.buffer_census_detail = str(census.violations[0])
     report = SoakReport(scenario=scenario, ledger=ledger, monitor=monitor)
     report.results = run_gates(scenario, monitor, ledger)
     report.ledger_timeline = audit.timeline()
+    report.buffer_timeline = census.timeline()
     if enforce_gates:
         # gates first (their failure message carries the scenario's
-        # exact repro call); the audit is the independent backstop
+        # exact repro call); the audit/census twins are the
+        # independent backstops
         enforce(report.results, scenario)
         audit.assert_clean()
+        census.assert_clean()
     return report

@@ -81,6 +81,10 @@ class GateThresholds:
     # kill_forever only: wall-clock bound on active-death →
     # standby-holds-the-lease (the lease ttl plus election slack)
     takeover_detect_max_s: float = 15.0
+    # settled growth bound for the driver-process BufferCensus
+    # (lint/buffer_census.py): max bytes of net jax.live_arrays()
+    # growth between arming and the terminal settlement
+    device_buffer_growth_max_bytes: int = 1 << 20
 
 
 @dataclass(frozen=True)
